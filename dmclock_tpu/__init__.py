@@ -1,0 +1,31 @@
+"""dmclock-tpu: a TPU-native dmClock QoS scheduling framework.
+
+Brand-new implementation of the capabilities of the reference C++
+library (dmClock reservation/weight/limit tags, two-phase selection,
+piggybacked rho/delta distributed tracking, pull/push queue surfaces,
+QoS simulator) re-designed for TPUs: scheduler state as HBM-resident
+arrays, tag recurrence as vmapped kernels, heap selection as fused
+stable argmin, multi-server corrections as psum collectives.
+
+Layers:
+  core      -- canonical int64-ns tag algebra + pure-Python oracle
+  ops       -- JAX device kernels (tag update, masked argmin select)
+  engine    -- batched TPU scheduler (SoA client state, scan decisions)
+  parallel  -- mesh sharding, multi-server cluster sim, psum tracker
+  sim       -- QoS simulation harness (INI-config compatible)
+  models    -- registered scheduler "models" (dmclock, ssched FIFO)
+  native    -- ctypes bindings to the C++ host runtime
+  utils     -- periodic tasks, profiling timers
+"""
+
+__version__ = "0.1.0"
+
+from . import core
+from .core import (AtLimit, ClientInfo, Phase, PullPriorityQueue,
+                   PushPriorityQueue, ReqParams, RequestTag, ServiceTracker)
+
+__all__ = [
+    "core", "AtLimit", "ClientInfo", "Phase", "PullPriorityQueue",
+    "PushPriorityQueue", "ReqParams", "RequestTag", "ServiceTracker",
+    "__version__",
+]

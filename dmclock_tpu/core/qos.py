@@ -1,0 +1,39 @@
+"""Per-client QoS parameters.
+
+Equivalent of the reference's ``ClientInfo`` (``src/dmclock_server.h:95-132``):
+(reservation, weight, limit) rates plus cached per-unit-cost virtual-time
+increments.  The reference caches multiplicative inverses as doubles; we
+cache integer nanosecond increments (see ``timebase.rate_to_inv_ns``)
+with the same 0 -> 0 "axis disabled" sentinel.
+"""
+
+from __future__ import annotations
+
+from .timebase import rate_to_inv_ns
+
+
+class ClientInfo:
+    """QoS triple: minimum (reservation), proportional (weight), maximum
+    (limit) -- with cached ns-per-unit-cost increments.
+
+    Mutable via :meth:`update` to support ``update_client_info``
+    (reference dmclock_server.h:633-648).
+    """
+
+    __slots__ = ("reservation", "weight", "limit",
+                 "reservation_inv_ns", "weight_inv_ns", "limit_inv_ns")
+
+    def __init__(self, reservation: float, weight: float, limit: float):
+        self.update(reservation, weight, limit)
+
+    def update(self, reservation: float, weight: float, limit: float) -> None:
+        self.reservation = float(reservation)
+        self.weight = float(weight)
+        self.limit = float(limit)
+        self.reservation_inv_ns = rate_to_inv_ns(self.reservation)
+        self.weight_inv_ns = rate_to_inv_ns(self.weight)
+        self.limit_inv_ns = rate_to_inv_ns(self.limit)
+
+    def __repr__(self) -> str:
+        return (f"ClientInfo(r={self.reservation}, w={self.weight}, "
+                f"l={self.limit})")

@@ -1,0 +1,52 @@
+"""Shared wire-level record types of the dmClock protocol.
+
+Equivalents of the reference's ``dmclock_recs.h``: ``Counter``/``Cost``
+scalar types, the reservation-vs-priority phase marker, and
+``ReqParams{delta, rho}`` -- the entire payload a client piggybacks onto
+each request (reference ``src/dmclock_recs.h:25-72``).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+# Counter: monotone completion counters (reference dmclock_recs.h:25).
+Counter = int
+# Cost: per-request service cost (reference dmclock_recs.h:31).
+Cost = int
+
+
+class Phase(enum.IntEnum):
+    """Which scheduling phase served a request (dmclock_recs.h:33).
+
+    Servers return this to clients; clients bump rho only for
+    reservation-phase completions.
+    """
+
+    RESERVATION = 0
+    PRIORITY = 1
+
+    def __str__(self) -> str:  # matches reference operator<< spirit
+        return "reservation" if self is Phase.RESERVATION else "priority"
+
+
+@dataclass(frozen=True)
+class ReqParams:
+    """Per-request distributed-protocol payload (dmclock_recs.h:40-72).
+
+    delta: count of ALL completions this client saw (across every
+    server) since its previous request to the receiving server.
+    rho: same, but only reservation-phase completions.
+    Invariant: rho <= delta (dmclock_recs.h:51).
+    """
+
+    delta: int = 0
+    rho: int = 0
+
+    def __post_init__(self) -> None:
+        if self.rho > self.delta:
+            raise ValueError(f"ReqParams invariant violated: rho {self.rho} > delta {self.delta}")
+
+    def __str__(self) -> str:
+        return f"ReqParams{{ delta:{self.delta}, rho:{self.rho} }}"

@@ -1,0 +1,100 @@
+"""The mClock/dmClock request-tag algebra, in int64-nanosecond fixed point.
+
+Equivalent of the reference's ``RequestTag`` (``src/dmclock_server.h:135-274``).
+Each request carries three virtual-time tags:
+
+  reservation = max(t, prev_r + r_inv * (rho   + cost))   # uses rho
+  proportion  = max(t, prev_p + w_inv * (delta + cost))   # uses delta
+  limit       = max(t, prev_l + l_inv * (delta + cost))   # uses delta
+
+where a zero inverse pins the tag to MAX_TAG (reservation/proportion:
+"never eligible on this axis") or MIN_TAG (limit: "never limited") --
+reference ``tag_calc`` at ``dmclock_server.h:246-259``.
+
+Anticipation (deceptive-idleness countermeasure, ``:159-161``): an
+arrival within ``anticipation_timeout`` of the previous request's
+arrival is backdated by the timeout so briefly-idle clients don't lose
+accumulated credit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from .qos import ClientInfo
+from .timebase import MAX_TAG, MIN_TAG
+
+__all__ = ["tag_calc", "RequestTag", "ZERO_TAG"]
+
+
+def tag_calc(time_ns: int, prev_ns: int, inv_ns: int, dist_val: int,
+             extreme_is_high: bool, cost: int) -> int:
+    """One tag-axis update (reference dmclock_server.h:246-259).
+
+    inv_ns == 0 means the axis is disabled -> pin to the sentinel.
+    Otherwise advance the per-client virtual clock by inv_ns units per
+    unit of (distributed credit + cost), floored at wall time.
+    """
+    if inv_ns == 0:
+        return MAX_TAG if extreme_is_high else MIN_TAG
+    return max(time_ns, prev_ns + inv_ns * (dist_val + cost))
+
+
+@dataclass
+class RequestTag:
+    """Tags + protocol metadata for one queued request
+    (reference dmclock_server.h:135-274).
+
+    ``ready`` flips true once the request's limit tag has passed
+    (within-limit), enabling weight-phase service.  ``arrival`` is the
+    wall time the request entered the queue (drives anticipation).
+    """
+
+    reservation: int
+    proportion: int
+    limit: int
+    arrival: int
+    delta: int = 0
+    rho: int = 0
+    cost: int = 1
+    ready: bool = False
+
+    @classmethod
+    def from_prev(cls, prev: "RequestTag", info: ClientInfo,
+                  delta: int, rho: int, time_ns: int, cost: int = 1,
+                  anticipation_timeout_ns: int = 0) -> "RequestTag":
+        """The tag recurrence (reference dmclock_server.h:145-183)."""
+        assert cost > 0
+        max_time = time_ns
+        if time_ns - anticipation_timeout_ns < prev.arrival:
+            max_time -= anticipation_timeout_ns
+        reservation = tag_calc(max_time, prev.reservation,
+                               info.reservation_inv_ns, rho, True, cost)
+        proportion = tag_calc(max_time, prev.proportion,
+                              info.weight_inv_ns, delta, True, cost)
+        limit = tag_calc(max_time, prev.limit,
+                         info.limit_inv_ns, delta, False, cost)
+        # At least one of reservation/proportion must be usable
+        # (reference asserts this, dmclock_server.h:182).
+        assert reservation < MAX_TAG or proportion < MAX_TAG, \
+            "client has neither reservation nor weight"
+        return cls(reservation=reservation, proportion=proportion,
+                   limit=limit, arrival=time_ns, delta=delta, rho=rho,
+                   cost=cost, ready=False)
+
+    def copy(self) -> "RequestTag":
+        return replace(self)
+
+    def __str__(self) -> str:
+        from .timebase import format_tag
+        return (f"{{ RequestTag:: ready:{str(self.ready).lower()}"
+                f" r:{format_tag(self.reservation)}"
+                f" p:{format_tag(self.proportion)}"
+                f" l:{format_tag(self.limit)} }}")
+
+
+# The zero tag used for not-yet-tagged queued requests under delayed tag
+# calculation (reference initial_tag(DelayedTagCalc), dmclock_server.h:878-880)
+# and as every client's initial prev_tag (reference ClientRec ctor :385).
+ZERO_TAG = RequestTag(reservation=0, proportion=0, limit=0, arrival=0,
+                      delta=0, rho=0, cost=1, ready=False)

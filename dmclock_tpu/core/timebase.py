@@ -1,0 +1,80 @@
+"""Canonical time / tag arithmetic base for dmclock-tpu.
+
+The reference (``/root/reference/src/dmclock_util.h:33``) represents time
+as ``double`` seconds since the epoch and tags as ``double`` virtual
+times.  TPUs have no fast f64, so this framework instead defines ONE
+canonical fixed-point algebra -- int64 nanoseconds -- implemented
+identically in the Python oracle scheduler, the C++ native runtime, and
+the JAX/Pallas device kernels.  Because every backend performs the same
+integer arithmetic, request-ordering parity between backends is exact
+(bit-equal), not merely approximate.
+
+Sentinels: the reference pins tags to +/-infinity when a QoS rate is
+zero (``dmclock_server.h:60-65``, ``tag_calc`` at ``:246-259``).  Here
+MAX_TAG / MIN_TAG are +/-2^62 -- far beyond any organic nanosecond
+timestamp (year-2026 epoch ns ~= 1.8e18 < 2^62 ~= 4.6e18) yet leaving
+int64 headroom so that ``prev + increment`` on organic values can never
+collide with a sentinel.
+"""
+
+from __future__ import annotations
+
+NS_PER_SEC = 1_000_000_000
+
+# Tag sentinels (reference: max_tag/min_tag, dmclock_server.h:60-65).
+MAX_TAG = 1 << 62
+MIN_TAG = -(1 << 62)
+
+# Time sentinels (reference: TimeZero/TimeMax, dmclock_util.h:34-35).
+TIME_ZERO = 0
+TIME_MAX = 1 << 62
+
+# Idle-reactivation trigger: the reference uses DBL_MAX/3 as "much
+# larger than any organic value" (dmclock_server.h:957-958); ours is
+# MAX_TAG/2 for the same purpose.
+LOWEST_PROP_TAG_TRIGGER = MAX_TAG // 2
+
+
+def sec_to_ns(t: float) -> int:
+    """Convert float seconds to integer nanoseconds (round-to-nearest)."""
+    return round(t * NS_PER_SEC)
+
+
+def ns_to_sec(t_ns: int) -> float:
+    return t_ns / NS_PER_SEC
+
+
+def rate_to_inv_ns(rate: float) -> int:
+    """QoS rate (ops/sec) -> nanoseconds of virtual time per unit cost.
+
+    Mirrors ``ClientInfo::update`` (dmclock_server.h:111-118) which
+    caches ``1/rate`` with a 0 -> 0 sentinel meaning "axis disabled".
+    Rounding happens exactly once, here, so all backends agree.
+    """
+    if rate == 0.0:
+        return 0
+    return round(NS_PER_SEC / rate)
+
+
+def min_not_0_time(current: int, possible: int) -> int:
+    """Minimum of two times where TIME_ZERO means "no time".
+
+    Mirrors ``min_not_0_time`` (dmclock_server.h:1192-1195).
+    """
+    if possible == TIME_ZERO:
+        return current
+    return min(current, possible)
+
+
+def format_tag(value_ns: int, modulo: int = 1_000_000) -> str:
+    """Human-readable tag: 'max' / 'min' sentinels else seconds modulo.
+
+    Mirrors ``RequestTag::format_tag`` (dmclock_server.h:234-242) and
+    ``format_time`` (dmclock_util.cc:24-29).
+    """
+    if value_ns >= MAX_TAG:
+        return "max"
+    if value_ns <= MIN_TAG:
+        return "min"
+    sec = value_ns / NS_PER_SEC
+    return f"{sec % modulo:0.6f}"

@@ -1,0 +1,4 @@
+from .periodic import PeriodicTask
+from .profile import ProfileCombiner, ProfileTimer
+
+__all__ = ["PeriodicTask", "ProfileTimer", "ProfileCombiner"]

@@ -1,0 +1,15 @@
+"""Test configuration.
+
+JAX runs on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware, and x64 is enabled because the canonical
+tag algebra is int64 nanoseconds.  Env vars must be set before the first
+jax import anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,491 @@
+"""Oracle scheduler behavioral tests.
+
+Scenario coverage modeled on the reference suite
+(``test/test_dmclock_server.cc``): virtual-time injection everywhere
+(no sleeps), white-box inspection of queue internals, and behavioral
+QoS-ratio checks.  Times are int64 ns; ``S`` is one second.
+"""
+
+import errno
+
+import pytest
+
+from dmclock_tpu.core import (AtLimit, ClientInfo, MAX_TAG, NS_PER_SEC,
+                              NextReqType, Phase, PullPriorityQueue,
+                              ReqParams, sec_to_ns)
+
+S = NS_PER_SEC
+
+
+def make_queue(infos, **kwargs):
+    """Queue whose client_info_f looks up the given dict of ClientInfo."""
+    kwargs.setdefault("run_gc_thread", False)
+    return PullPriorityQueue(lambda c: infos[c], **kwargs)
+
+
+def drain(q, now_ns, max_pulls=10_000):
+    """Pull until not returning; list of (client, phase, cost)."""
+    out = []
+    for _ in range(max_pulls):
+        pr = q.pull_request(now_ns)
+        if not pr.is_retn():
+            break
+        out.append((pr.client, pr.phase, pr.cost))
+    return out
+
+
+class TestBasicAccounting:
+    def test_empty_queue(self):
+        q = make_queue({1: ClientInfo(1, 1, 1)})
+        assert q.empty()
+        assert q.client_count() == 0
+        assert q.request_count() == 0
+
+    def test_add_and_counts(self):
+        q = make_queue({1: ClientInfo(1, 1, 1), 2: ClientInfo(1, 1, 1)})
+        assert q.add_request("a", 1, ReqParams(), time_ns=1 * S) == 0
+        assert q.add_request("b", 1, ReqParams(), time_ns=1 * S) == 0
+        assert q.add_request("c", 2, ReqParams(), time_ns=1 * S) == 0
+        assert not q.empty()
+        assert q.client_count() == 2
+        assert q.request_count() == 3
+
+    def test_request_payload_roundtrip(self):
+        q = make_queue({7: ClientInfo(0, 1, 0)})
+        payload = {"op": "write", "len": 4096}
+        q.add_request(payload, 7, ReqParams(), time_ns=1 * S)
+        pr = q.pull_request(10 * S)
+        assert pr.is_retn()
+        assert pr.request is payload
+        assert pr.client == 7
+        assert pr.cost == 1
+
+
+class TestQosRatios:
+    def test_pull_weight_ratio(self):
+        # weight 1:2 => 2:4 of 6 pulls
+        # (model: reference pull_weight :822-874); a large base time
+        # keeps organic tags away from the wall-time floor, as the
+        # reference achieves by using get_time()
+        T0 = 1000 * S
+        infos = {1: ClientInfo(0, 1, 0), 2: ClientInfo(0, 2, 0)}
+        q = make_queue(infos)
+        for i in range(10):
+            q.add_request(("c1", i), 1, ReqParams(1, 1), time_ns=T0)
+            q.add_request(("c2", i), 2, ReqParams(1, 1), time_ns=T0)
+        pulls = [q.pull_request(T0) for _ in range(6)]
+        counts = {1: 0, 2: 0}
+        for pr in pulls:
+            assert pr.is_retn()
+            assert pr.phase is Phase.PRIORITY
+            counts[pr.client] += 1
+        assert counts == {1: 2, 2: 4}
+
+    def test_pull_reservation_ratio(self):
+        # reservation 2:1 => 4:2 of 6 pulls
+        # (model: reference pull_reservation :877-929)
+        T0 = 1000 * S
+        infos = {1: ClientInfo(2, 0, 0), 2: ClientInfo(1, 0, 0)}
+        q = make_queue(infos)
+        for i in range(10):
+            q.add_request(("c1", i), 1, ReqParams(1, 1), time_ns=T0)
+            q.add_request(("c2", i), 2, ReqParams(1, 1), time_ns=T0)
+        pulls = [q.pull_request(T0 + 100 * S) for _ in range(6)]
+        counts = {1: 0, 2: 0}
+        for pr in pulls:
+            assert pr.is_retn()
+            assert pr.phase is Phase.RESERVATION
+            counts[pr.client] += 1
+        assert counts == {1: 4, 2: 2}
+        assert q.reserv_sched_count == 6
+        assert q.prop_sched_count == 0
+
+    def test_cost_weighting(self):
+        # a cost-3 client advances its tags 3x as fast -> gets 1/3 the ops
+        T0 = 1000 * S
+        infos = {1: ClientInfo(0, 1, 0), 2: ClientInfo(0, 1, 0)}
+        q = make_queue(infos)
+        for i in range(12):
+            q.add_request(("c1", i), 1, ReqParams(), time_ns=T0, cost=1)
+            q.add_request(("c2", i), 2, ReqParams(), time_ns=T0, cost=3)
+        pulls = [q.pull_request(2000 * S) for _ in range(8)]
+        counts = {1: 0, 2: 0}
+        for pr in pulls:
+            counts[pr.client] += 1
+        assert counts == {1: 6, 2: 2}
+
+
+class TestStateMachine:
+    def test_pull_none(self):
+        # (model: reference pull_none :1184-1205)
+        q = make_queue({1: ClientInfo(1, 1, 1)})
+        pr = q.pull_request(sec_to_ns(1e9) + 100 * S)
+        assert pr.is_none()
+
+    def test_pull_future(self):
+        # (model: reference pull_future :1208-1236): r=1 w=0 l=1,
+        # request arrives 100s in the future -> future(arrival)
+        q = make_queue({52: ClientInfo(1, 0, 1)})
+        now = 1000 * S
+        assert q.add_request("r", 52, ReqParams(1, 1),
+                             time_ns=now + 100 * S) == 0
+        pr = q.pull_request(now)
+        assert pr.is_future()
+        assert pr.when_ready == now + 100 * S
+
+    def test_pull_future_limit_break_weight(self):
+        # AtLimit.ALLOW serves the future request now via weight
+        q = make_queue({52: ClientInfo(0, 1, 1)}, at_limit=AtLimit.ALLOW)
+        now = 1000 * S
+        q.add_request("r", 52, ReqParams(1, 1), time_ns=now + 100 * S)
+        pr = q.pull_request(now)
+        assert pr.is_retn()
+        assert pr.client == 52
+        assert pr.phase is Phase.PRIORITY
+
+    def test_pull_future_limit_break_reservation(self):
+        q = make_queue({52: ClientInfo(1, 0, 1)}, at_limit=AtLimit.ALLOW)
+        now = 1000 * S
+        q.add_request("r", 52, ReqParams(1, 1), time_ns=now + 100 * S)
+        pr = q.pull_request(now)
+        assert pr.is_retn()
+        assert pr.client == 52
+        assert pr.phase is Phase.RESERVATION
+
+    def test_ready_and_under_limit(self):
+        # (model: reference ready_and_under_limit :1120-1181)
+        # limit 1 op/s gates the weight phase
+        q = make_queue({1: ClientInfo(0, 1, 1)})
+        q.add_request("a", 1, ReqParams(), time_ns=1 * S)
+        q.add_request("b", 1, ReqParams(), time_ns=1 * S)
+        # limit tags: 1s, 2s
+        pr = q.pull_request(1 * S)
+        assert pr.is_retn() and pr.request == "a"
+        pr = q.pull_request(1 * S)
+        assert pr.is_future()
+        assert pr.when_ready == 2 * S
+        pr = q.pull_request(2 * S)
+        assert pr.is_retn() and pr.request == "b"
+
+
+class TestWaitAtLimit:
+    def test_pull_wait_at_limit(self):
+        # (model: reference pull_wait_at_limit :1363-1471)
+        infos = {52: ClientInfo(1, 2, 100), 8: ClientInfo(1, 1, 2)}
+        q = make_queue(infos)
+        now = 2000 * S
+        add_time = now - 1 * S
+        old_time = add_time
+        for i in range(50):
+            assert q.add_request(("c1", i), 52, ReqParams(1, 1),
+                                 time_ns=add_time) == 0
+            assert q.add_request(("c2", i), 8, ReqParams(1, 1),
+                                 time_ns=add_time) == 0
+            add_time += S // 100
+        assert q.client_count() == 2
+        assert q.request_count() == 100
+
+        counts = {52: 0, 8: 0}
+        # first two pulls come from the reservation queue, one each
+        for _ in range(2):
+            pr = q.pull_request(now)
+            assert pr.is_retn()
+            assert pr.phase is Phase.RESERVATION
+            counts[pr.client] += 1
+        assert counts == {52: 1, 8: 1}
+        assert q.request_count() == 98
+
+        # next 50 pulls: all remaining c1 requests + exactly one from c2
+        for _ in range(50):
+            pr = q.pull_request(now)
+            assert pr.is_retn()
+            assert pr.phase is Phase.PRIORITY
+            counts[pr.client] += 1
+        assert counts == {52: 50, 8: 2}
+        assert q.request_count() == 48
+
+        # c2 is over its limit: future at old_time + 2s exactly
+        pr = q.pull_request(now)
+        assert pr.is_future()
+        assert pr.when_ready == old_time + 2 * S
+
+        # once the limit restores, c2 is served again
+        pr = q.pull_request(old_time + 2 * S)
+        assert pr.is_retn()
+        assert pr.client == 8
+        assert q.request_count() == 47
+
+
+class TestReject:
+    def test_reject_at_limit(self):
+        # (model: reference pull_reject_at_limit :1301-1337); immediate
+        # tag calc; rejected requests still advance the limit tag
+        q = make_queue({52: ClientInfo(0, 1, 1)}, at_limit=AtLimit.REJECT)
+        assert q.add_request("a", 52, ReqParams(), time_ns=1 * S) == 0
+        assert q.add_request("b", 52, ReqParams(), time_ns=2 * S) == 0
+        assert q.add_request("c", 52, ReqParams(), time_ns=3 * S) == 0
+        # too soon
+        assert q.add_request("d", 52, ReqParams(),
+                             time_ns=int(3.9 * S)) == errno.EAGAIN
+        # the rejected request still counted against the limit
+        assert q.add_request("e", 52, ReqParams(),
+                             time_ns=4 * S) == errno.EAGAIN
+        assert q.add_request("f", 52, ReqParams(), time_ns=6 * S) == 0
+
+    def test_reject_threshold(self):
+        # (model: reference pull_reject_threshold :1340-1360): passing a
+        # bare threshold implies AtLimit.REJECT
+        q = make_queue({52: ClientInfo(0, 1, 1)}, at_limit=3 * S)
+        assert q.at_limit is AtLimit.REJECT
+        for expected in (0, 0, 0, 0):
+            assert q.add_request("x", 52, ReqParams(), time_ns=1 * S) \
+                == expected
+        assert q.add_request("x", 52, ReqParams(),
+                             time_ns=1 * S) == errno.EAGAIN
+        assert q.add_request("x", 52, ReqParams(), time_ns=3 * S) == 0
+
+    def test_reject_incompatible_with_delayed(self):
+        # (model: reference death test + assert :856-857)
+        with pytest.raises(AssertionError):
+            make_queue({1: ClientInfo(0, 1, 1)}, at_limit=AtLimit.REJECT,
+                       delayed_tag_calc=True)
+
+
+class TestDelayedTagCalc:
+    def test_delayed_uses_latest_delta(self):
+        # Delayed mode tags a request only when it reaches the head,
+        # using the client's LATEST delta/rho (reference :277-280,
+        # :1021-1036).  Immediate mode uses each request's own params.
+        infos = {1: ClientInfo(0, 1, 0)}
+        qd = make_queue(infos, delayed_tag_calc=True)
+        qd.add_request("r1", 1, ReqParams(0, 0), time_ns=1 * S)
+        qd.add_request("r2", 1, ReqParams(3, 0), time_ns=1 * S)
+        qd.add_request("r3", 1, ReqParams(9, 0), time_ns=1 * S)
+        qd.pull_request(1 * S)
+        # white-box: r2's tag was computed at pop time with cur_delta=9
+        rec = qd.client_map[1]
+        # head tag: prev_p(1s) + 1s * (9 + 1) = 11s
+        assert rec.next_request().tag.proportion == 11 * S
+
+        qi = make_queue(infos, delayed_tag_calc=False)
+        qi.add_request("r1", 1, ReqParams(0, 0), time_ns=1 * S)
+        qi.add_request("r2", 1, ReqParams(3, 0), time_ns=1 * S)
+        qi.add_request("r3", 1, ReqParams(9, 0), time_ns=1 * S)
+        qi.pull_request(1 * S)
+        rec = qi.client_map[1]
+        # immediate: r2 tagged at add with its own delta=3 -> 1 + 4 = 5s
+        assert rec.next_request().tag.proportion == 5 * S
+
+    def test_delayed_zero_tag_until_head(self):
+        q = make_queue({1: ClientInfo(0, 1, 0)}, delayed_tag_calc=True)
+        q.add_request("r1", 1, ReqParams(), time_ns=1 * S)
+        q.add_request("r2", 1, ReqParams(), time_ns=1 * S)
+        rec = q.client_map[1]
+        assert rec.requests[0].tag.proportion == 1 * S  # head: real tag
+        assert rec.requests[1].tag.proportion == 0      # body: zero tag
+
+
+class TestReduceReservationTags:
+    def test_weight_service_pays_reservation_debt(self):
+        # a weight-phase pop subtracts r_inv*(cost+rho) from the
+        # client's queued reservation tags (reference :1077-1111)
+        q = make_queue({1: ClientInfo(1, 1, 0)})
+        q.add_request("a", 1, ReqParams(), time_ns=0)
+        q.add_request("b", 1, ReqParams(), time_ns=0)
+        rec = q.client_map[1]
+        assert rec.requests[0].tag.reservation == 1 * S
+        assert rec.requests[1].tag.reservation == 2 * S
+        # pull at now=0.5s: reservation (1s) not yet due -> weight phase
+        pr = q.pull_request(S // 2)
+        assert pr.phase is Phase.PRIORITY
+        # remaining request's reservation reduced by 1s*(1+0)
+        assert rec.requests[0].tag.reservation == 1 * S
+        assert rec.prev_tag.reservation == 1 * S
+
+    def test_reservation_phase_does_not_reduce(self):
+        q = make_queue({1: ClientInfo(1, 1, 0)})
+        q.add_request("a", 1, ReqParams(), time_ns=0)
+        q.add_request("b", 1, ReqParams(), time_ns=0)
+        rec = q.client_map[1]
+        pr = q.pull_request(10 * S)  # reservation due
+        assert pr.phase is Phase.RESERVATION
+        assert rec.requests[0].tag.reservation == 2 * S
+
+
+class TestRemovalApis:
+    def test_remove_by_req_filter(self):
+        # (model: reference remove_by_req_filter* :373-605)
+        q = make_queue({1: ClientInfo(0, 1, 0), 2: ClientInfo(0, 1, 0)})
+        for i in range(5):
+            q.add_request(("c1", i), 1, ReqParams(), time_ns=0)
+            q.add_request(("c2", i), 2, ReqParams(), time_ns=0)
+        removed = []
+
+        def filt(req):
+            if req[1] % 2 == 0:
+                removed.append(req)
+                return True
+            return False
+
+        assert q.remove_by_req_filter(filt)
+        assert q.request_count() == 4
+        assert len(removed) == 6
+        # forward visit order within each client
+        assert [r for r in removed if r[0] == "c1"] == \
+            [("c1", 0), ("c1", 2), ("c1", 4)]
+
+    def test_remove_by_req_filter_backwards(self):
+        q = make_queue({1: ClientInfo(0, 1, 0)})
+        for i in range(4):
+            q.add_request(i, 1, ReqParams(), time_ns=0)
+        seen = []
+        q.remove_by_req_filter(lambda r: (seen.append(r), True)[1],
+                               visit_backwards=True)
+        assert seen == [3, 2, 1, 0]
+        assert q.request_count() == 0
+
+    def test_remove_by_client(self):
+        # (model: reference remove_by_client :608-681)
+        q = make_queue({1: ClientInfo(0, 1, 0), 2: ClientInfo(0, 1, 0)})
+        for i in range(3):
+            q.add_request(("c1", i), 1, ReqParams(), time_ns=0)
+            q.add_request(("c2", i), 2, ReqParams(), time_ns=0)
+        acc = []
+        q.remove_by_client(1, accum=acc.append)
+        assert acc == [("c1", 0), ("c1", 1), ("c1", 2)]
+        assert q.request_count() == 3
+        q.remove_by_client(2, reverse=True, accum=acc.append)
+        assert acc[3:] == [("c2", 2), ("c2", 1), ("c2", 0)]
+        q.remove_by_client(99)  # unknown client: no-op
+
+
+class TestClientInfoUpdates:
+    def test_update_client_info(self):
+        # (model: reference update_client_info :932-1018)
+        infos = {1: ClientInfo(0, 1, 0)}
+        q = make_queue(infos)
+        q.add_request("a", 1, ReqParams(), time_ns=0)
+        infos[1].update(0, 4, 0)  # in-place rate change
+        q.update_client_info(1)
+        q.pull_request(10 * S)
+        q.add_request("b", 1, ReqParams(), time_ns=0)
+        rec = q.client_map[1]
+        # new tag advances at 0.25s per op from prev 1s
+        assert rec.requests[-1].tag.proportion == int(1.25 * S)
+
+    def test_dynamic_cli_info(self):
+        # (model: reference dynamic_cli_info_f :1021-1114): with
+        # dynamic lookup the info function is consulted on every use
+        calls = []
+        info_a = ClientInfo(0, 1, 0)
+        info_b = ClientInfo(0, 4, 0)
+
+        def info_f(c):
+            calls.append(c)
+            return info_a if len(calls) <= 2 else info_b
+
+        q = PullPriorityQueue(info_f, dynamic_cli_info=True,
+                              run_gc_thread=False)
+        q.add_request("a", 1, ReqParams(), time_ns=0)   # call 1 (create) + call 2 (tag)
+        q.pull_request(10 * S)
+        q.add_request("b", 1, ReqParams(), time_ns=0)   # call 3+ -> info_b
+        rec = q.client_map[1]
+        assert rec.requests[-1].tag.proportion == int(1.25 * S)
+
+
+class TestIdleReactivation:
+    def test_prop_delta_on_reactivation(self):
+        # an idle client returning competes from the lowest active
+        # proportion tag, not its stale one (reference :937-985)
+        infos = {1: ClientInfo(0, 1, 0), 2: ClientInfo(0, 1, 0)}
+        q = make_queue(infos)
+        # client 1 busy: tags run ahead to ~100s
+        for i in range(100):
+            q.add_request(("c1", i), 1, ReqParams(), time_ns=0)
+        for _ in range(50):
+            q.pull_request(1000 * S)
+        rec1 = q.client_map[1]
+        assert rec1.next_request().tag.proportion == 51 * S
+        # client 2 arrives fresh at t=0: would get tag ~1s and starve
+        # client 1 for 50 ops without the prop_delta shift
+        q.add_request(("c2", 0), 2, ReqParams(), time_ns=0)
+        rec2 = q.client_map[2]
+        assert rec2.prop_delta == 51 * S  # lowest active tag - time
+        # interleaved service from here on, not 50 consecutive c2 pulls
+        pulls = [q.pull_request(1000 * S).client for _ in range(4)]
+        assert set(pulls) == {1, 2}
+
+
+class TestGc:
+    def _fake_clock(self):
+        state = {"t": 0.0}
+
+        def clock():
+            return state["t"]
+
+        return state, clock
+
+    def test_idle_then_erase(self):
+        # (model: reference client_idle_erase :100-185, with an
+        # injected monotonic clock instead of sleeps)
+        state, clock = self._fake_clock()
+        q = make_queue({1: ClientInfo(1, 1, 0)}, idle_age_s=300,
+                       erase_age_s=600, check_time_s=60,
+                       monotonic_clock=clock)
+        q.add_request("a", 1, ReqParams(), time_ns=0)
+        q.pull_request(10 * S)
+        q.do_clean()  # mark (t=0, tick=1)
+        rec = q.client_map[1]
+        assert not rec.idle
+
+        state["t"] = 400.0
+        q.do_clean()  # idle_point from mark at t=0
+        assert rec.idle
+        assert q.client_count() == 1
+
+        state["t"] = 700.0
+        q.do_clean()  # erase_point from mark at t=0
+        assert q.client_count() == 0
+
+    def test_erase_max_bounds_work(self):
+        state, clock = self._fake_clock()
+        q = make_queue({i: ClientInfo(1, 1, 0) for i in range(10)},
+                       idle_age_s=10, erase_age_s=20, check_time_s=5,
+                       erase_max=3, monotonic_clock=clock)
+        for i in range(10):
+            q.add_request("r", i, ReqParams(), time_ns=0)
+            q.pull_request(10 * S)
+        q.do_clean()
+        state["t"] = 25.0
+        q.do_clean()  # erase capped at 3 per pass
+        assert q.client_count() == 7
+        state["t"] = 26.0
+        q.do_clean()
+        assert q.client_count() == 4
+
+
+class TestSchedulingInvariants:
+    def test_interleaved_add_pull_monotone_service(self):
+        # fuzz-ish determinism check: same inputs -> same outputs
+        infos = {i: ClientInfo(i % 3, 1 + (i % 2), 0) for i in range(8)}
+
+        def run():
+            q = make_queue(infos)
+            trace = []
+            t = 0
+            for step in range(400):
+                c = (step * 7) % 8
+                delta = step % 3
+                q.add_request(step, c, ReqParams(delta, min(step % 2, delta)),
+                              time_ns=t, cost=1 + step % 2)
+                if step % 2:
+                    pr = q.pull_request(t)
+                    if pr.is_retn():
+                        trace.append((pr.client, pr.request, pr.phase))
+                t += S // 200
+            trace.extend(drain(q, t + 100 * S))
+            return trace
+
+        t1, t2 = run(), run()
+        assert t1 == t2
+        assert len(t1) >= 400  # everything eventually served

@@ -1,0 +1,98 @@
+"""Tag-algebra unit tests (model: reference test_dmclock_server.cc
+tag-calculation coverage, e.g. delayed_tag_calc :273-316)."""
+
+import pytest
+
+from dmclock_tpu.core import (ClientInfo, MAX_TAG, MIN_TAG, NS_PER_SEC,
+                              RequestTag, ZERO_TAG, rate_to_inv_ns, tag_calc)
+
+S = NS_PER_SEC
+
+
+class TestTagCalc:
+    def test_zero_increment_pins_high(self):
+        assert tag_calc(5 * S, 3 * S, 0, 1, True, 1) == MAX_TAG
+
+    def test_zero_increment_pins_low(self):
+        assert tag_calc(5 * S, 3 * S, 0, 1, False, 1) == MIN_TAG
+
+    def test_advances_from_prev(self):
+        # rate 1 op/s -> 1s per unit; prev 3s + (0 dist + 1 cost) = 4s
+        inv = rate_to_inv_ns(1.0)
+        assert tag_calc(2 * S, 3 * S, inv, 0, True, 1) == 4 * S
+
+    def test_floors_at_now(self):
+        inv = rate_to_inv_ns(1.0)
+        assert tag_calc(10 * S, 3 * S, inv, 0, True, 1) == 10 * S
+
+    def test_dist_val_and_cost_both_charge(self):
+        inv = rate_to_inv_ns(2.0)  # 0.5s per unit
+        # prev 0 + 0.5 * (3 + 2) = 2.5s
+        assert tag_calc(0, 0, inv, 3, True, 2) == 2_500_000_000
+
+    def test_rate_inverse_rounding_is_canonical(self):
+        # 3 ops/s does not divide 1e9; all backends must round identically
+        assert rate_to_inv_ns(3.0) == 333333333
+        assert rate_to_inv_ns(0.0) == 0
+
+
+class TestRequestTagRecurrence:
+    def test_axes_use_correct_dist_values(self):
+        # reservation uses rho; proportion and limit use delta
+        # (reference dmclock_server.h:163-180)
+        info = ClientInfo(1.0, 1.0, 1.0)
+        tag = RequestTag.from_prev(ZERO_TAG, info, delta=5, rho=2,
+                                   time_ns=0, cost=1)
+        assert tag.reservation == 3 * S   # (2 + 1) * 1s
+        assert tag.proportion == 6 * S    # (5 + 1) * 1s
+        assert tag.limit == 6 * S
+
+    def test_zero_rates_pin(self):
+        info = ClientInfo(0.0, 1.0, 0.0)
+        tag = RequestTag.from_prev(ZERO_TAG, info, 0, 0, time_ns=S, cost=1)
+        assert tag.reservation == MAX_TAG
+        assert tag.limit == MIN_TAG
+        assert tag.proportion == S  # max(1s, 0 + 1s*(0+1)) = 1s
+
+    def test_no_reservation_nor_weight_asserts(self):
+        # reference asserts reservation < max || proportion < max (:182)
+        info = ClientInfo(0.0, 0.0, 1.0)
+        with pytest.raises(AssertionError):
+            RequestTag.from_prev(ZERO_TAG, info, 0, 0, time_ns=S, cost=1)
+
+    def test_anticipation_backdates_within_window(self):
+        # arrival within timeout of previous arrival is backdated
+        # (reference :159-161); weight 100 -> 0.01s increments so the
+        # wall-time floor dominates and the backdating is observable
+        info = ClientInfo(0.0, 100.0, 0.0)
+        prev = RequestTag(reservation=0, proportion=S, limit=0,
+                          arrival=1 * S)
+        ant = int(0.1 * S)
+        t2 = int(1.08 * S)
+        with_ant = RequestTag.from_prev(prev, info, 0, 0, t2, 1, ant)
+        without = RequestTag.from_prev(prev, info, 0, 0, t2, 1, 0)
+        assert with_ant.proportion == int(1.01 * S)  # prev + 0.01s
+        assert without.proportion == int(1.08 * S)   # floored at arrival
+        # outside the window: no backdating
+        t3 = int(2.5 * S)
+        far = RequestTag.from_prev(prev, info, 0, 0, t3, 1, ant)
+        assert far.proportion == int(2.5 * S)
+
+    def test_cost_scales_increment(self):
+        info = ClientInfo(4.0, 0.0, 0.0)  # 0.25s per unit
+        tag = RequestTag.from_prev(ZERO_TAG, info, delta=0, rho=0,
+                                   time_ns=0, cost=3)
+        assert tag.reservation == 750_000_000
+
+    def test_zero_cost_asserts(self):
+        info = ClientInfo(1.0, 1.0, 0.0)
+        with pytest.raises(AssertionError):
+            RequestTag.from_prev(ZERO_TAG, info, 0, 0, 0, cost=0)
+
+
+def test_proportion_floor_fixup():
+    # double-check the max(time, prev+inc) floor on the proportion axis
+    info = ClientInfo(0.0, 1.0, 0.0)
+    tag = RequestTag.from_prev(ZERO_TAG, info, 0, 0, time_ns=S, cost=1)
+    # max(1s, 0 + 1s) = 1s
+    assert tag.proportion == S

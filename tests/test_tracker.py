@@ -1,0 +1,152 @@
+"""Client-side ServiceTracker tests.
+
+Scenario coverage modeled on the reference's
+``test/test_dmclock_client.cc``: exact delta/rho sequences across
+interleaved multi-server responses for both accounting policies, and
+server-record GC with an injected clock.
+"""
+
+from dmclock_tpu.core import (BorrowingTracker, OrigTracker, Phase,
+                              ServiceTracker)
+
+
+def make_tracker(cls=OrigTracker, **kw):
+    kw.setdefault("run_gc_thread", False)
+    return ServiceTracker(tracker_cls=cls, **kw)
+
+
+class TestOrigTracker:
+    def test_first_contact_returns_1_1(self):
+        # first request to an unknown server (reference :241-251)
+        st = make_tracker()
+        p = st.get_req_params("s1")
+        assert (p.delta, p.rho) == (1, 1)
+
+    def test_own_responses_excluded(self):
+        # completions at the SAME server don't count toward the
+        # delta/rho sent to it (reference OrigTracker::prepare_req
+        # :59-67 subtracts my_delta/my_rho)
+        st = make_tracker()
+        st.get_req_params("s1")
+        st.track_resp("s1", Phase.RESERVATION)
+        p = st.get_req_params("s1")
+        assert (p.delta, p.rho) == (0, 0)
+
+    def test_cross_server_responses_counted(self):
+        st = make_tracker()
+        st.get_req_params("s1")  # (1,1), registers s1
+        st.get_req_params("s2")  # (1,1), registers s2
+        # two completions at s2: one reservation, one priority
+        st.track_resp("s2", Phase.RESERVATION)
+        st.track_resp("s2", Phase.PRIORITY)
+        # next request to s1 reports both, rho only for the reservation
+        p = st.get_req_params("s1")
+        assert (p.delta, p.rho) == (2, 1)
+        # and s2 excludes its own
+        p = st.get_req_params("s2")
+        assert (p.delta, p.rho) == (0, 0)
+
+    def test_cost_scales_counters(self):
+        st = make_tracker()
+        st.get_req_params("s1")
+        st.get_req_params("s2")
+        st.track_resp("s2", Phase.RESERVATION, request_cost=5)
+        p = st.get_req_params("s1")
+        assert (p.delta, p.rho) == (5, 5)
+
+    def test_interleaved_sequence(self):
+        st = make_tracker()
+        st.get_req_params("a")
+        st.get_req_params("b")
+        st.track_resp("a", Phase.RESERVATION)
+        st.track_resp("b", Phase.PRIORITY)
+        st.track_resp("a", Phase.PRIORITY)
+        p = st.get_req_params("a")  # sees b's 1 completion
+        assert (p.delta, p.rho) == (1, 0)
+        p = st.get_req_params("b")  # sees a's 2, one reservation
+        assert (p.delta, p.rho) == (2, 1)
+        p = st.get_req_params("a")  # nothing new anywhere
+        assert (p.delta, p.rho) == (0, 0)
+
+    def test_response_for_unknown_server_self_heals(self):
+        # response without a preceding request creates a tracker
+        # (reference track_resp :227-234)
+        st = make_tracker()
+        st.track_resp("ghost", Phase.PRIORITY)
+        assert "ghost" in st.server_map
+
+
+class TestBorrowingTracker:
+    def test_always_positive(self):
+        st = make_tracker(BorrowingTracker)
+        st.get_req_params("s1")
+        for _ in range(5):
+            p = st.get_req_params("s1")
+            assert p.delta >= 1 and p.rho >= 1
+
+    def test_borrow_then_repay(self):
+        # reference calc_with_borrow (:110-129): with no traffic a
+        # request borrows 1; a burst of completions repays the debt
+        st = make_tracker(BorrowingTracker)
+        st.get_req_params("s1")
+        p = st.get_req_params("s1")       # borrows delta:1 rho:1
+        assert (p.delta, p.rho) == (1, 1)
+        tr = st.server_map["s1"]
+        assert tr.delta_borrow == 1 and tr.rho_borrow == 1
+        for _ in range(4):
+            st.track_resp("s1", Phase.RESERVATION)
+        p = st.get_req_params("s1")       # 4 new - 1 borrowed = 3
+        assert (p.delta, p.rho) == (3, 3)
+        assert tr.delta_borrow == 0 and tr.rho_borrow == 0
+
+    def test_partial_repay(self):
+        st = make_tracker(BorrowingTracker)
+        st.get_req_params("s1")
+        st.get_req_params("s1")  # borrow 1
+        st.get_req_params("s1")  # borrow 2
+        tr = st.server_map["s1"]
+        assert tr.delta_borrow == 2
+        st.track_resp("s1", Phase.PRIORITY)
+        p = st.get_req_params("s1")  # 1 new <= 2 borrowed -> 1, debt 2
+        assert p.delta == 1
+        assert tr.delta_borrow == 2  # 2 - 1 + 1
+
+
+class TestServerGc:
+    def test_server_erase(self):
+        # (model: reference server_erase :42-105, injected clock)
+        state = {"t": 0.0}
+        st = make_tracker(clean_every_s=60, clean_age_s=120,
+                          monotonic_clock=lambda: state["t"])
+        st.get_req_params("s1")
+        st.get_req_params("s2")
+        st.do_clean()  # mark (0, delta=1)
+        # s2 stays active, s1 goes quiet
+        state["t"] = 130.0
+        st.track_resp("s2", Phase.PRIORITY)
+        st.do_clean()  # erase servers with last_delta <= 1 -> s1 kept? no:
+        # s1.last_delta == 1 <= earliest(1) -> erased; s2 was re-created?
+        assert "s1" not in st.server_map
+        # s2's tracker was created at delta=1 too; its last_delta is
+        # still 1 (track_resp doesn't advance delta_prev_req), so it is
+        # also erased -- matching reference get_last_delta semantics
+        assert "s2" not in st.server_map
+        # but the next request to s2 self-heals with fresh counters
+        p = st.get_req_params("s2")
+        assert (p.delta, p.rho) == (1, 1)
+
+    def test_recent_requester_survives(self):
+        state = {"t": 0.0}
+        st = make_tracker(clean_every_s=60, clean_age_s=120,
+                          monotonic_clock=lambda: state["t"])
+        st.get_req_params("s1")
+        st.get_req_params("s2")
+        st.do_clean()
+        state["t"] = 100.0
+        st.track_resp("s1", Phase.PRIORITY)   # delta -> 2
+        st.get_req_params("s1")               # s1.last_delta -> 2
+        st.do_clean()                          # mark (100, 2)
+        state["t"] = 130.0
+        st.do_clean()  # earliest = 1 (mark at t=0); s1 at 2 survives
+        assert "s1" in st.server_map
+        assert "s2" not in st.server_map
