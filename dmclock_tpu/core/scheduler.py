@@ -444,13 +444,17 @@ class PriorityQueueBase(Generic[C, R]):
                 readys.next_request().tag.proportion < MAX_TAG:
             return NextReq.returning(HeapId.READY)
 
-        # limit-break (reference :1157-1165)
+        # limit-break (reference :1157-1165); unlike the reference
+        # (whose limit_break_sched_count is declared but never bumped)
+        # we actually count these
         if self.at_limit is AtLimit.ALLOW:
             if readys.has_request() and \
                     readys.next_request().tag.proportion < MAX_TAG:
+                self.limit_break_sched_count += 1
                 return NextReq.returning(HeapId.READY)
             if reserv.has_request() and \
                     reserv.next_request().tag.reservation < MAX_TAG:
+                self.limit_break_sched_count += 1
                 return NextReq.returning(HeapId.RESERVATION)
 
         # nothing schedulable now: compute the next wake-up time
@@ -719,22 +723,25 @@ class PushPriorityQueue(PriorityQueueBase[C, R]):
                 self._sched_ahead_cv.notify_all()
 
     def _run_sched_ahead(self) -> None:
-        # reference run_sched_ahead (:1760-1786)
+        # reference run_sched_ahead (:1760-1786); the armed deadline is
+        # only consumed once it has actually passed -- an early wakeup
+        # (a newer, earlier deadline from _sched_at) just re-evaluates
+        # the wait, so timed wakeups can't be dropped
         with self._sched_ahead_cv:
             while not self.finishing:
                 if self._sched_ahead_when == TIME_ZERO:
                     self._sched_ahead_cv.wait()
-                else:
-                    delay_s = max(0.0, (self._sched_ahead_when - _now_ns())
-                                  / NS_PER_SEC)
+                    continue
+                delay_s = (self._sched_ahead_when - _now_ns()) / NS_PER_SEC
+                if delay_s > 0:
                     self._sched_ahead_cv.wait(timeout=delay_s)
-                    self._sched_ahead_when = TIME_ZERO
-                    if self.finishing:
-                        return
-                    self._sched_ahead_cv.release()
-                    try:
-                        if not self.finishing:
-                            with self.data_mtx:
-                                self._schedule_request()
-                    finally:
-                        self._sched_ahead_cv.acquire()
+                    continue
+                self._sched_ahead_when = TIME_ZERO
+                if self.finishing:
+                    return
+                self._sched_ahead_cv.release()
+                try:
+                    with self.data_mtx:
+                        self._schedule_request()
+                finally:
+                    self._sched_ahead_cv.acquire()

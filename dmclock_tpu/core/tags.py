@@ -22,7 +22,8 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 
 from .qos import ClientInfo
-from .timebase import MAX_TAG, MIN_TAG
+from .timebase import (MAX_CHARGE_UNITS, MAX_TAG, MIN_TAG,
+                       ORGANIC_TAG_CAP)
 
 __all__ = ["tag_calc", "RequestTag", "ZERO_TAG"]
 
@@ -34,10 +35,15 @@ def tag_calc(time_ns: int, prev_ns: int, inv_ns: int, dist_val: int,
     inv_ns == 0 means the axis is disabled -> pin to the sentinel.
     Otherwise advance the per-client virtual clock by inv_ns units per
     unit of (distributed credit + cost), floored at wall time.
+
+    Charged units saturate at MAX_CHARGE_UNITS and the result at
+    ORGANIC_TAG_CAP so organic tags never reach a sentinel and the
+    arithmetic stays in-range on true-int64 backends.
     """
     if inv_ns == 0:
         return MAX_TAG if extreme_is_high else MIN_TAG
-    return max(time_ns, prev_ns + inv_ns * (dist_val + cost))
+    units = min(dist_val + cost, MAX_CHARGE_UNITS)
+    return min(max(time_ns, prev_ns + inv_ns * units), ORGANIC_TAG_CAP)
 
 
 @dataclass

@@ -34,6 +34,18 @@ TIME_MAX = 1 << 62
 # MAX_TAG/2 for the same purpose.
 LOWEST_PROP_TAG_TRIGGER = MAX_TAG // 2
 
+# Saturation bounds keeping the int64 algebra overflow-free on every
+# backend (Python ints don't overflow, but the C++/JAX backends are
+# true int64 where wraparound is silent):
+#   inv <= 2^40 ns/unit (rates below ~0.00091 ops/s saturate),
+#   charged units (dist + cost) <= 2^20 per request,
+# so one increment is < 2^60 and prev (< 2^62) + increment < 2^63.
+# Organic tags are additionally capped at MAX_TAG - 1 so they can never
+# equal a sentinel.
+MAX_INV_NS = 1 << 40
+MAX_CHARGE_UNITS = 1 << 20
+ORGANIC_TAG_CAP = MAX_TAG - 1
+
 
 def sec_to_ns(t: float) -> int:
     """Convert float seconds to integer nanoseconds (round-to-nearest)."""
@@ -50,10 +62,12 @@ def rate_to_inv_ns(rate: float) -> int:
     Mirrors ``ClientInfo::update`` (dmclock_server.h:111-118) which
     caches ``1/rate`` with a 0 -> 0 sentinel meaning "axis disabled".
     Rounding happens exactly once, here, so all backends agree.
+    Saturates at MAX_INV_NS (see above) to keep int64 backends
+    overflow-free for absurdly low rates.
     """
     if rate == 0.0:
         return 0
-    return round(NS_PER_SEC / rate)
+    return min(round(NS_PER_SEC / rate), MAX_INV_NS)
 
 
 def min_not_0_time(current: int, possible: int) -> int:

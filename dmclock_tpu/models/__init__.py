@@ -1,0 +1,64 @@
+"""Scheduler "model" registry.
+
+The model families of this framework are its schedulers.  Each entry
+binds a queue factory + tracker factory pair for the sim harness
+(playing the role of the reference's type-glue headers
+``sim/src/test_dmclock.h:33-62`` and ``sim/src/test_ssched.h``):
+
+  dmclock       -- oracle CPU dmClock queue + OrigTracker
+  dmclock-delayed -- same with delayed tag calculation
+  ssched        -- FIFO baseline + no-op tracker
+  dmclock-tpu   -- JAX batch-engine-backed dmClock queue (engine/)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+from ..core import AtLimit, PullPriorityQueue, ServiceTracker
+from ..sim.ssched import NullServiceTracker, SimpleQueue
+
+QueueFactory = Callable
+
+_REGISTRY: Dict[str, Tuple[Callable, Callable]] = {}
+
+
+def register(name: str, queue_factory: Callable,
+             tracker_factory: Callable) -> None:
+    _REGISTRY[name] = (queue_factory, tracker_factory)
+
+
+def get(name: str) -> Tuple[Callable, Callable]:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown scheduler model {name!r}; "
+                       f"have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+def _dmclock_queue(delayed: bool):
+    def factory(server_id, client_info_f, anticipation_ns, soft_limit):
+        # soft limit -> AtLimit.Allow, hard -> Wait (reference
+        # test_dmclock_main.cc:190-198 create_queue_f)
+        return PullPriorityQueue(
+            client_info_f,
+            delayed_tag_calc=delayed,
+            at_limit=AtLimit.ALLOW if soft_limit else AtLimit.WAIT,
+            anticipation_timeout_ns=anticipation_ns,
+            run_gc_thread=False)
+    return factory
+
+
+def _dmclock_tracker():
+    return ServiceTracker(run_gc_thread=False)
+
+
+register("dmclock", _dmclock_queue(delayed=False), _dmclock_tracker)
+register("dmclock-delayed", _dmclock_queue(delayed=True), _dmclock_tracker)
+register("ssched",
+         lambda server_id, client_info_f, anticipation_ns, soft_limit:
+         SimpleQueue(),
+         NullServiceTracker)

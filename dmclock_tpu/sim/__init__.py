@@ -1,0 +1,9 @@
+from .config import ClientGroup, ServerGroup, SimConfig, parse_config_file
+from .harness import EventLoop, SimReport, SimulatedClient, SimulatedServer, Simulation
+from .ssched import NullServiceTracker, SimpleQueue
+
+__all__ = [
+    "ClientGroup", "ServerGroup", "SimConfig", "parse_config_file",
+    "EventLoop", "SimReport", "SimulatedClient", "SimulatedServer",
+    "Simulation", "NullServiceTracker", "SimpleQueue",
+]

@@ -1,0 +1,53 @@
+"""dmc_sim -- dmClock QoS simulation CLI.
+
+Equivalent of the reference simulator binary
+(``sim/src/test_dmclock_main.cc:46-342``): reads a reference-format INI
+config (``-c/--conf``), runs the closed-loop multi-server multi-client
+simulation, and prints per-group / per-server tables.
+
+    python -m dmclock_tpu.sim.dmc_sim -c sim/dmc_sim_example.conf
+    python -m dmclock_tpu.sim.dmc_sim -c conf --model dmclock-tpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .. import models
+from .config import SimConfig, parse_config_file
+from .harness import Simulation
+
+
+def run_sim(cfg: SimConfig, model: str = "dmclock", seed: int = 12345,
+            record_trace: bool = False) -> Simulation:
+    queue_factory, tracker_factory = models.get(model)
+    sim = Simulation(cfg, queue_factory, tracker_factory, seed=seed,
+                     record_trace=record_trace)
+    sim.run()
+    return sim
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dmc_sim",
+                                description=__doc__.splitlines()[0])
+    p.add_argument("-c", "--conf", help="INI config file "
+                   "(reference sim/dmc_sim_example.conf format)")
+    p.add_argument("--model", default="dmclock", choices=models.names(),
+                   help="scheduler model to simulate")
+    p.add_argument("--seed", type=int, default=12345)
+    p.add_argument("--intervals", action="store_true",
+                   help="print per-client per-second op counts")
+    args = p.parse_args(argv)
+
+    try:
+        cfg = parse_config_file(args.conf) if args.conf else SimConfig()
+    except OSError as e:
+        p.error(f"cannot read config file: {e}")
+    sim = run_sim(cfg, model=args.model, seed=args.seed)
+    print(sim.report().format(show_intervals=args.intervals))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,441 @@
+"""Discrete-event QoS simulation harness.
+
+Equivalent of the reference simulation framework
+(``sim/src/simulate.h``, ``sim_server.h``, ``sim_client.h``): generic
+over the queue/tracker pair, so the dmclock scheduler, the ssched FIFO
+baseline, and the TPU batch engine all plug in.
+
+Architectural departure from the reference (deliberate): the reference
+models time by *sleeping real threads* (server worker sleeps
+``op_time*cost``, sim_server.h:222; clients rate-limit with
+``wait_until``, sim_client.h:260-263) so a run takes as long as the
+simulated workload.  Here the same client/server state machines advance
+a virtual int64-ns clock through an event heap: deterministic
+(seq-numbered ties), reproducible, and able to simulate hours of QoS
+traffic in milliseconds -- which is also what lets the TPU backend be
+driven batch-at-a-time.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import time as _walltime
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core import NS_PER_SEC, Phase, ReqParams
+from ..utils.profile import ProfileTimer
+from .config import ClientGroup, ServerGroup, SimConfig
+
+
+# ----------------------------------------------------------------------
+# event loop
+# ----------------------------------------------------------------------
+
+class EventLoop:
+    """Virtual-time event loop; ties broken by schedule order."""
+
+    def __init__(self):
+        self.now_ns = 0
+        self._heap: List[Tuple[int, int, Callable[[], None]]] = []
+        self._seq = 0
+
+    def at(self, time_ns: int, fn: Callable[[], None]) -> None:
+        assert time_ns >= self.now_ns, "scheduling into the past"
+        heapq.heappush(self._heap, (time_ns, self._seq, fn))
+        self._seq += 1
+
+    def after(self, delay_ns: int, fn: Callable[[], None]) -> None:
+        self.at(self.now_ns + delay_ns, fn)
+
+    def run(self, until_ns: Optional[int] = None) -> None:
+        while self._heap:
+            t, _, fn = heapq.heappop(self._heap)
+            if until_ns is not None and t > until_ns:
+                self.now_ns = until_ns
+                return
+            self.now_ns = t
+            fn()
+
+
+# ----------------------------------------------------------------------
+# server
+# ----------------------------------------------------------------------
+
+@dataclass
+class ServerStats:
+    """Per-server accounting (reference server_data
+    test_dmclock_main.cc:285-316 + InternalStats sim_server.h:55-70)."""
+
+    ops_completed: int = 0
+    reservation_ops: int = 0
+    priority_ops: int = 0
+    per_client_phase: Dict[Any, List[int]] = field(default_factory=dict)
+    add_request_timer: ProfileTimer = field(default_factory=ProfileTimer)
+    request_complete_timer: ProfileTimer = field(default_factory=ProfileTimer)
+
+
+class SimulatedServer:
+    """Service station behind a QoS queue
+    (reference SimulatedServer, sim_server.h:31-242).
+
+    ``threads`` service slots each take ``op_time * cost`` of virtual
+    time per op, with op_time = threads/iops so aggregate service rate
+    is ``iops`` (reference ctor, sim_server.h:136-139).
+    """
+
+    def __init__(self, server_id: Any, iops: float, threads: int,
+                 queue, loop: EventLoop,
+                 client_resp_f: Callable[[Any, Any, Phase, int, Any], None],
+                 trace: Optional[list] = None):
+        self.id = server_id
+        self.queue = queue
+        self.loop = loop
+        self.client_resp_f = client_resp_f
+        self.threads = threads
+        # reference rounds to whole microseconds (sim_server.h:137-139)
+        self.op_time_ns = int(0.5 + threads * 1e6 / iops) * 1000
+        self.busy = 0
+        self.stats = ServerStats()
+        self.trace = trace
+        self._wake_at: Optional[int] = None
+
+    # the "network" seam: a client submits a request here
+    # (reference SimulatedServer::post, sim_server.h:162-177)
+    def post(self, request: Any, client_id: Any, req_params: ReqParams,
+             cost: int) -> None:
+        t = self.stats.add_request_timer
+        t.start()
+        self.queue.add_request(request, client_id, req_params,
+                               time_ns=self.loop.now_ns, cost=cost)
+        t.stop()
+        self._dispatch()
+
+    def _dispatch(self) -> None:
+        while self.busy < self.threads:
+            pr = self.queue.pull_request(self.loop.now_ns)
+            if pr.is_retn():
+                self.busy += 1
+                self._start_service(pr)
+            elif pr.is_future():
+                when = pr.when_ready
+                if self._wake_at is None or when < self._wake_at:
+                    self._wake_at = when
+                    self.loop.at(max(when, self.loop.now_ns), self._wake)
+                break
+            else:
+                break
+
+    def _wake(self) -> None:
+        self._wake_at = None
+        self._dispatch()
+
+    def _start_service(self, pr) -> None:
+        if self.trace is not None:
+            self.trace.append((self.loop.now_ns, self.id, pr.client,
+                               int(pr.phase), pr.cost))
+        phase_idx = self.stats.per_client_phase.setdefault(
+            pr.client, [0, 0])
+        phase_idx[int(pr.phase)] += 1
+        self.stats.ops_completed += 1
+        if pr.phase is Phase.RESERVATION:
+            self.stats.reservation_ops += 1
+        else:
+            self.stats.priority_ops += 1
+
+        def complete(client=pr.client, request=pr.request,
+                     phase=pr.phase, cost=pr.cost):
+            self.busy -= 1
+            self.client_resp_f(client, request, phase, cost, self.id)
+            t = self.stats.request_complete_timer
+            t.start()
+            # (push-mode queues would get request_completed() here; the
+            # pull driver simply re-polls)
+            t.stop()
+            self._dispatch()
+
+        self.loop.after(self.op_time_ns * pr.cost, complete)
+
+
+# ----------------------------------------------------------------------
+# client
+# ----------------------------------------------------------------------
+
+@dataclass
+class ClientStats:
+    """Per-client accounting (reference InternalStats sim_client.h:80-95
+    + per-interval op counts, simulate.h:214-270)."""
+
+    ops_requested: int = 0
+    ops_completed: int = 0
+    reservation_ops: int = 0
+    priority_ops: int = 0
+    completion_times_ns: List[int] = field(default_factory=list)
+    finish_time_ns: Optional[int] = None
+    get_req_params_timer: ProfileTimer = field(default_factory=ProfileTimer)
+    track_resp_timer: ProfileTimer = field(default_factory=ProfileTimer)
+
+
+class SimulatedClient:
+    """Closed-loop load generator
+    (reference SimulatedClient, sim_client.h:76-336): rate-limited to
+    ``iops_goal`` with at most ``outstanding_ops`` in flight, after an
+    initial ``wait``."""
+
+    def __init__(self, client_id: Any, group: ClientGroup, tracker,
+                 loop: EventLoop,
+                 server_select_f: Callable[[int], Any],
+                 submit_f: Callable[[Any, Any, Any, ReqParams, int], None],
+                 on_done: Callable[[Any], None]):
+        self.id = client_id
+        self.group = group
+        self.tracker = tracker
+        self.loop = loop
+        self.server_select_f = server_select_f
+        self.submit_f = submit_f
+        self.on_done = on_done
+        self.stats = ClientStats()
+        # reference rounds the inter-request gap to whole microseconds
+        # (CliInst ctor, sim_client.h:66-68)
+        self.gap_ns = int(0.5 + 1e6 / group.client_iops_goal) * 1000
+        self.total_ops = group.client_total_ops
+        self.max_outstanding = group.client_outstanding_ops
+        self.cost = group.client_req_cost
+        self.outstanding = 0
+        self.sent = 0
+        self._window_blocked = False
+        loop.at(int(group.client_wait_s * NS_PER_SEC), self._attempt_send)
+
+    def _attempt_send(self) -> None:
+        if self.sent >= self.total_ops:
+            return
+        if self.outstanding >= self.max_outstanding:
+            # window full: the op fires as soon as a response frees it
+            # (reference run_req window wait, sim_client.h:234-236)
+            self._window_blocked = True
+            return
+        server = self.server_select_f(self.sent)
+        t = self.stats.get_req_params_timer
+        t.start()
+        rp = self.tracker.get_req_params(server)
+        t.stop()
+        self.submit_f(server, (self.id, self.sent), self.id, rp, self.cost)
+        self.sent += 1
+        self.outstanding += 1
+        self.stats.ops_requested += 1
+        if self.sent < self.total_ops:
+            self.loop.after(self.gap_ns, self._attempt_send)
+
+    # response delivery (reference receive_response + run_resp,
+    # sim_client.h:204-212, :276-335)
+    def receive_response(self, request: Any, phase: Phase, cost: int,
+                         server: Any) -> None:
+        t = self.stats.track_resp_timer
+        t.start()
+        self.tracker.track_resp(server, phase, cost)
+        t.stop()
+        self.outstanding -= 1
+        self.stats.ops_completed += 1
+        if phase is Phase.RESERVATION:
+            self.stats.reservation_ops += 1
+        else:
+            self.stats.priority_ops += 1
+        self.stats.completion_times_ns.append(self.loop.now_ns)
+        if self._window_blocked:
+            self._window_blocked = False
+            self._attempt_send()
+        if self.sent >= self.total_ops and self.outstanding == 0:
+            self.stats.finish_time_ns = self.loop.now_ns
+            self.on_done(self.id)
+
+
+# ----------------------------------------------------------------------
+# simulation orchestrator
+# ----------------------------------------------------------------------
+
+class Simulation:
+    """Build servers+clients from a SimConfig and run to completion
+    (reference Simulation, simulate.h:33-445).
+
+    queue_factory(server_id, client_info_f, anticipation_timeout_ns,
+                  soft_limit) -> queue with add_request/pull_request
+    tracker_factory() -> tracker with get_req_params/track_resp
+    """
+
+    def __init__(self, cfg: SimConfig, queue_factory, tracker_factory,
+                 seed: int = 12345, record_trace: bool = False):
+        self.cfg = cfg
+        self.loop = EventLoop()
+        self.trace: Optional[list] = [] if record_trace else None
+        self._rng = random.Random(seed)
+        self._done_clients = set()
+
+        # client-id -> group index; ids are dense ints (servers too)
+        self.client_group_of: Dict[int, int] = {}
+        cid = 0
+        for gi, g in enumerate(cfg.cli_group):
+            for _ in range(g.client_count):
+                self.client_group_of[cid] = gi
+                cid += 1
+        self.n_clients = cid
+
+        self.server_group_of: Dict[int, int] = {}
+        sid = 0
+        for gi, g in enumerate(cfg.srv_group):
+            for _ in range(g.server_count):
+                self.server_group_of[sid] = gi
+                sid += 1
+        self.n_servers = sid
+
+        from ..core import ClientInfo
+        self._infos = [ClientInfo(g.client_reservation, g.client_weight,
+                                  g.client_limit) for g in cfg.cli_group]
+
+        def client_info_f(c):
+            return self._infos[self.client_group_of[c]]
+
+        self.servers: Dict[int, SimulatedServer] = {}
+        anticipation_ns = int(cfg.anticipation_timeout_s * NS_PER_SEC)
+        for s in range(self.n_servers):
+            g = cfg.srv_group[self.server_group_of[s]]
+            q = queue_factory(s, client_info_f, anticipation_ns,
+                              cfg.server_soft_limit)
+            self.servers[s] = SimulatedServer(
+                s, g.server_iops, g.server_threads, q, self.loop,
+                self._client_resp, trace=self.trace)
+
+        self.clients: Dict[int, SimulatedClient] = {}
+        for c in range(self.n_clients):
+            g = cfg.cli_group[self.client_group_of[c]]
+            select = self._make_server_select(c, g)
+            self.clients[c] = SimulatedClient(
+                c, g, tracker_factory(), self.loop, select,
+                self._submit, self._client_done)
+
+        self._wall_start = None
+        self._wall_elapsed_s = None
+
+    # -- server-selection policies (reference simulate.h:398-444) -----
+    def _make_server_select(self, client_idx: int, g: ClientGroup):
+        servers_per = min(g.client_server_select_range, self.n_servers)
+        factor = self.n_servers / max(1, self.n_clients)
+        if self.cfg.server_random_selection:
+            def select(seed: int) -> int:
+                offset = self._rng.randrange(servers_per)
+                return (int(0.5 + client_idx * factor) + offset) \
+                    % self.n_servers
+        else:
+            def select(seed: int) -> int:
+                offset = seed % servers_per
+                return (int(0.5 + client_idx * factor) + offset) \
+                    % self.n_servers
+        return select
+
+    # -- the callback "network" (reference test_dmclock_main.cc:146-188)
+    def _submit(self, server, request, client_id, rp, cost):
+        self.servers[server].post(request, client_id, rp, cost)
+
+    def _client_resp(self, client, request, phase, cost, server):
+        self.clients[client].receive_response(request, phase, cost, server)
+
+    def _client_done(self, client_id):
+        self._done_clients.add(client_id)
+
+    def run(self) -> None:
+        """Run to completion (reference Simulation::run, simulate.h:159-178)."""
+        self._wall_start = _walltime.perf_counter()
+        self.loop.run()
+        self._wall_elapsed_s = _walltime.perf_counter() - self._wall_start
+        assert len(self._done_clients) == self.n_clients, \
+            f"only {len(self._done_clients)}/{self.n_clients} clients finished"
+
+    # -- reporting (reference display_stats, simulate.h:181-395) -------
+    def report(self) -> "SimReport":
+        return SimReport(self)
+
+
+class SimReport:
+    """Aggregated results with a text table in the spirit of the
+    reference's display_stats output."""
+
+    def __init__(self, sim: Simulation):
+        self.sim = sim
+        self.virtual_duration_s = sim.loop.now_ns / NS_PER_SEC
+        self.wall_seconds = sim._wall_elapsed_s
+        self.total_ops = sum(c.stats.ops_completed
+                             for c in sim.clients.values())
+        self.total_reservation_ops = sum(c.stats.reservation_ops
+                                         for c in sim.clients.values())
+        self.total_priority_ops = sum(c.stats.priority_ops
+                                      for c in sim.clients.values())
+
+    def client_interval_ops(self, interval_s: float = 1.0) -> Dict[int, List[int]]:
+        out = {}
+        step = int(interval_s * NS_PER_SEC)
+        for cid, c in self.sim.clients.items():
+            if not c.stats.completion_times_ns:
+                out[cid] = []
+                continue
+            hi = max(c.stats.completion_times_ns)
+            buckets = [0] * (hi // step + 1)
+            for t in c.stats.completion_times_ns:
+                buckets[t // step] += 1
+            out[cid] = buckets
+        return out
+
+    def format(self, show_intervals: bool = False) -> str:
+        sim = self.sim
+        lines = []
+        lines.append(f"=== simulation report ===")
+        lines.append(f"clients: {sim.n_clients}  servers: {sim.n_servers}")
+        lines.append(f"virtual duration: {self.virtual_duration_s:.3f} s; "
+                     f"wall: {self.wall_seconds:.3f} s")
+        lines.append(f"total ops: {self.total_ops} "
+                     f"(reservation {self.total_reservation_ops}, "
+                     f"priority {self.total_priority_ops})")
+
+        # per-client-group summary
+        lines.append("-- client groups --")
+        for gi, g in enumerate(sim.cfg.cli_group):
+            cids = [c for c, gg in sim.client_group_of.items() if gg == gi]
+            ops = sum(sim.clients[c].stats.ops_completed for c in cids)
+            res = sum(sim.clients[c].stats.reservation_ops for c in cids)
+            prop = sum(sim.clients[c].stats.priority_ops for c in cids)
+            finish = max((sim.clients[c].stats.finish_time_ns or 0)
+                         for c in cids) / NS_PER_SEC
+            rate = ops / finish if finish else 0.0
+            lines.append(
+                f"group {gi}: {len(cids)} clients  r={g.client_reservation}"
+                f" w={g.client_weight} l={g.client_limit}"
+                f" | ops {ops} (res {res} / prop {prop})"
+                f" | done @ {finish:.2f}s | average {rate:.2f} ops/s")
+
+        # host-call latency averages (the numbers the reference
+        # benchmark greps, simulate.h:306-395)
+        add_t = ProfileTimer()
+        for s in sim.servers.values():
+            st = s.stats.add_request_timer
+            if st.count:
+                add_t.count += st.count
+                add_t.sum_ns += st.sum_ns
+        gr_t = ProfileTimer()
+        tr_t = ProfileTimer()
+        for c in sim.clients.values():
+            for acc, src in ((gr_t, c.stats.get_req_params_timer),
+                             (tr_t, c.stats.track_resp_timer)):
+                if src.count:
+                    acc.count += src.count
+                    acc.sum_ns += src.sum_ns
+        lines.append("-- server internal stats --")
+        lines.append(f"average add_request: {add_t.mean_ns():.0f} ns")
+        lines.append("-- client internal stats --")
+        lines.append(f"average get_req_params: {gr_t.mean_ns():.0f} ns")
+        lines.append(f"average track_resp: {tr_t.mean_ns():.0f} ns")
+
+        if show_intervals:
+            lines.append("-- per-client interval ops/sec --")
+            for cid, buckets in self.client_interval_ops().items():
+                lines.append(f"client {cid}: " +
+                             " ".join(str(b) for b in buckets))
+        return "\n".join(lines)

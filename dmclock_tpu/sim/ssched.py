@@ -1,0 +1,51 @@
+"""Simple-scheduler (FIFO) baseline.
+
+Equivalent of the reference's ssched comparison scheduler
+(``sim/src/ssched/ssched_server.h:35-192`` SimpleQueue FIFO,
+``ssched_client.h:25-49`` no-op tracker): same add/pull surface as the
+dmclock queues so it drops into the same sim harness as a baseline.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Deque, Optional, Tuple
+
+from ..core import NextReqType, Phase, PullReq, ReqParams
+
+
+class NullServiceTracker:
+    """No-op tracker (reference ssched_client.h:26-49)."""
+
+    def get_req_params(self, server: Any) -> ReqParams:
+        return ReqParams(0, 0)
+
+    def track_resp(self, server: Any, phase: Phase, cost: int = 1) -> None:
+        pass
+
+
+class SimpleQueue:
+    """Strict-FIFO queue with the pull interface
+    (reference SimpleQueue, ssched_server.h:36-192)."""
+
+    def __init__(self):
+        self._queue: Deque[Tuple[Any, Any, int]] = deque()
+
+    def add_request(self, request: Any, client_id: Any,
+                    req_params: ReqParams = ReqParams(),
+                    time_ns: Optional[int] = None, cost: int = 1) -> int:
+        self._queue.append((client_id, request, cost))
+        return 0
+
+    def pull_request(self, now_ns: Optional[int] = None) -> PullReq:
+        if not self._queue:
+            return PullReq(NextReqType.NONE)
+        client, request, cost = self._queue.popleft()
+        return PullReq(NextReqType.RETURNING, client=client,
+                       request=request, phase=Phase.PRIORITY, cost=cost)
+
+    def request_count(self) -> int:
+        return len(self._queue)
+
+    def empty(self) -> bool:
+        return not self._queue

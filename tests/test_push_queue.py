@@ -1,0 +1,110 @@
+"""Push-mode queue tests (reference PushPriorityQueue semantics,
+dmclock_server.h:1504-1797): autonomous dispatch via handle_f, the
+can_handle gate, and the sched-ahead timed wakeup."""
+
+import threading
+import time
+
+from dmclock_tpu.core import (ClientInfo, Phase, PushPriorityQueue,
+                              ReqParams, sec_to_ns)
+
+
+def wait_until(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+class TestPushQueue:
+    def test_immediate_dispatch(self):
+        handled = []
+        q = PushPriorityQueue(lambda c: ClientInfo(0, 1, 0),
+                              can_handle_f=lambda: True,
+                              handle_f=lambda c, r, p, cost:
+                              handled.append((c, r, p, cost)),
+                              run_gc_thread=False)
+        try:
+            q.add_request("req1", 7, ReqParams())
+            assert wait_until(lambda: len(handled) == 1)
+            assert handled[0][0] == 7
+            assert handled[0][2] is Phase.PRIORITY
+        finally:
+            q.shutdown()
+
+    def test_can_handle_gates_dispatch(self):
+        handled = []
+        gate = {"open": False}
+        q = PushPriorityQueue(lambda c: ClientInfo(0, 1, 0),
+                              can_handle_f=lambda: gate["open"],
+                              handle_f=lambda c, r, p, cost:
+                              handled.append(r),
+                              run_gc_thread=False)
+        try:
+            q.add_request("r", 1, ReqParams())
+            time.sleep(0.05)
+            assert handled == []
+            gate["open"] = True
+            q.request_completed()  # server signals capacity
+            assert wait_until(lambda: handled == ["r"])
+        finally:
+            q.shutdown()
+
+    def test_sched_ahead_timed_wakeup(self):
+        # a future-limited request is dispatched by the sched-ahead
+        # thread once its limit restores, without further prompting
+        handled = []
+        q = PushPriorityQueue(lambda c: ClientInfo(0, 1, 10),
+                              can_handle_f=lambda: True,
+                              handle_f=lambda c, r, p, cost:
+                              handled.append((r, time.monotonic())),
+                              at_limit=__import__(
+                                  "dmclock_tpu").AtLimit.WAIT,
+                              run_gc_thread=False)
+        try:
+            now = sec_to_ns(time.time())
+            # two requests: limit 10/s -> second eligible ~0.1s later
+            q.add_request("a", 1, ReqParams(), time_ns=now)
+            q.add_request("b", 1, ReqParams(), time_ns=now)
+            assert wait_until(lambda: len(handled) == 2, timeout_s=3.0)
+        finally:
+            q.shutdown()
+
+    def test_early_wakeup_does_not_drop_deadline(self):
+        # regression (code-review finding): a notify with a new earlier
+        # deadline while blocked must not discard the armed wakeup even
+        # if can_handle_f is False at that instant
+        handled = []
+        gate = {"open": True}
+        q = PushPriorityQueue(lambda c: ClientInfo(0, 1, 5),
+                              can_handle_f=lambda: gate["open"],
+                              handle_f=lambda c, r, p, cost:
+                              handled.append(r),
+                              run_gc_thread=False)
+        try:
+            now = sec_to_ns(time.time())
+            q.add_request("a", 1, ReqParams(), time_ns=now)
+            q.add_request("b", 1, ReqParams(), time_ns=now)  # future ~0.2s
+            gate["open"] = False
+            # poke the queue while the deadline is armed: previously
+            # this consumed the armed time inside the closed gate
+            q.request_completed()
+            gate["open"] = True
+            assert wait_until(lambda: len(handled) == 2, timeout_s=3.0), \
+                f"handled={handled}"
+        finally:
+            q.shutdown()
+
+
+class TestShutdown:
+    def test_shutdown_joins_threads(self):
+        q = PushPriorityQueue(lambda c: ClientInfo(0, 1, 0),
+                              can_handle_f=lambda: True,
+                              handle_f=lambda *a: None,
+                              run_gc_thread=True, check_time_s=0.05,
+                              idle_age_s=0.2, erase_age_s=0.4)
+        time.sleep(0.15)  # let the GC thread tick at least once
+        q.shutdown()
+        assert q.finishing

@@ -1,0 +1,186 @@
+"""Simulation-harness tests: config parsing, determinism, and
+closed-loop QoS behavior (the sim binaries double as integration tests
+in the reference; same idea here, but assertable because virtual time
+is deterministic)."""
+
+import os
+import textwrap
+
+import pytest
+
+from dmclock_tpu import models
+from dmclock_tpu.core import NS_PER_SEC
+from dmclock_tpu.sim import (ClientGroup, ServerGroup, SimConfig,
+                             parse_config_file, Simulation)
+from dmclock_tpu.sim.dmc_sim import run_sim
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_cfg(clients, servers, **global_kw):
+    return SimConfig(client_groups=len(clients), server_groups=len(servers),
+                     cli_group=clients, srv_group=servers, **global_kw)
+
+
+class TestConfig:
+    def test_parse_example_conf(self):
+        cfg = parse_config_file(os.path.join(REPO, "configs",
+                                             "dmc_sim_example.conf"))
+        assert cfg.client_groups == 4
+        assert cfg.server_groups == 1
+        assert not cfg.server_soft_limit
+        assert not cfg.server_random_selection
+        assert cfg.cli_group[2].client_weight == 2.0
+        assert cfg.cli_group[3].client_req_cost == 3
+        assert cfg.cli_group[1].client_wait_s == 5.0
+        assert cfg.srv_group[0].server_iops == 160.0
+        assert cfg.total_clients == 4
+        assert cfg.total_servers == 1
+
+    def test_defaults_match_reference(self, tmp_path):
+        # a minimal file inherits reference struct defaults
+        # (reference config.h:44-53, :92-97)
+        p = tmp_path / "min.conf"
+        p.write_text(textwrap.dedent("""\
+            [global]
+            client_groups = 1
+            server_groups = 1
+        """))
+        cfg = parse_config_file(str(p))
+        g = cfg.cli_group[0]
+        assert (g.client_count, g.client_total_ops, g.client_iops_goal) == \
+            (100, 1000, 50.0)
+        assert (g.client_reservation, g.client_limit, g.client_weight) == \
+            (20.0, 60.0, 1.0)
+        s = cfg.srv_group[0]
+        assert (s.server_count, s.server_iops, s.server_threads) == \
+            (100, 40.0, 1)
+
+
+class TestSimBehavior:
+    def test_weight_share_under_contention(self):
+        # one 100-iops server; two greedy clients with weights 1:3 and
+        # no reservation/limit -> service split ~1:3
+        cfg = make_cfg(
+            [ClientGroup(client_count=1, client_total_ops=500,
+                         client_iops_goal=200, client_outstanding_ops=32,
+                         client_reservation=0, client_limit=0,
+                         client_weight=1, client_server_select_range=1),
+             ClientGroup(client_count=1, client_total_ops=1500,
+                         client_iops_goal=200, client_outstanding_ops=32,
+                         client_reservation=0, client_limit=0,
+                         client_weight=3, client_server_select_range=1)],
+            [ServerGroup(server_count=1, server_iops=100,
+                         server_threads=1)])
+        sim = run_sim(cfg)
+        # while both are active (first ~20s), ratio should be ~1:3;
+        # compare ops completed when the faster client finishes
+        c0, c1 = sim.clients[0], sim.clients[1]
+        t1 = c1.stats.finish_time_ns
+        c0_at_t1 = sum(1 for t in c0.stats.completion_times_ns if t <= t1)
+        ratio = c1.stats.ops_completed / max(1, c0_at_t1)
+        assert 2.4 < ratio < 3.6, f"weight ratio {ratio}"
+
+    def test_limit_caps_throughput(self):
+        # client wants 200 iops from a 400-iops server but limit=50;
+        # hard limit (soft limit would legitimately break the cap on an
+        # idle server via AtLimit.ALLOW)
+        cfg = make_cfg(
+            [ClientGroup(client_count=1, client_total_ops=400,
+                         client_iops_goal=200, client_outstanding_ops=32,
+                         client_reservation=0, client_limit=50,
+                         client_weight=1, client_server_select_range=1)],
+            [ServerGroup(server_count=1, server_iops=400,
+                         server_threads=1)],
+            server_soft_limit=False)
+        sim = run_sim(cfg)
+        c = sim.clients[0]
+        dur_s = c.stats.finish_time_ns / NS_PER_SEC
+        rate = c.stats.ops_completed / dur_s
+        assert 45 <= rate <= 55, f"limited rate {rate}"
+
+    def test_reservation_floor_under_contention(self):
+        # low-weight client with r=40 keeps >=40 iops against a heavy
+        # competitor on a 100-iops server
+        cfg = make_cfg(
+            [ClientGroup(client_count=1, client_total_ops=400,
+                         client_iops_goal=100, client_outstanding_ops=32,
+                         client_reservation=40, client_limit=0,
+                         client_weight=0.001, client_server_select_range=1),
+             ClientGroup(client_count=1, client_total_ops=2000,
+                         client_iops_goal=200, client_outstanding_ops=64,
+                         client_reservation=0, client_limit=0,
+                         client_weight=10, client_server_select_range=1)],
+            [ServerGroup(server_count=1, server_iops=100,
+                         server_threads=1)])
+        sim = run_sim(cfg)
+        c0 = sim.clients[0]
+        dur_s = c0.stats.finish_time_ns / NS_PER_SEC
+        rate = c0.stats.ops_completed / dur_s
+        assert rate >= 36, f"reserved client got only {rate} ops/s"
+        assert c0.stats.reservation_ops > c0.stats.priority_ops
+
+    def test_trace_determinism(self):
+        cfg = make_cfg(
+            [ClientGroup(client_count=3, client_total_ops=200,
+                         client_iops_goal=100, client_outstanding_ops=16,
+                         client_reservation=10, client_limit=60,
+                         client_weight=1, client_server_select_range=2)],
+            [ServerGroup(server_count=2, server_iops=80,
+                         server_threads=1)])
+        s1 = run_sim(cfg, record_trace=True, seed=7)
+        s2 = run_sim(cfg, record_trace=True, seed=7)
+        assert s1.trace == s2.trace
+        assert len(s1.trace) == 600
+
+    def test_delayed_model_also_completes(self):
+        cfg = make_cfg(
+            [ClientGroup(client_count=2, client_total_ops=150,
+                         client_iops_goal=100, client_outstanding_ops=8,
+                         client_reservation=10, client_limit=0,
+                         client_weight=1, client_server_select_range=1)],
+            [ServerGroup(server_count=1, server_iops=100,
+                         server_threads=2)])
+        sim = run_sim(cfg, model="dmclock-delayed")
+        assert sum(c.stats.ops_completed
+                   for c in sim.clients.values()) == 300
+
+    def test_ssched_fifo_baseline(self):
+        cfg = make_cfg(
+            [ClientGroup(client_count=2, client_total_ops=100,
+                         client_iops_goal=100, client_outstanding_ops=8,
+                         client_server_select_range=1)],
+            [ServerGroup(server_count=1, server_iops=150,
+                         server_threads=1)])
+        sim = run_sim(cfg, model="ssched")
+        assert sum(c.stats.ops_completed
+                   for c in sim.clients.values()) == 200
+
+    def test_report_formats(self):
+        cfg = make_cfg(
+            [ClientGroup(client_count=1, client_total_ops=50,
+                         client_iops_goal=100, client_outstanding_ops=8,
+                         client_server_select_range=1)],
+            [ServerGroup(server_count=1, server_iops=100)])
+        sim = run_sim(cfg)
+        text = sim.report().format(show_intervals=True)
+        assert "average" in text and "ops" in text
+
+
+class TestMultiServerTracking:
+    def test_rho_delta_flow_across_servers(self):
+        # with several servers, delta/rho piggybacking keeps per-server
+        # views consistent: every client's tracker has entries for the
+        # servers it used, and reservation phases dominate when under
+        # reservation
+        cfg = make_cfg(
+            [ClientGroup(client_count=4, client_total_ops=200,
+                         client_iops_goal=80, client_outstanding_ops=16,
+                         client_reservation=30, client_limit=0,
+                         client_weight=1, client_server_select_range=4)],
+            [ServerGroup(server_count=4, server_iops=50,
+                         server_threads=1)])
+        sim = run_sim(cfg)
+        for c in sim.clients.values():
+            assert len(c.tracker.server_map) == 4
+            assert c.stats.ops_completed == 200

@@ -96,3 +96,27 @@ def test_proportion_floor_fixup():
     tag = RequestTag.from_prev(ZERO_TAG, info, 0, 0, time_ns=S, cost=1)
     # max(1s, 0 + 1s) = 1s
     assert tag.proportion == S
+
+
+class TestSaturation:
+    # regression (code-review finding): absurd inputs must saturate,
+    # never collide with sentinels or overflow int64 backends
+    def test_tiny_rate_saturates_not_asserts(self):
+        from dmclock_tpu.core.timebase import MAX_INV_NS, ORGANIC_TAG_CAP
+        info = ClientInfo(0.0, 1e-10, 0.0)
+        assert info.weight_inv_ns == MAX_INV_NS
+        tag = RequestTag.from_prev(ZERO_TAG, info, 0, 0, time_ns=0, cost=1)
+        assert tag.proportion == MAX_INV_NS < MAX_TAG
+
+    def test_organic_tag_capped_below_sentinel(self):
+        from dmclock_tpu.core.timebase import (MAX_INV_NS,
+                                               ORGANIC_TAG_CAP)
+        prev = ORGANIC_TAG_CAP - 5
+        got = tag_calc(0, prev, MAX_INV_NS, 2**31, True, 1)
+        assert got == ORGANIC_TAG_CAP < MAX_TAG
+
+    def test_huge_delta_charge_saturates(self):
+        from dmclock_tpu.core.timebase import MAX_CHARGE_UNITS
+        inv = rate_to_inv_ns(1.0)
+        got = tag_calc(0, 0, inv, 2**32 - 1, True, 5)
+        assert got == inv * MAX_CHARGE_UNITS
