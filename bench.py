@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Headline benchmark: dmClock scheduling decisions/sec at 100k clients.
+
+Preloads a 100k-client engine state (uniform reservation, mixed weights
+-- BASELINE.json config #3 shape), then times ``engine_run`` batches in
+advance-now mode (infinitely fast server: every launch is pure
+scheduling work).  Prints ONE json line; ``vs_baseline`` is the ratio to
+the BASELINE.json north-star target of 10M decisions/sec/chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from __graft_entry__ import _preloaded_state
+    from dmclock_tpu.engine import kernels
+
+    n_clients = 100_000
+    depth = 8
+    batch = 2048
+    state = _preloaded_state(n_clients, depth, ring=depth)
+
+    run = jax.jit(lambda st, now: kernels.engine_run(
+        st, now, batch, allow_limit_break=False, anticipation_ns=0,
+        advance_now=True))
+
+    # compile + warm
+    state, now, decs = run(state, jnp.int64(0))
+    jax.block_until_ready(decs)
+
+    total = 0
+    t0 = time.perf_counter()
+    launches = 8
+    for _ in range(launches):
+        state, now, decs = run(state, now)
+    served = int((jax.device_get(decs.type) == 0).sum())  # syncs all
+    elapsed = time.perf_counter() - t0
+    total = launches * batch  # all decisions in steady state serve
+    assert served == batch, f"engine starved: {served}/{batch}"
+
+    dps = total / elapsed
+    print(json.dumps({
+        "metric": "dmclock scheduling decisions/sec @100k clients",
+        "value": round(dps, 1),
+        "unit": "decisions/sec/chip",
+        "vs_baseline": round(dps / 10_000_000, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

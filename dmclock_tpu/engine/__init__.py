@@ -1,0 +1,32 @@
+"""TPU-batched dmClock scheduling engine.
+
+The device-resident replacement for the reference's three intrusive
+k-way heaps + mutex design (``/root/reference/src/dmclock_server.h``):
+per-client scheduler state lives as ``[capacity]`` SoA arrays in HBM
+(`state.py`), the RequestTag recurrence is a vectorized integer kernel,
+the three heap min-selections collapse into masked lexicographic argmins
+matching the oracle's total order exactly (`kernels.py`), and many
+scheduling decisions run per kernel launch via ``lax.scan``
+(`engine_run`).  `queue.py` wraps it all in the same Pull-queue API the
+oracle scheduler exposes, so the sim harness drives either backend
+interchangeably and request ordering can be compared bit-for-bit.
+
+The tag algebra is int64 nanoseconds end to end (see
+``dmclock_tpu.core.timebase``), hence the x64 requirement below.
+"""
+
+from jax import config as _config
+
+# The canonical tag algebra is int64; without x64 JAX silently truncates
+# to int32 and every tag comparison is wrong.
+_config.update("jax_enable_x64", True)
+
+from .state import EngineState, init_state  # noqa: E402
+from .kernels import engine_step, engine_run, ingest  # noqa: E402
+from .queue import TpuPullPriorityQueue  # noqa: E402
+
+__all__ = [
+    "EngineState", "init_state",
+    "engine_step", "engine_run", "ingest",
+    "TpuPullPriorityQueue",
+]

@@ -1,0 +1,447 @@
+"""Jitted dmClock kernels: tag recurrence, fused selection, batched run.
+
+Device-side equivalents of the reference's hot path, semantics pinned by
+the Python oracle (``dmclock_tpu.core.scheduler``) which is itself a
+re-implementation of ``/root/reference/src/dmclock_server.h``:
+
+- ``_make_tag``     = RequestTag recurrence / ``tag_calc`` (:145-183, :246-259)
+- ``engine_step``   = ``do_next_request`` (:1115-1186) +
+                      ``pop_process_request``/``update_next_tag`` (:1021-1073) +
+                      ``reduce_reservation_tags`` (:1077-1111),
+                      fused into one launch.  The three heap tops become
+                      masked lexicographic argmins over the same total
+                      order the oracle sorts by (tag, then creation
+                      order), which is what makes cross-backend request
+                      ordering bit-exact.
+- ``engine_run``    = ``lax.scan`` of engine_step: many scheduling
+                      decisions per launch (the batching that buys TPU
+                      throughput).
+- ``ingest``        = ``do_add_request`` (:913-1018) over a scanned op
+                      batch, including idle-reactivation prop_delta
+                      (:937-985) as a free masked min instead of the
+                      reference's O(n) scan.
+
+All arithmetic is int64 ns (see ``core.timebase``).  Everything here is
+pure and jittable; config axes (AtLimit, anticipation) are static args
+closed over by the queue wrapper's jit instances.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.timebase import (MAX_CHARGE_UNITS, MAX_TAG, MIN_TAG,
+                             LOWEST_PROP_TAG_TRIGGER, ORGANIC_TAG_CAP,
+                             TIME_MAX)
+from .state import EngineState
+
+# Masking sentinel for argmin keys: strictly above every legal key
+# (tags are <= MAX_TAG = 2^62; effective proportions reach ~1.5*2^62).
+KEY_INF = (1 << 63) - 1
+
+# Decision type codes (== core.scheduler.NextReqType values)
+RETURNING = 0
+FUTURE = 1
+NONE = 2
+
+
+class Decision(NamedTuple):
+    """One scheduling decision, device-side (oracle: NextReq/PullReq)."""
+
+    type: jnp.ndarray         # int32: RETURNING/FUTURE/NONE
+    slot: jnp.ndarray         # int32: winning client slot (-1 if none)
+    phase: jnp.ndarray        # int32: 0 reservation, 1 priority
+    cost: jnp.ndarray         # int64: served request cost
+    when: jnp.ndarray         # int64: FUTURE wake-up time (ns)
+    limit_break: jnp.ndarray  # bool: served via AtLimit::Allow fallback
+
+
+# ----------------------------------------------------------------------
+# tag algebra (vector form of core.tags)
+# ----------------------------------------------------------------------
+
+def _tag_axis(time_ns, prev, inv, dist, extreme_is_high: bool, cost):
+    """One tag axis (reference tag_calc, dmclock_server.h:246-259)."""
+    units = jnp.minimum(dist + cost, MAX_CHARGE_UNITS)
+    organic = jnp.minimum(jnp.maximum(time_ns, prev + inv * units),
+                          ORGANIC_TAG_CAP)
+    sentinel = MAX_TAG if extreme_is_high else MIN_TAG
+    return jnp.where(inv == 0, sentinel, organic)
+
+
+def _make_tag(prev_r, prev_p, prev_l, prev_arrival,
+              r_inv, w_inv, l_inv, delta, rho, time_ns, cost,
+              anticipation_ns: int):
+    """The RequestTag recurrence (reference :145-183): reservation uses
+    rho, proportion/limit use delta; anticipation backdates arrivals
+    within the window of the previous arrival (:159-161)."""
+    backdate = (time_ns - anticipation_ns) < prev_arrival
+    max_time = jnp.where(backdate, time_ns - anticipation_ns, time_ns)
+    r = _tag_axis(max_time, prev_r, r_inv, rho, True, cost)
+    p = _tag_axis(max_time, prev_p, w_inv, delta, True, cost)
+    l = _tag_axis(max_time, prev_l, l_inv, delta, False, cost)
+    return r, p, l
+
+
+def _fold_prev(prev, tag):
+    """prev_tag update skips pinned sentinels
+    (oracle ClientRec.update_req_tag; reference :399-412)."""
+    pinned = (tag == MAX_TAG) | (tag == MIN_TAG)
+    return jnp.where(pinned, prev, tag)
+
+
+def _min_not_0(current, possible):
+    """min where 0 means "no time" (reference min_not_0_time :1192-1195)."""
+    return jnp.where(possible == 0, current,
+                     jnp.minimum(current, possible))
+
+
+# ----------------------------------------------------------------------
+# selection: masked lexicographic argmin = a heap top
+# ----------------------------------------------------------------------
+
+def _masked_argmin(mask, key, order):
+    """Top of a 'heap' ordered by (mask desc, key asc, order asc).
+
+    Returns (valid, index, min_key).  Two-stage: min key among mask,
+    then min creation order among key-ties -- the oracle's exact
+    tie-break, so selection is deterministic and backend-independent.
+    """
+    k = jnp.where(mask, key, KEY_INF)
+    min_key = jnp.min(k)
+    tie = k == min_key
+    idx = jnp.argmin(jnp.where(tie, order, KEY_INF)).astype(jnp.int32)
+    return jnp.any(mask), idx, min_key
+
+
+# ----------------------------------------------------------------------
+# one scheduling decision (fused select + pop + retag)
+# ----------------------------------------------------------------------
+
+def engine_step(state: EngineState, now: jnp.ndarray, *,
+                allow_limit_break: bool,
+                anticipation_ns: int):
+    """One ``do_next_request`` + serve, fully on device.
+
+    Mirrors the oracle's decision order exactly: reservation phase,
+    ready promotion, weight phase, optional Allow limit-break, else
+    future/none (reference :1115-1186).
+    """
+    has_req = state.active & (state.depth > 0)
+    eff_prop = state.head_prop + state.prop_delta
+
+    # --- reservation heap top; constraint phase (:1124-1128)
+    resv_valid, resv_idx, resv_min = _masked_argmin(
+        has_req, state.head_resv, state.order)
+    serve_resv = resv_valid & (resv_min <= now)
+
+    # --- promote newly within-limit heads to ready (:1135-1144);
+    # the oracle's promote loop marks exactly {head.limit <= now}, which
+    # here is one mask op.  Gated on the reservation phase NOT serving:
+    # the oracle returns before the promote loop in that case, and the
+    # ready flags are persistent state, so promoting early would diverge
+    # under non-monotonic injected pull times.
+    head_ready = jnp.where(
+        serve_resv, state.head_ready,
+        state.head_ready | (has_req & ~state.head_ready &
+                            (state.head_limit <= now)))
+
+    # --- ready heap top; weight phase (:1146-1151)
+    ready_mask = has_req & head_ready
+    rdy_valid, rdy_idx, _ = _masked_argmin(ready_mask, eff_prop,
+                                           state.order)
+    serve_ready = (~serve_resv) & rdy_valid & \
+        (state.head_prop[rdy_idx] < MAX_TAG)
+
+    # --- overall ready-heap top (ready clients sort before non-ready:
+    # oracle _ready_key) -- needed for the Allow fallback (:1157-1165)
+    nonready_mask = has_req & ~head_ready
+    nr_valid, nr_idx, _ = _masked_argmin(nonready_mask, eff_prop,
+                                         state.order)
+    overall_idx = jnp.where(rdy_valid, rdy_idx, nr_idx)
+    overall_valid = rdy_valid | nr_valid
+    if allow_limit_break:
+        undecided = ~serve_resv & ~serve_ready
+        lb_ready_ok = overall_valid & \
+            (state.head_prop[overall_idx] < MAX_TAG)
+        lb_serve_ready = undecided & lb_ready_ok
+        lb_serve_resv = undecided & ~lb_ready_ok & resv_valid & \
+            (resv_min < MAX_TAG)
+    else:
+        lb_serve_ready = jnp.bool_(False)
+        lb_serve_resv = jnp.bool_(False)
+
+    # --- nothing eligible: earliest future time (:1170-1185).  The
+    # limit-heap top orders non-ready before ready (oracle _limit_key).
+    l_nr_valid, l_nr_idx, _ = _masked_argmin(
+        nonready_mask, state.head_limit, state.order)
+    l_r_valid, l_r_idx, _ = _masked_argmin(
+        ready_mask, state.head_limit, state.order)
+    lim_idx = jnp.where(l_nr_valid, l_nr_idx, l_r_idx)
+    lim_valid = l_nr_valid | l_r_valid
+    next_call = jnp.int64(TIME_MAX)
+    next_call = jnp.where(resv_valid, _min_not_0(next_call, resv_min),
+                          next_call)
+    next_call = jnp.where(lim_valid,
+                          _min_not_0(next_call, state.head_limit[lim_idx]),
+                          next_call)
+
+    serving = serve_resv | serve_ready | lb_serve_ready | lb_serve_resv
+    phase_is_ready = serve_ready | lb_serve_ready
+    w = jnp.where(serve_resv | lb_serve_resv, resv_idx, overall_idx)
+    limit_break = lb_serve_ready | lb_serve_resv
+
+    # ------------------------------------------------------------------
+    # serve winner w (pop_process_request :1046-1073 + update_next_tag
+    # :1021-1036 + reduce_reservation_tags :1077-1111)
+    # ------------------------------------------------------------------
+    served_r = state.head_resv[w]
+    served_p = state.head_prop[w]
+    served_l = state.head_limit[w]
+    served_arr = state.head_arrival[w]
+    served_cost = state.head_cost[w]
+    served_rho = state.head_rho[w]
+
+    new_depth = state.depth[w] - 1
+    has_more = new_depth > 0
+
+    # pop the oldest tail element as the new head
+    rq = state.q_head[w]
+    narr = state.q_arrival[w, rq]
+    ncost = state.q_cost[w, rq]
+
+    # delayed tagging of the new head: recurrence predecessor is the
+    # just-served tag, with the client's latest rho/delta (:1021-1036)
+    nr_tag, np_tag, nl_tag = _make_tag(
+        served_r, served_p, served_l, served_arr,
+        state.resv_inv[w], state.weight_inv[w], state.limit_inv[w],
+        state.cur_delta[w], state.cur_rho[w], narr, ncost,
+        anticipation_ns)
+
+    # weight-phase service pays reservation debt (:1077-1111); under
+    # delayed calc only the head (here: the freshly-tagged new head)
+    # and prev_tag are adjusted
+    offset = jnp.where(phase_is_ready,
+                       state.resv_inv[w] * (served_cost + served_rho),
+                       jnp.int64(0))
+
+    # prev_tag folds in the new head tag (update_req_tag), then the
+    # reservation offset -- matching the oracle's operation order
+    new_prev_r = jnp.where(has_more,
+                           _fold_prev(state.prev_resv[w], nr_tag),
+                           state.prev_resv[w]) - offset
+    new_prev_p = jnp.where(has_more,
+                           _fold_prev(state.prev_prop[w], np_tag),
+                           state.prev_prop[w])
+    new_prev_l = jnp.where(has_more,
+                           _fold_prev(state.prev_limit[w], nl_tag),
+                           state.prev_limit[w])
+    new_prev_arr = jnp.where(has_more, narr, state.prev_arrival[w])
+
+    def upd(arr, value, pred):
+        return arr.at[w].set(jnp.where(serving & pred, value, arr[w]))
+
+    true1 = jnp.bool_(True)
+    state = state._replace(
+        depth=upd(state.depth, new_depth.astype(jnp.int32), true1),
+        q_head=upd(state.q_head,
+                   ((rq + 1) % state.ring_capacity).astype(jnp.int32),
+                   has_more),
+        head_resv=upd(state.head_resv, nr_tag - offset, has_more),
+        head_prop=upd(state.head_prop, np_tag, has_more),
+        head_limit=upd(state.head_limit, nl_tag, has_more),
+        head_arrival=upd(state.head_arrival, narr, has_more),
+        head_cost=upd(state.head_cost, ncost, has_more),
+        head_rho=upd(state.head_rho, state.cur_rho[w], has_more),
+        head_ready=head_ready.at[w].set(
+            jnp.where(serving, False, head_ready[w])),
+        prev_resv=upd(state.prev_resv, new_prev_r, true1),
+        prev_prop=upd(state.prev_prop, new_prev_p, true1),
+        prev_limit=upd(state.prev_limit, new_prev_l, true1),
+        prev_arrival=upd(state.prev_arrival, new_prev_arr, true1),
+    )
+
+    decision = Decision(
+        type=jnp.where(serving, RETURNING,
+                       jnp.where(next_call < TIME_MAX, FUTURE,
+                                 NONE)).astype(jnp.int32),
+        slot=jnp.where(serving, w, -1).astype(jnp.int32),
+        phase=phase_is_ready.astype(jnp.int32),
+        cost=jnp.where(serving, served_cost, 0),
+        when=next_call,
+        limit_break=jnp.asarray(limit_break, dtype=bool),
+    )
+    return state, decision
+
+
+def engine_run(state: EngineState, now: jnp.ndarray, steps: int, *,
+               allow_limit_break: bool, anticipation_ns: int,
+               advance_now: bool = False):
+    """``steps`` scheduling decisions in one launch via lax.scan.
+
+    With a fixed ``now`` this equals ``steps`` successive pulls at the
+    same instant (once a FUTURE/NONE occurs, state is unchanged and all
+    later decisions repeat it).  With ``advance_now`` the virtual clock
+    jumps to each FUTURE's wake-up time -- an infinitely-fast server,
+    which is the decisions/sec benchmark mode.
+    """
+
+    def body(carry, _):
+        st, t = carry
+        st, dec = engine_step(st, t,
+                              allow_limit_break=allow_limit_break,
+                              anticipation_ns=anticipation_ns)
+        if advance_now:
+            t = jnp.where(dec.type == FUTURE, dec.when, t)
+        return (st, t), dec
+
+    (state, now), decisions = lax.scan(body, (state, now), None,
+                                       length=steps)
+    return state, now, decisions
+
+
+# ----------------------------------------------------------------------
+# ingest: batched do_add_request (+ client creation)
+# ----------------------------------------------------------------------
+
+OP_NOP = 0
+OP_ADD = 1
+OP_CREATE = 2
+
+
+class IngestOps(NamedTuple):
+    """A scanned batch of queue mutations (host-built, padded with NOPs
+    so batch shapes hit a few jit cache entries)."""
+
+    kind: jnp.ndarray     # int32[B]: OP_NOP/OP_ADD/OP_CREATE
+    slot: jnp.ndarray     # int32[B]
+    time: jnp.ndarray     # int64[B] arrival ns (ADD)
+    cost: jnp.ndarray     # int64[B]
+    rho: jnp.ndarray      # int64[B]
+    delta: jnp.ndarray    # int64[B]
+    resv_inv: jnp.ndarray   # int64[B] (CREATE)
+    weight_inv: jnp.ndarray  # int64[B]
+    limit_inv: jnp.ndarray   # int64[B]
+    order: jnp.ndarray    # int64[B] creation index (CREATE)
+
+
+def ingest(state: EngineState, ops: IngestOps, *,
+           anticipation_ns: int) -> EngineState:
+    """Apply a batch of creates/adds in order (scan), equivalent to the
+    oracle's per-call ``_do_add_request`` (reference :913-1018).
+
+    Sequencing matters: a batch may hold several ops for one client, and
+    idle-reactivation reads all other clients' state at its moment.
+    """
+
+    def body(st: EngineState, op):
+        s = op.slot
+        is_add = op.kind == OP_ADD
+        is_create = op.kind == OP_CREATE
+
+        # ---- CREATE: install a fresh ClientRec (reference :920-932)
+        def cset(arr, value):
+            return arr.at[s].set(jnp.where(is_create, value, arr[s]))
+
+        st = st._replace(
+            active=cset(st.active, True),
+            idle=cset(st.idle, True),
+            order=cset(st.order, op.order),
+            resv_inv=cset(st.resv_inv, op.resv_inv),
+            weight_inv=cset(st.weight_inv, op.weight_inv),
+            limit_inv=cset(st.limit_inv, op.limit_inv),
+            prop_delta=cset(st.prop_delta, 0),
+            prev_resv=cset(st.prev_resv, 0),
+            prev_prop=cset(st.prev_prop, 0),
+            prev_limit=cset(st.prev_limit, 0),
+            prev_arrival=cset(st.prev_arrival, 0),
+            cur_rho=cset(st.cur_rho, 1),
+            cur_delta=cset(st.cur_delta, 1),
+            depth=cset(st.depth, 0),
+            q_head=cset(st.q_head, 0),
+            head_ready=cset(st.head_ready, False),
+        )
+
+        # ---- ADD (reference do_add_request :913-1018)
+        # idle reactivation (:937-985): lowest effective proportion tag
+        # among other non-idle clients, as a masked min (the adding
+        # client is still marked idle here, excluding itself -- same as
+        # the oracle's scan)
+        others = st.active & ~st.idle
+        eff = jnp.where(st.depth > 0, st.head_prop, st.prev_prop) \
+            + st.prop_delta
+        lowest = jnp.min(jnp.where(others, eff, KEY_INF))
+        do_shift = is_add & st.idle[s] & jnp.any(others) & \
+            (lowest < LOWEST_PROP_TAG_TRIGGER)
+        st = st._replace(
+            prop_delta=st.prop_delta.at[s].set(
+                jnp.where(do_shift, lowest - op.time, st.prop_delta[s])),
+            idle=st.idle.at[s].set(jnp.where(is_add, False, st.idle[s])),
+        )
+
+        # delayed tagging (:878-893): a real tag only if the request
+        # lands at the queue head
+        empty = st.depth[s] == 0
+        tag_it = is_add & empty
+        r, p, l = _make_tag(
+            st.prev_resv[s], st.prev_prop[s], st.prev_limit[s],
+            st.prev_arrival[s],
+            st.resv_inv[s], st.weight_inv[s], st.limit_inv[s],
+            op.delta, op.rho, op.time, op.cost, anticipation_ns)
+
+        def hset(arr, value, pred=tag_it):
+            return arr.at[s].set(jnp.where(pred, value, arr[s]))
+
+        # tail ring write position (depth includes head; tail count is
+        # depth-1, so the new element lands at q_head + depth - 1)
+        wpos = (st.q_head[s] + st.depth[s] - 1) % st.ring_capacity
+        push_it = is_add & ~empty
+
+        st = st._replace(
+            head_resv=hset(st.head_resv, r),
+            head_prop=hset(st.head_prop, p),
+            head_limit=hset(st.head_limit, l),
+            head_arrival=hset(st.head_arrival, op.time),
+            head_cost=hset(st.head_cost, op.cost),
+            head_rho=hset(st.head_rho, op.rho),
+            head_ready=hset(st.head_ready, False),
+            prev_resv=hset(st.prev_resv, _fold_prev(st.prev_resv[s], r)),
+            prev_prop=hset(st.prev_prop, _fold_prev(st.prev_prop[s], p)),
+            prev_limit=hset(st.prev_limit,
+                            _fold_prev(st.prev_limit[s], l)),
+            prev_arrival=hset(st.prev_arrival, op.time),
+            q_arrival=st.q_arrival.at[s, wpos].set(
+                jnp.where(push_it, op.time, st.q_arrival[s, wpos])),
+            q_cost=st.q_cost.at[s, wpos].set(
+                jnp.where(push_it, op.cost, st.q_cost[s, wpos])),
+            depth=st.depth.at[s].set(
+                jnp.where(is_add, st.depth[s] + 1, st.depth[s])),
+            cur_rho=hset(st.cur_rho, op.rho, is_add),
+            cur_delta=hset(st.cur_delta, op.delta, is_add),
+        )
+        return st, None
+
+    state, _ = lax.scan(body, state, ops)
+    return state
+
+
+# ----------------------------------------------------------------------
+# small host-facing helpers
+# ----------------------------------------------------------------------
+
+def mark_idle(state: EngineState, slots: jnp.ndarray) -> EngineState:
+    """GC support: mark the given slots idle (oracle do_clean's idle
+    branch; reference :1206-1255)."""
+    return state._replace(idle=state.idle.at[slots].set(True))
+
+
+def deactivate(state: EngineState, slots: jnp.ndarray) -> EngineState:
+    """GC support: erase clients (slots are recycled by the host)."""
+    return state._replace(
+        active=state.active.at[slots].set(False),
+        depth=state.depth.at[slots].set(0),
+    )

@@ -1,0 +1,460 @@
+"""Host wrapper: the TPU engine behind the standard Pull-queue API.
+
+``TpuPullPriorityQueue`` speaks the same interface as the oracle
+``core.scheduler.PullPriorityQueue`` (itself mirroring the reference
+``PullPriorityQueue``, ``dmclock_server.h:1279-1501``), so the sim
+harness and tests drive either backend interchangeably.  The host side
+owns what cannot live in a compiled graph: client-id <-> slot mapping,
+request payload FIFOs, op batching/padding, capacity growth, and GC
+bookkeeping.  Everything per-request-hot runs on device.
+
+Restrictions vs the oracle (by design, documented):
+- DelayedTagCalc only -- the head-only device representation *is* the
+  delayed optimization (reference :277-280).  Consequently
+  AtLimit::Reject (which the reference asserts incompatible with
+  delayed calc, :856-857) is not offered here; use the oracle queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _walltime
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.qos import ClientInfo
+from ..core.recs import Phase, ReqParams
+from ..core.scheduler import AtLimit, NextReqType, PullReq
+from ..core.timebase import sec_to_ns
+from . import kernels
+from .kernels import (OP_ADD, OP_CREATE, OP_NOP, FUTURE, NONE, RETURNING,
+                      IngestOps)
+from .state import EngineState, init_state
+
+ClientInfoFunc = Callable[[Any], Optional[ClientInfo]]
+
+
+def _grow_rows(arr, new_n, fill):
+    pad = jnp.full((new_n - arr.shape[0],) + arr.shape[1:], fill,
+                   dtype=arr.dtype)
+    return jnp.concatenate([arr, pad], axis=0)
+
+
+class TpuPullPriorityQueue:
+    """Pull-mode dmClock queue on the batched device engine."""
+
+    def __init__(self,
+                 client_info_f: ClientInfoFunc,
+                 *,
+                 at_limit: AtLimit = AtLimit.WAIT,
+                 anticipation_timeout_ns: int = 0,
+                 capacity: int = 1024,
+                 ring_capacity: int = 64,
+                 delayed_tag_calc: bool = True,
+                 idle_age_s: float = 300.0,
+                 erase_age_s: float = 600.0,
+                 erase_max: int = 2000,
+                 monotonic_clock: Callable[[], float] =
+                 _walltime.monotonic):
+        assert delayed_tag_calc, \
+            "the TPU engine is DelayedTagCalc by construction"
+        assert at_limit in (AtLimit.WAIT, AtLimit.ALLOW), \
+            "AtLimit.REJECT needs immediate tags; use the oracle queue"
+        self.client_info_f = client_info_f
+        self.at_limit = at_limit
+        self.anticipation_timeout_ns = int(anticipation_timeout_ns)
+
+        self.data_mtx = threading.Lock()
+        self.state: EngineState = init_state(capacity, ring_capacity)
+
+        # host bookkeeping
+        self._slot_of: Dict[Any, int] = {}
+        self._client_of: Dict[int, Any] = {}
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._payloads: Dict[int, Deque[Tuple[Any, int, int]]] = {}
+        #   slot -> deque of (request, arrival_ns, cost); mirrors the
+        #   device queue so payload pops track device pops exactly
+        self._next_order = 0
+        self._pending: List[Tuple] = []  # buffered IngestOps rows
+        self._last_tick: Dict[int, int] = {}
+        self.tick = 0
+
+        # GC bookkeeping (oracle do_clean; reference :1206-1255).  The
+        # host owns the policy; the device just gets idle/deactivate
+        # scatters.  No background thread: embedders call do_clean().
+        self.idle_age_s = idle_age_s
+        self.erase_age_s = erase_age_s
+        self.erase_max = erase_max
+        self._monotonic = monotonic_clock
+        self._clean_mark_points: Deque[Tuple[float, int]] = deque()
+        self._last_erase_point = 0
+
+        # scheduling counters (reference :810-812)
+        self.reserv_sched_count = 0
+        self.prop_sched_count = 0
+        self.limit_break_sched_count = 0
+
+        self._jit_cache: Dict[Tuple, Callable] = {}
+
+    # ------------------------------------------------------------------
+    # jit plumbing
+    # ------------------------------------------------------------------
+    def _jit_ingest(self):
+        key = ("ingest", self.anticipation_timeout_ns)
+        if key not in self._jit_cache:
+            ant = self.anticipation_timeout_ns
+            self._jit_cache[key] = jax.jit(
+                lambda s, ops: kernels.ingest(s, ops, anticipation_ns=ant))
+        return self._jit_cache[key]
+
+    def _jit_run(self, steps: int, advance_now: bool):
+        key = ("run", steps, advance_now)
+        if key not in self._jit_cache:
+            allow = self.at_limit is AtLimit.ALLOW
+            ant = self.anticipation_timeout_ns
+            self._jit_cache[key] = jax.jit(
+                lambda s, t: kernels.engine_run(
+                    s, t, steps, allow_limit_break=allow,
+                    anticipation_ns=ant, advance_now=advance_now))
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------------------
+    # capacity management
+    # ------------------------------------------------------------------
+    def _grow_capacity(self) -> None:
+        st = self.state
+        old_n, new_n = st.capacity, st.capacity * 2
+        self.state = EngineState(
+            active=_grow_rows(st.active, new_n, False),
+            idle=_grow_rows(st.idle, new_n, True),
+            order=_grow_rows(st.order, new_n, 0),
+            resv_inv=_grow_rows(st.resv_inv, new_n, 0),
+            weight_inv=_grow_rows(st.weight_inv, new_n, 0),
+            limit_inv=_grow_rows(st.limit_inv, new_n, 0),
+            prop_delta=_grow_rows(st.prop_delta, new_n, 0),
+            prev_resv=_grow_rows(st.prev_resv, new_n, 0),
+            prev_prop=_grow_rows(st.prev_prop, new_n, 0),
+            prev_limit=_grow_rows(st.prev_limit, new_n, 0),
+            prev_arrival=_grow_rows(st.prev_arrival, new_n, 0),
+            cur_rho=_grow_rows(st.cur_rho, new_n, 1),
+            cur_delta=_grow_rows(st.cur_delta, new_n, 1),
+            head_resv=_grow_rows(st.head_resv, new_n, 0),
+            head_prop=_grow_rows(st.head_prop, new_n, 0),
+            head_limit=_grow_rows(st.head_limit, new_n, 0),
+            head_arrival=_grow_rows(st.head_arrival, new_n, 0),
+            head_cost=_grow_rows(st.head_cost, new_n, 1),
+            head_rho=_grow_rows(st.head_rho, new_n, 0),
+            head_ready=_grow_rows(st.head_ready, new_n, False),
+            depth=_grow_rows(st.depth, new_n, 0),
+            q_head=_grow_rows(st.q_head, new_n, 0),
+            q_arrival=_grow_rows(st.q_arrival, new_n, 0),
+            q_cost=_grow_rows(st.q_cost, new_n, 0),
+        )
+        self._free.extend(range(new_n - 1, old_n - 1, -1))
+
+    def _grow_ring(self) -> None:
+        """Double ring capacity, unrolling each row so q_head becomes 0
+        (ring positions are modulo ring_capacity, which changes)."""
+        self._flush()
+        st = self.state
+        q = st.ring_capacity
+
+        def unroll(rows):
+            return jax.vmap(lambda row, h: jnp.roll(row, -h))(
+                rows, st.q_head)
+
+        q_arrival = jnp.pad(unroll(st.q_arrival), ((0, 0), (0, q)))
+        q_cost = jnp.pad(unroll(st.q_cost), ((0, 0), (0, q)))
+        self.state = st._replace(
+            q_head=jnp.zeros_like(st.q_head),
+            q_arrival=q_arrival, q_cost=q_cost)
+
+    # ------------------------------------------------------------------
+    # op buffering
+    # ------------------------------------------------------------------
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        rows = self._pending
+        self._pending = []
+        n = len(rows)
+        # pad to a power of two to bound distinct jit shapes
+        padded = 1
+        while padded < n:
+            padded *= 2
+        cols = list(zip(*rows))
+        arrs = [np.zeros(padded, dtype=np.int64) for _ in range(10)]
+        for i, col in enumerate(cols):
+            arrs[i][:n] = col
+        ops = IngestOps(
+            kind=jnp.asarray(arrs[0], dtype=jnp.int32),
+            slot=jnp.asarray(arrs[1], dtype=jnp.int32),
+            time=jnp.asarray(arrs[2]), cost=jnp.asarray(arrs[3]),
+            rho=jnp.asarray(arrs[4]), delta=jnp.asarray(arrs[5]),
+            resv_inv=jnp.asarray(arrs[6]), weight_inv=jnp.asarray(arrs[7]),
+            limit_inv=jnp.asarray(arrs[8]), order=jnp.asarray(arrs[9]))
+        self.state = self._jit_ingest()(self.state, ops)
+
+    # ------------------------------------------------------------------
+    # public API (mirrors core.scheduler.PullPriorityQueue)
+    # ------------------------------------------------------------------
+    def add_request(self, request: Any, client_id: Any,
+                    req_params: ReqParams = ReqParams(),
+                    time_ns: Optional[int] = None, cost: int = 1) -> int:
+        if time_ns is None:
+            time_ns = sec_to_ns(_walltime.time())
+        with self.data_mtx:
+            self.tick += 1
+            slot = self._slot_of.get(client_id)
+            if slot is None:
+                info = self.client_info_f(client_id)
+                assert info is not None
+                if not self._free:
+                    self._grow_capacity()
+                slot = self._free.pop()
+                self._slot_of[client_id] = slot
+                self._client_of[slot] = client_id
+                self._payloads[slot] = deque()
+                self._pending.append(
+                    (OP_CREATE, slot, 0, 0, 0, 0,
+                     info.reservation_inv_ns, info.weight_inv_ns,
+                     info.limit_inv_ns, self._next_order))
+                self._next_order += 1
+            if len(self._payloads[slot]) >= self.state.ring_capacity:
+                self._grow_ring()
+            self._payloads[slot].append((request, time_ns, cost))
+            self._last_tick[slot] = self.tick
+            self._pending.append(
+                (OP_ADD, slot, time_ns, cost, req_params.rho,
+                 req_params.delta, 0, 0, 0, 0))
+            return 0
+
+    def _decision_to_pullreq(self, dtype: int, dslot: int, dphase: int,
+                             dcost: int, dwhen: int,
+                             dlimit_break: bool) -> PullReq:
+        if dtype == RETURNING:
+            client = self._client_of[dslot]
+            request, _arr, _cost = self._payloads[dslot].popleft()
+            if dphase == 0:
+                self.reserv_sched_count += 1
+                phase = Phase.RESERVATION
+            else:
+                self.prop_sched_count += 1
+                phase = Phase.PRIORITY
+            if dlimit_break:
+                self.limit_break_sched_count += 1
+            self._last_tick[dslot] = self.tick
+            return PullReq(NextReqType.RETURNING, client=client,
+                           request=request, phase=phase, cost=int(dcost))
+        if dtype == FUTURE:
+            return PullReq(NextReqType.FUTURE, when_ready=int(dwhen))
+        return PullReq(NextReqType.NONE)
+
+    def pull_request(self, now_ns: Optional[int] = None) -> PullReq:
+        if now_ns is None:
+            now_ns = sec_to_ns(_walltime.time())
+        with self.data_mtx:
+            self._flush()
+            self.state, _, dec = self._jit_run(1, False)(
+                self.state, jnp.int64(now_ns))
+            d = jax.device_get(dec)
+            return self._decision_to_pullreq(
+                int(d.type[0]), int(d.slot[0]), int(d.phase[0]),
+                int(d.cost[0]), int(d.when[0]), bool(d.limit_break[0]))
+
+    def pull_batch(self, now_ns: int, max_decisions: int,
+                   advance_now: bool = False) -> List[PullReq]:
+        """Up to ``max_decisions`` pulls in ONE device launch.
+
+        Returns the decision stream: RETURNING entries in service order;
+        the first non-RETURNING entry (FUTURE/NONE) terminates the list
+        (with ``advance_now`` the clock jumps over FUTUREs instead, so
+        only a trailing NONE terminates)."""
+        with self.data_mtx:
+            self._flush()
+            self.state, _, dec = self._jit_run(max_decisions, advance_now)(
+                self.state, jnp.int64(now_ns))
+            d = jax.device_get(dec)
+            out: List[PullReq] = []
+            for i in range(len(d.type)):
+                pr = self._decision_to_pullreq(
+                    int(d.type[i]), int(d.slot[i]), int(d.phase[i]),
+                    int(d.cost[i]), int(d.when[i]),
+                    bool(d.limit_break[i]))
+                if pr.is_retn():
+                    out.append(pr)
+                elif advance_now and pr.is_future():
+                    continue
+                else:
+                    out.append(pr)
+                    break
+            return out
+
+    # ------------------------------------------------------------------
+    # inspection (host mirrors; reference :545-564)
+    # ------------------------------------------------------------------
+    def empty(self) -> bool:
+        with self.data_mtx:
+            return all(not q for q in self._payloads.values()) \
+                and not any(op[0] == OP_ADD for op in self._pending)
+
+    def client_count(self) -> int:
+        with self.data_mtx:
+            return len(self._slot_of)
+
+    def request_count(self) -> int:
+        with self.data_mtx:
+            return sum(len(q) for q in self._payloads.values())
+
+    # ------------------------------------------------------------------
+    # removal / info updates (reference :567-648)
+    # ------------------------------------------------------------------
+    def update_client_info(self, client_id: Any) -> None:
+        with self.data_mtx:
+            slot = self._slot_of.get(client_id)
+            if slot is None:
+                return
+            # flush first: a buffered OP_CREATE for this slot would
+            # otherwise replay stale inverses over the update
+            self._flush()
+            info = self.client_info_f(client_id)
+            st = self.state
+            self.state = st._replace(
+                resv_inv=st.resv_inv.at[slot].set(info.reservation_inv_ns),
+                weight_inv=st.weight_inv.at[slot].set(info.weight_inv_ns),
+                limit_inv=st.limit_inv.at[slot].set(info.limit_inv_ns))
+
+    def update_client_infos(self) -> None:
+        for client_id in list(self._slot_of):
+            self.update_client_info(client_id)
+
+    def remove_by_client(self, client: Any, reverse: bool = False,
+                         accum: Optional[Callable[[Any], None]] = None
+                         ) -> None:
+        with self.data_mtx:
+            slot = self._slot_of.get(client)
+            if slot is None:
+                return
+            self._flush()
+            q = self._payloads[slot]
+            items = list(reversed(q)) if reverse else list(q)
+            if accum is not None:
+                for request, _a, _c in items:
+                    accum(request)
+            q.clear()
+            self.state = self.state._replace(
+                depth=self.state.depth.at[slot].set(0))
+
+    def remove_by_req_filter(self, filter_accum: Callable[[Any], bool],
+                             visit_backwards: bool = False) -> bool:
+        """Filtered removal (reference :567-605).  Rare/administrative,
+        so it syncs the affected clients' queues host<->device."""
+        with self.data_mtx:
+            self._flush()
+            any_removed = False
+            for slot, q in self._payloads.items():
+                if not q:
+                    continue
+                entries = list(q)
+                idxs = range(len(entries) - 1, -1, -1) if visit_backwards \
+                    else range(len(entries))
+                removed = [False] * len(entries)
+                for i in idxs:
+                    if filter_accum(entries[i][0]):
+                        removed[i] = True
+                        any_removed = True
+                if not any(removed):
+                    continue
+                kept = [e for e, r in zip(entries, removed) if not r]
+                self._payloads[slot] = deque(kept)
+                self._resync_client(slot, head_removed=removed[0],
+                                    kept=kept)
+            return any_removed
+
+    def _resync_client(self, slot: int, head_removed: bool,
+                       kept: List[Tuple[Any, int, int]]) -> None:
+        """Rewrite one client's device queue after host-side removal.
+
+        Matches oracle semantics: surviving requests keep their current
+        tags -- the old head keeps its real tag; a promoted former-tail
+        request carries the delayed-calc zero tag until it is tagged at
+        pop time (oracle ClientRec.remove_by_req_filter + _initial_tag)."""
+        st = self.state
+        n = len(kept)
+        ring = st.ring_capacity
+        arrs = np.zeros(ring, dtype=np.int64)
+        costs = np.zeros(ring, dtype=np.int64)
+        for i, (_req, a, c) in enumerate(kept[1:]):
+            arrs[i], costs[i] = a, c
+        updates = dict(
+            depth=st.depth.at[slot].set(n),
+            q_head=st.q_head.at[slot].set(0),
+            q_arrival=st.q_arrival.at[slot].set(jnp.asarray(arrs)),
+            q_cost=st.q_cost.at[slot].set(jnp.asarray(costs)),
+        )
+        if head_removed and n > 0:
+            _req, a, c = kept[0]
+            updates.update(
+                head_resv=st.head_resv.at[slot].set(0),
+                head_prop=st.head_prop.at[slot].set(0),
+                head_limit=st.head_limit.at[slot].set(0),
+                head_arrival=st.head_arrival.at[slot].set(a),
+                head_cost=st.head_cost.at[slot].set(c),
+                head_rho=st.head_rho.at[slot].set(0),
+                head_ready=st.head_ready.at[slot].set(False),
+            )
+        self.state = st._replace(**updates)
+
+    def do_clean(self) -> None:
+        """Idle-mark / erase long-inactive clients (oracle do_clean;
+        reference :1206-1255), freeing slots for reuse."""
+        now = self._monotonic()
+        with self.data_mtx:
+            self._flush()
+            self._clean_mark_points.append((now, self.tick))
+
+            erase_point = self._last_erase_point
+            while self._clean_mark_points and \
+                    self._clean_mark_points[0][0] <= now - self.erase_age_s:
+                self._last_erase_point = self._clean_mark_points[0][1]
+                erase_point = self._last_erase_point
+                self._clean_mark_points.popleft()
+
+            idle_point = 0
+            for t, tick in self._clean_mark_points:
+                if t <= now - self.idle_age_s:
+                    idle_point = tick
+                else:
+                    break
+
+            if not (erase_point or idle_point):
+                return
+            erase_slots: List[int] = []
+            idle_slots: List[int] = []
+            for slot, last in list(self._last_tick.items()):
+                if erase_point and len(erase_slots) < self.erase_max \
+                        and last <= erase_point:
+                    erase_slots.append(slot)
+                elif idle_point and last <= idle_point:
+                    idle_slots.append(slot)
+            if idle_slots:
+                self.state = kernels.mark_idle(
+                    self.state, jnp.asarray(idle_slots, dtype=jnp.int32))
+            if erase_slots:
+                self.state = kernels.deactivate(
+                    self.state, jnp.asarray(erase_slots, dtype=jnp.int32))
+                for slot in erase_slots:
+                    client = self._client_of.pop(slot)
+                    del self._slot_of[client]
+                    del self._payloads[slot]
+                    del self._last_tick[slot]
+                    self._free.append(slot)
+            if len(erase_slots) < self.erase_max:
+                self._last_erase_point = 0
+
+    def shutdown(self) -> None:
+        pass

@@ -1,0 +1,97 @@
+"""Device-resident scheduler state: the ClientState SoA.
+
+The reference keeps per-client state in heap-linked ``ClientRec`` objects
+(``dmclock_server.h:355-499``); here the same information is a struct of
+``[capacity]`` arrays living in device memory, so tag updates vectorize
+and selection is a masked argmin.  DelayedTagCalc semantics
+(``dmclock_server.h:878-893``) are what make a head-only tag
+representation sufficient: only the queue-head request of each client
+ever carries a real tag, so the device holds full tags for heads and
+just (arrival, cost) for the queued tail in a fixed-capacity ring.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class EngineState(NamedTuple):
+    """SoA over client slots.  ``capacity`` = len of every [N] array;
+    ``ring_capacity`` = Q of the [N, Q] tail rings.
+
+    Mirrors, per slot: ``ClientInfo`` cached inverses
+    (``dmclock_server.h:95-132``), ``ClientRec`` bookkeeping (:355-499),
+    and the head request's ``RequestTag`` (:135-274).
+    """
+
+    # slot bookkeeping
+    active: jnp.ndarray       # bool[N]  slot holds a live client
+    idle: jnp.ndarray         # bool[N]  ClientRec::idle
+    order: jnp.ndarray        # int64[N] creation index = selection tie-break
+
+    # QoS parameters (ClientInfo inverses, ns per unit cost)
+    resv_inv: jnp.ndarray     # int64[N]
+    weight_inv: jnp.ndarray   # int64[N]
+    limit_inv: jnp.ndarray    # int64[N]
+
+    # ClientRec scheduling state
+    prop_delta: jnp.ndarray   # int64[N] idle-reactivation shift (:937-985)
+    prev_resv: jnp.ndarray    # int64[N] prev_tag.reservation
+    prev_prop: jnp.ndarray    # int64[N] prev_tag.proportion
+    prev_limit: jnp.ndarray   # int64[N] prev_tag.limit
+    prev_arrival: jnp.ndarray  # int64[N] prev_tag.arrival (anticipation)
+    cur_rho: jnp.ndarray      # int64[N] latest ReqParams.rho (:378-379)
+    cur_delta: jnp.ndarray    # int64[N] latest ReqParams.delta
+
+    # head request tag (the only fully-tagged request per client)
+    head_resv: jnp.ndarray    # int64[N]
+    head_prop: jnp.ndarray    # int64[N]
+    head_limit: jnp.ndarray   # int64[N]
+    head_arrival: jnp.ndarray  # int64[N]
+    head_cost: jnp.ndarray    # int64[N]
+    head_rho: jnp.ndarray     # int64[N] rho the head was tagged with
+    head_ready: jnp.ndarray   # bool[N]  RequestTag::ready
+
+    # queued-tail ring (beyond the head): only (arrival, cost) is needed,
+    # because delayed tagging reads cur_rho/cur_delta at pop time
+    # (update_next_tag, dmclock_server.h:1021-1036)
+    depth: jnp.ndarray        # int32[N] request count INCLUDING head
+    q_head: jnp.ndarray       # int32[N] ring read index of oldest tail
+    q_arrival: jnp.ndarray    # int64[N, Q]
+    q_cost: jnp.ndarray       # int64[N, Q]
+
+    @property
+    def capacity(self) -> int:
+        return self.active.shape[-1]
+
+    @property
+    def ring_capacity(self) -> int:
+        return self.q_arrival.shape[-1]
+
+
+def init_state(capacity: int, ring_capacity: int = 64) -> EngineState:
+    """Fresh state: every slot free."""
+    n = capacity
+    i64 = lambda shape=(n,): jnp.zeros(shape, dtype=jnp.int64)  # noqa: E731
+    return EngineState(
+        active=jnp.zeros((n,), dtype=bool),
+        idle=jnp.ones((n,), dtype=bool),
+        order=i64(),
+        resv_inv=i64(), weight_inv=i64(), limit_inv=i64(),
+        prop_delta=i64(),
+        prev_resv=i64(), prev_prop=i64(), prev_limit=i64(),
+        prev_arrival=i64(),
+        cur_rho=jnp.ones((n,), dtype=jnp.int64),
+        cur_delta=jnp.ones((n,), dtype=jnp.int64),
+        head_resv=i64(), head_prop=i64(), head_limit=i64(),
+        head_arrival=i64(),
+        head_cost=jnp.ones((n,), dtype=jnp.int64),
+        head_rho=i64(),
+        head_ready=jnp.zeros((n,), dtype=bool),
+        depth=jnp.zeros((n,), dtype=jnp.int32),
+        q_head=jnp.zeros((n,), dtype=jnp.int32),
+        q_arrival=i64((n, ring_capacity)),
+        q_cost=i64((n, ring_capacity)),
+    )

@@ -56,8 +56,19 @@ def _dmclock_tracker():
     return ServiceTracker(run_gc_thread=False)
 
 
+def _dmclock_tpu_queue(server_id, client_info_f, anticipation_ns,
+                       soft_limit):
+    # imported lazily so the CPU-only models don't pull in jax
+    from ..engine import TpuPullPriorityQueue
+    return TpuPullPriorityQueue(
+        client_info_f,
+        at_limit=AtLimit.ALLOW if soft_limit else AtLimit.WAIT,
+        anticipation_timeout_ns=anticipation_ns)
+
+
 register("dmclock", _dmclock_queue(delayed=False), _dmclock_tracker)
 register("dmclock-delayed", _dmclock_queue(delayed=True), _dmclock_tracker)
+register("dmclock-tpu", _dmclock_tpu_queue, _dmclock_tracker)
 register("ssched",
          lambda server_id, client_info_f, anticipation_ns, soft_limit:
          SimpleQueue(),

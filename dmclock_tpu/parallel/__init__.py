@@ -1,0 +1,24 @@
+"""Distributed (multi-server / multi-chip) dmClock.
+
+The reference's entire inter-node mechanism is four piggybacked scalars:
+``ReqParams{delta, rho}`` client->server and ``PhaseType`` + cost back
+(``/root/reference/src/dmclock_recs.h:40-72``), with the client-side
+``ServiceTracker`` (``dmclock_client.h:157-287``) diffing global
+completion counters per server.  Here the same contract rides a JAX
+device mesh: each server's scheduler state is a shard on the ``servers``
+axis, per-(server, client) completion counters live sharded next to it,
+and the tracker's "global counters" are a ``psum`` over ICI -- so one
+pod simulates an N-server storage cluster in a single program (SURVEY.md
+section 2, parallelism table).
+"""
+
+from .cluster import (ClusterState, init_cluster, cluster_step,
+                      make_mesh, shard_cluster)
+from .tracker import (TrackerState, init_tracker, tracker_prepare,
+                      tracker_track)
+
+__all__ = [
+    "ClusterState", "init_cluster", "cluster_step", "make_mesh",
+    "shard_cluster",
+    "TrackerState", "init_tracker", "tracker_prepare", "tracker_track",
+]

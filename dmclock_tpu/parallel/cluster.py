@@ -1,0 +1,182 @@
+"""Mesh-sharded multi-server dmClock cluster.
+
+The TPU-native replacement for the reference's multi-server simulation
+(N ``SimulatedServer`` thread pools + callback "network",
+``sim/src/test_dmclock_main.cc:146-188``): every server's scheduler
+state is one shard of a stacked ``EngineState`` on the ``servers`` mesh
+axis, the per-(server, client) completion counters live next to it, and
+one ``cluster_step`` advances EVERY server by k scheduling decisions in
+a single program -- with the dmClock wire protocol's global counters
+computed as a ``psum`` over ICI (DCN across hosts, transparently, via
+the same collective).
+
+Layout notes (scaling-book recipe): pick the mesh, annotate shardings,
+let XLA insert the collectives.  All arrays are sharded on the leading
+``servers`` axis; the only cross-shard traffic is the [C]-sized psum of
+completion counters -- exactly the four-scalar-per-request piggyback
+contract, batched.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine import kernels
+from ..engine.state import EngineState, init_state
+from .tracker import (TrackerState, global_counters, init_tracker,
+                      tracker_prepare, tracker_track)
+
+SERVER_AXIS = "servers"
+
+
+class ClusterState(NamedTuple):
+    """Stacked per-server state; every leaf's leading axis is servers."""
+
+    engine: EngineState       # [S, ...] scheduler state per server
+    tracker: TrackerState     # [S, C] distributed-protocol counters
+    now: jnp.ndarray          # int64[S] per-server virtual clock
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (SERVER_AXIS,))
+
+
+def init_cluster(n_servers: int, n_clients: int,
+                 ring_capacity: int = 64) -> ClusterState:
+    """Host-side construction: capacity ``n_clients`` slots per server
+    (slot i == client i cluster-wide, which is what lets completion
+    counters psum by position)."""
+    one = init_state(n_clients, ring_capacity)
+    engine = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (n_servers,) + a.shape), one)
+    tracker = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (n_servers,) + a.shape),
+        init_tracker(n_clients))
+    return ClusterState(engine=engine, tracker=tracker,
+                        now=jnp.zeros((n_servers,), dtype=jnp.int64))
+
+
+def shard_cluster(cluster: ClusterState, mesh: Mesh) -> ClusterState:
+    """Place every leaf with its leading axis split over the servers
+    mesh axis."""
+    sharding = NamedSharding(mesh, P(SERVER_AXIS))
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), cluster)
+
+
+def install_clients(cluster: ClusterState, resv_inv, weight_inv,
+                    limit_inv) -> ClusterState:
+    """Register the same client population on every server (QoS inverses
+    are [C] int64 arrays).  Creation order = client index, making the
+    cross-backend tie-break deterministic."""
+    n_servers = cluster.now.shape[0]
+    c = resv_inv.shape[0]
+
+    def bcast(a):
+        return jnp.broadcast_to(a, (n_servers, c))
+
+    eng = cluster.engine._replace(
+        active=jnp.ones((n_servers, c), dtype=bool),
+        order=bcast(jnp.arange(c, dtype=jnp.int64)),
+        resv_inv=bcast(resv_inv), weight_inv=bcast(weight_inv),
+        limit_inv=bcast(limit_inv),
+    )
+    return cluster._replace(engine=eng)
+
+
+def _one_server_step(engine: EngineState, tracker: TrackerState,
+                     now: jnp.ndarray, arrivals_per_client: jnp.ndarray,
+                     cost: jnp.ndarray, decisions_per_step: int,
+                     anticipation_ns: int, allow_limit_break: bool):
+    """One server's slice of a cluster step (runs inside shard_map with
+    a [1, ...]-shaped shard; vmapped over that unit axis).
+
+    Phase A: clients with ``arrivals_per_client[c] > 0`` send that many
+    requests, each carrying psum-derived ReqParams.
+    Phase B: the engine makes ``decisions_per_step`` decisions.
+    Phase C: completions fold into the tracker counters.
+    """
+    # --- distributed ReqParams via the psum'd global counters
+    g_delta, g_rho = global_counters(
+        tracker, lambda x: lax.psum(x, SERVER_AXIS))
+    requesting = arrivals_per_client > 0
+    tracker, delta_out, rho_out = tracker_prepare(
+        tracker, requesting, g_delta, g_rho)
+
+    # --- ingest: one op per requesting client (queued heads only; the
+    # host sim generalizes this, this step models one request per
+    # client per round which is the pod-scale benchmark shape)
+    c = arrivals_per_client.shape[0]
+    slots = jnp.arange(c, dtype=jnp.int32)
+    ops = kernels.IngestOps(
+        kind=jnp.where(requesting, kernels.OP_ADD,
+                       kernels.OP_NOP).astype(jnp.int32),
+        slot=slots,
+        time=jnp.broadcast_to(now, (c,)),
+        cost=jnp.broadcast_to(cost, (c,)),
+        rho=jnp.where(requesting, rho_out, 1),
+        delta=jnp.where(requesting, delta_out, 1),
+        resv_inv=jnp.zeros((c,), dtype=jnp.int64),
+        weight_inv=jnp.zeros((c,), dtype=jnp.int64),
+        limit_inv=jnp.zeros((c,), dtype=jnp.int64),
+        order=jnp.zeros((c,), dtype=jnp.int64),
+    )
+    engine = kernels.ingest(engine, ops, anticipation_ns=anticipation_ns)
+
+    # --- scheduling decisions
+    engine, now, decs = kernels.engine_run(
+        engine, now, decisions_per_step,
+        allow_limit_break=allow_limit_break,
+        anticipation_ns=anticipation_ns, advance_now=True)
+
+    # --- completions -> counters (the response half of the protocol)
+    served = decs.type == kernels.RETURNING
+    tracker = tracker_track(tracker, decs.slot, decs.cost, decs.phase,
+                            served)
+    return engine, tracker, now, decs
+
+
+def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
+                 cost: int, mesh: Mesh, *,
+                 decisions_per_step: int,
+                 anticipation_ns: int = 0,
+                 allow_limit_break: bool = False):
+    """Advance the whole cluster: ``arrivals`` is int32[S, C] request
+    counts (currently 0/1 per round), sharded over servers.  Returns
+    (cluster, decisions) with decisions' leaves [S, k]-shaped.
+
+    Jit this (it is pure); under jit XLA turns the psum into one ICI
+    all-reduce per step.
+    """
+
+    def shard_fn(engine, tracker, now, arr):
+        step = functools.partial(
+            _one_server_step,
+            decisions_per_step=decisions_per_step,
+            anticipation_ns=anticipation_ns,
+            allow_limit_break=allow_limit_break)
+        # shards carry a leading [1] server axis; vmap it away
+        engine, tracker, now, decs = jax.vmap(
+            lambda e, t, n, a: step(e, t, n, a,
+                                    cost=jnp.int64(cost)),
+        )(engine, tracker, now, arr)
+        return engine, tracker, now, decs
+
+    spec = P(SERVER_AXIS)
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec, spec),
+        check_vma=False)
+    engine, tracker, now, decs = fn(cluster.engine, cluster.tracker,
+                                    cluster.now, arrivals)
+    return ClusterState(engine=engine, tracker=tracker, now=now), decs

@@ -1,0 +1,114 @@
+"""Device-side distributed ServiceTracker (the psum delta/rho protocol).
+
+Vectorized equivalent of the host ``core.tracker.ServiceTracker`` with
+``OrigTracker`` accounting (reference ``dmclock_client.h:39-84``,
+``:157-287``), laid out for a mesh: state is per-(server, client), and
+the client's *global* completion counters -- which the host tracker
+keeps as plain ints -- become a ``psum`` of per-server counters over the
+``servers`` mesh axis.
+
+Per (server s, client c), mirroring OrigTracker's fields:
+  ``last_mark``  = global counter value at c's previous request to s
+                   (``delta_prev_req``/``rho_prev_req``)
+  ``own_since``  = c's completions AT s since that request
+                   (``my_delta``/``my_rho``)
+so a request from c to s carries
+  ``delta_out = global_delta[c] - last_mark[s,c] - own_since[s,c]``
+(reference ``prepare_req``, dmclock_client.h:59-67).
+
+Counters start at 1, matching ``GlobalCounters`` (dmclock_client.h:191-198).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class TrackerState(NamedTuple):
+    """Per-server shard of the distributed tracker ([C] arrays local to
+    one server; stack/shard a leading ``servers`` axis for a cluster)."""
+
+    completed_delta: jnp.ndarray  # int64[C] completions served here, by client
+    completed_rho: jnp.ndarray    # int64[C] reservation-phase subset
+    last_mark_delta: jnp.ndarray  # int64[C] global delta at last request here
+    last_mark_rho: jnp.ndarray    # int64[C]
+    seen: jnp.ndarray             # bool[C] client has contacted this server
+
+
+def init_tracker(n_clients: int) -> TrackerState:
+    z = jnp.zeros((n_clients,), dtype=jnp.int64)
+    return TrackerState(
+        completed_delta=z, completed_rho=z,
+        last_mark_delta=z, last_mark_rho=z,
+        seen=jnp.zeros((n_clients,), dtype=bool),
+    )
+
+
+def global_counters(tracker: TrackerState, psum):
+    """The client-global counters: psum of per-server completions over
+    the mesh, plus the reference's start-at-1 offset.
+
+    ``psum`` is the collective to use -- ``lambda x: lax.psum(x,
+    'servers')`` inside shard_map, or a plain sum for unsharded use.
+    """
+    return 1 + psum(tracker.completed_delta), \
+        1 + psum(tracker.completed_rho)
+
+
+def tracker_track(tracker: TrackerState, slots: jnp.ndarray,
+                  costs: jnp.ndarray, phases: jnp.ndarray,
+                  served: jnp.ndarray) -> TrackerState:
+    """Fold a batch of completions at THIS server into the counters
+    (reference resp_update, dmclock_client.h:69-79): delta always, rho
+    only for reservation-phase service.
+
+    slots/costs/phases/served are the decision-stream arrays from
+    ``engine_run`` (phase 0 = reservation).
+    """
+    idx = jnp.where(served, slots, 0)
+    add = jnp.where(served, costs, 0)
+    add_rho = jnp.where(served & (phases == 0), costs, 0)
+    return tracker._replace(
+        completed_delta=tracker.completed_delta.at[idx].add(add),
+        completed_rho=tracker.completed_rho.at[idx].add(add_rho),
+    )
+
+
+def tracker_prepare(tracker: TrackerState, requesting: jnp.ndarray,
+                    global_delta: jnp.ndarray, global_rho: jnp.ndarray):
+    """ReqParams for every client in ``requesting`` (bool[C]) sending its
+    next request to THIS server (reference prepare_req + the first-
+    contact ReqParams(1,1) case, dmclock_client.h:241-251).
+
+    Returns (new_tracker, delta_out[C], rho_out[C]) with outputs valid
+    where ``requesting``.
+    """
+    # OrigTracker's algebra: delta_out = (global movement since the
+    # previous request here) - (own completions here since then), i.e.
+    #   delta_out = (global - global_mark) - (own - own_mark).
+    # One stored field suffices: last_mark_delta keeps
+    # ``global_mark - own_mark``, so
+    #   delta_out = global - completed - last_mark_delta
+    # and re-marking stores ``global - completed`` again.
+    mark = tracker.last_mark_delta
+    mark_rho = tracker.last_mark_rho
+    delta_out = jnp.where(
+        tracker.seen,
+        global_delta - tracker.completed_delta - mark,
+        1)
+    rho_out = jnp.where(
+        tracker.seen,
+        global_rho - tracker.completed_rho - mark_rho,
+        1)
+    new_mark = jnp.where(requesting,
+                         global_delta - tracker.completed_delta, mark)
+    new_mark_rho = jnp.where(requesting,
+                             global_rho - tracker.completed_rho, mark_rho)
+    tracker = tracker._replace(
+        last_mark_delta=new_mark,
+        last_mark_rho=new_mark_rho,
+        seen=tracker.seen | requesting,
+    )
+    return tracker, delta_out, rho_out

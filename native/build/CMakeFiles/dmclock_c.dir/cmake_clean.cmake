@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/dmclock_c.dir/src/capi.cc.o"
+  "CMakeFiles/dmclock_c.dir/src/capi.cc.o.d"
+  "libdmclock_c.pdb"
+  "libdmclock_c.so"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/dmclock_c.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

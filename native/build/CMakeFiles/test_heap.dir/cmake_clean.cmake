@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_heap.dir/tests/test_heap.cc.o"
+  "CMakeFiles/test_heap.dir/tests/test_heap.cc.o.d"
+  "test_heap"
+  "test_heap.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_heap.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

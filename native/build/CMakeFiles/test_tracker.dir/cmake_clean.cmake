@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_tracker.dir/tests/test_tracker.cc.o"
+  "CMakeFiles/test_tracker.dir/tests/test_tracker.cc.o.d"
+  "test_tracker"
+  "test_tracker.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_tracker.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,12 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/native
+# Build directory: /root/repo/native/build
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test(heap "/root/repo/native/build/test_heap")
+set_tests_properties(heap PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;41;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test(scheduler "/root/repo/native/build/test_scheduler")
+set_tests_properties(scheduler PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;41;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test(tracker "/root/repo/native/build/test_tracker")
+set_tests_properties(tracker PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;41;add_test;/root/repo/native/CMakeLists.txt;0;")

@@ -1,0 +1,154 @@
+// Indirect intrusive k-way min-heap.
+//
+// Native equivalent of the reference's load-bearing data structure
+// (/root/reference/support/src/indirect_intrusive_heap.h:47-565),
+// redesigned: elements are held by pointer ("indirect") and every
+// element stores its own position in a caller-chosen member
+// ("intrusive"), giving O(1) element->slot lookup so schedulers can
+// promote/demote/adjust/remove an element in place without searching.
+// One element can sit in several heaps at once by dedicating one index
+// member per heap (the dmclock scheduler keeps each client in three).
+//
+// Differences from the reference by design: K is a runtime constructor
+// argument rather than a template parameter (one binary serves the
+// whole K sweep in the benchmark pipeline), and there is a single
+// sift_down for all K (the compiler unrolls the K==2 case well enough;
+// see native/benchmark).
+
+#pragma once
+
+#include <cassert>
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <ostream>
+#include <vector>
+
+namespace dmclock {
+
+constexpr size_t HEAP_NOT_IN = SIZE_MAX;
+
+// T must be a class with a `size_t T::*Index` member reserved for this
+// heap; Compare is a strict-weak "less" over T.
+template <typename T, typename Compare, size_t T::*Index>
+class IndirectHeap {
+ public:
+  explicit IndirectHeap(unsigned branching = 2, Compare cmp = Compare())
+      : k_(branching < 2 ? 2 : branching), cmp_(cmp) {}
+
+  bool empty() const { return data_.empty(); }
+  size_t size() const { return data_.size(); }
+
+  T& top() { assert(!data_.empty()); return *data_[0]; }
+  const T& top() const { assert(!data_.empty()); return *data_[0]; }
+
+  T& at(size_t i) { return *data_[i]; }
+
+  bool contains(const T& elem) const { return elem.*Index != HEAP_NOT_IN; }
+
+  void push(T* elem) {
+    size_t i = data_.size();
+    data_.push_back(elem);
+    elem->*Index = i;
+    sift_up(i);
+  }
+
+  void pop() {
+    assert(!data_.empty());
+    data_[0]->*Index = HEAP_NOT_IN;
+    if (data_.size() > 1) {
+      data_[0] = data_.back();
+      data_[0]->*Index = 0;
+      data_.pop_back();
+      sift_down(0);
+    } else {
+      data_.pop_back();
+    }
+  }
+
+  // re-establish heap order for an element whose key changed; sifts in
+  // whichever direction is needed (reference adjust, :365-367)
+  void adjust(T& elem) {
+    size_t i = elem.*Index;
+    assert(i != HEAP_NOT_IN && i < data_.size());
+    sift_up(i);
+    if (data_[i] == &elem) sift_down(i);
+  }
+
+  // key got smaller (reference promote, :357-359)
+  void promote(T& elem) { sift_up(elem.*Index); }
+
+  // key got larger (reference demote, :361-363)
+  void demote(T& elem) { sift_down(elem.*Index); }
+
+  void remove(T& elem) {
+    size_t i = elem.*Index;
+    assert(i != HEAP_NOT_IN && i < data_.size());
+    data_[i]->*Index = HEAP_NOT_IN;
+    if (i == data_.size() - 1) {
+      data_.pop_back();
+      return;
+    }
+    T* filler = data_.back();
+    data_[i] = filler;
+    data_[i]->*Index = i;
+    data_.pop_back();
+    // the filler can need movement either way (reference notes the
+    // same subtlety at indirect_intrusive_heap.h:437-441): sift down
+    // only if sift_up left it in place
+    sift_up(i);
+    if (i < data_.size() && data_[i] == filler) sift_down(i);
+  }
+
+  // iteration over raw storage (heap order, not sorted)
+  typename std::vector<T*>::iterator begin() { return data_.begin(); }
+  typename std::vector<T*>::iterator end() { return data_.end(); }
+
+  template <typename Fn>
+  void display_sorted(std::ostream& os, Fn&& fmt) const {
+    std::vector<T*> copy = data_;
+    std::sort(copy.begin(), copy.end(),
+              [this](T* a, T* b) { return cmp_(*a, *b); });
+    for (T* e : copy) fmt(os, *e);
+  }
+
+  unsigned branching_factor() const { return k_; }
+
+ private:
+  void sift_up(size_t i) {
+    while (i > 0) {
+      size_t parent = (i - 1) / k_;
+      if (!cmp_(*data_[i], *data_[parent])) break;
+      swap_at(i, parent);
+      i = parent;
+    }
+  }
+
+  void sift_down(size_t i) {
+    const size_t n = data_.size();
+    for (;;) {
+      size_t first = i * k_ + 1;
+      if (first >= n) break;
+      size_t last = first + k_;
+      if (last > n) last = n;
+      size_t best = first;
+      for (size_t c = first + 1; c < last; ++c)
+        if (cmp_(*data_[c], *data_[best])) best = c;
+      if (!cmp_(*data_[best], *data_[i])) break;
+      swap_at(i, best);
+      i = best;
+    }
+  }
+
+  void swap_at(size_t a, size_t b) {
+    std::swap(data_[a], data_[b]);
+    data_[a]->*Index = a;
+    data_[b]->*Index = b;
+  }
+
+  unsigned k_;
+  Compare cmp_;
+  std::vector<T*> data_;
+};
+
+}  // namespace dmclock

@@ -1,0 +1,71 @@
+// Nanosecond profiling accumulators.
+//
+// Native equivalent of the reference's ProfileTimer / ProfileCombiner
+// (/root/reference/support/src/profile.h:25-120) and python
+// utils/profile.py: count / sum / sum-of-squares / min / max over
+// timed sections, mergeable across threads.  Always compiled (the
+// reference gates them behind -DPROFILE; here the sim decides at
+// runtime whether to record).
+
+#pragma once
+
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <limits>
+
+namespace dmclock {
+
+struct ProfileBase {
+  uint64_t count = 0;
+  int64_t sum_ns = 0;
+  double sum_sq_ns = 0.0;  // for std-dev (reference :43-51)
+  int64_t min_ns = std::numeric_limits<int64_t>::max();
+  int64_t max_ns = 0;
+
+  void record(int64_t ns) {
+    ++count;
+    sum_ns += ns;
+    sum_sq_ns += double(ns) * double(ns);
+    if (ns < min_ns) min_ns = ns;
+    if (ns > max_ns) max_ns = ns;
+  }
+
+  double mean_ns() const { return count ? double(sum_ns) / count : 0.0; }
+
+  double stddev_ns() const {
+    if (count < 2) return 0.0;
+    double m = mean_ns();
+    return std::sqrt(sum_sq_ns / count - m * m);
+  }
+};
+
+class ProfileTimer : public ProfileBase {
+ public:
+  void start() { start_ = std::chrono::steady_clock::now(); }
+  void stop() {
+    auto ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                  std::chrono::steady_clock::now() - start_)
+                  .count();
+    record(ns);
+  }
+
+ private:
+  std::chrono::steady_clock::time_point start_;
+};
+
+// merge per-thread/per-object timers for reporting
+// (reference ProfileCombiner :100-120)
+struct ProfileCombiner : ProfileBase {
+  void combine(const ProfileBase& o) {
+    count += o.count;
+    sum_ns += o.sum_ns;
+    sum_sq_ns += o.sum_sq_ns;
+    if (o.count) {
+      if (o.min_ns < min_ns) min_ns = o.min_ns;
+      if (o.max_ns > max_ns) max_ns = o.max_ns;
+    }
+  }
+};
+
+}  // namespace dmclock

@@ -1,0 +1,43 @@
+// Per-client QoS parameters.
+//
+// Native equivalent of the reference's ClientInfo
+// (/root/reference/src/dmclock_server.h:95-132) and python core/qos.py:
+// (reservation, weight, limit) rates with cached integer ns-per-unit
+// increments ("inverses"), 0 -> 0 meaning "axis disabled".
+
+#pragma once
+
+#include <ostream>
+
+#include "time.h"
+
+namespace dmclock {
+
+struct ClientInfo {
+  double reservation = 0.0;  // ops/sec floor
+  double weight = 0.0;       // proportional share
+  double limit = 0.0;        // ops/sec cap
+
+  int64_t reservation_inv_ns = 0;
+  int64_t weight_inv_ns = 0;
+  int64_t limit_inv_ns = 0;
+
+  ClientInfo() = default;
+  ClientInfo(double r, double w, double l) { update(r, w, l); }
+
+  void update(double r, double w, double l) {
+    reservation = r;
+    weight = w;
+    limit = l;
+    reservation_inv_ns = rate_to_inv_ns(r);
+    weight_inv_ns = rate_to_inv_ns(w);
+    limit_inv_ns = rate_to_inv_ns(l);
+  }
+};
+
+inline std::ostream& operator<<(std::ostream& os, const ClientInfo& i) {
+  return os << "ClientInfo(r=" << i.reservation << ", w=" << i.weight
+            << ", l=" << i.limit << ")";
+}
+
+}  // namespace dmclock

@@ -1,0 +1,41 @@
+// Shared wire-level record types of the dmClock protocol.
+//
+// Native equivalent of the reference's dmclock_recs.h
+// (/root/reference/src/dmclock_recs.h:25-72) and python core/recs.py:
+// Counter/Cost scalars, the phase marker a server returns with each
+// response, and ReqParams{delta, rho} -- the entire piggybacked payload
+// of the distributed protocol.
+
+#pragma once
+
+#include <cassert>
+#include <cstdint>
+#include <ostream>
+
+namespace dmclock {
+
+using Counter = uint64_t;
+using Cost = uint32_t;
+
+enum class Phase : uint8_t { reservation = 0, priority = 1 };
+
+inline std::ostream& operator<<(std::ostream& os, Phase p) {
+  return os << (p == Phase::reservation ? "reservation" : "priority");
+}
+
+struct ReqParams {
+  // delta: all completions this client saw since its previous request
+  // to the receiving server; rho: the reservation-phase subset.
+  // Invariant rho <= delta (dmclock_recs.h:51).
+  uint32_t delta = 0;
+  uint32_t rho = 0;
+
+  ReqParams() = default;
+  ReqParams(uint32_t d, uint32_t r) : delta(d), rho(r) { assert(rho <= delta); }
+};
+
+inline std::ostream& operator<<(std::ostream& os, const ReqParams& rp) {
+  return os << "ReqParams{ delta:" << rp.delta << ", rho:" << rp.rho << " }";
+}
+
+}  // namespace dmclock

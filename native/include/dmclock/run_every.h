@@ -1,0 +1,73 @@
+// Periodic background job thread.
+//
+// Native equivalent of the reference's RunEvery
+// (/root/reference/support/src/run_every.h:32-80, run_every.cc:61-94)
+// and python utils/periodic.py: runs a callback every period on its own
+// thread; the period can be changed on the fly (try_update); the
+// destructor stops and joins.
+
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <functional>
+#include <mutex>
+#include <thread>
+
+namespace dmclock {
+
+class RunEvery {
+ public:
+  RunEvery(double period_s, std::function<void()> body)
+      : period_(std::chrono::duration<double>(period_s)),
+        body_(std::move(body)),
+        thread_([this] { run(); }) {}
+
+  ~RunEvery() { join(); }
+
+  RunEvery(const RunEvery&) = delete;
+  RunEvery& operator=(const RunEvery&) = delete;
+
+  void join() {
+    {
+      std::lock_guard<std::mutex> g(mtx_);
+      if (finishing_) return;
+      finishing_ = true;
+      cv_.notify_all();
+    }
+    if (thread_.joinable()) thread_.join();
+  }
+
+  // update the period; takes effect from the next wait
+  // (reference try_update, run_every.cc:77-81)
+  void try_update(double period_s) {
+    std::lock_guard<std::mutex> g(mtx_);
+    period_ = std::chrono::duration<double>(period_s);
+    cv_.notify_all();
+  }
+
+ private:
+  void run() {
+    std::unique_lock<std::mutex> lk(mtx_);
+    while (!finishing_) {
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::duration_cast<
+                          std::chrono::steady_clock::duration>(period_);
+      while (!finishing_ && std::chrono::steady_clock::now() < deadline)
+        cv_.wait_until(lk, deadline);
+      if (finishing_) break;
+      lk.unlock();
+      body_();
+      lk.lock();
+    }
+  }
+
+  std::chrono::duration<double> period_;
+  std::function<void()> body_;
+  std::mutex mtx_;
+  std::condition_variable cv_;
+  bool finishing_ = false;
+  std::thread thread_;
+};
+
+}  // namespace dmclock

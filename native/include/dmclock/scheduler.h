@@ -1,0 +1,733 @@
+// The dmClock server-side scheduler: native (C++) backend.
+//
+// Equivalent of the reference's PriorityQueueBase / PullPriorityQueue /
+// PushPriorityQueue (/root/reference/src/dmclock_server.h:283-1797) and
+// a line-for-line semantic twin of the Python oracle
+// (dmclock_tpu/core/scheduler.py) -- same int64-ns tag algebra, same
+// AtLimit/anticipation/idle-reactivation/GC behavior, and the same
+// TOTAL selection order: every heap comparator ends with the client
+// creation index, so heap tops equal the oracle's linear-scan minima
+// and request ordering is bit-identical across the C++, Python, and
+// TPU backends.
+//
+// Departures from the reference (deliberate):
+//  - delayed-vs-immediate tag calc and the heap branching factor are
+//    runtime options, not template parameters (one library serves the
+//    whole configuration matrix and the benchmark K sweep);
+//  - times are int64 ns everywhere (see time.h).
+
+#pragma once
+
+#include <algorithm>
+#include <cassert>
+#include <cerrno>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "indirect_heap.h"
+#include "qos.h"
+#include "recs.h"
+#include "run_every.h"
+#include "tags.h"
+#include "time.h"
+
+namespace dmclock {
+
+enum class AtLimit : uint8_t { Wait = 0, Allow = 1, Reject = 2 };
+
+enum class NextReqType : uint8_t { returning = 0, future = 1, none = 2 };
+
+enum class HeapId : uint8_t { reservation = 0, ready = 1 };
+
+struct NextReq {
+  NextReqType type = NextReqType::none;
+  HeapId heap_id = HeapId::reservation;
+  TimeNs when_ready = 0;
+
+  static NextReq none() { return NextReq{}; }
+  static NextReq returning(HeapId h) {
+    return NextReq{NextReqType::returning, h, 0};
+  }
+  static NextReq future(TimeNs when) {
+    return NextReq{NextReqType::future, HeapId::reservation, when};
+  }
+};
+
+// GC defaults (reference dmclock_server.h:68-72)
+constexpr double STANDARD_IDLE_AGE_S = 300.0;
+constexpr double STANDARD_ERASE_AGE_S = 600.0;
+constexpr double STANDARD_CHECK_TIME_S = 60.0;
+constexpr double AGGRESSIVE_CHECK_TIME_S = 5.0;
+constexpr size_t STANDARD_ERASE_MAX = 2000;
+
+template <typename C, typename R>
+class PriorityQueueBase {
+ public:
+  using ClientInfoFunc = std::function<ClientInfo(const C&)>;
+
+  struct ClientReq {
+    RequestTag tag;
+    C client;
+    R request;
+    ClientReq(const RequestTag& t, const C& c, R&& r)
+        : tag(t), client(c), request(std::move(r)) {}
+  };
+
+  struct ClientRec {
+    C client;
+    uint64_t order;  // creation index: the deterministic tie-break
+    RequestTag prev_tag;
+    std::deque<ClientReq> requests;
+    int64_t prop_delta = 0;  // idle-reactivation shift (ns)
+    ClientInfo info;
+    bool idle = true;
+    uint64_t last_tick;
+    uint32_t cur_rho = 1, cur_delta = 1;
+
+    // intrusive heap slots (one per heap this record lives in)
+    size_t resv_pos = HEAP_NOT_IN;
+    size_t limit_pos = HEAP_NOT_IN;
+    size_t ready_pos = HEAP_NOT_IN;
+
+    ClientRec(const C& c, const ClientInfo& i, uint64_t tick, uint64_t ord)
+        : client(c), order(ord), info(i), last_tick(tick) {}
+
+    bool has_request() const { return !requests.empty(); }
+    ClientReq& next_request() { return requests.front(); }
+    const ClientReq& next_request() const { return requests.front(); }
+
+    // prev-tag maintenance (reference :399-412): pinned sentinels are
+    // never folded in
+    void update_req_tag(const RequestTag& tag, uint64_t tick) {
+      if (tag.reservation != MAX_TAG && tag.reservation != MIN_TAG)
+        prev_tag.reservation = tag.reservation;
+      if (tag.limit != MAX_TAG && tag.limit != MIN_TAG)
+        prev_tag.limit = tag.limit;
+      if (tag.proportion != MAX_TAG && tag.proportion != MIN_TAG)
+        prev_tag.proportion = tag.proportion;
+      prev_tag.arrival = tag.arrival;
+      last_tick = tick;
+    }
+  };
+
+  // --- selection total orders (oracle _resv/_limit/_ready_key;
+  // reference ClientCompare :722-757 + creation-order tie-break) -----
+  struct ResvCompare {
+    bool operator()(const ClientRec& a, const ClientRec& b) const {
+      if (a.has_request() != b.has_request()) return a.has_request();
+      if (!a.has_request()) return a.order < b.order;
+      int64_t ta = a.next_request().tag.reservation;
+      int64_t tb = b.next_request().tag.reservation;
+      if (ta != tb) return ta < tb;
+      return a.order < b.order;
+    }
+  };
+  struct LimitCompare {  // ready sorts AFTER not-ready (ready asc)
+    bool operator()(const ClientRec& a, const ClientRec& b) const {
+      if (a.has_request() != b.has_request()) return a.has_request();
+      if (!a.has_request()) return a.order < b.order;
+      bool ra = a.next_request().tag.ready, rb = b.next_request().tag.ready;
+      if (ra != rb) return rb;
+      int64_t ta = a.next_request().tag.limit;
+      int64_t tb = b.next_request().tag.limit;
+      if (ta != tb) return ta < tb;
+      return a.order < b.order;
+    }
+  };
+  struct ReadyCompare {  // ready sorts BEFORE not-ready (ready desc)
+    bool operator()(const ClientRec& a, const ClientRec& b) const {
+      if (a.has_request() != b.has_request()) return a.has_request();
+      if (!a.has_request()) return a.order < b.order;
+      bool ra = a.next_request().tag.ready, rb = b.next_request().tag.ready;
+      if (ra != rb) return ra;
+      int64_t ta = a.next_request().tag.proportion + a.prop_delta;
+      int64_t tb = b.next_request().tag.proportion + b.prop_delta;
+      if (ta != tb) return ta < tb;
+      return a.order < b.order;
+    }
+  };
+
+  struct Options {
+    bool delayed_tag_calc = false;
+    bool dynamic_cli_info = false;
+    AtLimit at_limit = AtLimit::Wait;
+    TimeNs reject_threshold_ns = 0;  // >0 implies AtLimit::Reject
+    TimeNs anticipation_timeout_ns = 0;
+    unsigned heap_branching = 2;  // the K_WAY_HEAP analog
+    double idle_age_s = STANDARD_IDLE_AGE_S;
+    double erase_age_s = STANDARD_ERASE_AGE_S;
+    double check_time_s = STANDARD_CHECK_TIME_S;
+    size_t erase_max = STANDARD_ERASE_MAX;
+    bool run_gc_thread = false;
+  };
+
+  PriorityQueueBase(ClientInfoFunc info_f, const Options& opt)
+      : client_info_f_(std::move(info_f)),
+        opt_(opt),
+        resv_heap_(opt.heap_branching),
+        limit_heap_(opt.heap_branching),
+        ready_heap_(opt.heap_branching) {
+    if (opt_.reject_threshold_ns > 0) opt_.at_limit = AtLimit::Reject;
+    // Reject needs accurate tags at add time (reference :856-857)
+    assert(!(opt_.at_limit == AtLimit::Reject && opt_.delayed_tag_calc));
+    assert(opt_.erase_age_s >= opt_.idle_age_s);
+    assert(opt_.check_time_s < opt_.idle_age_s);
+    if (opt_.run_gc_thread)
+      cleaning_job_ = std::make_unique<RunEvery>(
+          opt_.check_time_s, [this] { do_clean(); });
+  }
+
+  virtual ~PriorityQueueBase() { shutdown(); }
+
+  void shutdown() {
+    finishing_ = true;
+    cleaning_job_.reset();
+  }
+
+  // --- inspection (reference :545-564) ------------------------------
+  bool empty() {
+    std::lock_guard<std::mutex> g(data_mtx_);
+    return resv_heap_.empty() || !resv_heap_.top().has_request();
+  }
+  size_t client_count() {
+    std::lock_guard<std::mutex> g(data_mtx_);
+    return client_map_.size();
+  }
+  size_t request_count() {
+    std::lock_guard<std::mutex> g(data_mtx_);
+    size_t n = 0;
+    for (auto& kv : client_map_) n += kv.second->requests.size();
+    return n;
+  }
+
+  // --- removal / info updates (reference :567-648) ------------------
+  bool remove_by_req_filter(std::function<bool(R&&)> filter_accum,
+                            bool visit_backwards = false) {
+    std::lock_guard<std::mutex> g(data_mtx_);
+    bool any_removed = false;
+    for (auto& kv : client_map_) {
+      ClientRec& rec = *kv.second;
+      bool removed = false;
+      auto& reqs = rec.requests;
+      std::vector<bool> kill(reqs.size(), false);
+      if (visit_backwards) {
+        for (size_t i = reqs.size(); i-- > 0;)
+          if (filter_accum(std::move(reqs[i].request))) {
+            kill[i] = true; removed = true;
+          }
+      } else {
+        for (size_t i = 0; i < reqs.size(); ++i)
+          if (filter_accum(std::move(reqs[i].request))) {
+            kill[i] = true; removed = true;
+          }
+      }
+      if (removed) {
+        std::deque<ClientReq> keep;
+        for (size_t i = 0; i < reqs.size(); ++i)
+          if (!kill[i]) keep.push_back(std::move(reqs[i]));
+        reqs.swap(keep);
+        any_removed = true;
+        adjust_all_heaps(rec);
+      }
+    }
+    return any_removed;
+  }
+
+  void remove_by_client(const C& client, bool reverse = false,
+                        std::function<void(R&&)> accum = nullptr) {
+    std::lock_guard<std::mutex> g(data_mtx_);
+    auto it = client_map_.find(client);
+    if (it == client_map_.end()) return;
+    ClientRec& rec = *it->second;
+    if (accum) {
+      if (reverse)
+        for (auto r = rec.requests.rbegin(); r != rec.requests.rend(); ++r)
+          accum(std::move(r->request));
+      else
+        for (auto& cr : rec.requests) accum(std::move(cr.request));
+    }
+    rec.requests.clear();
+    adjust_all_heaps(rec);
+  }
+
+  void update_client_info(const C& client) {
+    std::lock_guard<std::mutex> g(data_mtx_);
+    auto it = client_map_.find(client);
+    if (it != client_map_.end()) {
+      it->second->info = client_info_f_(client);
+      adjust_all_heaps(*it->second);
+    }
+  }
+  void update_client_infos() {
+    std::lock_guard<std::mutex> g(data_mtx_);
+    for (auto& kv : client_map_) {
+      kv.second->info = client_info_f_(kv.second->client);
+      adjust_all_heaps(*kv.second);
+    }
+  }
+
+  unsigned get_heap_branching_factor() const {
+    return resv_heap_.branching_factor();
+  }
+
+  // scheduling counters (reference :810-812)
+  uint64_t reserv_sched_count = 0;
+  uint64_t prop_sched_count = 0;
+  uint64_t limit_break_sched_count = 0;
+
+  // --- GC (reference do_clean :1206-1255) ---------------------------
+  void do_clean() {
+    double now = monotonic_s_();
+    std::lock_guard<std::mutex> g(data_mtx_);
+    clean_mark_points_.emplace_back(now, tick_);
+
+    uint64_t erase_point = last_erase_point_;
+    while (!clean_mark_points_.empty() &&
+           clean_mark_points_.front().first <= now - opt_.erase_age_s) {
+      last_erase_point_ = clean_mark_points_.front().second;
+      erase_point = last_erase_point_;
+      clean_mark_points_.pop_front();
+    }
+    uint64_t idle_point = 0;
+    for (auto& mp : clean_mark_points_) {
+      if (mp.first <= now - opt_.idle_age_s) idle_point = mp.second;
+      else break;
+    }
+    size_t erased_num = 0;
+    if (erase_point > 0 || idle_point > 0) {
+      for (auto it = client_map_.begin(); it != client_map_.end();) {
+        ClientRec& rec = *it->second;
+        if (erase_point && erased_num < opt_.erase_max &&
+            rec.last_tick <= erase_point) {
+          remove_from_heaps(rec);
+          it = client_map_.erase(it);
+          ++erased_num;
+        } else {
+          if (idle_point && rec.last_tick <= idle_point) rec.idle = true;
+          ++it;
+        }
+      }
+      if (erased_num >= opt_.erase_max) {
+        if (cleaning_job_) cleaning_job_->try_update(AGGRESSIVE_CHECK_TIME_S);
+      } else {
+        last_erase_point_ = 0;
+        if (cleaning_job_) cleaning_job_->try_update(opt_.check_time_s);
+      }
+    }
+  }
+
+  void set_monotonic_clock(std::function<double()> f) {
+    monotonic_s_ = std::move(f);
+  }
+
+ protected:
+  using Heap = IndirectHeap<ClientRec, ResvCompare, &ClientRec::resv_pos>;
+  using LimitHeap =
+      IndirectHeap<ClientRec, LimitCompare, &ClientRec::limit_pos>;
+  using ReadyHeap =
+      IndirectHeap<ClientRec, ReadyCompare, &ClientRec::ready_pos>;
+
+  void adjust_all_heaps(ClientRec& rec) {
+    resv_heap_.adjust(rec);
+    limit_heap_.adjust(rec);
+    ready_heap_.adjust(rec);
+  }
+  void remove_from_heaps(ClientRec& rec) {
+    resv_heap_.remove(rec);
+    limit_heap_.remove(rec);
+    ready_heap_.remove(rec);
+  }
+
+  const ClientInfo& get_cli_info(ClientRec& rec) {
+    if (opt_.dynamic_cli_info) rec.info = client_info_f_(rec.client);
+    return rec.info;
+  }
+
+  // delayed/immediate initial tag (reference :878-907)
+  RequestTag initial_tag(ClientRec& rec, const ReqParams& params,
+                         TimeNs time_ns, Cost cost) {
+    if (opt_.delayed_tag_calc && rec.has_request()) {
+      RequestTag t;  // zero tag for a non-head request
+      t.arrival = time_ns;
+      t.cost = cost;
+      return t;
+    }
+    RequestTag tag(rec.prev_tag, get_cli_info(rec), params.delta,
+                   params.rho, time_ns, cost,
+                   opt_.anticipation_timeout_ns);
+    rec.update_req_tag(tag, tick_);
+    return tag;
+  }
+
+  // reference do_add_request (:913-1018); data_mtx held
+  int do_add_request(R&& request, const C& client,
+                     const ReqParams& req_params, TimeNs time_ns,
+                     Cost cost = 1) {
+    ++tick_;
+    ClientRec* rec;
+    auto it = client_map_.find(client);
+    if (it == client_map_.end()) {
+      auto r = std::make_unique<ClientRec>(client, client_info_f_(client),
+                                           tick_, next_order_++);
+      rec = r.get();
+      client_map_.emplace(client, std::move(r));
+      resv_heap_.push(rec);
+      limit_heap_.push(rec);
+      ready_heap_.push(rec);
+    } else {
+      rec = it->second.get();
+    }
+
+    if (rec->idle) {
+      // idle reactivation (reference :937-985): shift the returning
+      // client's effective proportion next to the lowest active tag
+      bool found = false;
+      int64_t lowest = 0;
+      for (auto& kv : client_map_) {
+        ClientRec& other = *kv.second;
+        if (other.idle) continue;
+        int64_t p = (other.has_request()
+                         ? other.next_request().tag.proportion
+                         : other.prev_tag.proportion) + other.prop_delta;
+        if (!found || p < lowest) { lowest = p; found = true; }
+      }
+      if (found && lowest < LOWEST_PROP_TAG_TRIGGER)
+        rec->prop_delta = lowest - time_ns;
+      rec->idle = false;
+    }
+
+    RequestTag tag = initial_tag(*rec, req_params, time_ns, cost);
+
+    if (opt_.at_limit == AtLimit::Reject &&
+        tag.limit > time_ns + opt_.reject_threshold_ns) {
+      return EAGAIN;  // without taking ownership (reference :989-993)
+    }
+
+    rec->requests.emplace_back(tag, client, std::move(request));
+    rec->cur_rho = req_params.rho;
+    rec->cur_delta = req_params.delta;
+    adjust_all_heaps(*rec);
+    return 0;
+  }
+
+  // reference do_next_request (:1115-1186); data_mtx held
+  NextReq do_next_request(TimeNs now) {
+    if (resv_heap_.empty()) return NextReq::none();
+
+    ClientRec& reserv = resv_heap_.top();
+    if (reserv.has_request() &&
+        reserv.next_request().tag.reservation <= now)
+      return NextReq::returning(HeapId::reservation);
+
+    // promote newly within-limit heads (reference :1135-1144)
+    for (;;) {
+      ClientRec& limits = limit_heap_.top();
+      if (!(limits.has_request() && !limits.next_request().tag.ready &&
+            limits.next_request().tag.limit <= now))
+        break;
+      limits.next_request().tag.ready = true;
+      ready_heap_.promote(limits);
+      limit_heap_.demote(limits);
+    }
+
+    ClientRec& readys = ready_heap_.top();
+    if (readys.has_request() && readys.next_request().tag.ready &&
+        readys.next_request().tag.proportion < MAX_TAG)
+      return NextReq::returning(HeapId::ready);
+
+    if (opt_.at_limit == AtLimit::Allow) {
+      if (readys.has_request() &&
+          readys.next_request().tag.proportion < MAX_TAG) {
+        ++limit_break_sched_count;
+        return NextReq::returning(HeapId::ready);
+      } else if (reserv.has_request() &&
+                 reserv.next_request().tag.reservation < MAX_TAG) {
+        ++limit_break_sched_count;
+        return NextReq::returning(HeapId::reservation);
+      }
+    }
+
+    TimeNs next_call = TIME_MAX;
+    if (resv_heap_.top().has_request())
+      next_call = min_not_0_time(
+          next_call, resv_heap_.top().next_request().tag.reservation);
+    if (limit_heap_.top().has_request()) {
+      const auto& nxt = limit_heap_.top().next_request();
+      assert(!nxt.tag.ready || nxt.tag.proportion >= MAX_TAG);
+      next_call = min_not_0_time(next_call, nxt.tag.limit);
+    }
+    if (next_call < TIME_MAX) return NextReq::future(next_call);
+    return NextReq::none();
+  }
+
+  // reference pop_process_request (:1046-1073) + update_next_tag
+  // (:1021-1041); data_mtx held
+  template <typename Fn>
+  RequestTag pop_process_request(HeapId heap, Fn&& process) {
+    ClientRec& top = (heap == HeapId::reservation)
+                         ? resv_heap_.top()
+                         : ready_heap_.top();
+    ClientReq head = std::move(top.next_request());
+    RequestTag tag = head.tag;
+    top.requests.pop_front();
+
+    if (opt_.delayed_tag_calc && top.has_request()) {
+      ClientReq& nxt = top.next_request();
+      nxt.tag = RequestTag(tag, get_cli_info(top), top.cur_delta,
+                           top.cur_rho, nxt.tag.arrival, nxt.tag.cost,
+                           opt_.anticipation_timeout_ns);
+      top.update_req_tag(nxt.tag, tick_);
+    }
+
+    adjust_all_heaps(top);
+    process(head.client, tag.cost, std::move(head.request));
+    return tag;
+  }
+
+  // reference reduce_reservation_tags (:1077-1111); data_mtx held
+  void reduce_reservation_tags(const C& client, const RequestTag& tag) {
+    auto it = client_map_.find(client);
+    assert(it != client_map_.end());
+    ClientRec& rec = *it->second;
+    int64_t offset =
+        rec.info.reservation_inv_ns * int64_t(tag.cost + tag.rho);
+    if (opt_.delayed_tag_calc) {
+      if (!rec.requests.empty())
+        rec.requests.front().tag.reservation -= offset;
+    } else {
+      for (auto& r : rec.requests) r.tag.reservation -= offset;
+    }
+    rec.prev_tag.reservation -= offset;
+    resv_heap_.promote(rec);
+  }
+
+  ClientInfoFunc client_info_f_;
+  Options opt_;
+  std::mutex data_mtx_;
+  std::map<C, std::unique_ptr<ClientRec>> client_map_;
+  bool finishing_ = false;
+  uint64_t tick_ = 0;
+  uint64_t next_order_ = 0;
+
+  Heap resv_heap_;
+  LimitHeap limit_heap_;
+  ReadyHeap ready_heap_;
+
+  uint64_t last_erase_point_ = 0;
+  std::deque<std::pair<double, uint64_t>> clean_mark_points_;
+  std::function<double()> monotonic_s_ = [] {
+    return double(get_time_ns()) / NS_PER_SEC;
+  };
+  std::unique_ptr<RunEvery> cleaning_job_;
+};
+
+// ---------------------------------------------------------------------
+// Pull mode (reference PullPriorityQueue :1279-1501)
+// ---------------------------------------------------------------------
+
+template <typename C, typename R>
+struct PullReq {
+  NextReqType type = NextReqType::none;
+  C client{};
+  R request{};
+  Phase phase = Phase::reservation;
+  Cost cost = 0;
+  TimeNs when_ready = 0;
+
+  bool is_none() const { return type == NextReqType::none; }
+  bool is_retn() const { return type == NextReqType::returning; }
+  bool is_future() const { return type == NextReqType::future; }
+};
+
+template <typename C, typename R>
+class PullPriorityQueue : public PriorityQueueBase<C, R> {
+  using Base = PriorityQueueBase<C, R>;
+
+ public:
+  using Base::Base;
+
+  int add_request(R request, const C& client,
+                  const ReqParams& params = ReqParams(),
+                  TimeNs time_ns = -1, Cost cost = 1) {
+    if (time_ns < 0) time_ns = get_time_ns();
+    std::lock_guard<std::mutex> g(this->data_mtx_);
+    return this->do_add_request(std::move(request), client, params,
+                                time_ns, cost);
+  }
+
+  PullReq<C, R> pull_request(TimeNs now = -1) {
+    if (now < 0) now = get_time_ns();
+    PullReq<C, R> result;
+    std::lock_guard<std::mutex> g(this->data_mtx_);
+    NextReq next = this->do_next_request(now);
+    result.type = next.type;
+    switch (next.type) {
+      case NextReqType::none:
+        return result;
+      case NextReqType::future:
+        result.when_ready = next.when_ready;
+        return result;
+      case NextReqType::returning:
+        break;
+    }
+    if (next.heap_id == HeapId::reservation) {
+      result.phase = Phase::reservation;
+      this->pop_process_request(
+          HeapId::reservation, [&](const C& c, Cost cost, R&& req) {
+            result.client = c;
+            result.cost = cost;
+            result.request = std::move(req);
+          });
+      ++this->reserv_sched_count;
+    } else {
+      result.phase = Phase::priority;
+      RequestTag tag = this->pop_process_request(
+          HeapId::ready, [&](const C& c, Cost cost, R&& req) {
+            result.client = c;
+            result.cost = cost;
+            result.request = std::move(req);
+          });
+      this->reduce_reservation_tags(result.client, tag);
+      ++this->prop_sched_count;
+    }
+    return result;
+  }
+};
+
+// ---------------------------------------------------------------------
+// Push mode (reference PushPriorityQueue :1504-1797)
+// ---------------------------------------------------------------------
+
+template <typename C, typename R>
+class PushPriorityQueue : public PriorityQueueBase<C, R> {
+  using Base = PriorityQueueBase<C, R>;
+
+ public:
+  using CanHandleFunc = std::function<bool()>;
+  using HandleFunc = std::function<void(const C&, R&&, Phase, Cost)>;
+
+  PushPriorityQueue(typename Base::ClientInfoFunc info_f,
+                    CanHandleFunc can_handle_f, HandleFunc handle_f,
+                    const typename Base::Options& opt)
+      : Base(std::move(info_f), opt),
+        can_handle_f_(std::move(can_handle_f)),
+        handle_f_(std::move(handle_f)) {
+    sched_ahead_thd_ = std::thread([this] { run_sched_ahead(); });
+  }
+
+  ~PushPriorityQueue() override {
+    this->finishing_ = true;
+    {
+      std::lock_guard<std::mutex> g(sched_ahead_mtx_);
+      sched_ahead_cv_.notify_all();
+    }
+    if (sched_ahead_thd_.joinable()) sched_ahead_thd_.join();
+  }
+
+  int add_request(R request, const C& client,
+                  const ReqParams& params = ReqParams(),
+                  TimeNs time_ns = -1, Cost cost = 1) {
+    if (time_ns < 0) time_ns = get_time_ns();
+    std::lock_guard<std::mutex> g(this->data_mtx_);
+    int r = this->do_add_request(std::move(request), client, params,
+                                 time_ns, cost);
+    if (r == 0) schedule_request();
+    return r;
+  }
+
+  void request_completed() {
+    std::lock_guard<std::mutex> g(this->data_mtx_);
+    schedule_request();
+  }
+
+ private:
+  // reference submit_top_request/submit_request (:1674-1715);
+  // data_mtx held
+  void submit_request(HeapId heap) {
+    C client{};
+    if (heap == HeapId::reservation) {
+      this->pop_process_request(heap,
+                                [&](const C& c, Cost cost, R&& req) {
+                                  client = c;
+                                  handle_f_(c, std::move(req),
+                                            Phase::reservation, cost);
+                                });
+      ++this->reserv_sched_count;
+    } else {
+      RequestTag tag = this->pop_process_request(
+          heap, [&](const C& c, Cost cost, R&& req) {
+            client = c;
+            handle_f_(c, std::move(req), Phase::priority, cost);
+          });
+      this->reduce_reservation_tags(client, tag);
+      ++this->prop_sched_count;
+    }
+  }
+
+  // reference schedule_request (:1741-1755); data_mtx held
+  void schedule_request() {
+    if (!can_handle_f_()) return;
+    TimeNs now = get_time_ns();
+    NextReq next = this->do_next_request(now);
+    switch (next.type) {
+      case NextReqType::returning:
+        submit_request(next.heap_id);
+        break;
+      case NextReqType::future:
+        sched_at(next.when_ready);
+        break;
+      case NextReqType::none:
+        break;
+    }
+  }
+
+  // reference sched_at (:1789-1796)
+  void sched_at(TimeNs when) {
+    std::lock_guard<std::mutex> g(sched_ahead_mtx_);
+    if (this->finishing_) return;
+    if (sched_ahead_when_ == TIME_ZERO || when < sched_ahead_when_) {
+      sched_ahead_when_ = when;
+      sched_ahead_cv_.notify_all();
+    }
+  }
+
+  // reference run_sched_ahead (:1760-1786)
+  void run_sched_ahead() {
+    std::unique_lock<std::mutex> lk(sched_ahead_mtx_);
+    while (!this->finishing_) {
+      if (sched_ahead_when_ == TIME_ZERO) {
+        sched_ahead_cv_.wait(lk);
+        continue;
+      }
+      TimeNs now = get_time_ns();
+      if (sched_ahead_when_ > now) {
+        sched_ahead_cv_.wait_for(
+            lk, std::chrono::nanoseconds(sched_ahead_when_ - now));
+        continue;
+      }
+      sched_ahead_when_ = TIME_ZERO;
+      if (this->finishing_) return;
+      lk.unlock();
+      {
+        std::lock_guard<std::mutex> g(this->data_mtx_);
+        schedule_request();
+      }
+      lk.lock();
+    }
+  }
+
+  CanHandleFunc can_handle_f_;
+  HandleFunc handle_f_;
+  std::mutex sched_ahead_mtx_;
+  std::condition_variable sched_ahead_cv_;
+  TimeNs sched_ahead_when_ = TIME_ZERO;
+  std::thread sched_ahead_thd_;
+};
+
+}  // namespace dmclock

@@ -1,0 +1,69 @@
+// The mClock/dmClock request-tag algebra, int64-ns fixed point.
+//
+// Native equivalent of the reference's RequestTag + tag_calc
+// (/root/reference/src/dmclock_server.h:135-274) and python
+// core/tags.py:
+//   reservation = max(t, prev_r + r_inv * (rho   + cost))
+//   proportion  = max(t, prev_p + w_inv * (delta + cost))
+//   limit       = max(t, prev_l + l_inv * (delta + cost))
+// with zero inverses pinning to MAX_TAG/MIN_TAG and anticipation
+// backdating arrivals inside the window (:159-161).
+
+#pragma once
+
+#include <algorithm>
+#include <cassert>
+
+#include "qos.h"
+#include "recs.h"
+#include "time.h"
+
+namespace dmclock {
+
+inline int64_t tag_calc(TimeNs time_ns, int64_t prev_ns, int64_t inv_ns,
+                        int64_t dist_val, bool extreme_is_high,
+                        int64_t cost) {
+  if (inv_ns == 0) return extreme_is_high ? MAX_TAG : MIN_TAG;
+  int64_t units = std::min(dist_val + cost, MAX_CHARGE_UNITS);
+  int64_t organic = std::max(time_ns, prev_ns + inv_ns * units);
+  return std::min(organic, ORGANIC_TAG_CAP);
+}
+
+struct RequestTag {
+  int64_t reservation = 0;
+  int64_t proportion = 0;
+  int64_t limit = 0;
+  TimeNs arrival = 0;
+  uint32_t delta = 0;
+  uint32_t rho = 0;
+  Cost cost = 1;
+  bool ready = false;  // limit has passed; weight-phase eligible
+
+  RequestTag() = default;
+
+  // The tag recurrence (reference dmclock_server.h:145-183).
+  RequestTag(const RequestTag& prev, const ClientInfo& info, uint32_t d,
+             uint32_t r, TimeNs time_ns, Cost c,
+             TimeNs anticipation_timeout_ns = 0)
+      : arrival(time_ns), delta(d), rho(r), cost(c), ready(false) {
+    assert(c > 0);
+    TimeNs max_time = time_ns;
+    if (time_ns - anticipation_timeout_ns < prev.arrival)
+      max_time -= anticipation_timeout_ns;
+    reservation = tag_calc(max_time, prev.reservation,
+                           info.reservation_inv_ns, r, true, c);
+    proportion = tag_calc(max_time, prev.proportion, info.weight_inv_ns,
+                          d, true, c);
+    limit = tag_calc(max_time, prev.limit, info.limit_inv_ns, d, false, c);
+    assert(reservation < MAX_TAG || proportion < MAX_TAG);
+  }
+};
+
+inline std::ostream& operator<<(std::ostream& os, const RequestTag& t) {
+  return os << "{ RequestTag:: ready:" << (t.ready ? "true" : "false")
+            << " r:" << format_tag(t.reservation)
+            << " p:" << format_tag(t.proportion)
+            << " l:" << format_tag(t.limit) << " }";
+}
+
+}  // namespace dmclock

@@ -1,0 +1,82 @@
+// Canonical int64-nanosecond time/tag algebra (C++ side).
+//
+// Native equivalent of python dmclock_tpu/core/timebase.py, which is the
+// framework's replacement for the reference's double-seconds Time
+// (/root/reference/src/dmclock_util.h:33-53).  Every backend -- Python
+// oracle, this C++ runtime, the JAX engine -- performs the SAME integer
+// arithmetic, so cross-backend request ordering is bit-exact.
+
+#pragma once
+
+#include <cfenv>
+#include <cmath>
+#include <cstdint>
+#include <ctime>
+#include <string>
+
+namespace dmclock {
+
+using TimeNs = int64_t;
+
+constexpr int64_t NS_PER_SEC = 1000000000LL;
+
+// Tag sentinels (reference max_tag/min_tag, dmclock_server.h:60-65).
+constexpr int64_t MAX_TAG = int64_t{1} << 62;
+constexpr int64_t MIN_TAG = -(int64_t{1} << 62);
+
+constexpr TimeNs TIME_ZERO = 0;
+constexpr TimeNs TIME_MAX = int64_t{1} << 62;
+
+// Idle-reactivation trigger (reference uses DBL_MAX/3,
+// dmclock_server.h:957-958).
+constexpr int64_t LOWEST_PROP_TAG_TRIGGER = MAX_TAG / 2;
+
+// Saturation bounds keeping int64 overflow-free (timebase.py:36-47).
+constexpr int64_t MAX_INV_NS = int64_t{1} << 40;
+constexpr int64_t MAX_CHARGE_UNITS = int64_t{1} << 20;
+constexpr int64_t ORGANIC_TAG_CAP = MAX_TAG - 1;
+
+// Round-half-even, matching Python round(); the default FP environment
+// rounds to nearest-even, which nearbyint honors.
+inline int64_t round_half_even(double v) {
+  return static_cast<int64_t>(std::nearbyint(v));
+}
+
+inline TimeNs sec_to_ns(double t) { return round_half_even(t * NS_PER_SEC); }
+inline double ns_to_sec(TimeNs t) { return double(t) / NS_PER_SEC; }
+
+// QoS rate (ops/sec) -> ns of virtual time per unit cost, 0 -> 0
+// "axis disabled" sentinel (reference ClientInfo::update,
+// dmclock_server.h:111-118; timebase.py rate_to_inv_ns).
+inline int64_t rate_to_inv_ns(double rate) {
+  if (rate == 0.0) return 0;
+  int64_t v = round_half_even(double(NS_PER_SEC) / rate);
+  return v < MAX_INV_NS ? v : MAX_INV_NS;
+}
+
+// Wall clock in ns (reference get_time, dmclock_util.h:39-53).
+inline TimeNs get_time_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return TimeNs(ts.tv_sec) * NS_PER_SEC + ts.tv_nsec;
+}
+
+// min where TIME_ZERO means "no time" (reference min_not_0_time,
+// dmclock_server.h:1192-1195).
+inline TimeNs min_not_0_time(TimeNs current, TimeNs possible) {
+  if (possible == TIME_ZERO) return current;
+  return possible < current ? possible : current;
+}
+
+// Human-readable tag (reference format_tag/format_time,
+// dmclock_server.h:234-242, dmclock_util.cc:24-29).
+inline std::string format_tag(int64_t value_ns, int64_t modulo = 1000000) {
+  if (value_ns >= MAX_TAG) return "max";
+  if (value_ns <= MIN_TAG) return "min";
+  double sec = double(value_ns) / NS_PER_SEC;
+  char buf[64];
+  snprintf(buf, sizeof(buf), "%0.6f", std::fmod(sec, double(modulo)));
+  return buf;
+}
+
+}  // namespace dmclock

@@ -1,0 +1,190 @@
+// Client-side distributed service tracking.
+//
+// Native equivalent of the reference's ServiceTracker with pluggable
+// OrigTracker / BorrowingTracker accounting
+// (/root/reference/src/dmclock_client.h:39-287) and python
+// core/tracker.py: a client keeps global completion counters and one
+// per-server tracker; each request carries the counter movement since
+// the previous request to that server minus the client's own
+// contribution there.
+
+#pragma once
+
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <utility>
+
+#include "recs.h"
+#include "run_every.h"
+#include "time.h"
+
+namespace dmclock {
+
+struct GlobalCounters {
+  // start at 1: 0 is reserved by the cleaning logic
+  // (reference dmclock_client.h:191-198)
+  Counter delta = 1;
+  Counter rho = 1;
+};
+
+// best-effort original accounting (reference dmclock_client.h:39-84)
+class OrigTracker {
+ public:
+  OrigTracker(Counter global_delta, Counter global_rho)
+      : delta_prev_req_(global_delta), rho_prev_req_(global_rho) {}
+
+  ReqParams prepare_req(GlobalCounters& c) {
+    Counter delta_out = c.delta - delta_prev_req_ - my_delta_;
+    Counter rho_out = c.rho - rho_prev_req_ - my_rho_;
+    delta_prev_req_ = c.delta;
+    rho_prev_req_ = c.rho;
+    my_delta_ = 0;
+    my_rho_ = 0;
+    return ReqParams(uint32_t(delta_out), uint32_t(rho_out));
+  }
+
+  void resp_update(Phase phase, GlobalCounters& c, Cost cost) {
+    c.delta += cost;
+    my_delta_ += cost;
+    if (phase == Phase::reservation) {
+      c.rho += cost;
+      my_rho_ += cost;
+    }
+  }
+
+  Counter get_last_delta() const { return delta_prev_req_; }
+
+ private:
+  Counter delta_prev_req_;
+  Counter rho_prev_req_;
+  Counter my_delta_ = 0;
+  Counter my_rho_ = 0;
+};
+
+// always-positive accounting by borrowing future replies
+// (reference dmclock_client.h:90-154)
+class BorrowingTracker {
+ public:
+  BorrowingTracker(Counter global_delta, Counter global_rho)
+      : delta_prev_req_(global_delta), rho_prev_req_(global_rho) {}
+
+  static std::pair<Counter, Counter> calc_with_borrow(Counter global,
+                                                      Counter previous,
+                                                      Counter borrow) {
+    Counter result = global - previous;
+    if (result == 0) return {1, borrow + 1};
+    if (result > borrow) return {result - borrow, 0};
+    return {1, borrow - result + 1};
+  }
+
+  ReqParams prepare_req(GlobalCounters& c) {
+    auto [d_out, d_borrow] =
+        calc_with_borrow(c.delta, delta_prev_req_, delta_borrow_);
+    auto [r_out, r_borrow] =
+        calc_with_borrow(c.rho, rho_prev_req_, rho_borrow_);
+    delta_borrow_ = d_borrow;
+    rho_borrow_ = r_borrow;
+    delta_prev_req_ = c.delta;
+    rho_prev_req_ = c.rho;
+    return ReqParams(uint32_t(d_out), uint32_t(r_out));
+  }
+
+  void resp_update(Phase phase, GlobalCounters& c, Cost cost) {
+    c.delta += cost;
+    if (phase == Phase::reservation) c.rho += cost;
+  }
+
+  Counter get_last_delta() const { return delta_prev_req_; }
+
+ private:
+  Counter delta_prev_req_;
+  Counter rho_prev_req_;
+  Counter delta_borrow_ = 0;
+  Counter rho_borrow_ = 0;
+};
+
+// per-client distributed state across servers
+// (reference ServiceTracker, dmclock_client.h:157-287)
+template <typename S, typename T = OrigTracker>
+class ServiceTracker {
+ public:
+  explicit ServiceTracker(double clean_every_s = 300.0,
+                          double clean_age_s = 600.0,
+                          bool run_gc_thread = false)
+      : clean_age_s_(clean_age_s) {
+    if (run_gc_thread)
+      cleaning_job_ = std::make_unique<RunEvery>(
+          clean_every_s, [this] { do_clean(); });
+  }
+
+  ~ServiceTracker() { cleaning_job_.reset(); }
+
+  // incorporate a response; self-heals for unknown/GC'd servers
+  // (reference track_resp :221-236)
+  void track_resp(const S& server, Phase phase, Cost cost = 1) {
+    std::lock_guard<std::mutex> g(mtx_);
+    auto it = server_map_.find(server);
+    if (it == server_map_.end())
+      it = server_map_.emplace(server, T(counters_.delta, counters_.rho))
+               .first;
+    it->second.resp_update(phase, counters_, cost);
+  }
+
+  // ReqParams for the next request to `server`
+  // (reference get_req_params :241-251)
+  ReqParams get_req_params(const S& server) {
+    std::lock_guard<std::mutex> g(mtx_);
+    auto it = server_map_.find(server);
+    if (it == server_map_.end()) {
+      server_map_.emplace(server, T(counters_.delta, counters_.rho));
+      return ReqParams(1, 1);
+    }
+    return it->second.prepare_req(counters_);
+  }
+
+  // GC server records unused for clean_age (reference do_clean :263-286)
+  void do_clean() {
+    double now = monotonic_s_();
+    std::lock_guard<std::mutex> g(mtx_);
+    clean_mark_points_.emplace_back(now, counters_.delta);
+    Counter earliest = 0;
+    while (!clean_mark_points_.empty() &&
+           clean_mark_points_.front().first <= now - clean_age_s_) {
+      earliest = clean_mark_points_.front().second;
+      clean_mark_points_.pop_front();
+    }
+    if (earliest > 0) {
+      for (auto it = server_map_.begin(); it != server_map_.end();) {
+        if (it->second.get_last_delta() <= earliest)
+          it = server_map_.erase(it);
+        else
+          ++it;
+      }
+    }
+  }
+
+  size_t server_count() {
+    std::lock_guard<std::mutex> g(mtx_);
+    return server_map_.size();
+  }
+
+  void set_monotonic_clock(std::function<double()> f) {
+    monotonic_s_ = std::move(f);
+  }
+
+ private:
+  GlobalCounters counters_;
+  std::map<S, T> server_map_;
+  std::mutex mtx_;
+  double clean_age_s_;
+  std::deque<std::pair<double, Counter>> clean_mark_points_;
+  std::function<double()> monotonic_s_ = [] {
+    return double(get_time_ns()) / NS_PER_SEC;
+  };
+  std::unique_ptr<RunEvery> cleaning_job_;
+};
+
+}  // namespace dmclock

@@ -1,0 +1,192 @@
+// C ABI over the native dmClock runtime.
+//
+// Exposes the Pull queue and ServiceTracker with integer client/request
+// handles so Python (ctypes) and other embedders can drive the C++
+// scheduler -- the framework's fast CPU backend and the cross-language
+// golden-parity surface (python tests compare its decision stream
+// bit-for-bit with the Python oracle and the TPU engine).
+//
+// QoS parameters are registered per client id up front (or updated
+// later), playing the role of the reference's ClientInfoFunc callback
+// seam (dmclock_server.h:542) without cross-language calls per request.
+
+#include <cstdint>
+#include <mutex>
+#include <unordered_map>
+
+#include "dmclock/scheduler.h"
+#include "dmclock/tracker.h"
+
+using dmclock::AtLimit;
+using dmclock::ClientInfo;
+using dmclock::Cost;
+using dmclock::Phase;
+using dmclock::ReqParams;
+using dmclock::TimeNs;
+
+namespace {
+
+using Queue = dmclock::PullPriorityQueue<uint64_t, uint64_t>;
+
+struct QueueHandle {
+  std::unordered_map<uint64_t, ClientInfo> infos;
+  std::mutex info_mtx;
+  std::unique_ptr<Queue> queue;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- queue ----------------------------------------------------------
+
+void* dmc_queue_create(int delayed_tag_calc, int at_limit,
+                       int64_t reject_threshold_ns,
+                       int64_t anticipation_timeout_ns,
+                       unsigned heap_branching, int dynamic_cli_info) {
+  auto* h = new QueueHandle();
+  Queue::Options opt;
+  opt.delayed_tag_calc = delayed_tag_calc != 0;
+  opt.at_limit = static_cast<AtLimit>(at_limit);
+  opt.reject_threshold_ns = reject_threshold_ns;
+  opt.anticipation_timeout_ns = anticipation_timeout_ns;
+  opt.heap_branching = heap_branching;
+  opt.dynamic_cli_info = dynamic_cli_info != 0;
+  opt.run_gc_thread = false;  // GC driven via dmc_queue_do_clean
+  h->queue = std::make_unique<Queue>(
+      [h](const uint64_t& c) {
+        std::lock_guard<std::mutex> g(h->info_mtx);
+        auto it = h->infos.find(c);
+        if (it == h->infos.end()) {
+          // fail loudly: the Python oracle asserts on missing info, and
+          // a silent default would break cross-backend parity
+          fprintf(stderr,
+                  "dmclock capi: no ClientInfo registered for client "
+                  "%llu (call dmc_queue_set_client_info first)\n",
+                  static_cast<unsigned long long>(c));
+          abort();
+        }
+        return it->second;
+      },
+      opt);
+  return h;
+}
+
+void dmc_queue_destroy(void* q) { delete static_cast<QueueHandle*>(q); }
+
+void dmc_queue_set_client_info(void* q, uint64_t client, double r,
+                               double w, double l) {
+  auto* h = static_cast<QueueHandle*>(q);
+  std::lock_guard<std::mutex> g(h->info_mtx);
+  h->infos[client].update(r, w, l);
+}
+
+void dmc_queue_update_client_info(void* q, uint64_t client) {
+  static_cast<QueueHandle*>(q)->queue->update_client_info(client);
+}
+
+int dmc_queue_add(void* q, uint64_t client, uint64_t req_id,
+                  uint32_t delta, uint32_t rho, int64_t time_ns,
+                  uint32_t cost) {
+  return static_cast<QueueHandle*>(q)->queue->add_request(
+      req_id, client, ReqParams(delta, rho), time_ns, cost);
+}
+
+// returns NextReqType (0 returning / 1 future / 2 none); fills outputs
+int dmc_queue_pull(void* q, int64_t now_ns, uint64_t* client,
+                   uint64_t* req_id, int* phase, uint32_t* cost,
+                   int64_t* when_ready) {
+  auto pr = static_cast<QueueHandle*>(q)->queue->pull_request(now_ns);
+  if (pr.is_retn()) {
+    *client = pr.client;
+    *req_id = pr.request;
+    *phase = static_cast<int>(pr.phase);
+    *cost = pr.cost;
+  } else if (pr.is_future()) {
+    *when_ready = pr.when_ready;
+  }
+  return static_cast<int>(pr.type);
+}
+
+uint64_t dmc_queue_request_count(void* q) {
+  return static_cast<QueueHandle*>(q)->queue->request_count();
+}
+uint64_t dmc_queue_client_count(void* q) {
+  return static_cast<QueueHandle*>(q)->queue->client_count();
+}
+int dmc_queue_empty(void* q) {
+  return static_cast<QueueHandle*>(q)->queue->empty() ? 1 : 0;
+}
+
+void dmc_queue_counters(void* q, uint64_t* reserv, uint64_t* prop,
+                        uint64_t* limit_break) {
+  auto* h = static_cast<QueueHandle*>(q);
+  *reserv = h->queue->reserv_sched_count;
+  *prop = h->queue->prop_sched_count;
+  *limit_break = h->queue->limit_break_sched_count;
+}
+
+// removed request ids are written into out[] (capacity cap); returns
+// the number removed
+uint64_t dmc_queue_remove_by_client(void* q, uint64_t client,
+                                    int reverse, uint64_t* out,
+                                    uint64_t cap) {
+  uint64_t n = 0;
+  static_cast<QueueHandle*>(q)->queue->remove_by_client(
+      client, reverse != 0, [&](uint64_t&& r) {
+        if (n < cap) out[n] = r;
+        ++n;
+      });
+  return n;
+}
+
+void dmc_queue_do_clean(void* q) {
+  static_cast<QueueHandle*>(q)->queue->do_clean();
+}
+
+unsigned dmc_queue_heap_branching(void* q) {
+  return static_cast<QueueHandle*>(q)->queue->get_heap_branching_factor();
+}
+
+// ---- tracker --------------------------------------------------------
+
+void* dmc_tracker_create(int borrowing) {
+  if (borrowing)
+    return new dmclock::ServiceTracker<uint64_t, dmclock::BorrowingTracker>();
+  return new dmclock::ServiceTracker<uint64_t>();
+}
+
+// `borrowing` must match the create call (selects the concrete type)
+void dmc_tracker_destroy(void* t, int borrowing) {
+  if (borrowing)
+    delete static_cast<
+        dmclock::ServiceTracker<uint64_t, dmclock::BorrowingTracker>*>(t);
+  else
+    delete static_cast<dmclock::ServiceTracker<uint64_t>*>(t);
+}
+
+void dmc_tracker_track_resp(void* t, int borrowing, uint64_t server,
+                            int phase, uint32_t cost) {
+  if (borrowing)
+    static_cast<
+        dmclock::ServiceTracker<uint64_t, dmclock::BorrowingTracker>*>(t)
+        ->track_resp(server, static_cast<Phase>(phase), cost);
+  else
+    static_cast<dmclock::ServiceTracker<uint64_t>*>(t)->track_resp(
+        server, static_cast<Phase>(phase), cost);
+}
+
+void dmc_tracker_get_req_params(void* t, int borrowing, uint64_t server,
+                                uint32_t* delta, uint32_t* rho) {
+  ReqParams rp =
+      borrowing
+          ? static_cast<dmclock::ServiceTracker<
+                uint64_t, dmclock::BorrowingTracker>*>(t)
+                ->get_req_params(server)
+          : static_cast<dmclock::ServiceTracker<uint64_t>*>(t)
+                ->get_req_params(server);
+  *delta = rp.delta;
+  *rho = rp.rho;
+}
+
+}  // extern "C"

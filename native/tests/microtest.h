@@ -1,0 +1,76 @@
+// Minimal single-header test harness (gtest is not available in this
+// environment; this provides the few primitives the suites need).
+
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+#include <functional>
+#include <iostream>
+#include <string>
+#include <vector>
+
+namespace microtest {
+
+struct Registry {
+  static Registry& get() {
+    static Registry r;
+    return r;
+  }
+  std::vector<std::pair<std::string, std::function<void()>>> tests;
+  int failures = 0;
+  std::string current;
+};
+
+struct Register {
+  Register(const char* name, std::function<void()> fn) {
+    Registry::get().tests.emplace_back(name, std::move(fn));
+  }
+};
+
+inline int run_all() {
+  auto& reg = Registry::get();
+  int ran = 0;
+  for (auto& [name, fn] : reg.tests) {
+    reg.current = name;
+    int before = reg.failures;
+    fn();
+    ++ran;
+    std::printf("[%s] %s\n",
+                reg.failures == before ? "PASS" : "FAIL", name.c_str());
+  }
+  std::printf("%d tests, %d failures\n", ran, reg.failures);
+  return reg.failures ? 1 : 0;
+}
+
+}  // namespace microtest
+
+#define MT_TEST(name)                                            \
+  static void mt_##name();                                       \
+  static microtest::Register mt_reg_##name(#name, mt_##name);    \
+  static void mt_##name()
+
+#define MT_CHECK(cond)                                                 \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      ++microtest::Registry::get().failures;                           \
+      std::printf("  CHECK failed: %s (%s:%d in %s)\n", #cond,         \
+                  __FILE__, __LINE__,                                  \
+                  microtest::Registry::get().current.c_str());         \
+    }                                                                  \
+  } while (0)
+
+#define MT_CHECK_EQ(a, b)                                              \
+  do {                                                                 \
+    auto va = (a);                                                     \
+    auto vb = (b);                                                     \
+    if (!(va == vb)) {                                                 \
+      ++microtest::Registry::get().failures;                           \
+      std::cout << "  CHECK_EQ failed: " << #a << " (" << va           \
+                << ") != " << #b << " (" << vb << ") at " << __FILE__  \
+                << ":" << __LINE__ << "\n";                            \
+    }                                                                  \
+  } while (0)
+
+#define MT_MAIN() \
+  int main() { return microtest::run_all(); }

@@ -1,0 +1,137 @@
+// Indirect intrusive k-way heap tests.
+//
+// Covers the reference suite's ground
+// (/root/reference/support/test/test_indirect_intrusive_heap.cc):
+// ordering across K, promote/demote/adjust, the remove-then-sift-both-
+// ways case, and one element living in two heaps via two index slots.
+
+#include <algorithm>
+#include <memory>
+#include <random>
+#include <vector>
+
+#include "dmclock/indirect_heap.h"
+#include "microtest.h"
+
+using dmclock::HEAP_NOT_IN;
+using dmclock::IndirectHeap;
+
+struct Elem {
+  int key = 0;
+  int key2 = 0;
+  size_t pos_a = HEAP_NOT_IN;
+  size_t pos_b = HEAP_NOT_IN;
+  explicit Elem(int k, int k2 = 0) : key(k), key2(k2) {}
+};
+
+struct CmpA {
+  bool operator()(const Elem& x, const Elem& y) const { return x.key < y.key; }
+};
+struct CmpB {
+  bool operator()(const Elem& x, const Elem& y) const {
+    return x.key2 < y.key2;
+  }
+};
+
+using HeapA = IndirectHeap<Elem, CmpA, &Elem::pos_a>;
+using HeapB = IndirectHeap<Elem, CmpB, &Elem::pos_b>;
+
+MT_TEST(push_pop_sorted_all_k) {
+  std::mt19937 rng(42);
+  for (unsigned k : {2u, 3u, 4u, 10u}) {
+    HeapA h(k);
+    std::vector<std::unique_ptr<Elem>> owner;
+    std::vector<int> keys(200);
+    for (int i = 0; i < 200; ++i) keys[i] = int(rng() % 1000);
+    for (int v : keys) {
+      owner.push_back(std::make_unique<Elem>(v));
+      h.push(owner.back().get());
+    }
+    std::sort(keys.begin(), keys.end());
+    for (int v : keys) {
+      MT_CHECK_EQ(h.top().key, v);
+      h.pop();
+    }
+    MT_CHECK(h.empty());
+  }
+}
+
+MT_TEST(intrusive_index_tracks_position) {
+  HeapA h(3);
+  std::vector<std::unique_ptr<Elem>> owner;
+  for (int v : {5, 1, 9, 3, 7}) {
+    owner.push_back(std::make_unique<Elem>(v));
+    h.push(owner.back().get());
+  }
+  for (auto& e : owner) {
+    MT_CHECK(e->pos_a != HEAP_NOT_IN);
+    MT_CHECK(&h.at(e->pos_a) == e.get());
+  }
+}
+
+MT_TEST(adjust_promote_demote) {
+  HeapA h(2);
+  std::vector<std::unique_ptr<Elem>> owner;
+  for (int v : {10, 20, 30, 40, 50}) {
+    owner.push_back(std::make_unique<Elem>(v));
+    h.push(owner.back().get());
+  }
+  owner[4]->key = 1;  // 50 -> 1
+  h.promote(*owner[4]);
+  MT_CHECK_EQ(h.top().key, 1);
+  owner[4]->key = 99;
+  h.demote(*owner[4]);
+  MT_CHECK_EQ(h.top().key, 10);
+  owner[0]->key = 25;  // adjust must sift whichever way is needed
+  h.adjust(*owner[0]);
+  MT_CHECK_EQ(h.top().key, 20);
+}
+
+MT_TEST(remove_middle_sifts_correctly) {
+  // a remove whose replacement must sift up (the tricky case the
+  // reference comments on at indirect_intrusive_heap.h:437-441)
+  std::mt19937 rng(7);
+  for (int trial = 0; trial < 50; ++trial) {
+    HeapA h(2);
+    std::vector<std::unique_ptr<Elem>> owner;
+    for (int i = 0; i < 30; ++i) {
+      owner.push_back(std::make_unique<Elem>(int(rng() % 100)));
+      h.push(owner.back().get());
+    }
+    size_t kill = rng() % owner.size();
+    int killed_key = owner[kill]->key;
+    h.remove(*owner[kill]);
+    MT_CHECK(owner[kill]->pos_a == HEAP_NOT_IN);
+    std::vector<int> rest;
+    for (size_t i = 0; i < owner.size(); ++i)
+      if (i != kill) rest.push_back(owner[i]->key);
+    std::sort(rest.begin(), rest.end());
+    // drain must return everything except the removed, sorted
+    for (int v : rest) {
+      MT_CHECK_EQ(h.top().key, v);
+      h.pop();
+    }
+    (void)killed_key;
+  }
+}
+
+MT_TEST(two_heaps_one_element) {
+  HeapA ha(2);
+  HeapB hb(3);
+  std::vector<std::unique_ptr<Elem>> owner;
+  for (int i = 0; i < 10; ++i) {
+    owner.push_back(std::make_unique<Elem>(i, 9 - i));
+    ha.push(owner.back().get());
+    hb.push(owner.back().get());
+  }
+  MT_CHECK_EQ(ha.top().key, 0);
+  MT_CHECK_EQ(hb.top().key2, 0);
+  MT_CHECK(&ha.top() == owner.front().get());
+  MT_CHECK(&hb.top() == owner.back().get());
+  // removing from one heap leaves the other intact
+  ha.remove(*owner.front());
+  MT_CHECK_EQ(ha.top().key, 1);
+  MT_CHECK_EQ(hb.top().key2, 0);
+}
+
+MT_MAIN()

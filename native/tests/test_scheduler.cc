@@ -1,0 +1,218 @@
+// Native scheduler tests, mirroring the reference server suite
+// (/root/reference/test/test_dmclock_server.cc) and the Python suite
+// (tests/test_scheduler.py): virtual-time injection throughout, QoS
+// ratio checks, AtLimit policies, delayed/immediate tag calc,
+// anticipation, idle-reactivation, and GC timing with an injected
+// clock.
+
+#include <map>
+
+#include "dmclock/scheduler.h"
+#include "microtest.h"
+
+using namespace dmclock;
+
+using Q = PullPriorityQueue<uint64_t, uint64_t>;
+constexpr int64_t S = NS_PER_SEC;
+
+static Q::Options opts(bool delayed = false,
+                       AtLimit at = AtLimit::Wait,
+                       int64_t anticipation = 0, unsigned k = 2) {
+  Q::Options o;
+  o.delayed_tag_calc = delayed;
+  o.at_limit = at;
+  o.anticipation_timeout_ns = anticipation;
+  o.heap_branching = k;
+  return o;
+}
+
+static std::map<uint64_t, ClientInfo> g_infos;
+static ClientInfo info_of(const uint64_t& c) { return g_infos.at(c); }
+
+MT_TEST(pull_weight_ratio) {
+  // weight 1:2 serves 2:4 of 6 (reference pull_weight :822-874)
+  g_infos = {{1, ClientInfo(0, 1, 0)}, {2, ClientInfo(0, 2, 0)}};
+  for (unsigned k : {2u, 3u, 4u}) {
+    Q q(info_of, opts(false, AtLimit::Wait, 0, k));
+    int64_t t = 1 * S;
+    for (uint64_t i = 0; i < 6; ++i) {
+      q.add_request(100 + i, 1, ReqParams(), t);
+      q.add_request(200 + i, 2, ReqParams(), t);
+    }
+    std::map<uint64_t, int> counts;
+    for (int i = 0; i < 6; ++i) {
+      auto pr = q.pull_request(t + S);
+      MT_CHECK(pr.is_retn());
+      MT_CHECK(pr.phase == Phase::priority);
+      ++counts[pr.client];
+    }
+    MT_CHECK_EQ(counts[1], 2);
+    MT_CHECK_EQ(counts[2], 4);
+  }
+}
+
+MT_TEST(pull_reservation_ratio) {
+  // reservation 2:1 serves 4:2 (reference pull_reservation :877-929)
+  g_infos = {{1, ClientInfo(2, 0, 0)}, {2, ClientInfo(1, 0, 0)}};
+  Q q(info_of, opts());
+  int64_t t = 100 * S;
+  for (uint64_t i = 0; i < 6; ++i) {
+    q.add_request(100 + i, 1, ReqParams(), t);
+    q.add_request(200 + i, 2, ReqParams(), t);
+  }
+  std::map<uint64_t, int> counts;
+  for (int i = 0; i < 6; ++i) {
+    auto pr = q.pull_request(t + 100 * S);
+    MT_CHECK(pr.is_retn());
+    MT_CHECK(pr.phase == Phase::reservation);
+    ++counts[pr.client];
+  }
+  MT_CHECK_EQ(counts[1], 4);
+  MT_CHECK_EQ(counts[2], 2);
+}
+
+MT_TEST(future_and_none) {
+  g_infos = {{1, ClientInfo(1, 1, 1)}};
+  Q q(info_of, opts());
+  MT_CHECK(q.pull_request(1 * S).is_none());
+  q.add_request(7, 1, ReqParams(), 10 * S);
+  auto pr = q.pull_request(10 * S);
+  MT_CHECK(pr.is_retn());
+  MT_CHECK_EQ(pr.request, uint64_t{7});
+  q.add_request(8, 1, ReqParams(), 10 * S);
+  pr = q.pull_request(10 * S);
+  MT_CHECK(pr.is_future());
+  MT_CHECK_EQ(pr.when_ready, 11 * S);  // limited 1/s away
+}
+
+MT_TEST(delayed_tag_calc_matches_immediate_order) {
+  // same workload under both modes yields the same service order when
+  // rho/delta are constant (the modes differ only in WHEN tags compute)
+  g_infos = {{1, ClientInfo(1, 2, 0)}, {2, ClientInfo(2, 1, 0)}};
+  Q qi(info_of, opts(false)), qd(info_of, opts(true));
+  int64_t t = 5 * S;
+  for (uint64_t i = 0; i < 8; ++i) {
+    for (uint64_t c : {1, 2}) {
+      qi.add_request(c * 100 + i, c, ReqParams(1, 1), t + int64_t(i));
+      qd.add_request(c * 100 + i, c, ReqParams(1, 1), t + int64_t(i));
+    }
+  }
+  for (int i = 0; i < 16; ++i) {
+    auto a = qi.pull_request(t + 60 * S);
+    auto b = qd.pull_request(t + 60 * S);
+    MT_CHECK(a.is_retn() && b.is_retn());
+    MT_CHECK_EQ(a.client, b.client);
+    MT_CHECK_EQ(a.request, b.request);
+  }
+}
+
+MT_TEST(allow_limit_break) {
+  g_infos = {{1, ClientInfo(0, 1, 1)}};
+  Q q(info_of, opts(false, AtLimit::Allow));
+  int64_t t = 50 * S;
+  q.add_request(1, 1, ReqParams(), t);
+  q.add_request(2, 1, ReqParams(), t);
+  MT_CHECK(q.pull_request(t).is_retn());
+  MT_CHECK(q.pull_request(t).is_retn());  // over-limit break
+  MT_CHECK_EQ(q.limit_break_sched_count, uint64_t{1});
+}
+
+MT_TEST(reject_over_limit) {
+  // Reject returns EAGAIN without taking ownership (reference :1301-1360)
+  g_infos = {{1, ClientInfo(0, 1, 1)}};
+  Q::Options o = opts(false, AtLimit::Reject);
+  Q q(info_of, o);
+  int64_t t = 50 * S;
+  MT_CHECK_EQ(q.add_request(1, 1, ReqParams(), t), 0);
+  MT_CHECK_EQ(q.add_request(2, 1, ReqParams(), t), EAGAIN);
+  MT_CHECK_EQ(q.request_count(), uint64_t{1});
+  // with a threshold, the next second of work is admitted
+  Q::Options o2 = opts(false, AtLimit::Wait);
+  o2.reject_threshold_ns = 1 * S;  // implies Reject (reference :89-93)
+  Q q2(info_of, o2);
+  MT_CHECK_EQ(q2.add_request(1, 1, ReqParams(), t), 0);
+  MT_CHECK_EQ(q2.add_request(2, 1, ReqParams(), t), 0);
+  MT_CHECK_EQ(q2.add_request(3, 1, ReqParams(), t), EAGAIN);
+}
+
+MT_TEST(anticipation_preserves_credit) {
+  // an arrival within the anticipation window is backdated so a
+  // briefly-idle client keeps its virtual-time credit (reference
+  // :159-161); with it, client 1's second request still sorts first
+  g_infos = {{1, ClientInfo(0, 1, 0)}, {2, ClientInfo(0, 1, 0)}};
+  Q qa(info_of, opts(false, AtLimit::Wait, S / 2));
+  int64_t t = 10 * S;
+  qa.add_request(11, 1, ReqParams(), t);
+  qa.add_request(21, 2, ReqParams(), t);
+  auto p1 = qa.pull_request(t);
+  MT_CHECK_EQ(p1.client, uint64_t{1});
+  // client 1 idles 0.3 s (inside the window) then asks again
+  qa.add_request(12, 1, ReqParams(), t + 3 * S / 10);
+  auto p2 = qa.pull_request(t + 3 * S / 10);
+  // backdating means client 1's proportion advanced from its previous
+  // tag, not from wall time: client 2 (still at t) wins
+  MT_CHECK_EQ(p2.client, uint64_t{2});
+}
+
+MT_TEST(update_client_info_applies) {
+  // delayed mode: queued-but-untagged requests pick up the new info
+  // when they reach the head (immediate mode tags at arrival, so an
+  // info change cannot retro-affect already-queued work)
+  g_infos = {{1, ClientInfo(0, 1, 0)}, {2, ClientInfo(0, 1, 0)}};
+  Q q(info_of, opts(true));
+  int64_t t = 5 * S;
+  for (uint64_t i = 0; i < 6; ++i) {
+    q.add_request(100 + i, 1, ReqParams(), t);
+    q.add_request(200 + i, 2, ReqParams(), t);
+  }
+  (void)q.pull_request(t + S);
+  g_infos[2].update(0, 4, 0);
+  q.update_client_info(2);
+  std::map<uint64_t, int> counts;
+  for (int i = 0; i < 8; ++i) {
+    auto pr = q.pull_request(t + S);
+    if (pr.is_retn()) ++counts[pr.client];
+  }
+  MT_CHECK(counts[2] > counts[1]);
+}
+
+MT_TEST(remove_by_client_and_filter) {
+  g_infos = {{1, ClientInfo(0, 1, 0)}, {2, ClientInfo(0, 1, 0)}};
+  Q q(info_of, opts());
+  int64_t t = 3 * S;
+  for (uint64_t i = 0; i < 4; ++i) {
+    q.add_request(100 + i, 1, ReqParams(), t);
+    q.add_request(200 + i, 2, ReqParams(), t);
+  }
+  std::vector<uint64_t> got;
+  q.remove_by_client(1, false, [&](uint64_t&& r) { got.push_back(r); });
+  MT_CHECK_EQ(got.size(), size_t{4});
+  MT_CHECK_EQ(got[0], uint64_t{100});
+  bool removed = q.remove_by_req_filter(
+      [](uint64_t&& r) { return r % 2 == 0; });
+  MT_CHECK(removed);
+  MT_CHECK_EQ(q.request_count(), uint64_t{2});
+}
+
+MT_TEST(gc_idle_then_erase) {
+  // injected monotonic clock; timeline mirrors the reference's
+  // client_idle_erase test (:100-185)
+  g_infos = {{1, ClientInfo(1, 1, 0)}};
+  double fake_now = 0.0;
+  Q::Options o = opts();
+  o.idle_age_s = 10.0;
+  o.erase_age_s = 20.0;
+  o.check_time_s = 1.0;
+  Q q(info_of, o);
+  q.set_monotonic_clock([&] { return fake_now; });
+  q.add_request(1, 1, ReqParams(), 1 * S);
+  (void)q.pull_request(2 * S);
+  MT_CHECK_EQ(q.client_count(), uint64_t{1});
+  for (int i = 0; i <= 30; ++i) {
+    fake_now = i;
+    q.do_clean();
+  }
+  MT_CHECK_EQ(q.client_count(), uint64_t{0});
+}
+
+MT_MAIN()

@@ -1,0 +1,92 @@
+// Native ServiceTracker tests, mirroring the reference client suite
+// (/root/reference/test/test_dmclock_client.cc): exact delta/rho
+// sequences for Orig and Borrowing accounting across interleaved
+// multi-server responses, plus GC of dead server records.
+
+#include "dmclock/tracker.h"
+#include "microtest.h"
+
+using namespace dmclock;
+
+MT_TEST(orig_tracker_sequences) {
+  // mirrors test_dmclock_client.cc:231-304's counting style
+  ServiceTracker<uint64_t, OrigTracker> st;
+  auto rp = st.get_req_params(1);  // first contact
+  MT_CHECK_EQ(rp.delta, 1u);
+  MT_CHECK_EQ(rp.rho, 1u);
+  // responses: 2 from server1 (one reservation), 1 from server2
+  st.track_resp(1, Phase::reservation, 1);
+  st.track_resp(1, Phase::priority, 1);
+  auto rp2 = st.get_req_params(2);  // first contact with 2
+  MT_CHECK_EQ(rp2.delta, 1u);
+  st.track_resp(2, Phase::priority, 1);
+  // server1 sees everything since last request there MINUS its own
+  // deliveries (2 own + 1 from server2 -> delta = 1)
+  auto rp3 = st.get_req_params(1);
+  MT_CHECK_EQ(rp3.delta, 1u);
+  MT_CHECK_EQ(rp3.rho, 0u);
+  // nothing happened since: zero movement
+  auto rp4 = st.get_req_params(1);
+  MT_CHECK_EQ(rp4.delta, 0u);
+  MT_CHECK_EQ(rp4.rho, 0u);
+}
+
+MT_TEST(borrowing_tracker_floors_at_one) {
+  // BorrowingTracker guarantees >=1 by borrowing future replies
+  // (reference calc_with_borrow :110-129)
+  ServiceTracker<uint64_t, BorrowingTracker> st;
+  auto rp = st.get_req_params(1);
+  MT_CHECK_EQ(rp.delta, 1u);
+  // no traffic at all; still reports 1 and accrues borrow
+  auto rp2 = st.get_req_params(1);
+  MT_CHECK_EQ(rp2.delta, 1u);
+  MT_CHECK_EQ(rp2.rho, 1u);
+  // two completions arrive; one is owed to the borrow
+  st.track_resp(1, Phase::reservation, 1);
+  st.track_resp(1, Phase::priority, 1);
+  auto rp3 = st.get_req_params(1);
+  MT_CHECK_EQ(rp3.delta, 1u);  // 2 seen - 1 borrowed
+}
+
+MT_TEST(calc_with_borrow_cases) {
+  // (global-previous, borrow) -> (out, new_borrow)
+  auto r1 = BorrowingTracker::calc_with_borrow(10, 10, 0);
+  MT_CHECK_EQ(r1.first, Counter{1});
+  MT_CHECK_EQ(r1.second, Counter{1});
+  auto r2 = BorrowingTracker::calc_with_borrow(15, 10, 2);
+  MT_CHECK_EQ(r2.first, Counter{3});
+  MT_CHECK_EQ(r2.second, Counter{0});
+  auto r3 = BorrowingTracker::calc_with_borrow(12, 10, 5);
+  MT_CHECK_EQ(r3.first, Counter{1});
+  MT_CHECK_EQ(r3.second, Counter{4});
+}
+
+MT_TEST(server_record_gc) {
+  // mirrors reference server_erase (:42-105): a server unused past
+  // clean_age is forgotten; tracker self-heals on its return
+  ServiceTracker<uint64_t, OrigTracker> st(/*clean_every_s=*/1.0,
+                                           /*clean_age_s=*/10.0,
+                                           /*run_gc_thread=*/false);
+  double fake_now = 0.0;
+  st.set_monotonic_clock([&] { return fake_now; });
+  (void)st.get_req_params(1);
+  (void)st.get_req_params(2);
+  MT_CHECK_EQ(st.server_count(), size_t{2});
+  st.track_resp(1, Phase::priority, 1);
+  for (int i = 0; i <= 12; ++i) {
+    fake_now = i;
+    st.do_clean();
+    if (i == 6) {
+      // keep server 1 alive mid-window: new traffic moves the global
+      // counter, then a request re-marks server 1 past the erase point
+      st.track_resp(1, Phase::priority, 1);
+      (void)st.get_req_params(1);
+    }
+  }
+  MT_CHECK_EQ(st.server_count(), size_t{1});
+  // self-heal: response from the forgotten server re-creates a record
+  st.track_resp(2, Phase::priority, 1);
+  MT_CHECK_EQ(st.server_count(), size_t{2});
+}
+
+MT_MAIN()

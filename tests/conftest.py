@@ -1,15 +1,15 @@
 """Test configuration.
 
-JAX runs on a virtual 8-device CPU mesh so multi-chip sharding logic is
-exercised without TPU hardware, and x64 is enabled because the canonical
-tag algebra is int64 nanoseconds.  Env vars must be set before the first
-jax import anywhere in the test session.
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware.  The environment's TPU boot shim force-
+selects its platform via ``jax.config`` at interpreter startup, so env
+vars alone don't stick -- override the config the same way, before any
+backend is used.  x64 stays enabled because the canonical tag algebra is
+int64 nanoseconds.
 """
 
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)

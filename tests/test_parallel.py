@@ -1,0 +1,128 @@
+"""Distributed layer: psum ServiceTracker parity + sharded cluster step.
+
+The device tracker must reproduce the host ``OrigTracker`` delta/rho
+sequences exactly (reference ``test/test_dmclock_client.cc:231-304``
+pins the same algebra), and the cluster step must run sharded over the
+virtual 8-device CPU mesh with its psum collective.
+"""
+
+import functools
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmclock_tpu.core import Phase
+from dmclock_tpu.core.timebase import rate_to_inv_ns
+from dmclock_tpu.core.tracker import ServiceTracker
+from dmclock_tpu.parallel import (cluster as CL, init_tracker,
+                                  tracker_prepare, tracker_track)
+
+
+def test_device_tracker_matches_orig_tracker():
+    """Random interleaving of requests/responses across servers: the
+    device algebra must equal host OrigTracker's ReqParams stream."""
+    rng = random.Random(7)
+    n_servers, n_steps = 3, 300
+
+    host = ServiceTracker(run_gc_thread=False)
+    # device trackers: one TrackerState per server, single client slot 0
+    dev = [init_tracker(1) for _ in range(n_servers)]
+
+    def dev_global():
+        d = 1 + sum(int(t.completed_delta[0]) for t in dev)
+        r = 1 + sum(int(t.completed_rho[0]) for t in dev)
+        return d, r
+
+    outstanding = []
+    for _ in range(n_steps):
+        if outstanding and rng.random() < 0.5:
+            s, phase, cost = outstanding.pop(rng.randrange(len(outstanding)))
+            host.track_resp(s, phase, cost)
+            dev[s] = tracker_track(
+                dev[s], jnp.zeros(1, jnp.int32),
+                jnp.full(1, cost, jnp.int64),
+                jnp.full(1, int(phase), jnp.int32),
+                jnp.ones(1, bool))
+        else:
+            s = rng.randrange(n_servers)
+            rp = host.get_req_params(s)
+            gd, gr = dev_global()
+            dev[s], d_out, r_out = tracker_prepare(
+                dev[s], jnp.ones(1, bool),
+                jnp.full(1, gd, jnp.int64), jnp.full(1, gr, jnp.int64))
+            assert (int(d_out[0]), int(r_out[0])) == (rp.delta, rp.rho), \
+                f"server {s}: device ({int(d_out[0])},{int(r_out[0])}) " \
+                f"!= host ({rp.delta},{rp.rho})"
+            phase = Phase.RESERVATION if rng.random() < 0.5 \
+                else Phase.PRIORITY
+            outstanding.append((s, phase, rng.randint(1, 3)))
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return CL.make_mesh(8)
+
+
+def _make_cluster(n_servers, n_clients, reservation=10.0):
+    cl = CL.init_cluster(n_servers, n_clients)
+    rinv = jnp.full((n_clients,), rate_to_inv_ns(reservation),
+                    dtype=jnp.int64)
+    winv = jnp.asarray([rate_to_inv_ns(1.0 + (i % 3))
+                        for i in range(n_clients)], dtype=jnp.int64)
+    linv = jnp.zeros((n_clients,), dtype=jnp.int64)
+    return CL.install_clients(cl, rinv, winv, linv)
+
+
+def test_cluster_step_sharded(mesh8):
+    n_servers, n_clients = 8, 16
+    cl = _make_cluster(n_servers, n_clients)
+    cl = CL.shard_cluster(cl, mesh8)
+    step = jax.jit(functools.partial(
+        CL.cluster_step, mesh=mesh8, cost=1, decisions_per_step=16))
+    arrivals = jnp.ones((n_servers, n_clients), dtype=jnp.int32)
+
+    cl, decs = step(cl, arrivals)
+    served = np.asarray(decs.type) == 0
+    assert served.sum() == n_servers * n_clients  # all requests served
+    # every server served every client exactly once
+    slots = np.asarray(decs.slot)
+    for s in range(n_servers):
+        assert sorted(slots[s][served[s]]) == list(range(n_clients))
+    # completion counters: each server recorded one completion/client
+    assert np.asarray(cl.tracker.completed_delta).sum() \
+        == n_servers * n_clients
+
+    # second round: ReqParams now flow from the psum'd counters
+    cl, decs = step(cl, arrivals)
+    assert (np.asarray(decs.type) == 0).sum() == n_servers * n_clients
+    # rho/delta reached the engine: cur_delta holds last ReqParams.delta,
+    # which after round 2 must reflect the other servers' traffic
+    cur_delta = np.asarray(cl.engine.cur_delta)
+    assert cur_delta.max() > 1
+
+
+def test_cluster_counters_match_protocol(mesh8):
+    """delta seen by a server == completions that client got everywhere
+    since its previous request to that server (the dmClock invariant)."""
+    n_servers, n_clients = 8, 4
+    cl = _make_cluster(n_servers, n_clients)
+    cl = CL.shard_cluster(cl, mesh8)
+    step = jax.jit(functools.partial(
+        CL.cluster_step, mesh=mesh8, cost=1, decisions_per_step=8))
+    arrivals = jnp.ones((n_servers, n_clients), dtype=jnp.int32)
+    cl, _ = step(cl, arrivals)
+    cl, _ = step(cl, arrivals)
+    # after round 1 each client completed once on each of 8 servers; a
+    # round-2 request to server s sees delta = 1 (global start) ... plus
+    # 8 completions minus bookkeeping; just pin the exact invariant:
+    # all servers saw the same delta for a given client
+    cur_delta = np.asarray(cl.engine.cur_delta)  # [S, C]
+    assert (cur_delta == cur_delta[0]).all()
+    # OrigTracker algebra: completions everywhere since the previous
+    # request to this server, MINUS own completions there -> S - 1
+    assert cur_delta[0, 0] == n_servers - 1
