@@ -1,0 +1,433 @@
+"""Speculative batched serving: thousands of decisions per O(N) pass.
+
+The exact engine (`kernels.engine_step`) pays an O(N) masked-argmin per
+decision -- semantically perfect, bandwidth-bound at scale.  This module
+exploits the structure of dmClock steady states: with a deep backlog,
+consecutive decisions serve DISTINCT clients (each serve advances that
+client's virtual time by ~inv, far past the tag spacing between
+clients), and serves of distinct clients commute.  So a batch of k
+decisions is just the k smallest candidate tags -- one `top_k` plus
+O(k) vectorized serves -- *provided* the speculation is validated.
+
+Two speculative regimes, each with an on-device validity check that
+compares against what the serial engine would have done (`engine_run`):
+
+- **weight regime** (reference weight phase, do_next_request :1146-1151):
+  no reservation tag is eligible (resv_min > now) and stays so through
+  the batch; candidates are effectively-ready clients by
+  (proportion + prop_delta, order).
+- **reservation regime** (constraint phase, :1124-1128): every served
+  tag is <= now (deep reservation backlog); weight phase is never
+  reached, so no promotion side-effects occur.
+
+Checks performed AFTER the vectorized serve (cheap, [k]-sized):
+one-serve-per-client (each new head tag must leave the served window),
+phase stability (reservation tags stay ineligible in the weight regime /
+served tags all eligible in the reservation regime), and strict key
+separation at the batch boundary (tie safety).  On failure the caller
+falls back to the exact serial engine for that batch -- results are
+therefore always bit-identical to `engine_run` (differentially tested).
+
+Restrictions (checked by the caller): AtLimit::Wait, monotonic `now`,
+fixed `now` within a batch.  The stored `ready` flags are superseded by
+the computed `limit <= now` (equivalent under monotonic now, since a
+promotion that serial processing would perform later in the batch is
+performed here eagerly and verified sound).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.timebase import MAX_TAG, TIME_MAX
+from . import kernels
+from .kernels import KEY_INF, Decision, _make_tag, _fold_prev
+from .state import EngineState
+
+
+class FastBatch(NamedTuple):
+    """Result of one speculative attempt."""
+
+    state: EngineState
+    ok: jnp.ndarray        # bool: speculation valid; else state is the
+    #                        INPUT state (caller reruns serially)
+    decisions: Decision    # [k] arrays, valid where ok
+
+
+# Creation indices stay far below this (2^45 ~ 35 trillion requests);
+# used to rank strictly-below-boundary candidates ahead of every
+# boundary tie in the order-aware second top_k pass.
+ORDER_BIG = 1 << 45
+
+
+def _lex_top_k(key, order, k: int):
+    """Indices of the k lexicographically-smallest (key, order) pairs.
+
+    Exact at tie boundaries: pass 1 finds the k-th smallest key V;
+    pass 2 ranks candidates with key < V ahead of everything and
+    resolves the key == V boundary group by creation order -- the
+    serial engine's exact tie-break.  Returns (idx[k], V,
+    max_tied_order, count_ok).
+    """
+    neg, _ = lax.top_k(-key, k)
+    v = -neg[k - 1]
+    below = key < v
+    tied = key == v
+    rank = jnp.where(below, order - ORDER_BIG,
+                     jnp.where(tied, order, KEY_INF))
+    neg2, idx = lax.top_k(-rank, k)
+    count_ok = -neg2[k - 1] < KEY_INF  # k real candidates exist
+    order_k = order[idx]
+    max_tied_order = jnp.max(jnp.where(key[idx] == v, order_k,
+                                       -(jnp.int64(1) << 62)))
+    return idx, v, max_tied_order, count_ok
+
+
+def _ready_now(state: EngineState, now):
+    """Effective readiness under monotonic now: stored flag OR limit
+    passed (the promote loop marks exactly {limit <= now},
+    reference :1135-1144)."""
+    return state.head_ready | (state.head_limit <= now)
+
+
+class ServePlan(NamedTuple):
+    """Planned (not yet applied) vectorized pop+retag of k clients."""
+
+    served_cost: jnp.ndarray
+    new_depth: jnp.ndarray
+    has_more: jnp.ndarray
+    rq_next: jnp.ndarray
+    head_resv: jnp.ndarray
+    head_prop: jnp.ndarray
+    head_limit: jnp.ndarray
+    head_arrival: jnp.ndarray
+    head_cost: jnp.ndarray
+    head_rho: jnp.ndarray
+    prev_resv: jnp.ndarray
+    prev_prop: jnp.ndarray
+    prev_limit: jnp.ndarray
+    prev_arrival: jnp.ndarray
+
+
+def _plan_serves(state: EngineState, idx, phase_is_ready,
+                 anticipation_ns: int) -> ServePlan:
+    """Compute the vectorized pop+retag of k distinct clients
+    (pop_process_request / update_next_tag / reduce_reservation_tags,
+    reference :1021-1111) without touching state -- valid only when idx
+    are distinct, which the speculation guarantees (one head per
+    client).  Application is deferred to `_apply_serves` so a failed
+    speculation costs nothing and needs no state rollback."""
+    served_r = state.head_resv[idx]
+    served_p = state.head_prop[idx]
+    served_l = state.head_limit[idx]
+    served_arr = state.head_arrival[idx]
+    served_cost = state.head_cost[idx]
+    served_rho = state.head_rho[idx]
+
+    new_depth = state.depth[idx] - 1
+    has_more = new_depth > 0
+    rq = state.q_head[idx]
+    narr = state.q_arrival[idx, rq]
+    ncost = state.q_cost[idx, rq]
+
+    nr, np_, nl = _make_tag(
+        served_r, served_p, served_l, served_arr,
+        state.resv_inv[idx], state.weight_inv[idx], state.limit_inv[idx],
+        state.cur_delta[idx], state.cur_rho[idx], narr, ncost,
+        anticipation_ns)
+
+    offset = jnp.where(phase_is_ready,
+                       state.resv_inv[idx] * (served_cost + served_rho),
+                       jnp.int64(0))
+
+    prev_r = jnp.where(has_more, _fold_prev(state.prev_resv[idx], nr),
+                       state.prev_resv[idx]) - offset
+    prev_p = jnp.where(has_more, _fold_prev(state.prev_prop[idx], np_),
+                       state.prev_prop[idx])
+    prev_l = jnp.where(has_more, _fold_prev(state.prev_limit[idx], nl),
+                       state.prev_limit[idx])
+    prev_arr = jnp.where(has_more, narr, state.prev_arrival[idx])
+
+    return ServePlan(
+        served_cost=served_cost,
+        new_depth=new_depth.astype(jnp.int32),
+        has_more=has_more,
+        rq_next=((rq + 1) % state.ring_capacity).astype(jnp.int32),
+        head_resv=nr - offset, head_prop=np_, head_limit=nl,
+        head_arrival=narr, head_cost=ncost,
+        head_rho=state.cur_rho[idx],
+        prev_resv=prev_r, prev_prop=prev_p, prev_limit=prev_l,
+        prev_arrival=prev_arr)
+
+
+def _apply_serves(state: EngineState, idx, plan: ServePlan,
+                  gate) -> EngineState:
+    """Scatter the plan at idx, gated on the scalar `gate` (speculation
+    validity): only k rows are touched, so a gated-off apply is free --
+    no whole-state select, which matters inside scanned epochs."""
+    has_more = plan.has_more & gate
+
+    def scat(arr, val, pred):
+        return arr.at[idx].set(jnp.where(pred, val, arr[idx]))
+
+    return state._replace(
+        depth=scat(state.depth, plan.new_depth, gate),
+        q_head=scat(state.q_head, plan.rq_next, has_more),
+        head_resv=scat(state.head_resv, plan.head_resv, has_more),
+        head_prop=scat(state.head_prop, plan.head_prop, has_more),
+        head_limit=scat(state.head_limit, plan.head_limit, has_more),
+        head_arrival=scat(state.head_arrival, plan.head_arrival,
+                          has_more),
+        head_cost=scat(state.head_cost, plan.head_cost, has_more),
+        head_rho=scat(state.head_rho, plan.head_rho, has_more),
+        head_ready=scat(state.head_ready, jnp.zeros_like(idx, bool),
+                        gate),
+        prev_resv=scat(state.prev_resv, plan.prev_resv, gate),
+        prev_prop=scat(state.prev_prop, plan.prev_prop, gate),
+        prev_limit=scat(state.prev_limit, plan.prev_limit, gate),
+        prev_arrival=scat(state.prev_arrival, plan.prev_arrival, gate),
+    )
+
+
+def speculate_weight_batch(state: EngineState, now, k: int, *,
+                           anticipation_ns: int,
+                           enabled=True) -> FastBatch:
+    """k weight-phase serves in one pass; state untouched when the
+    speculation fails (ok=False) or `enabled` is False."""
+    has_req = state.active & (state.depth > 0)
+    ready = has_req & _ready_now(state, now)
+    eff = state.head_prop + state.prop_delta
+    key = jnp.where(ready & (state.head_prop < MAX_TAG), eff, KEY_INF)
+
+    # entry condition: reservation phase must not fire (:1124-1128)
+    resv_key = jnp.where(has_req, state.head_resv, KEY_INF)
+    resv_min0 = jnp.min(resv_key)
+    cond_entry = resv_min0 > now
+
+    idx, kth, max_tied_order, cond_count = _lex_top_k(key, state.order, k)
+    key_k = key[idx]
+
+    plan = _plan_serves(state, idx, jnp.ones((k,), dtype=bool),
+                        anticipation_ns)
+
+    # one-serve-per-client: each served client must leave the window --
+    # its new head either empty, not ready at `now`, keyed strictly past
+    # the boundary V, or tied at V but ordered after every served tie
+    # (so the serial engine would also leave it unserved)
+    new_eff = plan.head_prop + state.prop_delta[idx]
+    new_ready = (plan.head_limit <= now) & (plan.head_prop < MAX_TAG)
+    beyond = (new_eff > kth) | \
+        ((new_eff == kth) & (state.order[idx] > max_tied_order))
+    cond_once = jnp.all((~plan.has_more) | (~new_ready) | beyond)
+    # phase stability: no served client's new reservation tag becomes
+    # eligible (unserved clients' tags didn't move; entry checked them)
+    cond_resv = jnp.all(
+        jnp.where(plan.has_more, plan.head_resv, TIME_MAX) > now)
+
+    ok = cond_entry & cond_count & cond_once & cond_resv
+    gate = ok & enabled
+
+    new_state = _apply_serves(state, idx, plan, gate)
+
+    # emit decisions in exact serial order: (key, order) ascending
+    order_k = state.order[idx]
+    perm = jnp.lexsort((order_k, key_k))
+
+    # Stored-flag parity with the serial engine: every weight decision
+    # runs the promote loop first (reference :1135-1144), so at batch
+    # end every current head with limit <= now carries ready=True --
+    # except the head popped by the LAST decision, which no later
+    # promotion pass ever saw.
+    has_req_after = new_state.active & (new_state.depth > 0)
+    promoted = new_state.head_ready | \
+        (has_req_after & (new_state.head_limit <= now))
+    last_client = idx[perm[k - 1]]
+    promoted = promoted.at[last_client].set(False)
+    new_state = new_state._replace(head_ready=jnp.where(
+        gate, promoted, new_state.head_ready))
+
+    decisions = Decision(
+        type=jnp.zeros((k,), dtype=jnp.int32),
+        slot=idx[perm].astype(jnp.int32),
+        phase=jnp.ones((k,), dtype=jnp.int32),
+        cost=plan.served_cost[perm],
+        when=jnp.zeros((k,), dtype=jnp.int64),
+        limit_break=jnp.zeros((k,), dtype=bool),
+    )
+    return FastBatch(state=new_state, ok=ok, decisions=decisions)
+
+
+def speculate_resv_batch(state: EngineState, now, k: int, *,
+                         anticipation_ns: int,
+                         enabled=True) -> FastBatch:
+    """k reservation-phase serves in one pass; state untouched when the
+    speculation fails or `enabled` is False.
+
+    Valid when the k smallest reservation tags are all <= now (deep
+    constraint backlog): phase 1 fires every time, so no promotion or
+    weight-phase side effects occur (reference :1124-1128)."""
+    has_req = state.active & (state.depth > 0)
+    key = jnp.where(has_req, state.head_resv, KEY_INF)
+
+    idx, kth, max_tied_order, cond_count = _lex_top_k(key, state.order, k)
+    key_k = key[idx]
+    cond_eligible = kth <= now            # all k fire the constraint phase
+
+    plan = _plan_serves(state, idx, jnp.zeros((k,), dtype=bool),
+                        anticipation_ns)
+
+    # one-serve-per-client: the new head tag must leave the window
+    beyond = (plan.head_resv > kth) | \
+        ((plan.head_resv == kth) & (state.order[idx] > max_tied_order))
+    cond_once = jnp.all((~plan.has_more) | beyond)
+
+    ok = cond_eligible & cond_count & cond_once
+    new_state = _apply_serves(state, idx, plan, ok & enabled)
+
+    order_k = state.order[idx]
+    perm = jnp.lexsort((order_k, key_k))
+    decisions = Decision(
+        type=jnp.zeros((k,), dtype=jnp.int32),
+        slot=idx[perm].astype(jnp.int32),
+        phase=jnp.zeros((k,), dtype=jnp.int32),
+        cost=plan.served_cost[perm],
+        when=jnp.zeros((k,), dtype=jnp.int64),
+        limit_break=jnp.zeros((k,), dtype=bool),
+    )
+    return FastBatch(state=new_state, ok=ok, decisions=decisions)
+
+
+def attempt_fast_batch(state: EngineState, now, k: int, *,
+                       anticipation_ns: int,
+                       enabled=True,
+                       weight_first=False) -> FastBatch:
+    """One speculative attempt: one regime, then the other on failure.
+
+    Both speculations are cheap (top_k + O(k) serves), so the branch is
+    a small device cond.  The caller checks ``ok`` on the host (or via
+    the epoch scan's commit mask) and falls back to the exact serial
+    engine when speculation fails -- keeping the expensive O(k*N)
+    fallback OUT of this compiled program.  With `enabled` False the
+    state passes through untouched.  ``weight_first`` orders the
+    attempts -- steady states stay in one regime for long stretches, so
+    trying last batch's regime first skips a wasted speculation.
+    """
+
+    def resv(_):
+        return speculate_resv_batch(state, now, k,
+                                    anticipation_ns=anticipation_ns,
+                                    enabled=enabled)
+
+    def weight(_):
+        return speculate_weight_batch(state, now, k,
+                                      anticipation_ns=anticipation_ns,
+                                      enabled=enabled)
+
+    def ordered(first, second):
+        def go(_):
+            fb = first(None)
+            return lax.cond(fb.ok, lambda _: fb, second, operand=None)
+        return go
+
+    return lax.cond(weight_first, ordered(weight, resv),
+                    ordered(resv, weight), operand=None)
+
+
+class FastEpoch(NamedTuple):
+    """M speculative batches' worth of output, compact for readback.
+
+    The tunneled single-chip runtime pays ~100ms round-trip latency per
+    host readback CALL regardless of size, so an epoch returns all M
+    batches' decisions in one pytree: one device_get per epoch.
+    """
+
+    state: EngineState     # after the last COMMITTED batch
+    ok: jnp.ndarray        # bool[M]: batch i committed
+    slot: jnp.ndarray      # int32[M, k] serial-order winners
+    phase: jnp.ndarray     # int8[M, k]
+    cost: jnp.ndarray      # int32[M, k]
+
+
+# state fields the speculative serve path never writes: rings are only
+# popped via q_head, and QoS/identity/ingest-time fields are mutated by
+# ingest alone, which cannot run mid-epoch.  Keeping them OUT of the
+# scan carry stops XLA from shuffling ~100MB of loop-invariant buffers
+# per iteration (the rings dominate).
+_EPOCH_INVARIANT = ("active", "idle", "order", "resv_inv", "weight_inv",
+                    "limit_inv", "prop_delta", "cur_rho", "cur_delta",
+                    "q_arrival", "q_cost")
+_EPOCH_MUTABLE = tuple(f for f in EngineState._fields
+                       if f not in _EPOCH_INVARIANT)
+
+
+def scan_fast_epoch(state: EngineState, now, m: int, k: int, *,
+                    anticipation_ns: int) -> FastEpoch:
+    """Run up to m speculative batches of k decisions, entirely on
+    device.  Commit-prefix semantics: the first failed speculation
+    stops the epoch (its state is NOT applied, and no later batch is),
+    so the returned state is always an exact serial prefix -- the host
+    reruns from it with the exact engine, then resumes epochs.
+    """
+    invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
+    mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+
+    def body(carry, _):
+        mut, dead, weight_hint = carry
+        st = EngineState(**invariant, **mut)
+        batch = attempt_fast_batch(st, now, k,
+                                   anticipation_ns=anticipation_ns,
+                                   enabled=~dead,
+                                   weight_first=weight_hint)
+        commit = batch.ok & ~dead
+        # batch.state is bit-identical to st when not committed (the
+        # serve scatters are gated), so no whole-state select is needed
+        out = (commit,
+               batch.decisions.slot,
+               batch.decisions.phase.astype(jnp.int8),
+               batch.decisions.cost.astype(jnp.int32))
+        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
+        weight_hint = jnp.where(batch.ok, batch.decisions.phase[0] == 1,
+                                weight_hint)
+        return (new_mut, dead | ~batch.ok, weight_hint), out
+
+    (mutable, _dead, _hint), (ok, slot, phase, cost) = lax.scan(
+        body, (mutable0, jnp.bool_(False), jnp.bool_(False)), None,
+        length=m)
+    state = EngineState(**invariant, **mutable)
+    return FastEpoch(state=state, ok=ok, slot=slot, phase=phase,
+                     cost=cost)
+
+
+def make_fast_runner(k: int, *, anticipation_ns: int = 0):
+    """Host-orchestrated runner: (state, now) -> (state, decisions,
+    used_fast).  Bit-identical to ``kernels.engine_run(...,
+    advance_now=False)`` under AtLimit::Wait with monotonic now
+    (differential tests pin this): speculation is validated on device,
+    and on failure the exact serial engine reruns the batch from the
+    untouched input state.
+
+    The one-scalar ``ok`` sync per batch costs ~launch latency, far
+    below the serial fallback it avoids compiling into the hot program.
+    """
+    import functools
+
+    import jax
+
+    attempt = jax.jit(functools.partial(
+        attempt_fast_batch, k=k, anticipation_ns=anticipation_ns))
+    exact = jax.jit(lambda s, t: kernels.engine_run(
+        s, t, k, allow_limit_break=False,
+        anticipation_ns=anticipation_ns, advance_now=False))
+
+    def run(state: EngineState, now):
+        batch = attempt(state, now)
+        if bool(batch.ok):
+            return batch.state, batch.decisions, True
+        st, _, decs = exact(state, now)
+        return st, decs, False
+
+    return run
