@@ -75,12 +75,16 @@ def _lex_top_k(key, order, k: int):
     """
     neg, _ = lax.top_k(-key, k)
     v = -neg[k - 1]
+    # Sentinel (masked) entries carry key == KEY_INF; they must never
+    # join the tie group, or an underfull candidate set would rank them
+    # by creation order and "serve" requestless clients.
+    real = key < KEY_INF
     below = key < v
-    tied = key == v
+    tied = real & (key == v)
     rank = jnp.where(below, order - ORDER_BIG,
                      jnp.where(tied, order, KEY_INF))
-    neg2, idx = lax.top_k(-rank, k)
-    count_ok = -neg2[k - 1] < KEY_INF  # k real candidates exist
+    _, idx = lax.top_k(-rank, k)
+    count_ok = v < KEY_INF  # k real candidates exist
     order_k = order[idx]
     max_tied_order = jnp.max(jnp.where(key[idx] == v, order_k,
                                        -(jnp.int64(1) << 62)))

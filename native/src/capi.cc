@@ -11,6 +11,8 @@
 // seam (dmclock_server.h:542) without cross-language calls per request.
 
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <mutex>
 #include <unordered_map>
 

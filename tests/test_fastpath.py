@@ -1,0 +1,406 @@
+"""Differential tests for the speculative fast path.
+
+``fastpath`` promises bit-identity with the exact serial engine
+(``kernels.engine_run`` under AtLimit::Wait, fixed ``now`` per batch):
+speculation either commits a batch the serial engine would have produced
+verbatim, or fails and leaves state untouched.  These tests pin that
+contract -- the same contract the headline benchmark rests on --
+including the edge cases speculation is most likely to get wrong:
+fewer-than-k eligible clients (underfull), equal-tag ties at the
+k-boundary, reservation<->weight regime flips, depth-1 clients, and
+commit-prefix semantics of the scanned epoch.
+
+Ordering spec being checked = the oracle's total order
+(``core/scheduler.py``), itself pinned to reference
+``dmclock_server.h:1115-1186`` by the oracle test suite.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmclock_tpu.core import ClientInfo, ReqParams
+from dmclock_tpu.core.scheduler import AtLimit
+from dmclock_tpu.core.timebase import NS_PER_SEC
+from dmclock_tpu.engine import TpuPullPriorityQueue, kernels
+from dmclock_tpu.engine.fastpath import (attempt_fast_batch,
+                                         make_fast_runner,
+                                         scan_fast_epoch,
+                                         speculate_resv_batch,
+                                         speculate_weight_batch)
+from dmclock_tpu.engine.state import EngineState
+
+S = NS_PER_SEC
+
+
+def states_equal(a: EngineState, b: EngineState) -> bool:
+    return all(bool(jnp.array_equal(x, y))
+               for x, y in zip(a, b))
+
+
+def assert_states_equal(a: EngineState, b: EngineState):
+    for name, x, y in zip(EngineState._fields, a, b):
+        assert bool(jnp.array_equal(x, y)), \
+            f"state field {name} diverged:\n{x}\nvs\n{y}"
+
+
+def serial_run(state, now, k, anticipation_ns=0):
+    st, _, decs = kernels.engine_run(
+        state, jnp.int64(now), k, allow_limit_break=False,
+        anticipation_ns=anticipation_ns, advance_now=False)
+    return st, jax.device_get(decs)
+
+
+def build_state(infos, adds, *, capacity=64, ring=64,
+                anticipation_ns=0) -> EngineState:
+    """EngineState populated via the queue's own ingest path.
+
+    ``adds`` = list of (client, time_ns, cost, delta, rho).
+    """
+    q = TpuPullPriorityQueue(lambda c: infos[c],
+                             anticipation_timeout_ns=anticipation_ns,
+                             capacity=capacity, ring_capacity=ring)
+    for client, t, cost, delta, rho in adds:
+        q.add_request(("r", client, t), client, ReqParams(delta, rho),
+                      time_ns=t, cost=cost)
+    with q.data_mtx:
+        q._flush()
+    return q.state
+
+
+def check_fast_vs_serial(state, now, k, *, anticipation_ns=0,
+                         expect_fast=None):
+    """One batch through the fast runner vs the exact serial engine."""
+    run = make_fast_runner(k, anticipation_ns=anticipation_ns)
+    fast_state, fast_decs, used_fast = run(state, jnp.int64(now))
+    ser_state, ser_decs = serial_run(state, now, k, anticipation_ns)
+    if expect_fast is not None:
+        assert used_fast == expect_fast, \
+            f"expected used_fast={expect_fast}, got {used_fast}"
+    fd = jax.device_get(fast_decs)
+    assert np.array_equal(fd.slot, ser_decs.slot)
+    assert np.array_equal(fd.cost, ser_decs.cost)
+    if used_fast:
+        # a committed speculation means every serial decision RETURNING
+        assert (ser_decs.type == kernels.RETURNING).all()
+        assert np.array_equal(fd.phase, ser_decs.phase)
+    assert_states_equal(fast_state, ser_state)
+    return fast_state, used_fast
+
+
+# ----------------------------------------------------------------------
+# underfull batches (the round-1 advisor bug): fewer real candidates
+# than k must fail speculation in BOTH regimes
+# ----------------------------------------------------------------------
+
+def test_underfull_weight_regime_falls_back():
+    infos = {c: ClientInfo(0, 1, 0) for c in range(3)}
+    adds = [(c, 1 * S, 1, 1, 1) for c in range(3)]
+    state = build_state(infos, adds, capacity=8)
+    fb = attempt_fast_batch(state, jnp.int64(1000 * S), 4,
+                            anticipation_ns=0)
+    assert not bool(fb.ok)
+    assert_states_equal(fb.state, state)  # untouched on failure
+    # depth must never go negative through the full runner either
+    st, _ = check_fast_vs_serial(state, 1000 * S, 4, expect_fast=False)
+    assert int(jnp.min(st.depth)) >= 0
+
+
+def test_underfull_resv_regime_falls_back():
+    infos = {c: ClientInfo(10, 0, 0) for c in range(3)}
+    adds = [(c, 1 * S, 1, 1, 1) for c in range(3)]
+    state = build_state(infos, adds, capacity=8)
+    fb = speculate_resv_batch(state, jnp.int64(1000 * S), 4,
+                              anticipation_ns=0)
+    assert not bool(fb.ok)
+    assert_states_equal(fb.state, state)
+    st, _ = check_fast_vs_serial(state, 1000 * S, 4, expect_fast=False)
+    assert int(jnp.min(st.depth)) >= 0
+
+
+def test_exactly_k_candidates_commits():
+    """k real candidates is the boundary case that must still commit."""
+    infos = {c: ClientInfo(0, 1 + (c % 2), 0) for c in range(4)}
+    adds = [(c, 1 * S, 1, 1, 1) for c in range(4)]
+    state = build_state(infos, adds, capacity=8)
+    check_fast_vs_serial(state, 5 * S, 4, expect_fast=True)
+
+
+# ----------------------------------------------------------------------
+# regime correctness on deep backlogs
+# ----------------------------------------------------------------------
+
+def deep_state(infos, depth, t=1 * S, capacity=64):
+    adds = [(c, t, 1, 1, 1) for _ in range(depth) for c in infos]
+    return build_state(infos, adds, capacity=capacity)
+
+
+def test_weight_regime_matches_serial():
+    """Mixed weights: speculation commits when consecutive winners are
+    distinct and legitimately falls back when the serial engine would
+    serve one client twice in-batch; parity must hold either way."""
+    infos = {c: ClientInfo(0, 1 + (c % 3), 0) for c in range(16)}
+    state = deep_state(infos, depth=8)
+    st = state
+    n_fast = 0
+    for _ in range(4):
+        st, used = check_fast_vs_serial(st, 10 * S, 8)
+        n_fast += used
+    assert n_fast >= 1, "speculation never committed -- tests vacuous"
+
+
+def test_resv_regime_matches_serial():
+    infos = {c: ClientInfo(5 + c % 3, 0, 0) for c in range(16)}
+    state = deep_state(infos, depth=8)
+    # far-future now: every reservation tag eligible (deep constraint
+    # backlog)
+    st = state
+    n_fast = 0
+    for _ in range(4):
+        st, used = check_fast_vs_serial(st, 10_000 * S, 8)
+        n_fast += used
+    assert n_fast >= 1, "speculation never committed -- tests vacuous"
+
+
+def test_equal_tag_ties_at_k_boundary():
+    """All clients share one weight and one arrival: every proportion
+    tag is equal, so the k-boundary is a pure tie group resolved by
+    creation order.  Exactness at the boundary is the hard case."""
+    infos = {c: ClientInfo(0, 2, 0) for c in range(12)}
+    state = deep_state(infos, depth=6)
+    st = state
+    for _ in range(6):
+        st, _ = check_fast_vs_serial(st, 8 * S, 8, expect_fast=True)
+
+
+def test_resv_ties_at_k_boundary():
+    infos = {c: ClientInfo(3, 0, 0) for c in range(12)}
+    state = deep_state(infos, depth=6)
+    st = state
+    for _ in range(6):
+        st, _ = check_fast_vs_serial(st, 9_000 * S, 8, expect_fast=True)
+
+
+def test_depth_one_clients():
+    """Depth-1 clients leave the window by emptying -- the has_more
+    branch of the one-serve check."""
+    infos = {c: ClientInfo(0, 1, 0) for c in range(10)}
+    adds = [(c, 1 * S, 1, 1, 1) for c in range(10)]
+    state = build_state(infos, adds, capacity=16)
+    check_fast_vs_serial(state, 4 * S, 8, expect_fast=True)
+
+
+def test_single_client_deep_queue_falls_back():
+    """One client with many requests violates one-serve-per-client, so
+    speculation must fail and the serial engine must take over."""
+    infos = {0: ClientInfo(0, 1, 0), 1: ClientInfo(0, 1, 0)}
+    adds = [(c, 1 * S, 1, 1, 1) for _ in range(16) for c in (0, 1)]
+    state = build_state(infos, adds, capacity=8)
+    check_fast_vs_serial(state, 100 * S, 8, expect_fast=False)
+
+
+def test_limited_clients_excluded():
+    """Clients whose head limit is in the future are not ready; with
+    too few ready candidates speculation fails; with enough it must
+    serve only ready ones, matching serial."""
+    infos = {}
+    for c in range(16):
+        if c < 8:
+            infos[c] = ClientInfo(0, 1, 0)          # unlimited
+        else:
+            infos[c] = ClientInfo(0, 1, 1000.0)     # high limit: ready
+    state = deep_state(infos, depth=4)
+    check_fast_vs_serial(state, 2 * S, 8)
+
+
+# ----------------------------------------------------------------------
+# regime flips + fallback-resume through the runner
+# ----------------------------------------------------------------------
+
+def test_regime_flip_resv_to_weight():
+    """Reservation backlog drains at a far-future now, then weight
+    phase takes over: the runner must track the flip batch by batch."""
+    infos = {c: ClientInfo(2, 1, 0) for c in range(8)}
+    state = deep_state(infos, depth=8)
+    run = make_fast_runner(4)
+    st = state
+    # fixed now: the reservation phase drains (~4 eligible serves per
+    # client before its tag passes now), then weight takes over
+    now = 4 * S
+    phases = []
+    for i in range(14):
+        ser_state, ser_decs = serial_run(st, now, 4)
+        st2, decs, used = run(st, jnp.int64(now))
+        fd = jax.device_get(decs)
+        assert np.array_equal(fd.slot, ser_decs.slot)
+        if used:
+            assert np.array_equal(fd.phase, ser_decs.phase)
+        phases.extend(int(p) for p in jax.device_get(ser_decs.phase)[
+            jax.device_get(ser_decs.type) == kernels.RETURNING])
+        assert_states_equal(st2, ser_state)
+        st = st2
+    assert 0 in phases and 1 in phases, \
+        "workload never exercised both phases"
+
+
+def test_fallback_then_resume():
+    """A batch that falls back must leave state so the NEXT batch can
+    speculate again -- the steady-state recovery path."""
+    infos = {c: ClientInfo(0, 1, 0) for c in range(6)}
+    # client 0 heavily queued => early batches violate one-serve
+    adds = [(0, 1 * S, 1, 1, 1) for _ in range(12)]
+    adds += [(c, 1 * S, 1, 1, 1) for _ in range(4) for c in range(1, 6)]
+    state = build_state(infos, adds, capacity=8)
+    run = make_fast_runner(4)
+    st = state
+    now = 50 * S
+    used_seq = []
+    for _ in range(8):
+        ser_state, ser_decs = serial_run(st, now, 4)
+        st2, decs, used = run(st, jnp.int64(now))
+        used_seq.append(used)
+        fd = jax.device_get(decs)
+        assert np.array_equal(fd.slot, ser_decs.slot)
+        assert_states_equal(st2, ser_state)
+        st = st2
+    assert False in used_seq, "expected at least one fallback"
+
+
+# ----------------------------------------------------------------------
+# scan_fast_epoch: commit-prefix semantics
+# ----------------------------------------------------------------------
+
+def test_epoch_commit_prefix_all_ok():
+    infos = {c: ClientInfo(0, 1 + (c % 2), 0) for c in range(16)}
+    state = deep_state(infos, depth=16)
+    m, k = 4, 8
+    ep = scan_fast_epoch(state, jnp.int64(20 * S), m, k,
+                         anticipation_ns=0)
+    ok = jax.device_get(ep.ok)
+    assert ok.all()
+    # replay serially: epoch output must equal m sequential k-batches
+    st = state
+    for i in range(m):
+        ser_state, ser_decs = serial_run(st, 20 * S, k)
+        assert np.array_equal(jax.device_get(ep.slot)[i], ser_decs.slot)
+        assert np.array_equal(jax.device_get(ep.phase)[i],
+                              ser_decs.phase)
+        st = ser_state
+    assert_states_equal(ep.state, st)
+
+
+def test_epoch_commit_prefix_stops_at_failure():
+    """Backlog shallower than m*k: the epoch must stop at the first
+    failed speculation and the returned state must be the exact serial
+    prefix -- later batches must not commit even if they would pass."""
+    infos = {c: ClientInfo(0, 1, 0) for c in range(8)}
+    state = deep_state(infos, depth=3)   # 24 requests total
+    m, k = 8, 8                          # 64 asked
+    ep = scan_fast_epoch(state, jnp.int64(5 * S), m, k,
+                         anticipation_ns=0)
+    ok = jax.device_get(ep.ok)
+    n_ok = int(ok.sum())
+    assert 0 < n_ok < m
+    # prefix property: no commit after the first failure
+    first_fail = int(np.argmin(ok))
+    assert not ok[first_fail:].any()
+    # state equals the serial replay of the committed prefix
+    st = state
+    for _ in range(n_ok):
+        st, _ = serial_run(st, 5 * S, k)
+    assert_states_equal(ep.state, st)
+    assert int(jnp.min(ep.state.depth)) >= 0
+
+
+def test_epoch_on_empty_state_commits_nothing():
+    infos = {0: ClientInfo(0, 1, 0)}
+    state = build_state(infos, [], capacity=8)
+    ep = scan_fast_epoch(state, jnp.int64(1 * S), 4, 4,
+                         anticipation_ns=0)
+    assert not jax.device_get(ep.ok).any()
+    assert_states_equal(ep.state, state)
+
+
+# ----------------------------------------------------------------------
+# randomized differential fuzz
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [11, 12, 13, 14, 15])
+def test_fuzz_fast_runner_matches_serial(seed):
+    rng = random.Random(seed)
+    n_clients = rng.randint(4, 24)
+    infos = {}
+    for c in range(n_clients):
+        kind = rng.randrange(4)
+        if kind == 0:
+            infos[c] = ClientInfo(rng.uniform(0.5, 4), 0, 0)
+        elif kind == 1:
+            infos[c] = ClientInfo(0, rng.uniform(0.5, 4), 0)
+        elif kind == 2:
+            infos[c] = ClientInfo(rng.uniform(0.5, 2),
+                                  rng.uniform(0.5, 4),
+                                  rng.uniform(3, 8))
+        else:
+            # equal weights: maximal tie pressure
+            infos[c] = ClientInfo(0, 2, 0)
+    adds = []
+    t = 1 * S
+    for step in range(rng.randint(20, 120)):
+        c = rng.randrange(n_clients)
+        t += rng.randint(0, S // 4)
+        delta = rng.randint(1, 5)
+        adds.append((c, t, rng.randint(1, 3), delta,
+                     rng.randint(1, delta)))
+    state = build_state(infos, adds, capacity=32)
+
+    k = rng.choice([2, 4, 8])
+    run = make_fast_runner(k)
+    now = t + rng.randint(0, 10) * S
+    st = state
+    n_fast = 0
+    for _ in range(10):
+        ser_state, ser_decs = serial_run(st, now, k)
+        st2, decs, used = run(st, jnp.int64(now))
+        fd = jax.device_get(decs)
+        assert np.array_equal(fd.slot, ser_decs.slot), \
+            f"seed={seed} now={now} k={k}"
+        assert np.array_equal(fd.cost, ser_decs.cost)
+        assert_states_equal(st2, ser_state)
+        st = st2
+        n_fast += used
+        now += rng.randint(1, 3) * S
+    assert int(jnp.min(st.depth)) >= 0
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_fuzz_epoch_matches_serial(seed):
+    rng = random.Random(seed)
+    n_clients = rng.randint(8, 20)
+    infos = {c: ClientInfo(rng.choice([0, 1, 2]),
+                           rng.choice([1, 2, 3]), 0)
+             for c in range(n_clients)}
+    # ensure every client has either r or w
+    for c in range(n_clients):
+        if infos[c].reservation == 0 and infos[c].weight == 0:
+            infos[c] = ClientInfo(0, 1, 0)
+    depth = rng.randint(1, 8)
+    state = deep_state(infos, depth=depth, capacity=32)
+    m, k = rng.choice([(2, 4), (4, 4), (3, 8)])
+    now = rng.randint(2, 2000) * S
+    ep = scan_fast_epoch(state, jnp.int64(now), m, k, anticipation_ns=0)
+    ok = jax.device_get(ep.ok)
+    n_ok = int(ok.sum())
+    # prefix property
+    if n_ok < m:
+        first_fail = int(np.argmin(ok))
+        assert not ok[first_fail:].any()
+    st = state
+    for i in range(n_ok):
+        ser_state, ser_decs = serial_run(st, now, k)
+        assert np.array_equal(jax.device_get(ep.slot)[i], ser_decs.slot)
+        st = ser_state
+    assert_states_equal(ep.state, st)
+    assert int(jnp.min(ep.state.depth)) >= 0
