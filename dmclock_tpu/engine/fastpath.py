@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.timebase import MAX_TAG, TIME_MAX
+from ..core.timebase import MAX_TAG
 from . import kernels
 from .kernels import KEY_INF, Decision, _make_tag, _fold_prev
 from .state import EngineState
@@ -58,37 +58,45 @@ class FastBatch(NamedTuple):
     decisions: Decision    # [k] arrays, valid where ok
 
 
-# Creation indices stay far below this (2^45 ~ 35 trillion requests);
-# used to rank strictly-below-boundary candidates ahead of every
-# boundary tie in the order-aware second top_k pass.
-ORDER_BIG = 1 << 45
+# Packed-key layout: one int64 sort key holds (key - key_min) in the
+# high bits and (order - order_min) in the low ORDER_BITS, so a SINGLE
+# top_k yields the exact lexicographic (key, creation-order) selection
+# already sorted in serial decision order.  The rebase windows (2^36 ns
+# ~ 69 s of tag spread at the boundary; 2^26 client creations of order
+# spread) are checked on device -- overflow fails the speculation and
+# the serial engine takes the batch, so exactness is never at risk.
+ORDER_BITS = 26
+_ORDER_MASK = (1 << ORDER_BITS) - 1
+_KEY_WINDOW = jnp.int64(1) << (62 - ORDER_BITS)
 
 
 def _lex_top_k(key, order, k: int):
-    """Indices of the k lexicographically-smallest (key, order) pairs.
+    """Indices of the k lexicographically-smallest (key, order) pairs,
+    sorted ascending (= exact serial service order).
 
-    Exact at tie boundaries: pass 1 finds the k-th smallest key V;
-    pass 2 ranks candidates with key < V ahead of everything and
-    resolves the key == V boundary group by creation order -- the
-    serial engine's exact tie-break.  Returns (idx[k], V,
-    max_tied_order, count_ok).
+    Returns (idx[k], V, max_tied_order, ok) where V is the k-th
+    smallest key and max_tied_order the largest creation order selected
+    at the V boundary.  ``ok`` is False when fewer than k real
+    candidates exist (sentinel keys carry KEY_INF) or a rebase window
+    overflowed -- the caller must then fall back to the serial engine.
     """
-    neg, _ = lax.top_k(-key, k)
-    v = -neg[k - 1]
-    # Sentinel (masked) entries carry key == KEY_INF; they must never
-    # join the tie group, or an underfull candidate set would rank them
-    # by creation order and "serve" requestless clients.
     real = key < KEY_INF
-    below = key < v
-    tied = real & (key == v)
-    rank = jnp.where(below, order - ORDER_BIG,
-                     jnp.where(tied, order, KEY_INF))
-    _, idx = lax.top_k(-rank, k)
-    count_ok = v < KEY_INF  # k real candidates exist
-    order_k = order[idx]
-    max_tied_order = jnp.max(jnp.where(key[idx] == v, order_k,
-                                       -(jnp.int64(1) << 62)))
-    return idx, v, max_tied_order, count_ok
+    kmin = jnp.min(jnp.where(real, key, KEY_INF))
+    omin = jnp.min(jnp.where(real, order, jnp.int64(1) << 62))
+    krel = key - kmin
+    orel = order - omin
+    fit = real & (krel < _KEY_WINDOW) & (orel <= _ORDER_MASK)
+    packed = jnp.where(fit, (krel << ORDER_BITS) | orel, KEY_INF)
+    negv, idx = lax.top_k(-packed, k)
+    vk = -negv[k - 1]
+    count_ok = vk < KEY_INF
+    v = (vk >> ORDER_BITS) + kmin
+    max_tied_order = (vk & _ORDER_MASK) + omin
+    # Window check, relaxed: only candidates at-or-below the boundary V
+    # must have fit the rebase windows; anything strictly beyond V may
+    # overflow harmlessly (it was never selectable).
+    window_ok = jnp.all(~real | fit | (key > v))
+    return idx, v, max_tied_order, count_ok & window_ok
 
 
 def _ready_now(state: EngineState, now):
@@ -98,103 +106,108 @@ def _ready_now(state: EngineState, now):
     return state.head_ready | (state.head_limit <= now)
 
 
-class ServePlan(NamedTuple):
-    """Planned (not yet applied) vectorized pop+retag of k clients."""
+class DenseServe(NamedTuple):
+    """Elementwise ([N]) serve computation: what every client's state
+    would become if its head were popped this batch.  Scatter-free --
+    TPU row-scatters of 8-byte rows serialize badly, so the serve is
+    computed densely and committed with ``jnp.where`` selects; the only
+    index ops per batch are the [k]-sized ring reads and the decision
+    emit."""
 
-    served_cost: jnp.ndarray
-    new_depth: jnp.ndarray
-    has_more: jnp.ndarray
-    rq_next: jnp.ndarray
-    head_resv: jnp.ndarray
-    head_prop: jnp.ndarray
-    head_limit: jnp.ndarray
-    head_arrival: jnp.ndarray
-    head_cost: jnp.ndarray
-    head_rho: jnp.ndarray
-    prev_resv: jnp.ndarray
-    prev_prop: jnp.ndarray
-    prev_limit: jnp.ndarray
-    prev_arrival: jnp.ndarray
+    has_more: jnp.ndarray     # bool[N] client still has queued work
+    new_depth: jnp.ndarray    # int32[N]
+    narr: jnp.ndarray         # int64[N] next head arrival (valid at idx)
+    ncost: jnp.ndarray        # int64[N] next head cost (valid at idx)
+    head_resv: jnp.ndarray    # int64[N] new tag minus weight-debt offset
+    head_prop: jnp.ndarray    # int64[N]
+    head_limit: jnp.ndarray   # int64[N]
+    prev_resv: jnp.ndarray    # int64[N]
+    prev_prop: jnp.ndarray    # int64[N]
+    prev_limit: jnp.ndarray   # int64[N]
 
 
-def _plan_serves(state: EngineState, idx, phase_is_ready,
-                 anticipation_ns: int) -> ServePlan:
-    """Compute the vectorized pop+retag of k distinct clients
-    (pop_process_request / update_next_tag / reduce_reservation_tags,
-    reference :1021-1111) without touching state -- valid only when idx
-    are distinct, which the speculation guarantees (one head per
-    client).  Application is deferred to `_apply_serves` so a failed
-    speculation costs nothing and needs no state rollback."""
-    served_r = state.head_resv[idx]
-    served_p = state.head_prop[idx]
-    served_l = state.head_limit[idx]
-    served_arr = state.head_arrival[idx]
-    served_cost = state.head_cost[idx]
-    served_rho = state.head_rho[idx]
-
-    new_depth = state.depth[idx] - 1
-    has_more = new_depth > 0
+def _dense_serve(state: EngineState, idx, phase_is_ready: bool,
+                 anticipation_ns: int) -> DenseServe:
+    """The vectorized pop+retag (pop_process_request / update_next_tag /
+    reduce_reservation_tags, reference :1021-1111) computed for EVERY
+    client; rows outside the served set are garbage and masked out at
+    commit.  ``idx`` is only used to fetch the ring heads ([k] gathers +
+    one scatter pair -- the rings are too large for a dense pass)."""
+    # ring head of each *served* client, scattered into dense [N] slots
     rq = state.q_head[idx]
-    narr = state.q_arrival[idx, rq]
-    ncost = state.q_cost[idx, rq]
+    narr_k = state.q_arrival[idx, rq]
+    ncost_k = state.q_cost[idx, rq]
+    narr = jnp.zeros_like(state.head_arrival).at[idx].set(narr_k)
+    ncost = jnp.ones_like(state.head_cost).at[idx].set(ncost_k)
 
     nr, np_, nl = _make_tag(
-        served_r, served_p, served_l, served_arr,
-        state.resv_inv[idx], state.weight_inv[idx], state.limit_inv[idx],
-        state.cur_delta[idx], state.cur_rho[idx], narr, ncost,
+        state.head_resv, state.head_prop, state.head_limit,
+        state.head_arrival, state.resv_inv, state.weight_inv,
+        state.limit_inv, state.cur_delta, state.cur_rho, narr, ncost,
         anticipation_ns)
 
-    offset = jnp.where(phase_is_ready,
-                       state.resv_inv[idx] * (served_cost + served_rho),
-                       jnp.int64(0))
+    if phase_is_ready:
+        offset = state.resv_inv * (state.head_cost + state.head_rho)
+    else:
+        offset = jnp.zeros_like(state.head_resv)
 
-    prev_r = jnp.where(has_more, _fold_prev(state.prev_resv[idx], nr),
-                       state.prev_resv[idx]) - offset
-    prev_p = jnp.where(has_more, _fold_prev(state.prev_prop[idx], np_),
-                       state.prev_prop[idx])
-    prev_l = jnp.where(has_more, _fold_prev(state.prev_limit[idx], nl),
-                       state.prev_limit[idx])
-    prev_arr = jnp.where(has_more, narr, state.prev_arrival[idx])
+    new_depth = state.depth - 1
+    has_more = new_depth > 0
 
-    return ServePlan(
-        served_cost=served_cost,
-        new_depth=new_depth.astype(jnp.int32),
+    prev_r = jnp.where(has_more, _fold_prev(state.prev_resv, nr),
+                       state.prev_resv) - offset
+    prev_p = jnp.where(has_more, _fold_prev(state.prev_prop, np_),
+                       state.prev_prop)
+    prev_l = jnp.where(has_more, _fold_prev(state.prev_limit, nl),
+                       state.prev_limit)
+
+    return DenseServe(
         has_more=has_more,
-        rq_next=((rq + 1) % state.ring_capacity).astype(jnp.int32),
-        head_resv=nr - offset, head_prop=np_, head_limit=nl,
-        head_arrival=narr, head_cost=ncost,
-        head_rho=state.cur_rho[idx],
+        new_depth=new_depth.astype(jnp.int32),
+        narr=narr, ncost=ncost,
+        head_resv=nr - offset,
+        head_prop=np_, head_limit=nl,
         prev_resv=prev_r, prev_prop=prev_p, prev_limit=prev_l,
-        prev_arrival=prev_arr)
+    )
 
 
-def _apply_serves(state: EngineState, idx, plan: ServePlan,
-                  gate) -> EngineState:
-    """Scatter the plan at idx, gated on the scalar `gate` (speculation
-    validity): only k rows are touched, so a gated-off apply is free --
-    no whole-state select, which matters inside scanned epochs."""
-    has_more = plan.has_more & gate
+def _commit_serves(state: EngineState, mask, serve: DenseServe,
+                   gate) -> EngineState:
+    """Apply the dense serve to the rows in ``mask``, gated on the
+    scalar speculation-validity flag: pure elementwise selects, no
+    scatters."""
+    sel = mask & gate
+    selm = sel & serve.has_more
 
-    def scat(arr, val, pred):
-        return arr.at[idx].set(jnp.where(pred, val, arr[idx]))
+    def pick(pred, new, old):
+        return jnp.where(pred, new, old)
 
     return state._replace(
-        depth=scat(state.depth, plan.new_depth, gate),
-        q_head=scat(state.q_head, plan.rq_next, has_more),
-        head_resv=scat(state.head_resv, plan.head_resv, has_more),
-        head_prop=scat(state.head_prop, plan.head_prop, has_more),
-        head_limit=scat(state.head_limit, plan.head_limit, has_more),
-        head_arrival=scat(state.head_arrival, plan.head_arrival,
-                          has_more),
-        head_cost=scat(state.head_cost, plan.head_cost, has_more),
-        head_rho=scat(state.head_rho, plan.head_rho, has_more),
-        head_ready=scat(state.head_ready, jnp.zeros_like(idx, bool),
-                        gate),
-        prev_resv=scat(state.prev_resv, plan.prev_resv, gate),
-        prev_prop=scat(state.prev_prop, plan.prev_prop, gate),
-        prev_limit=scat(state.prev_limit, plan.prev_limit, gate),
-        prev_arrival=scat(state.prev_arrival, plan.prev_arrival, gate),
+        depth=pick(sel, serve.new_depth, state.depth),
+        q_head=pick(selm, (state.q_head + 1) % state.ring_capacity,
+                    state.q_head).astype(jnp.int32),
+        head_resv=pick(selm, serve.head_resv, state.head_resv),
+        head_prop=pick(selm, serve.head_prop, state.head_prop),
+        head_limit=pick(selm, serve.head_limit, state.head_limit),
+        head_arrival=pick(selm, serve.narr, state.head_arrival),
+        head_cost=pick(selm, serve.ncost, state.head_cost),
+        head_rho=pick(selm, state.cur_rho, state.head_rho),
+        head_ready=state.head_ready & ~sel,
+        prev_resv=pick(sel, serve.prev_resv, state.prev_resv),
+        prev_prop=pick(sel, serve.prev_prop, state.prev_prop),
+        prev_limit=pick(sel, serve.prev_limit, state.prev_limit),
+        prev_arrival=pick(selm, serve.narr, state.prev_arrival),
     )
+
+
+def _served_mask(key, order, v, max_tied_order):
+    """Dense membership of the k-smallest (key, order) set: strictly
+    below the kth key V, or tied at V with creation order within the
+    selected tie prefix (orders are unique, so ``order <=
+    max_tied_order`` picks exactly the chosen ties)."""
+    real = key < KEY_INF
+    return real & ((key < v) |
+                   ((key == v) & (order <= max_tied_order)))
 
 
 def speculate_weight_batch(state: EngineState, now, k: int, *,
@@ -213,33 +226,30 @@ def speculate_weight_batch(state: EngineState, now, k: int, *,
     cond_entry = resv_min0 > now
 
     idx, kth, max_tied_order, cond_count = _lex_top_k(key, state.order, k)
-    key_k = key[idx]
+    mask = _served_mask(key, state.order, kth, max_tied_order)
 
-    plan = _plan_serves(state, idx, jnp.ones((k,), dtype=bool),
-                        anticipation_ns)
+    serve = _dense_serve(state, idx, True, anticipation_ns)
 
     # one-serve-per-client: each served client must leave the window --
     # its new head either empty, not ready at `now`, keyed strictly past
     # the boundary V, or tied at V but ordered after every served tie
     # (so the serial engine would also leave it unserved)
-    new_eff = plan.head_prop + state.prop_delta[idx]
-    new_ready = (plan.head_limit <= now) & (plan.head_prop < MAX_TAG)
+    new_eff = serve.head_prop + state.prop_delta
+    new_ready = (serve.head_limit <= now) & (serve.head_prop < MAX_TAG)
     beyond = (new_eff > kth) | \
-        ((new_eff == kth) & (state.order[idx] > max_tied_order))
-    cond_once = jnp.all((~plan.has_more) | (~new_ready) | beyond)
+        ((new_eff == kth) & (state.order > max_tied_order))
+    cond_once = jnp.all(~mask | ~serve.has_more | ~new_ready | beyond)
     # phase stability: no served client's new reservation tag becomes
     # eligible (unserved clients' tags didn't move; entry checked them)
-    cond_resv = jnp.all(
-        jnp.where(plan.has_more, plan.head_resv, TIME_MAX) > now)
+    cond_resv = jnp.all(~mask | ~serve.has_more |
+                        (serve.head_resv > now))
 
     ok = cond_entry & cond_count & cond_once & cond_resv
     gate = ok & enabled
 
-    new_state = _apply_serves(state, idx, plan, gate)
+    new_state = _commit_serves(state, mask, serve, gate)
 
-    # emit decisions in exact serial order: (key, order) ascending
-    order_k = state.order[idx]
-    perm = jnp.lexsort((order_k, key_k))
+    # idx is already in exact serial order: (key, order) ascending
 
     # Stored-flag parity with the serial engine: every weight decision
     # runs the promote loop first (reference :1135-1144), so at batch
@@ -249,16 +259,17 @@ def speculate_weight_batch(state: EngineState, now, k: int, *,
     has_req_after = new_state.active & (new_state.depth > 0)
     promoted = new_state.head_ready | \
         (has_req_after & (new_state.head_limit <= now))
-    last_client = idx[perm[k - 1]]
-    promoted = promoted.at[last_client].set(False)
+    last_client = idx[k - 1]
+    promoted = promoted & (
+        jnp.arange(state.capacity, dtype=jnp.int32) != last_client)
     new_state = new_state._replace(head_ready=jnp.where(
         gate, promoted, new_state.head_ready))
 
     decisions = Decision(
         type=jnp.zeros((k,), dtype=jnp.int32),
-        slot=idx[perm].astype(jnp.int32),
+        slot=idx.astype(jnp.int32),
         phase=jnp.ones((k,), dtype=jnp.int32),
-        cost=plan.served_cost[perm],
+        cost=state.head_cost[idx],
         when=jnp.zeros((k,), dtype=jnp.int64),
         limit_break=jnp.zeros((k,), dtype=bool),
     )
@@ -278,27 +289,24 @@ def speculate_resv_batch(state: EngineState, now, k: int, *,
     key = jnp.where(has_req, state.head_resv, KEY_INF)
 
     idx, kth, max_tied_order, cond_count = _lex_top_k(key, state.order, k)
-    key_k = key[idx]
     cond_eligible = kth <= now            # all k fire the constraint phase
+    mask = _served_mask(key, state.order, kth, max_tied_order)
 
-    plan = _plan_serves(state, idx, jnp.zeros((k,), dtype=bool),
-                        anticipation_ns)
+    serve = _dense_serve(state, idx, False, anticipation_ns)
 
     # one-serve-per-client: the new head tag must leave the window
-    beyond = (plan.head_resv > kth) | \
-        ((plan.head_resv == kth) & (state.order[idx] > max_tied_order))
-    cond_once = jnp.all((~plan.has_more) | beyond)
+    beyond = (serve.head_resv > kth) | \
+        ((serve.head_resv == kth) & (state.order > max_tied_order))
+    cond_once = jnp.all(~mask | ~serve.has_more | beyond)
 
     ok = cond_eligible & cond_count & cond_once
-    new_state = _apply_serves(state, idx, plan, ok & enabled)
+    new_state = _commit_serves(state, mask, serve, ok & enabled)
 
-    order_k = state.order[idx]
-    perm = jnp.lexsort((order_k, key_k))
     decisions = Decision(
         type=jnp.zeros((k,), dtype=jnp.int32),
-        slot=idx[perm].astype(jnp.int32),
+        slot=idx.astype(jnp.int32),
         phase=jnp.zeros((k,), dtype=jnp.int32),
-        cost=plan.served_cost[perm],
+        cost=state.head_cost[idx],
         when=jnp.zeros((k,), dtype=jnp.int64),
         limit_break=jnp.zeros((k,), dtype=bool),
     )

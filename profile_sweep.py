@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""k sweep with the device_get-digest harness + top_k cost by k."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dmclock_tpu.engine import kernels  # noqa: F401 (enables x64)
+from dmclock_tpu.engine.fastpath import scan_fast_epoch
+from __graft_entry__ import _preloaded_state
+from profile_util import scalar_latency, state_digest as digest, \
+    timed_chain
+
+N, depth = 100_000, 64
+now = jnp.int64(0)
+
+
+def main():
+    lat = scalar_latency()
+    print(f"scalar round-trip latency: {lat*1e3:.1f} ms")
+
+    # top_k cost vs k and dtype, as a dependent chain
+    rng = np.random.default_rng(0)
+    key0 = jnp.asarray(rng.integers(0, 1 << 45, N), dtype=jnp.int64)
+    for dt, name in ((jnp.int64, "i64"), (jnp.int32, "i32")):
+        for k in (4096, 16384):
+            reps = 40
+
+            @jax.jit
+            def chain(key, k=k, dt=dt):
+                kk = key.astype(dt) if dt == jnp.int32 else key
+                for _ in range(reps):
+                    negv, idx = lax.top_k(-kk, k)
+                    kk = kk.at[idx].add(1)
+                return jnp.int64(kk.sum())
+            x = chain(key0)
+            jax.device_get(x)  # warm
+            t, _, _ = timed_chain(lambda s: s, key0, 0,
+                                  chain, latency=lat)
+            print(f"top_k {name} k={k:6d}: {t/reps*1e3:7.3f} ms/op")
+
+    # epoch sweep
+    for k, m in ((4096, 32), (8192, 16), (16384, 8)):
+        state = _preloaded_state(N, depth, ring=depth)
+        run = jax.jit(functools.partial(scan_fast_epoch, m=m, k=k,
+                                        anticipation_ns=0))
+
+        def step(st, run=run):
+            return run(st, now).state
+        # warm
+        st = step(state)
+        jax.device_get(digest(st))
+        n_epochs = 6
+        t, _, st2 = timed_chain(step, st, n_epochs, digest, latency=lat)
+        # commit rate check (separate, untimed)
+        ep = run(state, now)
+        n_ok = int(jax.device_get(ep.ok).sum())
+        per_epoch = t / n_epochs
+        print(f"epoch k={k:6d} m={m:3d}: {per_epoch*1e3:8.2f} ms/epoch, "
+              f"{per_epoch/m*1e3:7.2f} ms/batch, "
+              f"{m*k/per_epoch/1e6:7.2f}M dec/s (warm ok {n_ok}/{m})")
+
+
+if __name__ == "__main__":
+    main()
