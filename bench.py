@@ -61,34 +61,40 @@ def main() -> None:
     latency = scalar_latency()
 
     t0 = time.perf_counter()
-    eps = []
+    ep0 = None          # first epoch kept for the untimed sanity check
     n_committed = 0
+    n_serial_decisions = 0
     n_serial = 0
     n_roundtrips = 0
     for _ in range(epochs):
         ep = run(state, jnp.int64(0))
         state = ep.state
-        eps.append(ep)
+        if ep0 is None:
+            ep0 = ep
         ok = jax.device_get(ep.ok)          # one round-trip per epoch
         n_roundtrips += 1
         n_committed += int(ok.sum())
         if not ok.all():
-            # speculation stalled: one exact serial k-batch recovers
-            state, _, _ = serial(state, jnp.int64(0))
+            # speculation stalled: one exact serial k-batch recovers;
+            # count only decisions that actually RETURNING-served
+            state, _, decs = serial(state, jnp.int64(0))
+            n_serial_decisions += int(
+                jax.device_get((decs.type == kernels.RETURNING).sum()))
+            n_roundtrips += 1
             n_serial += 1
     jax.device_get(state_digest(state))
     n_roundtrips += 1
     elapsed = time.perf_counter() - t0 - latency * n_roundtrips
 
-    total = (n_committed + n_serial) * batch
+    total = n_committed * batch + n_serial_decisions
     n_batches = epochs * epoch_m
     fallback_rate = 1.0 - n_committed / n_batches
 
     # sanity (untimed, falsifiable): within each committed batch of the
     # first epoch every served slot must be distinct (one serve per
     # client per batch is a speculation invariant)
-    ok0 = jax.device_get(eps[0].ok)
-    slot0 = jax.device_get(eps[0].slot)
+    ok0 = jax.device_get(ep0.ok)
+    slot0 = jax.device_get(ep0.slot)
     for i in range(len(ok0)):
         if ok0[i]:
             assert len(np.unique(slot0[i])) == batch, \

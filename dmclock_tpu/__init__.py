@@ -9,11 +9,12 @@ stable argmin, multi-server corrections as psum collectives.
 
 Layers:
   core      -- canonical int64-ns tag algebra + pure-Python oracle
-  ops       -- JAX device kernels (tag update, masked argmin select)
-  engine    -- batched TPU scheduler (SoA client state, scan decisions)
+  engine    -- batched TPU scheduler: SoA client state, JAX device
+               kernels (tag update, fused select), speculative fastpath
   parallel  -- mesh sharding, multi-server cluster sim, psum tracker
   sim       -- QoS simulation harness (INI-config compatible)
-  models    -- registered scheduler "models" (dmclock, ssched FIFO)
+  models    -- registered scheduler "models" (dmclock oracle, dmclock
+               native C++, dmclock TPU engine, ssched FIFO)
   native    -- ctypes bindings to the C++ host runtime
   utils     -- periodic tasks, profiling timers
 """

@@ -9,6 +9,7 @@ binds a queue factory + tracker factory pair for the sim harness
   dmclock-delayed -- same with delayed tag calculation
   ssched        -- FIFO baseline + no-op tracker
   dmclock-tpu   -- JAX batch-engine-backed dmClock queue (engine/)
+  dmclock-native -- C++ runtime via ctypes (native/), delayed tags
 """
 
 from __future__ import annotations
@@ -66,9 +67,21 @@ def _dmclock_tpu_queue(server_id, client_info_f, anticipation_ns,
         anticipation_timeout_ns=anticipation_ns)
 
 
+def _dmclock_native_queue(server_id, client_info_f, anticipation_ns,
+                          soft_limit):
+    # imported lazily; raises with a clear message if no toolchain
+    from ..native import NativePullPriorityQueue
+    return NativePullPriorityQueue(
+        client_info_f,
+        delayed_tag_calc=True,
+        at_limit=AtLimit.ALLOW if soft_limit else AtLimit.WAIT,
+        anticipation_timeout_ns=anticipation_ns)
+
+
 register("dmclock", _dmclock_queue(delayed=False), _dmclock_tracker)
 register("dmclock-delayed", _dmclock_queue(delayed=True), _dmclock_tracker)
 register("dmclock-tpu", _dmclock_tpu_queue, _dmclock_tracker)
+register("dmclock-native", _dmclock_native_queue, _dmclock_tracker)
 register("ssched",
          lambda server_id, client_info_f, anticipation_ns, soft_limit:
          SimpleQueue(),

@@ -1,0 +1,299 @@
+"""Three-way golden parity: Python oracle vs C++ native vs TPU engine.
+
+All three backends implement the identical int64-ns tag algebra and
+total order, so on any workload their decision streams must match
+bit-for-bit -- this enforces the claim in ``native/src/capi.cc:5-7``.
+Scenarios mirror the reference server tests
+(``/root/reference/test/test_dmclock_server.cc``) plus randomized
+differential fuzz; tracker parity covers both accounting policies
+(``/root/reference/src/dmclock_client.h:39-154``).
+
+Skips cleanly when no C++ toolchain is available to build
+``libdmclock_c.so``.
+"""
+
+import random
+
+import pytest
+
+from dmclock_tpu.core import ClientInfo, Phase, ReqParams
+from dmclock_tpu.core.scheduler import (AtLimit, NextReqType,
+                                        PullPriorityQueue)
+from dmclock_tpu.core.timebase import NS_PER_SEC
+from dmclock_tpu.core.tracker import (BorrowingTracker, OrigTracker,
+                                      ServiceTracker)
+from dmclock_tpu.engine import TpuPullPriorityQueue
+
+native = pytest.importorskip("dmclock_tpu.native")
+
+if native.load_library() is None:
+    pytest.skip("native dmclock library unavailable (no toolchain)",
+                allow_module_level=True)
+
+S = NS_PER_SEC
+
+
+def make_trio(info_map, at_limit=AtLimit.WAIT, anticipation_ns=0,
+              delayed=True, with_tpu=True):
+    def info_f(c):
+        return info_map[c]
+
+    oracle = PullPriorityQueue(info_f, delayed_tag_calc=delayed,
+                               at_limit=at_limit,
+                               anticipation_timeout_ns=anticipation_ns,
+                               run_gc_thread=False)
+    nat = native.NativePullPriorityQueue(
+        info_f, delayed_tag_calc=delayed, at_limit=at_limit,
+        anticipation_timeout_ns=anticipation_ns)
+    queues = [oracle, nat]
+    if with_tpu and delayed and at_limit in (AtLimit.WAIT, AtLimit.ALLOW):
+        queues.append(TpuPullPriorityQueue(
+            info_f, at_limit=at_limit,
+            anticipation_timeout_ns=anticipation_ns, capacity=64))
+    return queues
+
+
+def pull_all(queues, now_ns):
+    prs = [q.pull_request(now_ns) for q in queues]
+    p0 = prs[0]
+    for i, p in enumerate(prs[1:], 1):
+        assert p0.type == p.type, (i, p0, p)
+        if p0.type is NextReqType.RETURNING:
+            assert p0.client == p.client, (i, p0, p)
+            assert p0.phase == p.phase
+            assert p0.cost == p.cost
+            assert p0.request == p.request
+        elif p0.type is NextReqType.FUTURE:
+            assert p0.when_ready == p.when_ready, (i, p0, p)
+    return p0
+
+
+def add_all(queues, request, client, rp, now, cost=1):
+    rcs = {q.add_request(request, client, rp, time_ns=now, cost=cost)
+           for q in queues}
+    assert len(rcs) == 1, "backends disagree on add_request rc"
+    return rcs.pop()
+
+
+def counters_all(queues):
+    triples = {(q.reserv_sched_count, q.prop_sched_count,
+                q.limit_break_sched_count) for q in queues}
+    assert len(triples) == 1, triples
+
+
+# ----------------------------------------------------------------------
+# behavioral scenarios (reference test_dmclock_server.cc re-derivations)
+# ----------------------------------------------------------------------
+
+def test_weight_ratio_three_way():
+    infos = {1: ClientInfo(0, 1, 0), 2: ClientInfo(0, 2, 0)}
+    qs = make_trio(infos)
+    t = 1 * S
+    for i in range(6):
+        for c in (1, 2):
+            add_all(qs, ("r", c, i), c, ReqParams(), t)
+    counts = {1: 0, 2: 0}
+    for _ in range(6):
+        pr = pull_all(qs, t + S)
+        counts[pr.client] += 1
+    assert counts == {1: 2, 2: 4}
+    counters_all(qs)
+
+
+def test_reservation_ratio_three_way():
+    infos = {1: ClientInfo(2, 0, 0), 2: ClientInfo(1, 0, 0)}
+    qs = make_trio(infos)
+    t = 100 * S
+    for i in range(6):
+        for c in (1, 2):
+            add_all(qs, ("r", c, i), c, ReqParams(), t)
+    counts = {1: 0, 2: 0}
+    for _ in range(6):
+        pr = pull_all(qs, t + 100 * S)
+        assert pr.phase is Phase.RESERVATION
+        counts[pr.client] += 1
+    assert counts == {1: 4, 2: 2}
+    counters_all(qs)
+
+
+def test_limit_future_none_three_way():
+    infos = {1: ClientInfo(1, 1, 1)}
+    qs = make_trio(infos)
+    assert pull_all(qs, 1 * S).is_none()
+    add_all(qs, "a", 1, ReqParams(), 10 * S)
+    assert pull_all(qs, 10 * S).is_retn()
+    add_all(qs, "b", 1, ReqParams(), 10 * S)
+    pr = pull_all(qs, 10 * S)
+    assert pr.is_future() and pr.when_ready == 11 * S
+
+
+def test_allow_limit_break_three_way():
+    infos = {1: ClientInfo(0, 1, 1)}
+    qs = make_trio(infos, at_limit=AtLimit.ALLOW)
+    t = 50 * S
+    add_all(qs, "a", 1, ReqParams(), t)
+    add_all(qs, "b", 1, ReqParams(), t)
+    assert pull_all(qs, t).is_retn()
+    assert pull_all(qs, t).is_retn()
+    counters_all(qs)
+    assert qs[0].limit_break_sched_count == 1
+
+
+def test_reject_two_way():
+    """AtLimit.REJECT (immediate tags): oracle vs native only -- the
+    TPU engine is DelayedTagCalc-only by design (queue.py:11-15)."""
+    infos = {1: ClientInfo(0, 1, 1)}
+    qs = make_trio(infos, at_limit=AtLimit.REJECT, delayed=False,
+                   with_tpu=False)
+    t = 5 * S
+    assert add_all(qs, "a", 1, ReqParams(), t) == 0
+    # second request's limit tag is 1s out: rejected by both
+    rc = add_all(qs, "b", 1, ReqParams(), t)
+    assert rc != 0
+    assert qs[0].request_count() == qs[1].request_count() == 1
+
+
+def test_update_client_info_three_way():
+    infos = {1: ClientInfo(0, 1, 0), 2: ClientInfo(0, 1, 0)}
+    qs = make_trio(infos)
+    t = 5 * S
+    for i in range(6):
+        for c in (1, 2):
+            add_all(qs, ("r", c, i), c, ReqParams(), t)
+    pull_all(qs, t + 1)
+    infos[2].update(0, 4, 0)
+    for q in qs:
+        q.update_client_info(2)
+    for _ in range(8):
+        pull_all(qs, t + S)
+
+
+def test_remove_by_client_three_way():
+    infos = {1: ClientInfo(0, 1, 0), 2: ClientInfo(0, 1, 0)}
+    qs = make_trio(infos)
+    t = 3 * S
+    for i in range(4):
+        for c in (1, 2):
+            add_all(qs, ("x", c, i), c, ReqParams(), t)
+    got = []
+    for q in qs:
+        acc = []
+        q.remove_by_client(1, accum=acc.append)
+        got.append(acc)
+    assert all(g == got[0] for g in got) and len(got[0]) == 4
+    for _ in range(5):
+        pull_all(qs, t + S)
+
+
+# ----------------------------------------------------------------------
+# randomized three-way differential fuzz
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed,at_limit,anticipation_s", [
+    (31, AtLimit.WAIT, 0.0),
+    (32, AtLimit.ALLOW, 0.0),
+    (33, AtLimit.WAIT, 0.1),
+    (34, AtLimit.ALLOW, 0.05),
+])
+def test_differential_three_way(seed, at_limit, anticipation_s):
+    rng = random.Random(seed)
+    n_clients = rng.randint(2, 10)
+    infos = {}
+    for c in range(n_clients):
+        kind = rng.randrange(4)
+        if kind == 0:
+            infos[c] = ClientInfo(rng.uniform(0.5, 4), 0, 0)
+        elif kind == 1:
+            infos[c] = ClientInfo(0, rng.uniform(0.5, 4), 0)
+        elif kind == 2:
+            infos[c] = ClientInfo(rng.uniform(0.5, 2),
+                                  rng.uniform(0.5, 4), rng.uniform(3, 8))
+        else:
+            infos[c] = ClientInfo(rng.uniform(0.5, 2),
+                                  rng.uniform(0.5, 4), 0)
+    qs = make_trio(infos, at_limit=at_limit,
+                   anticipation_ns=int(anticipation_s * S))
+    assert len(qs) == 3
+
+    now = 1 * S
+    n_retn = 0
+    for step in range(150):
+        now += rng.randint(0, S // 2)
+        if rng.random() < 0.55:
+            c = rng.randrange(n_clients)
+            delta = rng.randint(1, 5)
+            rho = rng.randint(1, delta)
+            add_all(qs, ("req", c, step), c, ReqParams(delta, rho), now,
+                    cost=rng.randint(1, 3))
+        else:
+            if pull_all(qs, now).is_retn():
+                n_retn += 1
+    for _ in range(600):
+        now += 4 * S
+        if pull_all(qs, now).is_retn():
+            n_retn += 1
+        if qs[0].request_count() == 0:
+            break
+    assert qs[0].request_count() == 0
+    assert qs[1].request_count() == 0
+    assert n_retn > 40
+    counters_all(qs)
+
+
+@pytest.mark.parametrize("seed", [41, 42])
+def test_differential_immediate_tags_two_way(seed):
+    """ImmediateTagCalc: oracle vs native (TPU is delayed-only)."""
+    rng = random.Random(seed)
+    infos = {c: ClientInfo(rng.uniform(0.5, 2), rng.uniform(0.5, 3),
+                           rng.choice([0, 5]))
+             for c in range(rng.randint(2, 8))}
+    qs = make_trio(infos, delayed=False, with_tpu=False)
+    now = 1 * S
+    for step in range(200):
+        now += rng.randint(0, S // 3)
+        if rng.random() < 0.6:
+            c = rng.randrange(len(infos))
+            delta = rng.randint(1, 4)
+            add_all(qs, (c, step), c, ReqParams(delta, rng.randint(1, delta)),
+                    now, cost=rng.randint(1, 2))
+        else:
+            pull_all(qs, now)
+    for _ in range(500):
+        now += 4 * S
+        pull_all(qs, now)
+        if qs[0].request_count() == 0:
+            break
+    assert qs[0].request_count() == 0
+    counters_all(qs)
+
+
+# ----------------------------------------------------------------------
+# tracker parity (Orig + Borrowing)
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("borrowing,cls", [
+    (False, OrigTracker),
+    (True, BorrowingTracker),
+])
+def test_tracker_parity(borrowing, cls):
+    rng = random.Random(7 + borrowing)
+    py = ServiceTracker(tracker_cls=cls, run_gc_thread=False)
+    nat = native.NativeServiceTracker(borrowing=borrowing)
+    servers = ["s0", "s1", "s2"]
+    outstanding = []
+    for step in range(300):
+        if rng.random() < 0.5 or not outstanding:
+            srv = rng.choice(servers)
+            a = py.get_req_params(srv)
+            b = nat.get_req_params(srv)
+            assert (a.delta, a.rho) == (b.delta, b.rho), \
+                (step, srv, a, b)
+            outstanding.append(srv)
+        else:
+            srv = outstanding.pop(rng.randrange(len(outstanding)))
+            phase = rng.choice([Phase.RESERVATION, Phase.PRIORITY])
+            cost = rng.randint(1, 3)
+            py.track_resp(srv, phase, cost)
+            nat.track_resp(srv, phase, cost)
+    py.shutdown()
+    nat.shutdown()
