@@ -1,0 +1,118 @@
+// CPython-compatible Mersenne Twister.
+//
+// The Python sim harness's only randomness is random.Random(seed)
+// .randrange(n) consumed in event order (harness.py _make_server_select);
+// replicating CPython's MT19937 seeding (init_by_array) and
+// _randbelow_with_getrandbits draw-for-draw makes the native simulator's
+// service trace BIT-IDENTICAL to the Python simulator's for the same
+// seed -- the cross-language sim parity gate.  Algorithm constants are
+// the published MT19937 reference (Matsumoto & Nishimura); the seeding
+// path mirrors CPython Modules/_randommodule.c.
+
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+namespace qos_sim {
+
+class PyMT19937 {
+ public:
+  explicit PyMT19937(uint64_t seed) {
+    // CPython random.seed(int): key = abs(seed) as 32-bit LE chunks
+    std::vector<uint32_t> key;
+    if (seed == 0) key.push_back(0);
+    while (seed) {
+      key.push_back(static_cast<uint32_t>(seed & 0xffffffffu));
+      seed >>= 32;
+    }
+    init_by_array(key);
+  }
+
+  uint32_t genrand() {
+    if (idx_ >= N) generate();
+    uint32_t y = mt_[idx_++];
+    y ^= y >> 11;
+    y ^= (y << 7) & 0x9d2c5680u;
+    y ^= (y << 15) & 0xefc60000u;
+    y ^= y >> 18;
+    return y;
+  }
+
+  // CPython getrandbits(k) for k <= 32
+  uint32_t getrandbits(int k) { return genrand() >> (32 - k); }
+
+  // CPython _randbelow_with_getrandbits: rejection-sample bit_length(n)
+  // bits until < n (consumes a data-dependent number of draws -- this
+  // must match Python exactly, including for n == 1)
+  uint32_t randrange(uint32_t n) {
+    int k = bit_length(n);
+    uint32_t r = getrandbits(k);
+    while (r >= n) r = getrandbits(k);
+    return r;
+  }
+
+ private:
+  static constexpr int N = 624;
+  uint32_t mt_[N];
+  int idx_ = N;
+
+  static int bit_length(uint32_t n) {
+    int k = 0;
+    while (n) {
+      ++k;
+      n >>= 1;
+    }
+    return k;
+  }
+
+  void init_genrand(uint32_t s) {
+    mt_[0] = s;
+    for (int i = 1; i < N; ++i)
+      mt_[i] = 1812433253u * (mt_[i - 1] ^ (mt_[i - 1] >> 30)) + i;
+    idx_ = N;
+  }
+
+  void init_by_array(const std::vector<uint32_t>& key) {
+    init_genrand(19650218u);
+    int i = 1, j = 0;
+    int k = N > static_cast<int>(key.size()) ? N
+                                             : static_cast<int>(key.size());
+    for (; k; --k) {
+      mt_[i] = (mt_[i] ^ ((mt_[i - 1] ^ (mt_[i - 1] >> 30)) * 1664525u)) +
+               key[j] + j;
+      ++i;
+      ++j;
+      if (i >= N) {
+        mt_[0] = mt_[N - 1];
+        i = 1;
+      }
+      if (j >= static_cast<int>(key.size())) j = 0;
+    }
+    for (k = N - 1; k; --k) {
+      mt_[i] =
+          (mt_[i] ^ ((mt_[i - 1] ^ (mt_[i - 1] >> 30)) * 1566083941u)) - i;
+      ++i;
+      if (i >= N) {
+        mt_[0] = mt_[N - 1];
+        i = 1;
+      }
+    }
+    mt_[0] = 0x80000000u;
+  }
+
+  void generate() {
+    constexpr uint32_t M = 397;
+    constexpr uint32_t MATRIX_A = 0x9908b0dfu;
+    constexpr uint32_t UPPER = 0x80000000u;
+    constexpr uint32_t LOWER = 0x7fffffffu;
+    for (int i = 0; i < N; ++i) {
+      uint32_t y = (mt_[i] & UPPER) | (mt_[(i + 1) % N] & LOWER);
+      mt_[i] = mt_[(i + M) % N] ^ (y >> 1);
+      if (y & 1) mt_[i] ^= MATRIX_A;
+    }
+    idx_ = 0;
+  }
+};
+
+}  // namespace qos_sim

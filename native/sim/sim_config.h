@@ -1,0 +1,185 @@
+// Sim configuration: INI-compatible with the reference and with the
+// Python sim (dmclock_tpu/sim/config.py; reference sim/src/config.h:32-155
+// + config.cc:123-184).  Same sections ([global], [client.N],
+// [server.N]), same keys, same defaults.
+
+#pragma once
+
+#include <algorithm>
+#include <cctype>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace qos_sim {
+
+struct ClientGroup {
+  int client_count = 100;
+  double client_wait_s = 0.0;
+  int client_total_ops = 1000;
+  int client_server_select_range = 10;
+  double client_iops_goal = 50.0;
+  int client_outstanding_ops = 100;
+  double client_reservation = 20.0;
+  double client_limit = 60.0;
+  double client_weight = 1.0;
+  int client_req_cost = 1;
+};
+
+struct ServerGroup {
+  int server_count = 100;
+  double server_iops = 40.0;
+  int server_threads = 1;
+};
+
+struct SimConfig {
+  int server_groups = 1;
+  int client_groups = 1;
+  bool server_random_selection = false;
+  bool server_soft_limit = true;
+  double anticipation_timeout_s = 0.0;
+  std::vector<ClientGroup> cli_group;
+  std::vector<ServerGroup> srv_group;
+
+  void fill_defaults() {
+    while (static_cast<int>(cli_group.size()) < client_groups)
+      cli_group.emplace_back();
+    while (static_cast<int>(srv_group.size()) < server_groups)
+      srv_group.emplace_back();
+  }
+
+  int total_clients() const {
+    int n = 0;
+    for (auto& g : cli_group) n += g.client_count;
+    return n;
+  }
+  int total_servers() const {
+    int n = 0;
+    for (auto& g : srv_group) n += g.server_count;
+    return n;
+  }
+};
+
+namespace detail {
+
+inline std::string trim(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r\n");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r\n");
+  return s.substr(a, b - a + 1);
+}
+
+inline bool to_bool(const std::string& v, bool dflt) {
+  if (v.empty()) return dflt;
+  std::string lo = v;
+  std::transform(lo.begin(), lo.end(), lo.begin(),
+                 [](unsigned char c) { return std::tolower(c); });
+  return lo == "1" || lo == "true" || lo == "yes" || lo == "on";
+}
+
+using Section = std::map<std::string, std::string>;
+
+inline std::map<std::string, Section> parse_ini(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) throw std::runtime_error("cannot read config file: " + path);
+  std::map<std::string, Section> out;
+  std::string line, section;
+  while (std::getline(f, line)) {
+    line = trim(line);
+    if (line.empty() || line[0] == '#' || line[0] == ';') continue;
+    if (line.front() == '[' && line.back() == ']') {
+      section = trim(line.substr(1, line.size() - 2));
+      out[section];
+      continue;
+    }
+    size_t eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = trim(line.substr(0, eq));
+    std::string val = trim(line.substr(eq + 1));
+    // strip trailing inline comments
+    size_t h = val.find_first_of("#;");
+    if (h != std::string::npos) val = trim(val.substr(0, h));
+    out[section][key] = val;
+  }
+  return out;
+}
+
+inline const std::string* find(const std::map<std::string, Section>& ini,
+                               const std::string& sec,
+                               const std::string& key) {
+  auto s = ini.find(sec);
+  if (s == ini.end()) return nullptr;
+  auto k = s->second.find(key);
+  if (k == s->second.end()) return nullptr;
+  return &k->second;
+}
+
+inline int geti(const std::map<std::string, Section>& ini,
+                const std::string& sec, const std::string& key, int d) {
+  auto* v = find(ini, sec, key);
+  return v ? std::stoi(*v) : d;
+}
+inline double getd(const std::map<std::string, Section>& ini,
+                   const std::string& sec, const std::string& key,
+                   double d) {
+  auto* v = find(ini, sec, key);
+  return v ? std::stod(*v) : d;
+}
+inline bool getb(const std::map<std::string, Section>& ini,
+                 const std::string& sec, const std::string& key, bool d) {
+  auto* v = find(ini, sec, key);
+  return v ? to_bool(*v, d) : d;
+}
+
+}  // namespace detail
+
+inline SimConfig parse_config_file(const std::string& path) {
+  using namespace detail;
+  auto ini = parse_ini(path);
+  SimConfig cfg;
+  cfg.server_groups = geti(ini, "global", "server_groups", 1);
+  cfg.client_groups = geti(ini, "global", "client_groups", 1);
+  cfg.server_random_selection =
+      getb(ini, "global", "server_random_selection", false);
+  cfg.server_soft_limit = getb(ini, "global", "server_soft_limit", true);
+  cfg.anticipation_timeout_s =
+      getd(ini, "global", "anticipation_timeout", 0.0);
+
+  for (int i = 0; i < cfg.client_groups; ++i) {
+    std::string sec = "client." + std::to_string(i);
+    ClientGroup d;
+    ClientGroup g;
+    g.client_count = geti(ini, sec, "client_count", d.client_count);
+    g.client_wait_s = getd(ini, sec, "client_wait", d.client_wait_s);
+    g.client_total_ops =
+        geti(ini, sec, "client_total_ops", d.client_total_ops);
+    g.client_server_select_range = geti(
+        ini, sec, "client_server_select_range", d.client_server_select_range);
+    g.client_iops_goal =
+        getd(ini, sec, "client_iops_goal", d.client_iops_goal);
+    g.client_outstanding_ops =
+        geti(ini, sec, "client_outstanding_ops", d.client_outstanding_ops);
+    g.client_reservation =
+        getd(ini, sec, "client_reservation", d.client_reservation);
+    g.client_limit = getd(ini, sec, "client_limit", d.client_limit);
+    g.client_weight = getd(ini, sec, "client_weight", d.client_weight);
+    g.client_req_cost = geti(ini, sec, "client_req_cost", d.client_req_cost);
+    cfg.cli_group.push_back(g);
+  }
+  for (int i = 0; i < cfg.server_groups; ++i) {
+    std::string sec = "server." + std::to_string(i);
+    ServerGroup d;
+    ServerGroup g;
+    g.server_count = geti(ini, sec, "server_count", d.server_count);
+    g.server_iops = getd(ini, sec, "server_iops", d.server_iops);
+    g.server_threads = geti(ini, sec, "server_threads", d.server_threads);
+    cfg.srv_group.push_back(g);
+  }
+  cfg.fill_defaults();
+  return cfg;
+}
+
+}  // namespace qos_sim

@@ -1,0 +1,501 @@
+// Discrete-event QoS simulation harness, native edition.
+//
+// Line-for-line behavioral mirror of the Python harness
+// (dmclock_tpu/sim/harness.py), which is itself the framework's
+// redesign of the reference's thread-sleep simulator
+// (/root/reference/sim/src/simulate.h, sim_server.h, sim_client.h):
+// virtual int64-ns clock, (time, seq)-ordered event heap, closed-loop
+// rate-limited clients, thread-slot servers.  Because event scheduling
+// and RNG consumption (pymt19937.h) happen in the same order as the
+// Python sim, the service trace is bit-identical across languages for
+// the same config+seed -- enforced by tests/test_native_sim.py.
+
+#pragma once
+
+#include <cassert>
+#include <chrono>
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <queue>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "dmclock/profile.h"
+#include "dmclock/recs.h"
+#include "dmclock/scheduler.h"
+#include "pymt19937.h"
+#include "sim_config.h"
+
+namespace qos_sim {
+
+constexpr int64_t NS_PER_SEC = 1000000000;
+
+using dmclock::Phase;
+using dmclock::ProfileTimer;
+using dmclock::ReqParams;
+
+using ClientId = uint64_t;
+using ServerId = uint64_t;
+using ReqId = uint64_t;  // (client << 32) | send-seq
+using Decision = dmclock::PullReq<ClientId, ReqId>;
+
+// ---------------------------------------------------------------------
+// event loop (harness.py EventLoop)
+// ---------------------------------------------------------------------
+
+class EventLoop {
+ public:
+  int64_t now_ns = 0;
+
+  void at(int64_t t, std::function<void()> fn) {
+    assert(t >= now_ns && "scheduling into the past");
+    heap_.push(Event{t, seq_++, std::move(fn)});
+  }
+  void after(int64_t delay, std::function<void()> fn) {
+    at(now_ns + delay, std::move(fn));
+  }
+
+  void run() {
+    while (!heap_.empty()) {
+      Event e = heap_.top();
+      heap_.pop();
+      now_ns = e.t;
+      e.fn();
+    }
+  }
+
+ private:
+  struct Event {
+    int64_t t;
+    uint64_t seq;
+    std::function<void()> fn;
+  };
+  struct Cmp {
+    bool operator()(const Event& a, const Event& b) const {
+      return a.t > b.t || (a.t == b.t && a.seq > b.seq);
+    }
+  };
+  std::priority_queue<Event, std::vector<Event>, Cmp> heap_;
+  uint64_t seq_ = 0;
+};
+
+// ---------------------------------------------------------------------
+// trace record (harness.py SimulatedServer._start_service)
+// ---------------------------------------------------------------------
+
+struct TraceOp {
+  int64_t t_ns;
+  ServerId server;
+  ClientId client;
+  int phase;
+  uint32_t cost;
+};
+
+// ---------------------------------------------------------------------
+// server (harness.py SimulatedServer; reference sim_server.h:31-242)
+// ---------------------------------------------------------------------
+
+struct ServerStats {
+  uint64_t ops_completed = 0;
+  uint64_t reservation_ops = 0;
+  uint64_t priority_ops = 0;
+  ProfileTimer add_request_timer;
+  ProfileTimer request_complete_timer;
+};
+
+template <typename Queue>
+class SimulatedServer {
+ public:
+  using ClientRespF =
+      std::function<void(ClientId, ReqId, Phase, uint32_t, ServerId)>;
+
+  SimulatedServer(ServerId id, double iops, int threads,
+                  std::unique_ptr<Queue> queue, EventLoop* loop,
+                  ClientRespF client_resp_f, std::vector<TraceOp>* trace)
+      : id_(id),
+        threads_(threads),
+        // reference rounds op time to whole microseconds
+        // (sim_server.h:137-139)
+        op_time_ns_(static_cast<int64_t>(0.5 + threads * 1e6 / iops) * 1000),
+        queue_(std::move(queue)),
+        loop_(loop),
+        client_resp_f_(std::move(client_resp_f)),
+        trace_(trace) {}
+
+  void post(ReqId request, ClientId client, const ReqParams& rp,
+            uint32_t cost) {
+    stats.add_request_timer.start();
+    queue_->add_request(request, client, rp, loop_->now_ns, cost);
+    stats.add_request_timer.stop();
+    dispatch();
+  }
+
+  Queue& queue() { return *queue_; }
+  ServerStats stats;
+
+ private:
+  void dispatch() {
+    while (busy_ < threads_) {
+      Decision pr = queue_->pull_request(loop_->now_ns);
+      if (pr.is_retn()) {
+        ++busy_;
+        start_service(pr);
+      } else if (pr.is_future()) {
+        int64_t when = pr.when_ready;
+        if (!wake_armed_ || when < wake_at_) {
+          wake_armed_ = true;
+          wake_at_ = when;
+          int64_t t = when > loop_->now_ns ? when : loop_->now_ns;
+          loop_->at(t, [this] { wake(); });
+        }
+        break;
+      } else {
+        break;
+      }
+    }
+  }
+
+  void wake() {
+    wake_armed_ = false;
+    dispatch();
+  }
+
+  void start_service(const Decision& pr) {
+    if (trace_)
+      trace_->push_back(TraceOp{loop_->now_ns, id_, pr.client,
+                                static_cast<int>(pr.phase), pr.cost});
+    ++stats.ops_completed;
+    if (pr.phase == Phase::reservation)
+      ++stats.reservation_ops;
+    else
+      ++stats.priority_ops;
+    ClientId client = pr.client;
+    ReqId request = pr.request;
+    Phase phase = pr.phase;
+    uint32_t cost = pr.cost;
+    loop_->after(op_time_ns_ * cost, [this, client, request, phase, cost] {
+      --busy_;
+      client_resp_f_(client, request, phase, cost, id_);
+      stats.request_complete_timer.start();
+      // (push-mode queues would get request_completed() here)
+      stats.request_complete_timer.stop();
+      dispatch();
+    });
+  }
+
+  ServerId id_;
+  int threads_;
+  int64_t op_time_ns_;
+  std::unique_ptr<Queue> queue_;
+  EventLoop* loop_;
+  ClientRespF client_resp_f_;
+  std::vector<TraceOp>* trace_;
+  int busy_ = 0;
+  bool wake_armed_ = false;
+  int64_t wake_at_ = 0;
+};
+
+// ---------------------------------------------------------------------
+// client (harness.py SimulatedClient; reference sim_client.h:76-336)
+// ---------------------------------------------------------------------
+
+struct ClientStats {
+  uint64_t ops_requested = 0;
+  uint64_t ops_completed = 0;
+  uint64_t reservation_ops = 0;
+  uint64_t priority_ops = 0;
+  std::vector<int64_t> completion_times_ns;
+  int64_t finish_time_ns = -1;
+  ProfileTimer get_req_params_timer;
+  ProfileTimer track_resp_timer;
+};
+
+template <typename Tracker>
+class SimulatedClient {
+ public:
+  using SelectF = std::function<ServerId(int)>;
+  using SubmitF =
+      std::function<void(ServerId, ReqId, ClientId, const ReqParams&,
+                         uint32_t)>;
+  using DoneF = std::function<void(ClientId)>;
+
+  SimulatedClient(ClientId id, const ClientGroup& g,
+                  std::unique_ptr<Tracker> tracker, EventLoop* loop,
+                  SelectF select, SubmitF submit, DoneF on_done)
+      : id_(id),
+        tracker_(std::move(tracker)),
+        loop_(loop),
+        select_(std::move(select)),
+        submit_(std::move(submit)),
+        on_done_(std::move(on_done)),
+        // reference rounds the gap to whole microseconds
+        // (sim_client.h:66-68)
+        gap_ns_(static_cast<int64_t>(0.5 + 1e6 / g.client_iops_goal) * 1000),
+        total_ops_(g.client_total_ops),
+        max_outstanding_(g.client_outstanding_ops),
+        cost_(g.client_req_cost) {
+    loop_->at(static_cast<int64_t>(g.client_wait_s * NS_PER_SEC),
+              [this] { attempt_send(); });
+  }
+
+  void receive_response(ReqId /*request*/, Phase phase, uint32_t cost,
+                        ServerId server) {
+    stats.track_resp_timer.start();
+    tracker_->track_resp(server, phase, cost);
+    stats.track_resp_timer.stop();
+    --outstanding_;
+    ++stats.ops_completed;
+    if (phase == Phase::reservation)
+      ++stats.reservation_ops;
+    else
+      ++stats.priority_ops;
+    stats.completion_times_ns.push_back(loop_->now_ns);
+    if (window_blocked_) {
+      window_blocked_ = false;
+      attempt_send();
+    }
+    if (sent_ >= total_ops_ && outstanding_ == 0) {
+      stats.finish_time_ns = loop_->now_ns;
+      on_done_(id_);
+    }
+  }
+
+  ClientStats stats;
+
+ private:
+  void attempt_send() {
+    if (sent_ >= total_ops_) return;
+    if (outstanding_ >= max_outstanding_) {
+      window_blocked_ = true;
+      return;
+    }
+    ServerId server = select_(sent_);
+    stats.get_req_params_timer.start();
+    ReqParams rp = tracker_->get_req_params(server);
+    stats.get_req_params_timer.stop();
+    ReqId req = (id_ << 32) | static_cast<uint32_t>(sent_);
+    submit_(server, req, id_, rp, cost_);
+    ++sent_;
+    ++outstanding_;
+    ++stats.ops_requested;
+    if (sent_ < total_ops_)
+      loop_->after(gap_ns_, [this] { attempt_send(); });
+  }
+
+  ClientId id_;
+  std::unique_ptr<Tracker> tracker_;
+  EventLoop* loop_;
+  SelectF select_;
+  SubmitF submit_;
+  DoneF on_done_;
+  int64_t gap_ns_;
+  int total_ops_;
+  int max_outstanding_;
+  uint32_t cost_;
+  int outstanding_ = 0;
+  int sent_ = 0;
+  bool window_blocked_ = false;
+};
+
+// ---------------------------------------------------------------------
+// simulation orchestrator (harness.py Simulation; reference
+// simulate.h:33-445)
+// ---------------------------------------------------------------------
+
+template <typename Queue, typename Tracker>
+class Simulation {
+ public:
+  using QueueFactory = std::function<std::unique_ptr<Queue>(
+      ServerId, std::function<dmclock::ClientInfo(const ClientId&)>,
+      int64_t anticipation_ns, bool soft_limit)>;
+  using TrackerFactory = std::function<std::unique_ptr<Tracker>()>;
+
+  Simulation(const SimConfig& cfg, QueueFactory queue_factory,
+             TrackerFactory tracker_factory, uint64_t seed,
+             bool record_trace)
+      : cfg_(cfg), rng_(seed) {
+    if (record_trace) trace_ptr_ = &trace;
+
+    for (size_t gi = 0; gi < cfg_.cli_group.size(); ++gi)
+      for (int i = 0; i < cfg_.cli_group[gi].client_count; ++i)
+        client_group_of_.push_back(static_cast<int>(gi));
+    n_clients_ = static_cast<int>(client_group_of_.size());
+
+    for (size_t gi = 0; gi < cfg_.srv_group.size(); ++gi)
+      for (int i = 0; i < cfg_.srv_group[gi].server_count; ++i)
+        server_group_of_.push_back(static_cast<int>(gi));
+    n_servers_ = static_cast<int>(server_group_of_.size());
+
+    for (auto& g : cfg_.cli_group)
+      infos_.emplace_back(g.client_reservation, g.client_weight,
+                          g.client_limit);
+
+    auto info_f = [this](const ClientId& c) {
+      return infos_[client_group_of_[c]];
+    };
+
+    int64_t anticipation_ns =
+        static_cast<int64_t>(cfg_.anticipation_timeout_s * NS_PER_SEC);
+    for (int s = 0; s < n_servers_; ++s) {
+      auto& g = cfg_.srv_group[server_group_of_[s]];
+      servers_.push_back(std::make_unique<SimulatedServer<Queue>>(
+          s, g.server_iops, g.server_threads,
+          queue_factory(s, info_f, anticipation_ns, cfg_.server_soft_limit),
+          &loop_,
+          [this](ClientId c, ReqId r, Phase p, uint32_t cost, ServerId sv) {
+            clients_[c]->receive_response(r, p, cost, sv);
+          },
+          trace_ptr_));
+    }
+
+    for (int c = 0; c < n_clients_; ++c) {
+      auto& g = cfg_.cli_group[client_group_of_[c]];
+      clients_.push_back(std::make_unique<SimulatedClient<Tracker>>(
+          c, g, tracker_factory(), &loop_, make_server_select(c, g),
+          [this](ServerId s, ReqId r, ClientId c2, const ReqParams& rp,
+                 uint32_t cost) { servers_[s]->post(r, c2, rp, cost); },
+          [this](ClientId c2) { done_.insert(c2); }));
+    }
+  }
+
+  void run() {
+    auto t0 = std::chrono::steady_clock::now();
+    loop_.run();
+    wall_seconds_ = std::chrono::duration<double>(
+                        std::chrono::steady_clock::now() - t0)
+                        .count();
+    assert(static_cast<int>(done_.size()) == n_clients_ &&
+           "not all clients finished");
+  }
+
+  // -- report (harness.py SimReport.format) ---------------------------
+  std::string report(bool show_intervals = false) const {
+    std::ostringstream os;
+    uint64_t total = 0, res = 0, prop = 0;
+    for (auto& c : clients_) {
+      total += c->stats.ops_completed;
+      res += c->stats.reservation_ops;
+      prop += c->stats.priority_ops;
+    }
+    os << "=== simulation report ===\n";
+    os << "clients: " << n_clients_ << "  servers: " << n_servers_ << "\n";
+    char buf[160];
+    snprintf(buf, sizeof buf,
+             "virtual duration: %.3f s; wall: %.3f s\n",
+             loop_.now_ns / double(NS_PER_SEC), wall_seconds_);
+    os << buf;
+    os << "total ops: " << total << " (reservation " << res
+       << ", priority " << prop << ")\n";
+    os << "-- client groups --\n";
+    for (size_t gi = 0; gi < cfg_.cli_group.size(); ++gi) {
+      auto& g = cfg_.cli_group[gi];
+      uint64_t ops = 0, gres = 0, gprop = 0;
+      int64_t finish = 0;
+      int count = 0;
+      for (int c = 0; c < n_clients_; ++c) {
+        if (client_group_of_[c] != static_cast<int>(gi)) continue;
+        ++count;
+        ops += clients_[c]->stats.ops_completed;
+        gres += clients_[c]->stats.reservation_ops;
+        gprop += clients_[c]->stats.priority_ops;
+        if (clients_[c]->stats.finish_time_ns > finish)
+          finish = clients_[c]->stats.finish_time_ns;
+      }
+      double fin_s = finish / double(NS_PER_SEC);
+      double rate = fin_s > 0 ? ops / fin_s : 0.0;
+      snprintf(buf, sizeof buf,
+               "group %zu: %d clients  r=%g w=%g l=%g | ops %llu "
+               "(res %llu / prop %llu) | done @ %.2fs | average %.2f "
+               "ops/s\n",
+               gi, count, g.client_reservation, g.client_weight,
+               g.client_limit, (unsigned long long)ops,
+               (unsigned long long)gres, (unsigned long long)gprop, fin_s,
+               rate);
+      os << buf;
+    }
+    dmclock::ProfileCombiner add_t, gr_t, tr_t;
+    for (auto& s : servers_) add_t.combine(s->stats.add_request_timer);
+    for (auto& c : clients_) {
+      gr_t.combine(c->stats.get_req_params_timer);
+      tr_t.combine(c->stats.track_resp_timer);
+    }
+    os << "-- server internal stats --\n";
+    snprintf(buf, sizeof buf, "average add_request: %.0f ns\n",
+             add_t.mean_ns());
+    os << buf;
+    os << "-- client internal stats --\n";
+    snprintf(buf, sizeof buf, "average get_req_params: %.0f ns\n",
+             gr_t.mean_ns());
+    os << buf;
+    snprintf(buf, sizeof buf, "average track_resp: %.0f ns\n",
+             tr_t.mean_ns());
+    os << buf;
+    if (show_intervals) {
+      os << "-- per-client interval ops/sec --\n";
+      for (int c = 0; c < n_clients_; ++c) {
+        auto& times = clients_[c]->stats.completion_times_ns;
+        os << "client " << c << ":";
+        if (!times.empty()) {
+          int64_t hi = 0;
+          for (auto t : times)
+            if (t > hi) hi = t;
+          std::vector<int> buckets(hi / NS_PER_SEC + 1, 0);
+          for (auto t : times) ++buckets[t / NS_PER_SEC];
+          for (int b : buckets) os << " " << b;
+        }
+        os << "\n";
+      }
+    }
+    return os.str();
+  }
+
+  int64_t virtual_now_ns() const { return loop_.now_ns; }
+  double wall_seconds() const { return wall_seconds_; }
+  uint64_t total_ops() const {
+    uint64_t t = 0;
+    for (auto& c : clients_) t += c->stats.ops_completed;
+    return t;
+  }
+
+  std::vector<TraceOp> trace;
+
+ private:
+  // (harness.py _make_server_select; reference simulate.h:398-444)
+  std::function<ServerId(int)> make_server_select(int client_idx,
+                                                  const ClientGroup& g) {
+    int servers_per = g.client_server_select_range < n_servers_
+                          ? g.client_server_select_range
+                          : n_servers_;
+    double factor = double(n_servers_) / (n_clients_ > 1 ? n_clients_ : 1);
+    if (cfg_.server_random_selection) {
+      return [this, client_idx, servers_per, factor](int) -> ServerId {
+        uint32_t offset = rng_.randrange(servers_per);
+        return (static_cast<int64_t>(0.5 + client_idx * factor) + offset) %
+               n_servers_;
+      };
+    }
+    return [this, client_idx, servers_per, factor](int seed) -> ServerId {
+      int offset = seed % servers_per;
+      return (static_cast<int64_t>(0.5 + client_idx * factor) + offset) %
+             n_servers_;
+    };
+  }
+
+  SimConfig cfg_;
+  EventLoop loop_;
+  PyMT19937 rng_;
+  std::vector<int> client_group_of_;
+  std::vector<int> server_group_of_;
+  std::vector<dmclock::ClientInfo> infos_;
+  std::vector<std::unique_ptr<SimulatedServer<Queue>>> servers_;
+  std::vector<std::unique_ptr<SimulatedClient<Tracker>>> clients_;
+  std::set<ClientId> done_;
+  std::vector<TraceOp>* trace_ptr_ = nullptr;
+  int n_clients_ = 0;
+  int n_servers_ = 0;
+  double wall_seconds_ = 0.0;
+};
+
+}  // namespace qos_sim

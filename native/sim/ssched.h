@@ -1,0 +1,68 @@
+// Simple-scheduler (FIFO) baseline, native edition.
+//
+// Equivalent of the reference's ssched comparison scheduler
+// (/root/reference/sim/src/ssched/ssched_server.h:35-192 SimpleQueue,
+// ssched_client.h:25-49 no-op tracker) and the Python
+// dmclock_tpu/sim/ssched.py: same add/pull surface as the dmclock
+// queues so it drops into the same sim harness.
+
+#pragma once
+
+#include <deque>
+#include <functional>
+
+#include "dmclock/recs.h"
+#include "dmclock/scheduler.h"
+
+namespace qos_sim {
+
+class NullServiceTracker {
+ public:
+  dmclock::ReqParams get_req_params(uint64_t /*server*/) {
+    return dmclock::ReqParams(0, 0);
+  }
+  void track_resp(uint64_t /*server*/, dmclock::Phase /*phase*/,
+                  dmclock::Cost /*cost*/ = 1) {}
+};
+
+// strict-FIFO queue with the pull surface (reference ssched_server.h)
+class SimpleQueue {
+ public:
+  using Decision = dmclock::PullReq<uint64_t, uint64_t>;
+
+  int add_request(uint64_t request, const uint64_t& client,
+                  const dmclock::ReqParams& /*params*/, int64_t /*time_ns*/,
+                  dmclock::Cost cost = 1) {
+    queue_.push_back(Entry{client, request, cost});
+    return 0;
+  }
+
+  Decision pull_request(int64_t /*now_ns*/) {
+    Decision d;
+    if (queue_.empty()) {
+      d.type = dmclock::NextReqType::none;
+      return d;
+    }
+    Entry e = queue_.front();
+    queue_.pop_front();
+    d.type = dmclock::NextReqType::returning;
+    d.client = e.client;
+    d.request = e.request;
+    d.phase = dmclock::Phase::priority;
+    d.cost = e.cost;
+    return d;
+  }
+
+  size_t request_count() const { return queue_.size(); }
+  bool empty() const { return queue_.empty(); }
+
+ private:
+  struct Entry {
+    uint64_t client;
+    uint64_t request;
+    dmclock::Cost cost;
+  };
+  std::deque<Entry> queue_;
+};
+
+}  // namespace qos_sim

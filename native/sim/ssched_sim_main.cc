@@ -1,0 +1,61 @@
+// ssched_sim -- native FIFO-baseline simulator binary.
+//
+// Equivalent of the reference's ssched_sim
+// (/root/reference/sim/src/test_ssched_main.cc:49-199): the same
+// discrete-event harness over the SimpleQueue FIFO + no-op tracker,
+// used as the comparison baseline for the dmClock scheduler.  Unlike
+// the reference binary (hardcoded parameters) this accepts the same
+// config format as dmc_sim, mirroring the Python ssched_sim CLI.
+
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <string>
+
+#include "sim_harness.h"
+#include "ssched.h"
+
+int main(int argc, char** argv) {
+  std::string conf;
+  uint64_t seed = 12345;
+  bool intervals = false;
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "-c") || !strcmp(argv[i], "--conf")) {
+      if (++i >= argc) return 2;
+      conf = argv[i];
+    } else if (!strcmp(argv[i], "--seed")) {
+      if (++i >= argc) return 2;
+      seed = strtoull(argv[i], nullptr, 10);
+    } else if (!strcmp(argv[i], "--intervals")) {
+      intervals = true;
+    } else {
+      fprintf(stderr, "usage: %s -c CONF [--seed N] [--intervals]\n",
+              argv[0]);
+      return 2;
+    }
+  }
+
+  qos_sim::SimConfig cfg;
+  if (!conf.empty()) {
+    try {
+      cfg = qos_sim::parse_config_file(conf);
+    } catch (const std::exception& e) {
+      fprintf(stderr, "ssched_sim: %s\n", e.what());
+      return 2;
+    }
+  } else {
+    cfg.fill_defaults();
+  }
+
+  qos_sim::Simulation<qos_sim::SimpleQueue, qos_sim::NullServiceTracker>
+      sim(
+          cfg,
+          [](qos_sim::ServerId,
+             std::function<dmclock::ClientInfo(const qos_sim::ClientId&)>,
+             int64_t, bool) { return std::make_unique<qos_sim::SimpleQueue>(); },
+          [] { return std::make_unique<qos_sim::NullServiceTracker>(); },
+          seed, false);
+  sim.run();
+  printf("%s", sim.report(intervals).c_str());
+  return 0;
+}

@@ -1,0 +1,91 @@
+"""Cross-language simulator parity: native dmc_sim vs Python dmc_sim.
+
+The native simulator (native/sim/) replicates the Python discrete-event
+harness including CPython-compatible MT19937 server selection
+(native/sim/pymt19937.h), so for the same config+seed the full service
+trace -- (virtual ns, server, client, phase, cost) per op -- must be
+BIT-IDENTICAL across languages.  This is the strongest cross-language
+gate: it transitively pins the native scheduler, tracker, harness, and
+config parser against their Python counterparts.
+"""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from dmclock_tpu.sim.config import parse_config_file
+from dmclock_tpu.sim.dmc_sim import run_sim
+
+REPO = Path(__file__).resolve().parent.parent
+BUILD = REPO / "native" / "build"
+
+
+@pytest.fixture(scope="module")
+def dmc_sim_native():
+    exe = BUILD / "dmc_sim_native"
+    if not exe.exists():
+        import shutil
+        if not shutil.which("cmake"):
+            pytest.skip("no cmake; native sim unavailable")
+        subprocess.run(["cmake", "-S", str(REPO / "native"), "-B",
+                        str(BUILD)], check=True, capture_output=True)
+        subprocess.run(["cmake", "--build", str(BUILD), "-j", "--target",
+                        "dmc_sim_native"], check=True,
+                       capture_output=True)
+    return exe
+
+
+def native_trace(exe, conf, model, seed):
+    out = subprocess.run(
+        [str(exe), "-c", str(conf), "--model", model, "--seed",
+         str(seed), "--trace"],
+        check=True, capture_output=True, text=True, timeout=300).stdout
+    trace = []
+    report = []
+    for line in out.splitlines():
+        if line.startswith("TRACE "):
+            t, srv, cli, phase, cost = line.split()[1:]
+            trace.append((int(t), int(srv), int(cli), int(phase),
+                          int(cost)))
+        else:
+            report.append(line)
+    return trace, "\n".join(report)
+
+
+@pytest.mark.parametrize("conf,py_model,native_model,seed", [
+    ("configs/dmc_sim_example.conf", "dmclock", "dmclock", 12345),
+    ("configs/dmc_sim_example.conf", "dmclock-delayed", "dmclock-delayed",
+     12345),
+    ("configs/dmc_sim_100th.conf", "dmclock", "dmclock", 12345),
+    ("configs/dmc_sim_100th.conf", "dmclock", "dmclock", 999),
+    ("configs/dmc_sim_example.conf", "ssched", "ssched", 12345),
+])
+def test_trace_parity_native_vs_python(dmc_sim_native, conf, py_model,
+                                       native_model, seed):
+    cfg = parse_config_file(str(REPO / conf))
+    py = run_sim(cfg, model=py_model, seed=seed, record_trace=True)
+    py_trace = [(t, s, c, p, co) for (t, s, c, p, co) in py.trace]
+    nat_trace, _ = native_trace(dmc_sim_native, REPO / conf,
+                                native_model, seed)
+    assert len(py_trace) == len(nat_trace) > 0
+    for i, (a, b) in enumerate(zip(py_trace, nat_trace)):
+        assert a == b, f"trace diverges at op {i}: py={a} native={b}"
+
+
+def test_native_report_totals(dmc_sim_native):
+    _, report = native_trace(dmc_sim_native,
+                             REPO / "configs/dmc_sim_100th.conf",
+                             "dmclock", 12345)
+    assert "total ops: 100000" in report
+    assert "clients: 100  servers: 100" in report
+
+
+def test_ssched_sim_native_runs():
+    exe = BUILD / "ssched_sim_native"
+    if not exe.exists():
+        pytest.skip("ssched_sim_native not built")
+    out = subprocess.run(
+        [str(exe), "-c", str(REPO / "configs/dmc_sim_example.conf")],
+        check=True, capture_output=True, text=True, timeout=120).stdout
+    assert "total ops: 8000" in out
