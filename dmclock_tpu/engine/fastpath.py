@@ -58,45 +58,52 @@ class FastBatch(NamedTuple):
     decisions: Decision    # [k] arrays, valid where ok
 
 
-# Packed-key layout: one int64 sort key holds (key - key_min) in the
-# high bits and (order - order_min) in the low ORDER_BITS, so a SINGLE
-# top_k yields the exact lexicographic (key, creation-order) selection
-# already sorted in serial decision order.  The rebase windows (2^36 ns
-# ~ 69 s of tag spread at the boundary; 2^26 client creations of order
-# spread) are checked on device -- overflow fails the speculation and
-# the serial engine takes the batch, so exactness is never at risk.
-ORDER_BITS = 26
-_ORDER_MASK = (1 << ORDER_BITS) - 1
-_KEY_WINDOW = jnp.int64(1) << (62 - ORDER_BITS)
+# Selection = ONE full lexicographic sort on 32-bit rebased keys.  TPUs
+# emulate int64 as register pairs, so sorting (key-key_min) as int32 with
+# a second int32 creation-order key is ~4x cheaper than a packed-int64
+# top_k -- and a full sort yields the ENTIRE service order, letting the
+# batch size k grow to tens of thousands of decisions per O(N) pass.
+# Rebase-window overflow clamps to _CLAMP32: harmless for candidates
+# strictly beyond the selection boundary (never selectable), and the
+# boundary check ``vk < _CLAMP32`` fails speculation otherwise, so
+# exactness is never at risk (the serial engine takes the batch).
+_CLAMP32 = (1 << 31) - 2     # in-window ceiling for real candidates
+_SENT32 = (1 << 31) - 1      # non-candidate sentinel (sorts last)
+_ORDER32_LIMIT = jnp.int64(1) << 31
 
 
-def _lex_top_k(key, order, k: int):
+def _sorted_selection(key, order, k: int):
     """Indices of the k lexicographically-smallest (key, order) pairs,
     sorted ascending (= exact serial service order).
 
     Returns (idx[k], V, max_tied_order, ok) where V is the k-th
     smallest key and max_tied_order the largest creation order selected
-    at the V boundary.  ``ok`` is False when fewer than k real
+    at the V boundary.  ``ok`` is False when fewer than k real in-window
     candidates exist (sentinel keys carry KEY_INF) or a rebase window
-    overflowed -- the caller must then fall back to the serial engine.
+    overflowed at the boundary -- the caller must then fall back to the
+    serial engine.
     """
     real = key < KEY_INF
     kmin = jnp.min(jnp.where(real, key, KEY_INF))
-    omin = jnp.min(jnp.where(real, order, jnp.int64(1) << 62))
     krel = key - kmin
-    orel = order - omin
-    fit = real & (krel < _KEY_WINDOW) & (orel <= _ORDER_MASK)
-    packed = jnp.where(fit, (krel << ORDER_BITS) | orel, KEY_INF)
-    negv, idx = lax.top_k(-packed, k)
-    vk = -negv[k - 1]
-    count_ok = vk < KEY_INF
-    v = (vk >> ORDER_BITS) + kmin
-    max_tied_order = (vk & _ORDER_MASK) + omin
-    # Window check, relaxed: only candidates at-or-below the boundary V
-    # must have fit the rebase windows; anything strictly beyond V may
-    # overflow harmlessly (it was never selectable).
-    window_ok = jnp.all(~real | fit | (key > v))
-    return idx, v, max_tied_order, count_ok & window_ok
+    fits = real & (krel < _CLAMP32)
+    k32 = jnp.where(fits, krel,
+                    jnp.where(real, _CLAMP32, _SENT32)).astype(jnp.int32)
+    # order rebased like the keys: creation indices grow without bound,
+    # so the int32 cast must be of the spread, not the absolute value
+    omin = jnp.min(jnp.where(real, order, jnp.int64(1) << 62))
+    o32 = (order - omin).astype(jnp.int32)
+    iota = jnp.arange(key.shape[0], dtype=jnp.int32)
+    ks, _, idxs = lax.sort((k32, o32, iota), num_keys=2)
+    vk = ks[k - 1]
+    # vk < _CLAMP32 ensures >= k real candidates AND that every
+    # selected key fit the rebase window (clamped/sentinel rows sort at
+    # or past _CLAMP32); the order-spread rebase must be exact too.
+    omax = jnp.max(jnp.where(real, order, omin))
+    ok = (vk < _CLAMP32) & (omax - omin < _ORDER32_LIMIT)
+    v = kmin + vk.astype(jnp.int64)
+    max_tied_order = order[idxs[k - 1]]
+    return idxs[:k], v, max_tied_order, ok
 
 
 def _ready_now(state: EngineState, now):
@@ -106,18 +113,78 @@ def _ready_now(state: EngineState, now):
     return state.head_ready | (state.head_limit <= now)
 
 
+class RingWindow(NamedTuple):
+    """Per-epoch prefetch of the tail rings.
+
+    A speculative batch pops at most ONE request per client, so an
+    m-batch epoch only ever reads ring positions ``q_head0 ..
+    q_head0+m-1``.  Prefetching that [m, N] window once per epoch
+    replaces the per-batch ring gather, which XLA lowers to a dense
+    read of the ENTIRE [N, Q] ring pair (~200 MB/batch at bench shapes
+    -- measured as 60x the window's traffic)."""
+
+    arr: jnp.ndarray    # int64[m, N] arrivals at q_head0 + j
+    cost: jnp.ndarray   # int64[m, N]
+    q0: jnp.ndarray     # int32[N] q_head at prefetch time
+
+
+def ring_window(state: EngineState, m: int) -> RingWindow:
+    """Prefetch the next ``min(m, Q)`` ring elements of every client,
+    transposed to [w, N] for cheap per-batch row selects.
+
+    Built by barrel-shifting each client's ring left by its own
+    ``q_head`` (log2(Q) masked dense rolls), then slicing the leading
+    columns.  TPU gathers with per-row indices serialize (measured 10x
+    the rolls' cost for a 32-wide window; a vmapped dynamic-slice was
+    50x), while rolls are dense contiguous copies the TPU streams at
+    full bandwidth.  Window rows past a client's queued tail carry
+    stale ring values -- reads of them only happen after the client
+    drained, and are masked at commit."""
+    q = state.ring_capacity
+    q0 = state.q_head
+    wsize = min(m, q)
+
+    def rot(r):
+        s = 0
+        while (1 << s) < q:
+            bit = ((q0 >> s) & 1).astype(bool)
+            r = jnp.where(bit[:, None], jnp.roll(r, -(1 << s), axis=1), r)
+            s += 1
+        return r[:, :wsize].T
+    return RingWindow(arr=rot(state.q_arrival), cost=rot(state.q_cost),
+                      q0=q0)
+
+
+def _window_heads(state: EngineState, window: RingWindow):
+    """Every client's next tail element (new head after a pop), read
+    from the prefetched window: rows consumed so far = q_head - q0.
+    Unrolled one-hot selects -- a [w, N] take_along_axis lowers to a
+    serializing gather (measured 20x slower)."""
+    wsize = window.arr.shape[0]
+    off = jnp.remainder(state.q_head - window.q0,
+                        state.ring_capacity).astype(jnp.int32)
+    narr = window.arr[0]
+    ncost = window.cost[0]
+    for j in range(1, wsize):
+        pick = off == j
+        narr = jnp.where(pick, window.arr[j], narr)
+        ncost = jnp.where(pick, window.cost[j], ncost)
+    return narr, ncost
+
+
 class DenseServe(NamedTuple):
     """Elementwise ([N]) serve computation: what every client's state
     would become if its head were popped this batch.  Scatter-free --
-    TPU row-scatters of 8-byte rows serialize badly, so the serve is
-    computed densely and committed with ``jnp.where`` selects; the only
-    index ops per batch are the [k]-sized ring reads and the decision
-    emit."""
+    TPU scatters serialize badly (measured ~6x the whole elementwise
+    serve), so the serve is computed densely for every client (ring
+    heads read with a per-row ``take_along_axis``) and committed with
+    ``jnp.where`` selects; the only index ops per batch are the
+    [k]-sized decision-emit gathers."""
 
     has_more: jnp.ndarray     # bool[N] client still has queued work
     new_depth: jnp.ndarray    # int32[N]
-    narr: jnp.ndarray         # int64[N] next head arrival (valid at idx)
-    ncost: jnp.ndarray        # int64[N] next head cost (valid at idx)
+    narr: jnp.ndarray         # int64[N] next head arrival
+    ncost: jnp.ndarray        # int64[N] next head cost
     head_resv: jnp.ndarray    # int64[N] new tag minus weight-debt offset
     head_prop: jnp.ndarray    # int64[N]
     head_limit: jnp.ndarray   # int64[N]
@@ -126,19 +193,21 @@ class DenseServe(NamedTuple):
     prev_limit: jnp.ndarray   # int64[N]
 
 
-def _dense_serve(state: EngineState, idx, phase_is_ready: bool,
+def _dense_serve(state: EngineState, heads,
+                 phase_is_ready: bool,
                  anticipation_ns: int) -> DenseServe:
     """The vectorized pop+retag (pop_process_request / update_next_tag /
     reduce_reservation_tags, reference :1021-1111) computed for EVERY
     client; rows outside the served set are garbage and masked out at
-    commit.  ``idx`` is only used to fetch the ring heads ([k] gathers +
-    one scatter pair -- the rings are too large for a dense pass)."""
-    # ring head of each *served* client, scattered into dense [N] slots
-    rq = state.q_head[idx]
-    narr_k = state.q_arrival[idx, rq]
-    ncost_k = state.q_cost[idx, rq]
-    narr = jnp.zeros_like(state.head_arrival).at[idx].set(narr_k)
-    ncost = jnp.ones_like(state.head_cost).at[idx].set(ncost_k)
+    commit.
+
+    ``heads`` = (narr, ncost): every client's next tail element (the
+    new head after a pop), precomputed by the caller OUTSIDE any
+    ``lax.cond`` -- large arrays captured by cond branches are
+    materialized as branch operands every call, so only these two [N]
+    arrays may cross the regime branch, never the [m, N] window."""
+    # rows with depth <= 1 carry stale ring values -- masked at commit
+    narr, ncost = heads
 
     nr, np_, nl = _make_tag(
         state.head_resv, state.head_prop, state.head_limit,
@@ -210,11 +279,19 @@ def _served_mask(key, order, v, max_tied_order):
                    ((key == v) & (order <= max_tied_order)))
 
 
+def _default_heads(state: EngineState):
+    """Single-batch ring-head read (the m=1 window)."""
+    return _window_heads(state, ring_window(state, 1))
+
+
 def speculate_weight_batch(state: EngineState, now, k: int, *,
                            anticipation_ns: int,
-                           enabled=True) -> FastBatch:
+                           enabled=True,
+                           heads=None) -> FastBatch:
     """k weight-phase serves in one pass; state untouched when the
     speculation fails (ok=False) or `enabled` is False."""
+    if heads is None:
+        heads = _default_heads(state)
     has_req = state.active & (state.depth > 0)
     ready = has_req & _ready_now(state, now)
     eff = state.head_prop + state.prop_delta
@@ -225,10 +302,11 @@ def speculate_weight_batch(state: EngineState, now, k: int, *,
     resv_min0 = jnp.min(resv_key)
     cond_entry = resv_min0 > now
 
-    idx, kth, max_tied_order, cond_count = _lex_top_k(key, state.order, k)
+    idx, kth, max_tied_order, cond_count = _sorted_selection(
+        key, state.order, k)
     mask = _served_mask(key, state.order, kth, max_tied_order)
 
-    serve = _dense_serve(state, idx, True, anticipation_ns)
+    serve = _dense_serve(state, heads, True, anticipation_ns)
 
     # one-serve-per-client: each served client must leave the window --
     # its new head either empty, not ready at `now`, keyed strictly past
@@ -278,21 +356,25 @@ def speculate_weight_batch(state: EngineState, now, k: int, *,
 
 def speculate_resv_batch(state: EngineState, now, k: int, *,
                          anticipation_ns: int,
-                         enabled=True) -> FastBatch:
+                         enabled=True,
+                         heads=None) -> FastBatch:
     """k reservation-phase serves in one pass; state untouched when the
     speculation fails or `enabled` is False.
 
     Valid when the k smallest reservation tags are all <= now (deep
     constraint backlog): phase 1 fires every time, so no promotion or
     weight-phase side effects occur (reference :1124-1128)."""
+    if heads is None:
+        heads = _default_heads(state)
     has_req = state.active & (state.depth > 0)
     key = jnp.where(has_req, state.head_resv, KEY_INF)
 
-    idx, kth, max_tied_order, cond_count = _lex_top_k(key, state.order, k)
+    idx, kth, max_tied_order, cond_count = _sorted_selection(
+        key, state.order, k)
     cond_eligible = kth <= now            # all k fire the constraint phase
     mask = _served_mask(key, state.order, kth, max_tied_order)
 
-    serve = _dense_serve(state, idx, False, anticipation_ns)
+    serve = _dense_serve(state, heads, False, anticipation_ns)
 
     # one-serve-per-client: the new head tag must leave the window
     beyond = (serve.head_resv > kth) | \
@@ -316,28 +398,35 @@ def speculate_resv_batch(state: EngineState, now, k: int, *,
 def attempt_fast_batch(state: EngineState, now, k: int, *,
                        anticipation_ns: int,
                        enabled=True,
-                       weight_first=False) -> FastBatch:
+                       weight_first=False,
+                       window: RingWindow | None = None) -> FastBatch:
     """One speculative attempt: one regime, then the other on failure.
 
-    Both speculations are cheap (top_k + O(k) serves), so the branch is
-    a small device cond.  The caller checks ``ok`` on the host (or via
-    the epoch scan's commit mask) and falls back to the exact serial
-    engine when speculation fails -- keeping the expensive O(k*N)
-    fallback OUT of this compiled program.  With `enabled` False the
-    state passes through untouched.  ``weight_first`` orders the
+    Both speculations are cheap (one sort + O(N) elementwise serves), so
+    the branch is a small device cond.  The caller checks ``ok`` on the
+    host (or via the epoch scan's commit mask) and falls back to the
+    exact serial engine when speculation fails -- keeping the expensive
+    O(k*N) fallback OUT of this compiled program.  With `enabled` False
+    the state passes through untouched.  ``weight_first`` orders the
     attempts -- steady states stay in one regime for long stretches, so
     trying last batch's regime first skips a wasted speculation.
     """
+    # read the ring heads ONCE, outside the regime branches: both
+    # regimes pop the same next element, and cond branches materialize
+    # captured arrays as operands (capturing the [m, N] window here was
+    # measured at ~7x the whole batch cost)
+    heads = _default_heads(state) if window is None \
+        else _window_heads(state, window)
 
     def resv(_):
         return speculate_resv_batch(state, now, k,
                                     anticipation_ns=anticipation_ns,
-                                    enabled=enabled)
+                                    enabled=enabled, heads=heads)
 
     def weight(_):
         return speculate_weight_batch(state, now, k,
                                       anticipation_ns=anticipation_ns,
-                                      enabled=enabled)
+                                      enabled=enabled, heads=heads)
 
     def ordered(first, second):
         def go(_):
@@ -386,6 +475,8 @@ def scan_fast_epoch(state: EngineState, now, m: int, k: int, *,
     """
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+    # one dense ring read for the whole epoch (see RingWindow)
+    window = ring_window(state, m)
 
     def body(carry, _):
         mut, dead, weight_hint = carry
@@ -393,7 +484,8 @@ def scan_fast_epoch(state: EngineState, now, m: int, k: int, *,
         batch = attempt_fast_batch(st, now, k,
                                    anticipation_ns=anticipation_ns,
                                    enabled=~dead,
-                                   weight_first=weight_hint)
+                                   weight_first=weight_hint,
+                                   window=window)
         commit = batch.ok & ~dead
         # batch.state is bit-identical to st when not committed (the
         # serve scatters are gated), so no whole-state select is needed

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Component profile of the speculative fastpath (the tool behind
+PROFILE.md).
+
+Timing protocol: the tunneled single-chip runtime adds large, VARIABLE
+per-call dispatch overhead (tens of ms), so naive per-call timing is
+useless.  Every measurement here runs the component M_HI and M_LO times
+inside one jitted ``lax.scan`` (data dependence threaded through the
+carry) and reports ``(T(M_HI) - T(M_LO)) / (M_HI - M_LO)`` -- fixed
+per-call costs cancel exactly.  All buffers are passed as real jit
+arguments: device arrays captured as jit constants are re-uploaded
+through the tunnel per call and would dominate.
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from __graft_entry__ import _preloaded_state
+from dmclock_tpu.engine import fastpath, kernels
+from profile_util import scalar_latency, state_digest
+
+N = 100_000
+K = 32768
+M_LO, M_HI = 8, 32
+
+
+def _time_call(f, *args, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = f(*args)
+        jax.device_get(state_digest(out.state) if hasattr(out, "state")
+                       else out[1])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure_epoch(name, state, m_lo=M_LO, m_hi=M_HI, k=K):
+    f_lo = jax.jit(functools.partial(fastpath.scan_fast_epoch,
+                                     m=m_lo, k=k, anticipation_ns=0))
+    f_hi = jax.jit(functools.partial(fastpath.scan_fast_epoch,
+                                     m=m_hi, k=k, anticipation_ns=0))
+    now = jnp.int64(0)
+    jax.device_get(state_digest(f_lo(state, now).state))
+    jax.device_get(state_digest(f_hi(state, now).state))
+    t_lo = _time_call(f_lo, state, now)
+    t_hi = _time_call(f_hi, state, now)
+    t = (t_hi - t_lo) / (m_hi - m_lo)
+    print(f"{name:52s} {t*1e6:9.1f} us/batch  "
+          f"({t/k*1e9:5.1f} ns/dec, {k/t/1e6:5.1f} M dec/s)")
+    return t
+
+
+def measure_scan(name, make_body, state, init):
+    """make_body(state) -> (carry, _) -> carry scan body; differenced."""
+    def mk(m):
+        def fn(state, tick):
+            body = make_body(state)
+            c, vs = lax.scan(body, (tick, init), None, length=m)
+            return state, c[0] + jnp.asarray(vs[0]).astype(jnp.int64).sum()
+        return fn
+    f_lo = jax.jit(mk(64))
+    f_hi = jax.jit(mk(256))
+    jax.device_get(f_lo(state, jnp.int64(0))[1])
+    jax.device_get(f_hi(state, jnp.int64(0))[1])
+    t_lo = _time_call(f_lo, state, jnp.int64(0))
+    t_hi = _time_call(f_hi, state, jnp.int64(0))
+    t = (t_hi - t_lo) / (256 - 64)
+    print(f"{name:52s} {t*1e6:9.1f} us/iter")
+    return t
+
+
+def main():
+    print(f"scalar round-trip latency: {scalar_latency()*1e3:.1f} ms\n")
+    state = _preloaded_state(N, 128, ring=128)
+
+    # -- whole epoch at bench shape
+    measure_epoch("scan_fast_epoch (k=32768, ring=128)", state)
+
+    # -- selection: full 2-key int32 sort (the shipped design)
+    def sel_sort(state):
+        iota = jnp.arange(N, dtype=jnp.int32)
+        o32 = state.order.astype(jnp.int32)
+
+        def body(c, _):
+            t, _x = c
+            key = state.head_prop + state.prop_delta + t
+            kmin = jnp.min(key)
+            k32 = jnp.clip(key - kmin, 0, (1 << 31) - 2).astype(jnp.int32)
+            ks, os_, idxs = lax.sort((k32, o32, iota), num_keys=2)
+            return (t + idxs[0].astype(jnp.int64) + 1, _x), ks[K - 1]
+        return body
+    measure_scan("selection: 2-key i32 full sort", sel_sort, state,
+                 jnp.int32(0))
+
+    # -- serve: dense elementwise retag (no ring access)
+    def serve(state):
+        def body(c, _):
+            t, _x = c
+            st = state._replace(prev_prop=state.prev_prop + t)
+            heads = (st.head_arrival, st.head_cost)
+            sv = fastpath._dense_serve(st, heads, True, 0)
+            return (t + sv.head_prop[0] + 1, _x), sv.head_resv[0]
+        return body
+    measure_scan("serve: dense elementwise retag", serve, state,
+                 jnp.int32(0))
+
+    # -- ring window: prefetch (per epoch) and select (per batch)
+    def prefetch(state):
+        def body(c, _):
+            t, _x = c
+            st = state._replace(q_head=(state.q_head + jnp.int32(t)) % 128)
+            win = fastpath.ring_window(st, 32)
+            return (t + win.arr[0, 0] + 1, _x), win.cost[0, 0]
+        return body
+    measure_scan("ring_window prefetch (barrel shift, per EPOCH)",
+                 prefetch, state, jnp.int32(0))
+
+    win = jax.jit(lambda s: fastpath.ring_window(s, 32))(state)
+
+    def select(state):
+        def body(c, _):
+            t, _x = c
+            st = state._replace(q_head=(state.q_head + jnp.int32(t)) % 128)
+            narr, ncost = fastpath._window_heads(st, win)
+            return (t + narr[0] + 1, _x), ncost[0]
+        return body
+    measure_scan("window head select (one-hot, per batch)", select,
+                 state, jnp.int32(0))
+
+
+if __name__ == "__main__":
+    main()
