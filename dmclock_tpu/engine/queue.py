@@ -17,6 +17,7 @@ Restrictions vs the oracle (by design, documented):
 
 from __future__ import annotations
 
+import functools
 import threading
 import time as _walltime
 from collections import deque
@@ -36,6 +37,48 @@ from .kernels import (OP_ADD, OP_CREATE, OP_NOP, FUTURE, NONE, RETURNING,
 from .state import EngineState, init_state
 
 ClientInfoFunc = Callable[[Any], Optional[ClientInfo]]
+
+
+# Module-level jit cache shared across queue instances: a 100-server
+# sim builds 100 queues, and per-instance jits would re-TRACE the
+# engine for every one of them (tracing a long engine_run scan costs
+# seconds; XLA's compile cache only deduplicates after tracing).
+_JIT_CACHE: Dict[Tuple, Callable] = {}
+
+
+def _shared_jit_ingest(anticipation_ns: int):
+    key = ("ingest", anticipation_ns)
+    if key not in _JIT_CACHE:
+        _JIT_CACHE[key] = jax.jit(functools.partial(
+            kernels.ingest, anticipation_ns=anticipation_ns))
+    return _JIT_CACHE[key]
+
+
+def _shared_jit_run(steps: int, advance_now: bool, allow: bool,
+                    anticipation_ns: int):
+    key = ("run", steps, advance_now, allow, anticipation_ns)
+    if key not in _JIT_CACHE:
+        _JIT_CACHE[key] = jax.jit(
+            lambda s, t: kernels.engine_run(
+                s, t, steps, allow_limit_break=allow,
+                anticipation_ns=anticipation_ns,
+                advance_now=advance_now))
+    return _JIT_CACHE[key]
+
+
+def _shared_jit_ingest_run(steps: int, advance_now: bool, allow: bool,
+                           anticipation_ns: int):
+    key = ("ingest_run", steps, advance_now, allow, anticipation_ns)
+    if key not in _JIT_CACHE:
+        ant = anticipation_ns
+
+        def fused(s, ops, t):
+            s = kernels.ingest(s, ops, anticipation_ns=ant)
+            return kernels.engine_run(
+                s, t, steps, allow_limit_break=allow,
+                anticipation_ns=ant, advance_now=advance_now)
+        _JIT_CACHE[key] = jax.jit(fused)
+    return _JIT_CACHE[key]
 
 
 def _grow_rows(arr, new_n, fill):
@@ -98,29 +141,24 @@ class TpuPullPriorityQueue:
         self.prop_sched_count = 0
         self.limit_break_sched_count = 0
 
-        self._jit_cache: Dict[Tuple, Callable] = {}
 
     # ------------------------------------------------------------------
     # jit plumbing
     # ------------------------------------------------------------------
     def _jit_ingest(self):
-        key = ("ingest", self.anticipation_timeout_ns)
-        if key not in self._jit_cache:
-            ant = self.anticipation_timeout_ns
-            self._jit_cache[key] = jax.jit(
-                lambda s, ops: kernels.ingest(s, ops, anticipation_ns=ant))
-        return self._jit_cache[key]
+        return _shared_jit_ingest(self.anticipation_timeout_ns)
 
     def _jit_run(self, steps: int, advance_now: bool):
-        key = ("run", steps, advance_now)
-        if key not in self._jit_cache:
-            allow = self.at_limit is AtLimit.ALLOW
-            ant = self.anticipation_timeout_ns
-            self._jit_cache[key] = jax.jit(
-                lambda s, t: kernels.engine_run(
-                    s, t, steps, allow_limit_break=allow,
-                    anticipation_ns=ant, advance_now=advance_now))
-        return self._jit_cache[key]
+        return _shared_jit_run(steps, advance_now,
+                               self.at_limit is AtLimit.ALLOW,
+                               self.anticipation_timeout_ns)
+
+    def _jit_ingest_run(self, steps: int, advance_now: bool):
+        """Fused flush + decide: one device launch per pull instead of
+        two (launch latency dominates the sim's TPU-backend cost)."""
+        return _shared_jit_ingest_run(steps, advance_now,
+                                      self.at_limit is AtLimit.ALLOW,
+                                      self.anticipation_timeout_ns)
 
     # ------------------------------------------------------------------
     # capacity management
@@ -176,9 +214,10 @@ class TpuPullPriorityQueue:
     # ------------------------------------------------------------------
     # op buffering
     # ------------------------------------------------------------------
-    def _flush(self) -> None:
+    def _build_ops(self) -> Optional[IngestOps]:
+        """Drain buffered rows into a padded IngestOps (None if empty)."""
         if not self._pending:
-            return
+            return None
         rows = self._pending
         self._pending = []
         n = len(rows)
@@ -190,14 +229,18 @@ class TpuPullPriorityQueue:
         arrs = [np.zeros(padded, dtype=np.int64) for _ in range(10)]
         for i, col in enumerate(cols):
             arrs[i][:n] = col
-        ops = IngestOps(
+        return IngestOps(
             kind=jnp.asarray(arrs[0], dtype=jnp.int32),
             slot=jnp.asarray(arrs[1], dtype=jnp.int32),
             time=jnp.asarray(arrs[2]), cost=jnp.asarray(arrs[3]),
             rho=jnp.asarray(arrs[4]), delta=jnp.asarray(arrs[5]),
             resv_inv=jnp.asarray(arrs[6]), weight_inv=jnp.asarray(arrs[7]),
             limit_inv=jnp.asarray(arrs[8]), order=jnp.asarray(arrs[9]))
-        self.state = self._jit_ingest()(self.state, ops)
+
+    def _flush(self) -> None:
+        ops = self._build_ops()
+        if ops is not None:
+            self.state = self._jit_ingest()(self.state, ops)
 
     # ------------------------------------------------------------------
     # public API (mirrors core.scheduler.PullPriorityQueue)
@@ -258,9 +301,13 @@ class TpuPullPriorityQueue:
         if now_ns is None:
             now_ns = sec_to_ns(_walltime.time())
         with self.data_mtx:
-            self._flush()
-            self.state, _, dec = self._jit_run(1, False)(
-                self.state, jnp.int64(now_ns))
+            ops = self._build_ops()
+            if ops is None:
+                self.state, _, dec = self._jit_run(1, False)(
+                    self.state, jnp.int64(now_ns))
+            else:
+                self.state, _, dec = self._jit_ingest_run(1, False)(
+                    self.state, ops, jnp.int64(now_ns))
             d = jax.device_get(dec)
             return self._decision_to_pullreq(
                 int(d.type[0]), int(d.slot[0]), int(d.phase[0]),
@@ -275,9 +322,15 @@ class TpuPullPriorityQueue:
         (with ``advance_now`` the clock jumps over FUTUREs instead, so
         only a trailing NONE terminates)."""
         with self.data_mtx:
-            self._flush()
-            self.state, _, dec = self._jit_run(max_decisions, advance_now)(
-                self.state, jnp.int64(now_ns))
+            ops = self._build_ops()
+            if ops is None:
+                self.state, _, dec = self._jit_run(
+                    max_decisions, advance_now)(self.state,
+                                                jnp.int64(now_ns))
+            else:
+                self.state, _, dec = self._jit_ingest_run(
+                    max_decisions, advance_now)(self.state, ops,
+                                                jnp.int64(now_ns))
             d = jax.device_get(dec)
             out: List[PullReq] = []
             for i in range(len(d.type)):
