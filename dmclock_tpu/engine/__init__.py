@@ -24,9 +24,10 @@ _config.update("jax_enable_x64", True)
 from .state import EngineState, init_state  # noqa: E402
 from .kernels import engine_step, engine_run, ingest  # noqa: E402
 from .queue import TpuPullPriorityQueue  # noqa: E402
+from .push_queue import TpuPushPriorityQueue  # noqa: E402
 
 __all__ = [
     "EngineState", "init_state",
     "engine_step", "engine_run", "ingest",
-    "TpuPullPriorityQueue",
+    "TpuPullPriorityQueue", "TpuPushPriorityQueue",
 ]

@@ -1,0 +1,183 @@
+"""Push-mode surface over the TPU batch engine.
+
+Equivalent of the reference ``PushPriorityQueue``
+(``dmclock_server.h:1504-1797``) redesigned for a batched device
+engine: the queue drives the server by invoking ``handle_f(client,
+request, phase, cost)`` whenever ``can_handle_f()`` is true and a
+request is eligible, with timed wakeups for future-eligible requests on
+a dedicated sched-ahead thread (reference ``run_sched_ahead``
+:1760-1786).
+
+Batch-boundary sched_ahead (the SURVEY §7 hard part): instead of one
+``do_next_request`` per dispatch, a scheduling pass pulls a BATCH of
+decisions in one device launch -- sized by the embedder's
+``capacity_f()`` when provided (a server that knows its free service
+slots), else one at a time so the ``can_handle_f`` gate is consulted
+before every dispatch exactly like the reference.  The sched-ahead
+timer is armed once per batch from the batch-terminal FUTURE decision,
+not per decision.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _walltime
+from typing import Any, Callable, Optional
+
+from ..core.qos import ClientInfo
+from ..core.recs import Phase, ReqParams
+from ..core.timebase import NS_PER_SEC, TIME_ZERO, sec_to_ns
+from .queue import TpuPullPriorityQueue
+
+ClientInfoFunc = Callable[[Any], Optional[ClientInfo]]
+
+
+class TpuPushPriorityQueue:
+    """Queue-drives-server mode on the batched device engine."""
+
+    def __init__(self, client_info_f: ClientInfoFunc,
+                 can_handle_f: Callable[[], bool],
+                 handle_f: Callable[[Any, Any, Phase, int], None],
+                 *,
+                 capacity_f: Optional[Callable[[], int]] = None,
+                 batch_max: int = 64,
+                 **pull_kwargs):
+        self._q = TpuPullPriorityQueue(client_info_f, **pull_kwargs)
+        self.can_handle_f = can_handle_f
+        self.handle_f = handle_f
+        self.capacity_f = capacity_f
+        self.batch_max = batch_max
+        self._finishing = False
+        # serializes scheduling passes so handle_f invocations are
+        # totally ordered (the oracle holds data_mtx across the whole
+        # pass; here pull_batch only locks per launch)
+        self._dispatch_mtx = threading.Lock()
+        self._sched_cv = threading.Condition()
+        self._sched_when = TIME_ZERO  # ns; 0 = unarmed
+        self._sched_thd = threading.Thread(
+            target=self._run_sched_ahead, daemon=True,
+            name="dmclock-tpu-sched-ahead")
+        self._sched_thd.start()
+
+    # ------------------------------------------------------------------
+    # embedder API (mirrors oracle PushPriorityQueue)
+    # ------------------------------------------------------------------
+    def add_request(self, request: Any, client_id: Any,
+                    req_params: ReqParams = ReqParams(),
+                    time_ns: Optional[int] = None, cost: int = 1) -> int:
+        r = self._q.add_request(request, client_id, req_params,
+                                time_ns=time_ns, cost=cost)
+        if r == 0:
+            self._schedule_request()
+        return r
+
+    def request_completed(self) -> None:
+        """Server signals a finished op (reference request_completed
+        :1651-1660): capacity may have opened, so re-evaluate."""
+        self._schedule_request()
+
+    def shutdown(self) -> None:
+        self._finishing = True
+        with self._sched_cv:
+            self._sched_cv.notify_all()
+        self._sched_thd.join()
+        self._q.shutdown()
+
+    # pass-through inspection / maintenance surface
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def client_count(self) -> int:
+        return self._q.client_count()
+
+    def request_count(self) -> int:
+        return self._q.request_count()
+
+    def update_client_info(self, client_id: Any) -> None:
+        self._q.update_client_info(client_id)
+
+    def do_clean(self) -> None:
+        self._q.do_clean()
+
+    @property
+    def reserv_sched_count(self) -> int:
+        return self._q.reserv_sched_count
+
+    @property
+    def prop_sched_count(self) -> int:
+        return self._q.prop_sched_count
+
+    @property
+    def limit_break_sched_count(self) -> int:
+        return self._q.limit_break_sched_count
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _schedule_request(self) -> None:
+        """One scheduling pass (reference schedule_request :1741-1755 +
+        next_request's can_handle gate :1729-1737), batched."""
+        with self._dispatch_mtx:
+            self._schedule_locked()
+
+    def _schedule_locked(self) -> None:
+        while True:
+            if self._finishing or not self.can_handle_f():
+                return
+            if self.capacity_f is not None:
+                n = min(self.capacity_f(), self.batch_max)
+                if n <= 0:
+                    return
+            else:
+                n = 1  # consult can_handle_f before every dispatch
+            now_ns = sec_to_ns(_walltime.time())
+            batch = self._q.pull_batch(now_ns, n)
+            dispatched = 0
+            for pr in batch:
+                if pr.is_retn():
+                    self.handle_f(pr.client, pr.request, pr.phase,
+                                  pr.cost)
+                    dispatched += 1
+                elif pr.is_future():
+                    self._sched_at(pr.when_ready)
+                    return
+                else:
+                    return
+            if dispatched < n:
+                # fewer decisions than requested: queue went NONE/FUTURE
+                # inside the launch; nothing more is eligible right now
+                return
+            # full batch served -- more may be eligible; loop re-checks
+            # the can_handle gate before pulling again
+
+    def _sched_at(self, when_ns: int) -> None:
+        # reference sched_at (:1789-1796)
+        with self._sched_cv:
+            if self._finishing:
+                return
+            if self._sched_when == TIME_ZERO or \
+                    when_ns < self._sched_when:
+                self._sched_when = when_ns
+                self._sched_cv.notify_all()
+
+    def _run_sched_ahead(self) -> None:
+        # reference run_sched_ahead (:1760-1786): the armed deadline is
+        # only consumed once it has passed; early wakeups re-evaluate
+        with self._sched_cv:
+            while not self._finishing:
+                if self._sched_when == TIME_ZERO:
+                    self._sched_cv.wait()
+                    continue
+                delay_s = (self._sched_when - sec_to_ns(
+                    _walltime.time())) / NS_PER_SEC
+                if delay_s > 0:
+                    self._sched_cv.wait(timeout=delay_s)
+                    continue
+                self._sched_when = TIME_ZERO
+                if self._finishing:
+                    return
+                self._sched_cv.release()
+                try:
+                    self._schedule_request()
+                finally:
+                    self._sched_cv.acquire()

@@ -1,0 +1,144 @@
+"""TPU push-mode queue tests (reference PushPriorityQueue semantics,
+dmclock_server.h:1504-1797): autonomous dispatch via handle_f, the
+can_handle gate, batch dispatch via capacity_f, the sched-ahead timed
+wakeup, and dispatch-order parity with the oracle push queue."""
+
+import threading
+import time
+
+from dmclock_tpu import AtLimit
+from dmclock_tpu.core import (ClientInfo, Phase, PushPriorityQueue,
+                              ReqParams, sec_to_ns)
+from dmclock_tpu.engine import TpuPushPriorityQueue
+
+
+def wait_until(pred, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+class TestTpuPushQueue:
+    def test_immediate_dispatch(self):
+        handled = []
+        q = TpuPushPriorityQueue(lambda c: ClientInfo(0, 1, 0),
+                                 can_handle_f=lambda: True,
+                                 handle_f=lambda c, r, p, cost:
+                                 handled.append((c, r, p, cost)))
+        try:
+            q.add_request("req1", 7, ReqParams())
+            assert wait_until(lambda: len(handled) == 1)
+            assert handled[0][0] == 7
+            assert handled[0][2] is Phase.PRIORITY
+            assert q.prop_sched_count == 1
+        finally:
+            q.shutdown()
+
+    def test_can_handle_gates_dispatch(self):
+        handled = []
+        gate = {"open": False}
+        q = TpuPushPriorityQueue(lambda c: ClientInfo(0, 1, 0),
+                                 can_handle_f=lambda: gate["open"],
+                                 handle_f=lambda c, r, p, cost:
+                                 handled.append(r))
+        try:
+            q.add_request("r", 1, ReqParams())
+            time.sleep(0.05)
+            assert handled == []
+            gate["open"] = True
+            q.request_completed()  # server signals capacity
+            assert wait_until(lambda: handled == ["r"])
+        finally:
+            q.shutdown()
+
+    def test_capacity_batch_dispatch(self):
+        """capacity_f > 1 drains several decisions per device launch."""
+        handled = []
+        q = TpuPushPriorityQueue(lambda c: ClientInfo(0, 1, 0),
+                                 can_handle_f=lambda: True,
+                                 handle_f=lambda c, r, p, cost:
+                                 handled.append((c, r)),
+                                 capacity_f=lambda: 8)
+        try:
+            now = sec_to_ns(time.time())
+            for i in range(6):
+                q.add_request(f"r{i}", i % 2, ReqParams(), time_ns=now)
+            assert wait_until(lambda: len(handled) == 6)
+            assert sorted(r for _c, r in handled) == \
+                sorted(f"r{i}" for i in range(6))
+        finally:
+            q.shutdown()
+
+    def test_sched_ahead_timed_wakeup(self):
+        # a future-limited request is dispatched by the sched-ahead
+        # thread once its limit restores, without further prompting
+        handled = []
+        q = TpuPushPriorityQueue(lambda c: ClientInfo(0, 1, 10),
+                                 can_handle_f=lambda: True,
+                                 handle_f=lambda c, r, p, cost:
+                                 handled.append(r),
+                                 at_limit=AtLimit.WAIT)
+        try:
+            now = sec_to_ns(time.time())
+            # two requests: limit 10/s -> second eligible ~0.1s later
+            q.add_request("a", 1, ReqParams(), time_ns=now)
+            q.add_request("b", 1, ReqParams(), time_ns=now)
+            assert wait_until(lambda: len(handled) == 2)
+        finally:
+            q.shutdown()
+
+    def test_shutdown_joins_thread(self):
+        q = TpuPushPriorityQueue(lambda c: ClientInfo(0, 1, 0),
+                                 can_handle_f=lambda: False,
+                                 handle_f=lambda *a: None)
+        q.shutdown()
+        assert not q._sched_thd.is_alive()
+
+    def test_dispatch_order_parity_with_oracle(self):
+        """Same weighted backlog, same virtual arrival times: the TPU
+        push queue must hand requests to handle_f in the same order as
+        the oracle push queue (weights 1:2 under a shared gate that
+        admits one dispatch per completion)."""
+
+        def run(queue_cls, **kw):
+            handled = []
+            gate = {"tokens": 0}
+            lock = threading.Lock()
+
+            def can_handle():
+                with lock:
+                    return gate["tokens"] > 0
+
+            def handle(c, r, p, cost):
+                with lock:
+                    gate["tokens"] -= 1
+                handled.append((c, r))
+
+            q = queue_cls(
+                lambda c: ClientInfo(0, 1.0 if c == 1 else 2.0, 0),
+                can_handle_f=can_handle, handle_f=handle, **kw)
+            try:
+                now = sec_to_ns(time.time())
+                for i in range(6):
+                    q.add_request(f"a{i}", 1, ReqParams(), time_ns=now)
+                    q.add_request(f"b{i}", 2, ReqParams(), time_ns=now)
+                for i in range(12):
+                    with lock:
+                        gate["tokens"] += 1
+                    q.request_completed()
+                    assert wait_until(lambda: len(handled) == i + 1), \
+                        f"stalled at dispatch {i} ({handled})"
+            finally:
+                q.shutdown()
+            return handled
+
+        oracle = run(PushPriorityQueue, run_gc_thread=False)
+        tpu = run(TpuPushPriorityQueue)
+        assert oracle == tpu
+        # weight 2 client gets twice the share while both have backlog
+        # (the full drain is 6:6 by construction)
+        first6 = [c for c, _r in tpu[:6]]
+        assert first6.count(2) == 2 * first6.count(1)
