@@ -14,11 +14,15 @@ section 2, parallelism table).
 
 from .cluster import (ClusterState, init_cluster, cluster_step,
                       make_mesh, shard_cluster)
-from .tracker import (TrackerState, init_tracker, tracker_prepare,
-                      tracker_track)
+from .tracker import (BorrowTrackerState, TrackerState,
+                      borrow_tracker_prepare, borrow_tracker_track,
+                      init_borrow_tracker, init_tracker,
+                      tracker_prepare, tracker_track)
 
 __all__ = [
     "ClusterState", "init_cluster", "cluster_step", "make_mesh",
     "shard_cluster",
     "TrackerState", "init_tracker", "tracker_prepare", "tracker_track",
+    "BorrowTrackerState", "init_borrow_tracker",
+    "borrow_tracker_prepare", "borrow_tracker_track",
 ]

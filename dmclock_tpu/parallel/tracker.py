@@ -112,3 +112,78 @@ def tracker_prepare(tracker: TrackerState, requesting: jnp.ndarray,
         seen=tracker.seen | requesting,
     )
     return tracker, delta_out, rho_out
+
+
+# ----------------------------------------------------------------------
+# BorrowingTracker variant (reference dmclock_client.h:90-154)
+# ----------------------------------------------------------------------
+
+class BorrowTrackerState(NamedTuple):
+    """Per-server shard of the distributed BorrowingTracker: guarantees
+    delta/rho >= 1 by borrowing future replies (reference
+    calc_with_borrow, dmclock_client.h:110-129)."""
+
+    completed_delta: jnp.ndarray  # int64[C] completions served here
+    completed_rho: jnp.ndarray    # int64[C] reservation-phase subset
+    prev_delta: jnp.ndarray       # int64[C] global delta at last request here
+    prev_rho: jnp.ndarray         # int64[C]
+    borrow_delta: jnp.ndarray     # int64[C] outstanding borrow
+    borrow_rho: jnp.ndarray       # int64[C]
+    seen: jnp.ndarray             # bool[C]
+
+
+def init_borrow_tracker(n_clients: int) -> BorrowTrackerState:
+    z = jnp.zeros((n_clients,), dtype=jnp.int64)
+    return BorrowTrackerState(
+        completed_delta=z, completed_rho=z,
+        prev_delta=z, prev_rho=z,
+        borrow_delta=z, borrow_rho=z,
+        seen=jnp.zeros((n_clients,), dtype=bool),
+    )
+
+
+def borrow_tracker_track(tracker: BorrowTrackerState, slots, costs,
+                         phases, served) -> BorrowTrackerState:
+    """Fold a batch of completions at THIS server (reference
+    BorrowingTracker::resp_update, dmclock_client.h:131-141: only the
+    global counters move -- the psum source here).  The fold is the
+    same completed_delta/completed_rho scatter-add as OrigTracker's."""
+    return tracker_track(tracker, slots, costs, phases, served)
+
+
+def _calc_with_borrow(global_c, prev, borrow):
+    """Vector form of calc_with_borrow (dmclock_client.h:110-129)."""
+    result = global_c - prev
+    out = jnp.where(result == 0, 1,
+                    jnp.where(result > borrow, result - borrow, 1))
+    new_borrow = jnp.where(result == 0, borrow + 1,
+                           jnp.where(result > borrow, 0,
+                                     borrow - result + 1))
+    return out, new_borrow
+
+
+def borrow_tracker_prepare(tracker: BorrowTrackerState, requesting,
+                           global_delta, global_rho):
+    """ReqParams for every client in ``requesting`` sending its next
+    request to THIS server (reference prepare_req,
+    dmclock_client.h:131-137; first contact returns ReqParams(1,1) and
+    installs the marks, :241-251)."""
+    d_out, nbd = _calc_with_borrow(global_delta, tracker.prev_delta,
+                                   tracker.borrow_delta)
+    r_out, nbr = _calc_with_borrow(global_rho, tracker.prev_rho,
+                                   tracker.borrow_rho)
+    d_out = jnp.where(tracker.seen, d_out, 1)
+    r_out = jnp.where(tracker.seen, r_out, 1)
+    upd = requesting
+    first = upd & ~tracker.seen
+    tracker = tracker._replace(
+        prev_delta=jnp.where(upd, global_delta, tracker.prev_delta),
+        prev_rho=jnp.where(upd, global_rho, tracker.prev_rho),
+        borrow_delta=jnp.where(first, 0,
+                               jnp.where(upd, nbd,
+                                         tracker.borrow_delta)),
+        borrow_rho=jnp.where(first, 0,
+                             jnp.where(upd, nbr, tracker.borrow_rho)),
+        seen=tracker.seen | requesting,
+    )
+    return tracker, d_out, r_out

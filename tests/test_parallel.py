@@ -16,8 +16,10 @@ import pytest
 
 from dmclock_tpu.core import Phase
 from dmclock_tpu.core.timebase import rate_to_inv_ns
-from dmclock_tpu.core.tracker import ServiceTracker
-from dmclock_tpu.parallel import (cluster as CL, init_tracker,
+from dmclock_tpu.core.tracker import BorrowingTracker, ServiceTracker
+from dmclock_tpu.parallel import (cluster as CL, borrow_tracker_prepare,
+                                  borrow_tracker_track,
+                                  init_borrow_tracker, init_tracker,
                                   tracker_prepare, tracker_track)
 
 
@@ -56,6 +58,49 @@ def test_device_tracker_matches_orig_tracker():
             assert (int(d_out[0]), int(r_out[0])) == (rp.delta, rp.rho), \
                 f"server {s}: device ({int(d_out[0])},{int(r_out[0])}) " \
                 f"!= host ({rp.delta},{rp.rho})"
+            phase = Phase.RESERVATION if rng.random() < 0.5 \
+                else Phase.PRIORITY
+            outstanding.append((s, phase, rng.randint(1, 3)))
+
+
+def test_device_tracker_matches_borrowing_tracker():
+    """Same interleaving gate for the BorrowingTracker variant
+    (reference dmclock_client.h:90-154; host parity pinned by
+    test_tracker.py against test_dmclock_client.cc:108-225)."""
+    rng = random.Random(11)
+    n_servers, n_steps = 3, 300
+
+    host = ServiceTracker(tracker_cls=BorrowingTracker,
+                          run_gc_thread=False)
+    dev = [init_borrow_tracker(1) for _ in range(n_servers)]
+
+    def dev_global():
+        d = 1 + sum(int(t.completed_delta[0]) for t in dev)
+        r = 1 + sum(int(t.completed_rho[0]) for t in dev)
+        return d, r
+
+    outstanding = []
+    for _ in range(n_steps):
+        if outstanding and rng.random() < 0.5:
+            s, phase, cost = outstanding.pop(rng.randrange(len(outstanding)))
+            host.track_resp(s, phase, cost)
+            dev[s] = borrow_tracker_track(
+                dev[s], jnp.zeros(1, jnp.int32),
+                jnp.full(1, cost, jnp.int64),
+                jnp.full(1, int(phase), jnp.int32),
+                jnp.ones(1, bool))
+        else:
+            s = rng.randrange(n_servers)
+            rp = host.get_req_params(s)
+            gd, gr = dev_global()
+            dev[s], d_out, r_out = borrow_tracker_prepare(
+                dev[s], jnp.ones(1, bool),
+                jnp.full(1, gd, jnp.int64), jnp.full(1, gr, jnp.int64))
+            assert (int(d_out[0]), int(r_out[0])) == (rp.delta, rp.rho), \
+                f"server {s}: device ({int(d_out[0])},{int(r_out[0])}) " \
+                f"!= host ({rp.delta},{rp.rho})"
+            # borrowing guarantees strictly positive params
+            assert int(d_out[0]) >= 1 and int(r_out[0]) >= 1
             phase = Phase.RESERVATION if rng.random() < 0.5 \
                 else Phase.PRIORITY
             outstanding.append((s, phase, rng.randint(1, 3)))
