@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Benchmark sweeps (the reference benchmark/ pipeline analog,
+``benchmark/data_gen.sh:28-38`` + ``plot_gen.sh``):
+
+1. K-sweep: native ``dmc_sim_native --k-way K`` for K=2..10 over the
+   acceptance config, harvesting the mean ns-per-call numbers the
+   reference pipeline greps (``simulate.h:306-349``).  The reference's
+   rule of thumb ("<= 6 elements: K small; otherwise K=3",
+   benchmark/README.md:17-19) is what this reproduces with runtime K.
+2. TPU k/m sweep: ``scan_fast_epoch`` decisions/sec at 100k clients
+   across speculative batch size k and epoch length m (the analog of
+   the K_WAY_HEAP study for the batch engine: k trades selection-sort
+   amortization against speculation-window validity).
+
+Writes benchmark/RESULTS.md.  Usage:
+    python benchmark/run_sweeps.py [--skip-native] [--skip-tpu]
+        [--repeat N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import re
+import statistics
+import subprocess
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+BUILD = REPO / "native" / "build"
+RESULTS = Path(__file__).resolve().parent / "RESULTS.md"
+
+
+def build_native() -> Path:
+    exe = BUILD / "dmc_sim_native"
+    subprocess.run(["cmake", "-S", str(REPO / "native"), "-B",
+                    str(BUILD)], check=True, capture_output=True)
+    subprocess.run(["cmake", "--build", str(BUILD), "-j", "--target",
+                    "dmc_sim_native"], check=True, capture_output=True)
+    return exe
+
+
+def native_k_sweep(repeat: int):
+    exe = build_native()
+    # the reference sweep's workload (benchmark/configs/
+    # dmc_sim_100_100.conf): 100 servers x 100 clients, 1M ops
+    conf = REPO / "configs" / "dmc_sim_100_100.conf"
+    rows = []
+    for k in range(2, 11):
+        add_ns, wall = [], []
+        for r in range(repeat):
+            t0 = time.perf_counter()
+            out = subprocess.run(
+                [str(exe), "-c", str(conf), "--k-way", str(k),
+                 "--seed", str(12345 + r)],
+                check=True, capture_output=True, text=True,
+                timeout=600).stdout
+            wall.append(time.perf_counter() - t0)
+            m = re.search(r"average add_request:\s+(\d+) ns", out)
+            add_ns.append(int(m.group(1)))
+        rows.append((k, statistics.mean(add_ns),
+                     statistics.mean(wall)))
+        print(f"K={k}: add_request {rows[-1][1]:.0f} ns "
+              f"(wall {rows[-1][2]:.2f}s)")
+    return rows
+
+
+def tpu_km_sweep():
+    import jax
+    import jax.numpy as jnp
+    import sys
+    sys.path.insert(0, str(REPO))
+    from __graft_entry__ import _preloaded_state
+    from dmclock_tpu.engine.fastpath import scan_fast_epoch
+    from profile_util import scalar_latency, state_digest
+
+    n, depth = 100_000, 128
+    rows = []
+    lat = scalar_latency()
+    for k in (8192, 16384, 32768, 49152):
+        for m in (8, 32):
+            state = _preloaded_state(n, depth, ring=depth)
+            run = jax.jit(functools.partial(
+                scan_fast_epoch, m=m, k=k, anticipation_ns=0),
+                donate_argnums=(0,))
+            ep = run(state, jnp.int64(0))
+            jax.device_get(state_digest(ep.state))  # warm
+            state = ep.state
+            epochs = max(1, (1 << 21) // (m * k))
+            t0 = time.perf_counter()
+            committed = 0
+            for _ in range(epochs):
+                ep = run(state, jnp.int64(0))
+                state = ep.state
+                committed += int(jax.device_get(ep.ok.sum()))
+            jax.device_get(state_digest(state))
+            t = time.perf_counter() - t0 - lat * (epochs + 1)
+            dps = committed * k / t
+            fb = 1 - committed / (epochs * m)
+            rows.append((k, m, dps, fb))
+            print(f"k={k} m={m}: {dps/1e6:.2f} M dec/s "
+                  f"(fallback {fb:.3f})")
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-native", action="store_true")
+    ap.add_argument("--skip-tpu", action="store_true")
+    ap.add_argument("--repeat", type=int, default=3)
+    args = ap.parse_args()
+
+    here = Path(__file__).resolve().parent
+    native_part = here / ".native_section.md"
+    tpu_part = here / ".tpu_section.md"
+
+    if not args.skip_native:
+        lines = ["## Native heap K-sweep (dmc_sim_100_100.conf, "
+                 f"mean of {args.repeat} runs)", "",
+                 "| K | add_request ns | sim wall s |", "|---|---|---|"]
+        for k, add, wall in native_k_sweep(args.repeat):
+            lines.append(f"| {k} | {add:.0f} | {wall:.2f} |")
+        lines.append("")
+        native_part.write_text("\n".join(lines))
+    if not args.skip_tpu:
+        import jax
+        plat = jax.devices()[0].platform
+        lines = [f"## TPU epoch k/m sweep (100k clients, platform="
+                 f"{plat})", "",
+                 "| k | m | M dec/s | fallback rate |", "|---|---|---|---|"]
+        for k, m, dps, fb in tpu_km_sweep():
+            lines.append(f"| {k} | {m} | {dps/1e6:.2f} | {fb:.3f} |")
+        lines.append("")
+        tpu_part.write_text("\n".join(lines))
+
+    head = ["# Benchmark sweeps", "",
+            "Produced by `python benchmark/run_sweeps.py` "
+            "(see its docstring).", ""]
+    body = [p.read_text() for p in (native_part, tpu_part)
+            if p.exists()]
+    RESULTS.write_text("\n".join(head + body))
+    print(f"wrote {RESULTS}")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,8 @@ struct Args {
   std::string conf;
   std::string model = "dmclock";
   uint64_t seed = 12345;
+  unsigned k_way = 2;  // heap branching (reference K_WAY_HEAP,
+                       // sim/CMakeLists.txt:1-10 -- runtime here)
   bool intervals = false;
   bool trace = false;
 };
@@ -41,7 +43,7 @@ struct Args {
 int usage(const char* prog) {
   fprintf(stderr,
           "usage: %s -c CONF [--model dmclock|dmclock-delayed|ssched] "
-          "[--seed N] [--intervals] [--trace]\n",
+          "[--seed N] [--k-way K] [--intervals] [--trace]\n",
           prog);
   return 2;
 }
@@ -60,13 +62,15 @@ int finish(Sim& sim, const Args& args) {
 }
 
 int run_dmclock(const SimConfig& cfg, const Args& args, bool delayed) {
+  unsigned k_way = args.k_way;
   qos_sim::Simulation<DmcQueue, DmcTracker> sim(
       cfg,
-      [delayed](ServerId, std::function<dmclock::ClientInfo(
-                              const ClientId&)> info_f,
-                int64_t anticipation_ns, bool soft_limit) {
+      [delayed, k_way](ServerId, std::function<dmclock::ClientInfo(
+                                     const ClientId&)> info_f,
+                       int64_t anticipation_ns, bool soft_limit) {
         DmcQueue::Options opt;
         opt.delayed_tag_calc = delayed;
+        opt.heap_branching = k_way;
         // soft limit -> Allow, hard -> Wait (reference
         // test_dmclock_main.cc:190-198 create_queue_f)
         opt.at_limit = soft_limit ? dmclock::AtLimit::Allow
@@ -106,6 +110,9 @@ int main(int argc, char** argv) {
     } else if (!strcmp(argv[i], "--seed")) {
       if (++i >= argc) return usage(argv[0]);
       args.seed = strtoull(argv[i], nullptr, 10);
+    } else if (!strcmp(argv[i], "--k-way")) {
+      if (++i >= argc) return usage(argv[0]);
+      args.k_way = (unsigned)strtoul(argv[i], nullptr, 10);
     } else if (!strcmp(argv[i], "--intervals")) {
       args.intervals = true;
     } else if (!strcmp(argv[i], "--trace")) {
