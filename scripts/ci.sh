@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# One-command CI: python tests, native build+tests, CLI/bench smoke.
+# (The role of the reference's .travis.yml:9-26 build matrix.)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== python test suite =="
+python -m pytest tests/ -x -q
+
+echo "== native build + ctest =="
+cmake -S native -B native/build >/dev/null
+cmake --build native/build -j >/dev/null
+ctest --test-dir native/build --output-on-failure
+
+echo "== simulator smoke =="
+python -m dmclock_tpu.sim.dmc_sim -c configs/dmc_sim_example.conf | tail -3
+native/build/dmc_sim_native -c configs/dmc_sim_example.conf | tail -3
+
+echo "== graft entry compile check =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== bench smoke (one small epoch) =="
+python - <<'EOF'
+import functools, jax, jax.numpy as jnp
+from __graft_entry__ import _preloaded_state
+from dmclock_tpu.engine.fastpath import scan_fast_epoch
+state = _preloaded_state(4096, 16, ring=16)
+ep = jax.jit(functools.partial(scan_fast_epoch, m=4, k=256,
+                               anticipation_ns=0))(state, jnp.int64(0))
+ok = int(jax.device_get(ep.ok.sum()))
+assert ok == 4, f"bench smoke: only {ok}/4 batches committed"
+print(f"bench smoke ok ({ok}/4 batches committed)")
+EOF
+
+echo "CI PASSED"
