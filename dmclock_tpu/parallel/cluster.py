@@ -96,41 +96,48 @@ def install_clients(cluster: ClusterState, resv_inv, weight_inv,
 def _one_server_step(engine: EngineState, tracker: TrackerState,
                      now: jnp.ndarray, arrivals_per_client: jnp.ndarray,
                      cost: jnp.ndarray, decisions_per_step: int,
-                     anticipation_ns: int, allow_limit_break: bool):
+                     anticipation_ns: int, allow_limit_break: bool,
+                     max_arrivals: int):
     """One server's slice of a cluster step (runs inside shard_map with
     a [1, ...]-shaped shard; vmapped over that unit axis).
 
-    Phase A: clients with ``arrivals_per_client[c] > 0`` send that many
-    requests, each carrying psum-derived ReqParams.
+    Phase A: client c sends ``min(arrivals_per_client[c],
+    max_arrivals)`` requests, each carrying psum-derived ReqParams;
+    arrivals interleave wave-major (every client's j-th request before
+    any client's j+1-th, clients in slot order within a wave) -- the
+    order the host-sim parity test replicates.
     Phase B: the engine makes ``decisions_per_step`` decisions.
     Phase C: completions fold into the tracker counters.
     """
     # --- distributed ReqParams via the psum'd global counters
     g_delta, g_rho = global_counters(
         tracker, lambda x: lax.psum(x, SERVER_AXIS))
-    requesting = arrivals_per_client > 0
-    tracker, delta_out, rho_out = tracker_prepare(
-        tracker, requesting, g_delta, g_rho)
 
-    # --- ingest: one op per requesting client (queued heads only; the
-    # host sim generalizes this, this step models one request per
-    # client per round which is the pod-scale benchmark shape)
     c = arrivals_per_client.shape[0]
     slots = jnp.arange(c, dtype=jnp.int32)
-    ops = kernels.IngestOps(
-        kind=jnp.where(requesting, kernels.OP_ADD,
-                       kernels.OP_NOP).astype(jnp.int32),
-        slot=slots,
-        time=jnp.broadcast_to(now, (c,)),
-        cost=jnp.broadcast_to(cost, (c,)),
-        rho=jnp.where(requesting, rho_out, 1),
-        delta=jnp.where(requesting, delta_out, 1),
-        resv_inv=jnp.zeros((c,), dtype=jnp.int64),
-        weight_inv=jnp.zeros((c,), dtype=jnp.int64),
-        limit_inv=jnp.zeros((c,), dtype=jnp.int64),
-        order=jnp.zeros((c,), dtype=jnp.int64),
-    )
-    engine = kernels.ingest(engine, ops, anticipation_ns=anticipation_ns)
+    for wave in range(max_arrivals):
+        requesting = arrivals_per_client > wave
+        # waves after a client's first request this round re-mark an
+        # unchanged global counter, so their params are (0, 0) -- the
+        # same stream the host OrigTracker emits for back-to-back
+        # requests with no interleaved completions
+        tracker, delta_out, rho_out = tracker_prepare(
+            tracker, requesting, g_delta, g_rho)
+        ops = kernels.IngestOps(
+            kind=jnp.where(requesting, kernels.OP_ADD,
+                           kernels.OP_NOP).astype(jnp.int32),
+            slot=slots,
+            time=jnp.broadcast_to(now, (c,)),
+            cost=jnp.broadcast_to(cost, (c,)),
+            rho=jnp.where(requesting, rho_out, 1),
+            delta=jnp.where(requesting, delta_out, 1),
+            resv_inv=jnp.zeros((c,), dtype=jnp.int64),
+            weight_inv=jnp.zeros((c,), dtype=jnp.int64),
+            limit_inv=jnp.zeros((c,), dtype=jnp.int64),
+            order=jnp.zeros((c,), dtype=jnp.int64),
+        )
+        engine = kernels.ingest(engine, ops,
+                                anticipation_ns=anticipation_ns)
 
     # --- scheduling decisions
     engine, now, decs = kernels.engine_run(
@@ -148,11 +155,14 @@ def _one_server_step(engine: EngineState, tracker: TrackerState,
 def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
                  cost: int, mesh: Mesh, *,
                  decisions_per_step: int,
+                 max_arrivals: int = 1,
                  anticipation_ns: int = 0,
                  allow_limit_break: bool = False):
     """Advance the whole cluster: ``arrivals`` is int32[S, C] request
-    counts (currently 0/1 per round), sharded over servers.  Returns
-    (cluster, decisions) with decisions' leaves [S, k]-shaped.
+    counts (honored up to the static ``max_arrivals`` per client per
+    round, wave-major order -- see _one_server_step), sharded over
+    servers.  Returns (cluster, decisions) with decisions' leaves
+    [S, k]-shaped.
 
     Jit this (it is pure); under jit XLA turns the psum into one ICI
     all-reduce per step.
@@ -163,7 +173,8 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
             _one_server_step,
             decisions_per_step=decisions_per_step,
             anticipation_ns=anticipation_ns,
-            allow_limit_break=allow_limit_break)
+            allow_limit_break=allow_limit_break,
+            max_arrivals=max_arrivals)
         # shards carry a leading [1] server axis; vmap it away
         engine, tracker, now, decs = jax.vmap(
             lambda e, t, n, a: step(e, t, n, a,

@@ -151,6 +151,104 @@ def test_cluster_step_sharded(mesh8):
     assert cur_delta.max() > 1
 
 
+def test_cluster_step_matches_independent_host_sims(mesh8):
+    """The whole cluster step equals S independent host oracle queues +
+    per-client host OrigTrackers fed the same arrival schedule: per
+    round, every server's full k-decision stream (type/slot/phase/cost/
+    when), its virtual clock, and the ReqParams flowing into every
+    ingest must match the host composition exactly."""
+    from dmclock_tpu.core import ClientInfo, PullPriorityQueue, ReqParams
+    from dmclock_tpu.core.scheduler import NextReqType
+
+    n_servers, n_clients, rounds, k, max_arr = 8, 12, 3, 16, 3
+    infos = [ClientInfo(10.0, 1.0 + (c % 3), 0.0)
+             for c in range(n_clients)]
+
+    # --- device cluster
+    cl = CL.init_cluster(n_servers, n_clients)
+    cl = CL.install_clients(
+        cl,
+        jnp.asarray([i.reservation_inv_ns for i in infos], jnp.int64),
+        jnp.asarray([i.weight_inv_ns for i in infos], jnp.int64),
+        jnp.asarray([i.limit_inv_ns for i in infos], jnp.int64))
+    cl = CL.shard_cluster(cl, mesh8)
+    step = jax.jit(functools.partial(
+        CL.cluster_step, mesh=mesh8, cost=1, decisions_per_step=k,
+        max_arrivals=max_arr))
+
+    # --- host composition: S oracle queues + C ServiceTrackers
+    queues = [PullPriorityQueue(lambda c, i=s: infos[c],
+                                delayed_tag_calc=True,
+                                run_gc_thread=False)
+              for s in range(n_servers)]
+    trackers = [ServiceTracker(run_gc_thread=False)
+                for _ in range(n_clients)]
+    host_now = [0] * n_servers
+
+    rng = random.Random(23)
+    for rnd in range(rounds + 1):
+        if rnd == 0:
+            # Warmup: every client contacts every server once.  The
+            # cluster's tie-break convention is order == client slot
+            # (install_clients); the host oracle assigns creation order
+            # at first contact, so first contacts must happen in client
+            # index order for the two compositions to share a tie rank.
+            arrivals = np.ones((n_servers, n_clients), dtype=np.int32)
+        else:
+            arrivals = np.asarray(
+                [[rng.randint(0, max_arr) for _ in range(n_clients)]
+                 for _ in range(n_servers)], dtype=np.int32)
+
+        # device round
+        cl, decs = step(cl, jnp.asarray(arrivals))
+        d_type = np.asarray(decs.type)
+        d_slot = np.asarray(decs.slot)
+        d_phase = np.asarray(decs.phase)
+        d_cost = np.asarray(decs.cost)
+        d_when = np.asarray(decs.when)
+        d_now = np.asarray(cl.now)
+
+        # host round, replicating the cluster's phase structure: ALL
+        # servers ingest against the pre-round tracker state (the psum
+        # is computed once per round), THEN every server pulls, THEN
+        # responses fold -- interleaving per server would let server 0's
+        # completions leak into server 1's ReqParams mid-round
+        for s in range(n_servers):
+            # phase A: wave-major ingest with tracker-derived params
+            for wave in range(max_arr):
+                for c in range(n_clients):
+                    if arrivals[s][c] > wave:
+                        rp = trackers[c].get_req_params(s)
+                        queues[s].add_request(
+                            (rnd, wave, c), c,
+                            ReqParams(rp.delta, rp.rho),
+                            time_ns=host_now[s], cost=1)
+        for s in range(n_servers):
+            # phase B: k pulls with advance-on-FUTURE clock semantics
+            responses = []
+            for i in range(k):
+                pr = queues[s].pull_request(host_now[s])
+                if pr.type is NextReqType.RETURNING:
+                    assert (d_type[s][i], d_slot[s][i], d_phase[s][i],
+                            d_cost[s][i]) == \
+                        (0, pr.client, int(pr.phase is Phase.PRIORITY),
+                         pr.cost), \
+                        f"round {rnd} server {s} step {i}"
+                    responses.append((pr.client, pr.phase, pr.cost))
+                elif pr.type is NextReqType.FUTURE:
+                    assert (d_type[s][i], d_when[s][i]) == \
+                        (1, pr.when_ready), \
+                        f"round {rnd} server {s} step {i} FUTURE"
+                    host_now[s] = pr.when_ready
+                else:
+                    assert d_type[s][i] == 2, \
+                        f"round {rnd} server {s} step {i} NONE"
+            assert host_now[s] == d_now[s], f"round {rnd} server {s} now"
+            # phase C: responses fold into the client trackers
+            for client, phase, cost in responses:
+                trackers[client].track_resp(s, phase, cost)
+
+
 def test_cluster_counters_match_protocol(mesh8):
     """delta seen by a server == completions that client got everywhere
     since its previous request to that server (the dmClock invariant)."""
