@@ -363,6 +363,48 @@ class TpuPullPriorityQueue:
         with self.data_mtx:
             return sum(len(q) for q in self._payloads.values())
 
+    def display_queues(self) -> str:
+        """Debug dump of the three selection orders from device state
+        (oracle display_queues / reference :676-697): one line per
+        'heap', clients sorted by that heap's total order, showing the
+        head tag as R/P/L/ready."""
+        with self.data_mtx:
+            self._flush()
+            st = jax.device_get(self.state)
+            rows = []
+            for cid, slot in self._slot_of.items():
+                has_req = bool(st.active[slot]) and int(st.depth[slot]) > 0
+                rows.append((
+                    cid, int(st.order[slot]), has_req,
+                    int(st.head_resv[slot]), int(st.head_prop[slot])
+                    + int(st.prop_delta[slot]), int(st.head_limit[slot]),
+                    bool(st.head_ready[slot])))
+
+            def fmt(r):
+                cid, _o, has_req, rt, pt, lt, ready = r
+                return f"{cid}:" + (
+                    f"R{rt}/P{pt}/L{lt}/{'ready' if ready else 'wait'}"
+                    if has_req else "noreq")
+
+            def section(name, key):
+                order = sorted(rows, key=key)
+                return name + ": " + " | ".join(fmt(r) for r in order)
+
+            # requestless clients sort last BY CREATION ORDER (their
+            # head_* fields hold stale last-served tags; the oracle
+            # keys requestless clients on order alone)
+            return "\n".join([
+                section("RESER",
+                        lambda r: (not r[2], r[3] if r[2] else 0, r[1])),
+                section("LIMIT",
+                        lambda r: (not r[2], r[6] if r[2] else False,
+                                   r[5] if r[2] else 0, r[1])),
+                section("READY",
+                        lambda r: (not r[2],
+                                   (not r[6]) if r[2] else False,
+                                   r[4] if r[2] else 0, r[1])),
+            ])
+
     # ------------------------------------------------------------------
     # removal / info updates (reference :567-648)
     # ------------------------------------------------------------------

@@ -25,17 +25,37 @@ class NullServiceTracker:
 
 
 class SimpleQueue:
-    """Strict-FIFO queue with the pull interface
-    (reference SimpleQueue, ssched_server.h:36-192)."""
+    """Strict-FIFO queue with both the pull and push interfaces
+    (reference SimpleQueue, ssched_server.h:36-192: pull_request :154
+    and the push-mode schedule_request :184 driven by handle_f under a
+    can_handle gate -- the same dual surface the dmclock queues have,
+    so ssched can A/B either path)."""
 
-    def __init__(self):
+    def __init__(self, can_handle_f=None, handle_f=None):
         self._queue: Deque[Tuple[Any, Any, int]] = deque()
+        self.can_handle_f = can_handle_f
+        self.handle_f = handle_f
 
     def add_request(self, request: Any, client_id: Any,
                     req_params: ReqParams = ReqParams(),
                     time_ns: Optional[int] = None, cost: int = 1) -> int:
         self._queue.append((client_id, request, cost))
+        if self.handle_f is not None:
+            self.schedule_request()
         return 0
+
+    # -- push mode (reference ssched_server.h:184-191) -----------------
+    def request_completed(self) -> None:
+        if self.handle_f is not None:
+            self.schedule_request()
+
+    def schedule_request(self) -> None:
+        # at most ONE dispatch per call, like the reference: pacing is
+        # one request per add/completion event
+        if self._queue and \
+                (self.can_handle_f is None or self.can_handle_f()):
+            client, request, cost = self._queue.popleft()
+            self.handle_f(client, request, Phase.PRIORITY, cost)
 
     def pull_request(self, now_ns: Optional[int] = None) -> PullReq:
         if not self._queue:

@@ -21,12 +21,16 @@
 #include <algorithm>
 #include <cassert>
 #include <cerrno>
+#include <cstdio>
+#include <cstdlib>
 #include <condition_variable>
 #include <deque>
 #include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
+#include <sstream>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -174,8 +178,13 @@ class PriorityQueueBase {
         limit_heap_(opt.heap_branching),
         ready_heap_(opt.heap_branching) {
     if (opt_.reject_threshold_ns > 0) opt_.at_limit = AtLimit::Reject;
-    // Reject needs accurate tags at add time (reference :856-857)
-    assert(!(opt_.at_limit == AtLimit::Reject && opt_.delayed_tag_calc));
+    // Reject needs accurate tags at add time (reference :856-857);
+    // always-on like the reference's death-tested assert
+    if (opt_.at_limit == AtLimit::Reject && opt_.delayed_tag_calc) {
+      fprintf(stderr,
+              "dmclock: AtLimit::Reject requires immediate tag calc\n");
+      abort();
+    }
     assert(opt_.erase_age_s >= opt_.idle_age_s);
     assert(opt_.check_time_s < opt_.idle_age_s);
     if (opt_.run_gc_thread)
@@ -274,6 +283,36 @@ class PriorityQueueBase {
 
   unsigned get_heap_branching_factor() const {
     return resv_heap_.branching_factor();
+  }
+
+  // Debug dump: the three selection orders (reference display_queues
+  // :676-697 / heap display_sorted; same RESER/LIMIT/READY layout as
+  // the Python oracle's display_queues so dumps diff cleanly).
+  std::string display_queues() {
+    std::lock_guard<std::mutex> g(data_mtx_);
+    std::vector<const ClientRec*> recs;
+    for (auto& kv : client_map_) recs.push_back(kv.second.get());
+    std::ostringstream os;
+    auto section = [&](const char* name, auto cmp) {
+      std::sort(recs.begin(), recs.end(),
+                [&](const ClientRec* a, const ClientRec* b) {
+                  return cmp(*a, *b);
+                });
+      os << name << ": ";
+      bool first = true;
+      for (const ClientRec* r : recs) {
+        if (!first) os << " | ";
+        first = false;
+        os << r->client << ":";
+        if (r->has_request()) os << r->next_request().tag;
+        else os << "noreq";
+      }
+      os << "\n";
+    };
+    section("RESER", ResvCompare());
+    section("LIMIT", LimitCompare());
+    section("READY", ReadyCompare());
+    return os.str();
   }
 
   // scheduling counters (reference :810-812)

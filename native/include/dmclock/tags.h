@@ -13,6 +13,8 @@
 
 #include <algorithm>
 #include <cassert>
+#include <cstdio>
+#include <cstdlib>
 
 #include "qos.h"
 #include "recs.h"
@@ -55,7 +57,14 @@ struct RequestTag {
     proportion = tag_calc(max_time, prev.proportion, info.weight_inv_ns,
                           d, true, c);
     limit = tag_calc(max_time, prev.limit, info.limit_inv_ns, d, false, c);
-    assert(reservation < MAX_TAG || proportion < MAX_TAG);
+    // a client with neither reservation nor weight can never be
+    // scheduled; always-on (the reference death-tests this contract,
+    // test_dmclock_server.cc:51-97, and Release strips assert)
+    if (!(reservation < MAX_TAG || proportion < MAX_TAG)) {
+      fprintf(stderr,
+              "dmclock: client with zero reservation and zero weight\n");
+      abort();
+    }
   }
 };
 

@@ -25,16 +25,40 @@ class NullServiceTracker {
                   dmclock::Cost /*cost*/ = 1) {}
 };
 
-// strict-FIFO queue with the pull surface (reference ssched_server.h)
+// strict-FIFO queue with the pull AND push surfaces (reference
+// ssched_server.h: pull_request :154, push schedule_request :184)
 class SimpleQueue {
  public:
   using Decision = dmclock::PullReq<uint64_t, uint64_t>;
+  using CanHandleFunc = std::function<bool()>;
+  using HandleFunc = std::function<void(uint64_t client, uint64_t request,
+                                        dmclock::Phase, dmclock::Cost)>;
+
+  SimpleQueue() = default;
+  SimpleQueue(CanHandleFunc can_handle, HandleFunc handle)
+      : can_handle_(std::move(can_handle)), handle_(std::move(handle)) {}
 
   int add_request(uint64_t request, const uint64_t& client,
                   const dmclock::ReqParams& /*params*/, int64_t /*time_ns*/,
                   dmclock::Cost cost = 1) {
     queue_.push_back(Entry{client, request, cost});
+    if (handle_) schedule_request();
     return 0;
+  }
+
+  // -- push mode -----------------------------------------------------
+  void request_completed() {
+    if (handle_) schedule_request();
+  }
+
+  void schedule_request() {
+    // at most ONE dispatch per call (reference pacing: one request per
+    // add/completion event, ssched_server.h:184-191)
+    if (!queue_.empty() && (!can_handle_ || can_handle_())) {
+      Entry e = queue_.front();
+      queue_.pop_front();
+      handle_(e.client, e.request, dmclock::Phase::priority, e.cost);
+    }
   }
 
   Decision pull_request(int64_t /*now_ns*/) {
@@ -63,6 +87,8 @@ class SimpleQueue {
     dmclock::Cost cost;
   };
   std::deque<Entry> queue_;
+  CanHandleFunc can_handle_;
+  HandleFunc handle_;
 };
 
 }  // namespace qos_sim

@@ -135,3 +135,70 @@ MT_TEST(two_heaps_one_element) {
 }
 
 MT_MAIN()
+
+MT_TEST(cross_k_consistency_random_ops) {
+  // The same random op sequence (push / pop / adjust / remove) must
+  // yield the same pop order for every K -- unique keys make the order
+  // total (reference cross-K suite,
+  // test_indirect_intrusive_heap.cc:266-465).
+  std::mt19937 rng(7);
+  constexpr int kOps = 1500;
+  // pre-generate the op tape so every K replays identical decisions
+  struct Op { int kind; int a; int newkey; };
+  std::vector<Op> tape(kOps);
+  for (auto& op : tape)
+    op = Op{int(rng() % 5), int(rng()), int(rng() % 1000000)};
+
+  std::vector<std::vector<int>> popped_by_k;
+  for (unsigned k : {2u, 3u, 4u, 7u, 10u}) {
+    HeapA h(k);
+    std::vector<std::unique_ptr<Elem>> owner;
+    std::vector<Elem*> live;
+    int next_key = 0;
+    std::vector<int> popped;
+    for (const auto& op : tape) {
+      switch (op.kind < 2 ? 0 : op.kind - 1) {
+        case 0: {  // push (2x weight) (unique ascending-scrambled key)
+          owner.push_back(std::make_unique<Elem>(
+              (op.newkey << 11) | (next_key++ & 0x7FF)));
+          live.push_back(owner.back().get());
+          h.push(owner.back().get());
+          break;
+        }
+        case 1: {  // pop
+          if (!h.empty()) {
+            Elem* top = &h.top();
+            popped.push_back(top->key);
+            h.pop();
+            live.erase(std::find(live.begin(), live.end(), top));
+          }
+          break;
+        }
+        case 2: {  // adjust: rewrite a live element's key
+          if (!live.empty()) {
+            Elem* e = live[size_t(op.a) % live.size()];
+            e->key = (op.newkey << 11) | (next_key++ & 0x7FF);
+            h.adjust(*e);
+          }
+          break;
+        }
+        case 3: {  // remove from the middle
+          if (!live.empty()) {
+            size_t i = size_t(op.a) % live.size();
+            h.remove(*live[i]);
+            live.erase(live.begin() + long(i));
+          }
+          break;
+        }
+      }
+    }
+    while (!h.empty()) {
+      popped.push_back(h.top().key);
+      h.pop();
+    }
+    popped_by_k.push_back(std::move(popped));
+  }
+  for (size_t i = 1; i < popped_by_k.size(); ++i)
+    MT_CHECK(popped_by_k[i] == popped_by_k[0]);
+  MT_CHECK(popped_by_k[0].size() > 100);  // enough coverage
+}

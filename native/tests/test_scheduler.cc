@@ -5,6 +5,10 @@
 // anticipation, idle-reactivation, and GC timing with an injected
 // clock.
 
+#include <sys/resource.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
 #include <map>
 
 #include "dmclock/scheduler.h"
@@ -213,6 +217,58 @@ MT_TEST(gc_idle_then_erase) {
     q.do_clean();
   }
   MT_CHECK_EQ(q.client_count(), uint64_t{0});
+}
+
+// fork-based death check (the reference's gtest death tests,
+// test_dmclock_server.cc:51-97, with dmcPrCtl.h's core-dump disable)
+template <typename Fn>
+static bool dies_with_abort(Fn fn) {
+  pid_t pid = fork();
+  if (pid == 0) {
+    struct rlimit rl {0, 0};
+    setrlimit(RLIMIT_CORE, &rl);  // no core files from expected aborts
+    freopen("/dev/null", "w", stderr);
+    fn();
+    _exit(0);  // reached only if the invariant did NOT fire
+  }
+  int st = 0;
+  waitpid(pid, &st, 0);
+  return WIFSIGNALED(st) && WTERMSIG(st) == SIGABRT;
+}
+
+MT_TEST(death_zero_reservation_and_weight) {
+  // a client with r=0 AND w=0 can never be scheduled: adding its
+  // request must abort (reference test_dmclock_server.cc:51-75)
+  g_infos = {{1, ClientInfo(0, 0, 1)}};
+  MT_CHECK(dies_with_abort([] {
+    Q q(info_of, opts());
+    q.add_request(1, 1, ReqParams(), 1 * S);
+  }));
+}
+
+MT_TEST(death_reject_with_delayed_calc) {
+  // AtLimit::Reject needs accurate tags at add time; combining it with
+  // DelayedTagCalc must abort (reference :856-857, death test :77-97)
+  g_infos = {{1, ClientInfo(1, 1, 2)}};
+  MT_CHECK(dies_with_abort([] {
+    Q q(info_of, opts(/*delayed=*/true, AtLimit::Reject));
+  }));
+}
+
+MT_TEST(display_queues_dump) {
+  // debug dump: three sections, every client listed (oracle
+  // display_queues layout; reference :676-697)
+  g_infos = {{1, ClientInfo(0, 1, 0)}, {2, ClientInfo(0, 2, 0)}};
+  Q q(info_of, opts());
+  q.add_request(100, 1, ReqParams(), 1 * S);
+  q.add_request(200, 2, ReqParams(), 1 * S);
+  std::string dump = q.display_queues();
+  MT_CHECK(dump.find("RESER: ") != std::string::npos);
+  MT_CHECK(dump.find("LIMIT: ") != std::string::npos);
+  MT_CHECK(dump.find("READY: ") != std::string::npos);
+  MT_CHECK(dump.find("1:") != std::string::npos);
+  MT_CHECK(dump.find("2:") != std::string::npos);
+  MT_CHECK(dump.find("noreq") == std::string::npos);
 }
 
 MT_MAIN()

@@ -184,3 +184,24 @@ class TestMultiServerTracking:
         for c in sim.clients.values():
             assert len(c.tracker.server_map) == 4
             assert c.stats.ops_completed == 200
+
+
+class TestSschedPush:
+    def test_push_surface(self):
+        """ssched push mode (reference ssched_server.h:184-191): FIFO
+        dispatch through handle_f under a can_handle gate."""
+        from dmclock_tpu.sim.ssched import SimpleQueue
+        handled = []
+        gate = {"open": False}
+        q = SimpleQueue(can_handle_f=lambda: gate["open"],
+                        handle_f=lambda c, r, p, cost:
+                        handled.append((c, r, cost)))
+        q.add_request("a", 1, cost=2)
+        q.add_request("b", 2)
+        assert handled == []           # gated
+        gate["open"] = True
+        q.request_completed()          # server signals capacity
+        assert handled == [(1, "a", 2)]   # ONE dispatch per completion
+        q.request_completed()
+        assert handled == [(1, "a", 2), (2, "b", 1)]  # strict FIFO
+        assert q.empty()

@@ -337,3 +337,33 @@ def test_capacity_growth():
     assert tpu.state.capacity >= 40
     for _ in range(41):
         pull_compare(oracle, tpu, t + S)
+
+
+def test_display_queues_dump():
+    """Device-state debug dump: three sections in the oracle's
+    RESER/LIMIT/READY layout, selection order = (tag, creation order),
+    requestless clients last."""
+    from dmclock_tpu.core import ClientInfo, ReqParams
+    from dmclock_tpu.engine import TpuPullPriorityQueue
+
+    infos = {1: ClientInfo(0, 1, 0), 2: ClientInfo(0, 2, 0)}
+    q = TpuPullPriorityQueue(lambda c: infos[c], capacity=8,
+                             ring_capacity=8)
+    q.add_request("a", 1, ReqParams(), time_ns=0)
+    q.add_request("b", 2, ReqParams(), time_ns=0)
+    dump = q.display_queues()
+    lines = dump.splitlines()
+    assert [ln.split(":")[0] for ln in lines] == ["RESER", "LIMIT",
+                                                 "READY"]
+    ready = lines[2]
+    # client 1 leads READY: its eff tag is 1e9; client 2's smaller raw
+    # prop tag (5e8) is shifted past it by idle-reactivation prop_delta
+    # (it was created while client 1 was already active)
+    assert ready.startswith("READY: 1:")
+    assert "2:" in ready
+    # draining client 1 leaves it 'noreq', sorted last in every section
+    pr = q.pull_request(now_ns=10**9)
+    assert pr.client == 1
+    dump = q.display_queues()
+    for ln in dump.splitlines():
+        assert ln.endswith("1:noreq")
