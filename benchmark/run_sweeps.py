@@ -104,16 +104,116 @@ def tpu_km_sweep():
     return rows
 
 
+def tpu_regime_sweep():
+    """Decisions/sec by REGIME, beyond the headline's weight-only
+    steady state: pure reservation backlog (constraint phase every
+    decision), a reservation->weight transition (forces speculation
+    failures + serial recovery at the boundary), and the exact serial
+    engine as the floor."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import sys
+    sys.path.insert(0, str(REPO))
+    from __graft_entry__ import _preloaded_state
+    from dmclock_tpu.engine import kernels
+    from dmclock_tpu.engine.fastpath import scan_fast_epoch
+    from profile_util import scalar_latency, state_digest
+
+    n, depth, k, m = 100_000, 128, 32768, 32
+    lat = scalar_latency()
+    rows = []
+
+    def run_epochs(state, now_ns, epochs):
+        run = jax.jit(functools.partial(
+            scan_fast_epoch, m=m, k=k, anticipation_ns=0),
+            donate_argnums=(0,))
+        serial = jax.jit(lambda s, t: kernels.engine_run(
+            s, t, 4096, allow_limit_break=False, anticipation_ns=0,
+            advance_now=False))
+        _ = serial(state, jnp.int64(now_ns))
+        ep = run(state, jnp.int64(now_ns))
+        jax.device_get(state_digest(ep.state))
+        state = ep.state
+        t0 = time.perf_counter()
+        committed = serial_dec = recoveries = trips = 0
+        for _ in range(epochs):
+            ep = run(state, jnp.int64(now_ns))
+            state = ep.state
+            ok = jax.device_get(ep.ok)
+            trips += 1
+            committed += int(ok.sum())
+            if not ok.all():
+                state, _, decs = serial(state, jnp.int64(now_ns))
+                serial_dec += int(jax.device_get(
+                    (decs.type == kernels.RETURNING).sum()))
+                trips += 1
+                recoveries += 1
+        jax.device_get(state_digest(state))
+        trips += 1
+        t = time.perf_counter() - t0 - lat * trips
+        total = committed * k + serial_dec
+        return total / t, 1 - committed / (epochs * m), recoveries
+
+    def resv_state():
+        st = _preloaded_state(n, depth, ring=depth)
+        # stagger reservation phases over the serve period (2*rinv)
+        c = np.arange(n)
+        phase = ((c * 2654435761) & 0xFFFFF) / float(1 << 20)
+        rinv = np.asarray(st.resv_inv)
+        jit = (phase * 2.0 * rinv).astype(np.int64)
+        return st._replace(head_resv=jnp.asarray(rinv + jit))
+
+    # pure reservation regime: now far beyond every reservation tag
+    dps, fb, rec = run_epochs(resv_state(), 10**15, 4)
+    rows.append(("reservation backlog", dps, fb, rec))
+    print(f"reservation: {dps/1e6:.2f} M dec/s fallback {fb:.3f}")
+
+    # transition: only ~3 batches of reservation serves are eligible,
+    # then the regime flips to weight mid-run (speculation must fail
+    # and serially recover at the boundary)
+    st = resv_state()
+    now = int(np.asarray(st.head_resv).min()) + 2 * 10**7
+    dps, fb, rec = run_epochs(st, now, 4)
+    rows.append(("resv->weight transition", dps, fb, rec))
+    print(f"transition: {dps/1e6:.2f} M dec/s fallback {fb:.3f} "
+          f"recoveries {rec}")
+
+    # weight regime baseline at the same epoch budget
+    dps, fb, rec = run_epochs(_preloaded_state(n, depth, ring=depth),
+                              0, 4)
+    rows.append(("weight steady state", dps, fb, rec))
+    print(f"weight: {dps/1e6:.2f} M dec/s fallback {fb:.3f}")
+
+    # exact serial engine floor
+    state = _preloaded_state(n, depth, ring=depth)
+    serial = jax.jit(lambda s, t: kernels.engine_run(
+        s, t, 4096, allow_limit_break=False, anticipation_ns=0,
+        advance_now=False))
+    state, _, decs = serial(state, jnp.int64(0))
+    jax.device_get(state_digest(state))
+    t0 = time.perf_counter()
+    state, _, decs = serial(state, jnp.int64(0))
+    jax.device_get(state_digest(state))
+    t = time.perf_counter() - t0 - lat
+    rows.append(("exact serial engine", 4096 / t, 0.0, 0))
+    print(f"serial exact: {4096/t/1e3:.1f} k dec/s")
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-native", action="store_true")
     ap.add_argument("--skip-tpu", action="store_true")
+    ap.add_argument("--regimes", action="store_true",
+                    help="also run the regime-coverage sweep")
     ap.add_argument("--repeat", type=int, default=3)
     args = ap.parse_args()
 
     here = Path(__file__).resolve().parent
     native_part = here / ".native_section.md"
     tpu_part = here / ".tpu_section.md"
+    regime_part = here / ".regime_section.md"
 
     if not args.skip_native:
         lines = ["## Native heap K-sweep (dmc_sim_100_100.conf, "
@@ -133,11 +233,20 @@ def main():
             lines.append(f"| {k} | {m} | {dps/1e6:.2f} | {fb:.3f} |")
         lines.append("")
         tpu_part.write_text("\n".join(lines))
+    if args.regimes:
+        lines = ["## Regime coverage (100k clients, k=32768, m=32)", "",
+                 "| scenario | M dec/s | fallback rate | serial "
+                 "recoveries |", "|---|---|---|---|"]
+        for name, dps, fb, rec in tpu_regime_sweep():
+            lines.append(f"| {name} | {dps/1e6:.2f} | {fb:.3f} | "
+                         f"{rec} |")
+        lines.append("")
+        regime_part.write_text("\n".join(lines))
 
     head = ["# Benchmark sweeps", "",
             "Produced by `python benchmark/run_sweeps.py` "
             "(see its docstring).", ""]
-    body = [p.read_text() for p in (native_part, tpu_part)
+    body = [p.read_text() for p in (native_part, tpu_part, regime_part)
             if p.exists()]
     RESULTS.write_text("\n".join(head + body))
     print(f"wrote {RESULTS}")

@@ -429,6 +429,86 @@ def ingest(state: EngineState, ops: IngestOps, *,
     return state
 
 
+def ingest_wave(state: EngineState, requesting: jnp.ndarray,
+                time_ns, cost: jnp.ndarray, rho: jnp.ndarray,
+                delta: jnp.ndarray, *,
+                anticipation_ns: int) -> EngineState:
+    """Vectorized do_add_request for a WAVE: at most one new request per
+    client, all slots distinct, applied in parallel.
+
+    Semantics differ from the sequential ``ingest`` scan in exactly one
+    place, by design: idle-reactivation's lowest-proportion-tag scan
+    (reference :960-983) reads the PRE-wave state, so a reactivating
+    client misses EVERY earlier same-wave op's effect on the scanned
+    tags -- other reactivations AND plain adds that retag a drained
+    lower-slot client's head.  (Bit-for-bit parity with the scan holds
+    when each wave's reactivator, if any, is the wave's lowest slot --
+    pinned by tests/test_tpu_engine.py.)  This is the batch-synchronous
+    model of ``sim.device_sim``: same-instant arrivals are unordered.
+    Everything else -- delayed tagging, ring append, cur rho/delta --
+    matches the scan bit for bit.
+
+    ``requesting`` bool[N]; time_ns scalar; cost/rho/delta int64[N].
+    """
+    st = state
+    n = st.capacity
+
+    # --- idle reactivation vs pre-wave state
+    others = st.active & ~st.idle
+    eff = jnp.where(st.depth > 0, st.head_prop, st.prev_prop) \
+        + st.prop_delta
+    lowest = jnp.min(jnp.where(others, eff, KEY_INF))
+    do_shift = requesting & st.idle & jnp.any(others) & \
+        (lowest < LOWEST_PROP_TAG_TRIGGER)
+    prop_delta = jnp.where(do_shift, lowest - time_ns, st.prop_delta)
+    idle = st.idle & ~requesting
+
+    # --- delayed tagging: a real tag only when the queue is empty
+    empty = st.depth == 0
+    tag_it = requesting & empty
+    t_arr = jnp.full((n,), time_ns, dtype=jnp.int64) \
+        if jnp.ndim(time_ns) == 0 else time_ns
+    r, p, l = _make_tag(
+        st.prev_resv, st.prev_prop, st.prev_limit, st.prev_arrival,
+        st.resv_inv, st.weight_inv, st.limit_inv,
+        delta, rho, t_arr, cost, anticipation_ns)
+
+    def hset(new, old, pred=tag_it):
+        return jnp.where(pred, new, old)
+
+    # --- ring append for non-empty queues: dense one-hot write along
+    # the ring axis (per-row scatters serialize on TPU)
+    push_it = requesting & ~empty
+    wpos = (st.q_head + st.depth - 1) % st.ring_capacity
+    onehot = jnp.arange(st.ring_capacity,
+                        dtype=jnp.int32)[None, :] == wpos[:, None]
+    write = push_it[:, None] & onehot
+    q_arrival = jnp.where(write, t_arr[:, None], st.q_arrival)
+    q_cost = jnp.where(write, cost[:, None], st.q_cost)
+
+    return st._replace(
+        idle=idle,
+        prop_delta=prop_delta,
+        head_resv=hset(r, st.head_resv),
+        head_prop=hset(p, st.head_prop),
+        head_limit=hset(l, st.head_limit),
+        head_arrival=hset(t_arr, st.head_arrival),
+        head_cost=hset(cost, st.head_cost),
+        head_rho=hset(rho, st.head_rho),
+        head_ready=st.head_ready & ~tag_it,
+        prev_resv=hset(_fold_prev(st.prev_resv, r), st.prev_resv),
+        prev_prop=hset(_fold_prev(st.prev_prop, p), st.prev_prop),
+        prev_limit=hset(_fold_prev(st.prev_limit, l), st.prev_limit),
+        prev_arrival=hset(t_arr, st.prev_arrival),
+        q_arrival=q_arrival,
+        q_cost=q_cost,
+        depth=jnp.where(requesting, st.depth + 1,
+                        st.depth).astype(jnp.int32),
+        cur_rho=hset(rho, st.cur_rho, requesting),
+        cur_delta=hset(delta, st.cur_delta, requesting),
+    )
+
+
 # ----------------------------------------------------------------------
 # small host-facing helpers
 # ----------------------------------------------------------------------

@@ -1,0 +1,417 @@
+"""Device-resident batch-synchronous QoS simulator.
+
+The SURVEY's "sharded batch sim" (parallelism table, SURVEY.md section
+2) as a user-facing model: the ENTIRE closed loop -- client load
+generation, the delta/rho piggyback protocol, dmClock scheduling, and
+service completion -- lives on device, with servers as a mesh axis and
+clients vmapped, so one program advances a whole multi-server cluster
+thousands of operations per launch.  The host only drives slice chunks
+and reads back aggregate stats.
+
+This is deliberately a DIFFERENT model from the discrete-event host
+harness (``sim.harness``), trading event-exact timing for compiled
+throughput:
+
+- Time advances in fixed slices of ``q * op_time`` ns; a server with
+  backlog serves exactly ``q`` requests per slice (its iops rate), and
+  every serve in a slice is stamped at the slice boundary.
+- A client's sends for a slice are computed from its rate gap and
+  window at the slice start; completions feed back with one-slice
+  latency (outstanding decreases at the end of the slice that served
+  them).
+- Server selection is the harness's deterministic policy
+  (``Simulation._make_server_select`` non-random branch); random
+  selection needs the host's RNG stream and stays host-side.
+
+QoS semantics (tags, phases, AtLimit, idle-reactivation, the tracker
+algebra) are exactly the engine's -- inherited from ``kernels.ingest``
+/ ``engine_run`` and ``parallel.tracker``, the same kernels pinned by
+the oracle differential suites.  Behavioral validation:
+``tests/test_device_sim.py`` checks weight-proportional shares,
+reservation floors, limit caps, and determinism.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import NS_PER_SEC, ClientInfo
+from ..engine import kernels
+from ..engine.state import EngineState, init_state
+from ..parallel.cluster import SERVER_AXIS, make_mesh
+from ..parallel.tracker import (TrackerState, global_counters,
+                                init_tracker, tracker_prepare,
+                                tracker_track)
+from .config import SimConfig
+
+
+class ClientLoad(NamedTuple):
+    """Replicated ([C]) load-generator state, identical on every shard
+    (updates derive from psum'd quantities, keeping shards in step)."""
+
+    gap_ns: jnp.ndarray        # int64[C] inter-send gap
+    next_send: jnp.ndarray     # int64[C] next send time (TIME-like ns)
+    sent: jnp.ndarray          # int32[C] requests sent so far
+    total_ops: jnp.ndarray     # int32[C]
+    outstanding: jnp.ndarray   # int32[C]
+    window: jnp.ndarray        # int32[C] max outstanding
+    cost: jnp.ndarray          # int64[C]
+    sel_base: jnp.ndarray      # int32[C] server-select base offset
+    sel_range: jnp.ndarray     # int32[C] server-select range
+
+
+class DeviceSim(NamedTuple):
+    engine: EngineState        # [S, ...]
+    tracker: TrackerState      # [S, C]
+    load: ClientLoad           # [C] replicated
+    served_resv: jnp.ndarray   # int64[S, C] completions by phase
+    served_prop: jnp.ndarray   # int64[S, C]
+    last_served: jnp.ndarray   # int64[S, C] slice-end of last completion
+    t: jnp.ndarray             # int64 slice-aligned clock (scalar)
+
+
+@dataclass
+class DeviceSimSpec:
+    """Static launch parameters derived from a SimConfig."""
+
+    n_servers: int
+    n_clients: int
+    op_time_ns: int            # uniform across servers
+    q_per_slice: int           # serves per server per slice
+    max_sends: int             # per client per slice (static bound)
+    slice_ns: int
+    allow_limit_break: bool
+
+
+def _make_spec(cfg: SimConfig, q_per_slice: int = 4) -> DeviceSimSpec:
+    assert not cfg.server_random_selection, \
+        "device_sim uses the deterministic server-select policy"
+    iops = {g.server_iops for g in cfg.srv_group}
+    threads = {g.server_threads for g in cfg.srv_group}
+    assert len(iops) == 1 and threads == {1}, \
+        "device_sim v1: uniform single-thread servers"
+    n_servers = sum(g.server_count for g in cfg.srv_group)
+    n_clients = sum(g.client_count for g in cfg.cli_group)
+    op_time_ns = int(0.5 + 1e6 / iops.pop()) * 1000
+    slice_ns = op_time_ns * q_per_slice
+    # static bound on sends per client per slice; refuse configs whose
+    # offered load cannot be expressed (a silent clamp would misreport
+    # a simulator artifact as a QoS limit)
+    min_gap = min(int(0.5 + 1e6 / g.client_iops_goal) * 1000
+                  for g in cfg.cli_group)
+    max_sends = max(1, slice_ns // max(min_gap, 1) + 1)
+    assert max_sends <= 16, (
+        f"client iops goals need {max_sends} sends/client/slice; the "
+        "wave unroll caps at 16 -- raise server_iops (shorter slices) "
+        "or lower client_iops_goal")
+    return DeviceSimSpec(
+        n_servers=n_servers, n_clients=n_clients,
+        op_time_ns=op_time_ns, q_per_slice=q_per_slice,
+        max_sends=max_sends, slice_ns=slice_ns,
+        allow_limit_break=cfg.server_soft_limit)
+
+
+def init_device_sim(cfg: SimConfig, ring_capacity: int = 256
+                    ) -> tuple[DeviceSim, DeviceSimSpec]:
+    spec = _make_spec(cfg)
+    s, c = spec.n_servers, spec.n_clients
+    max_window = max(g.client_outstanding_ops for g in cfg.cli_group)
+    assert max_window <= ring_capacity, (
+        f"client_outstanding_ops {max_window} can exceed a per-client "
+        f"ring of {ring_capacity}; raise ring_capacity")
+
+    infos, gaps, waits, totals, windows, costs, ranges = \
+        [], [], [], [], [], [], []
+    for g in cfg.cli_group:
+        for _ in range(g.client_count):
+            infos.append(ClientInfo(g.client_reservation,
+                                    g.client_weight, g.client_limit))
+            gaps.append(int(0.5 + 1e6 / g.client_iops_goal) * 1000)
+            waits.append(int(g.client_wait_s * NS_PER_SEC))
+            totals.append(g.client_total_ops)
+            windows.append(g.client_outstanding_ops)
+            costs.append(g.client_req_cost)
+            ranges.append(min(g.client_server_select_range, s))
+
+    factor = s / max(1, c)
+    sel_base = np.asarray([int(0.5 + i * factor) % s for i in range(c)],
+                          dtype=np.int32)
+
+    one = init_state(c, ring_capacity)
+    engine = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (s,) + a.shape), one)
+    engine = engine._replace(
+        active=jnp.ones((s, c), dtype=bool),
+        order=jnp.broadcast_to(jnp.arange(c, dtype=jnp.int64), (s, c)),
+        resv_inv=jnp.broadcast_to(jnp.asarray(
+            [i.reservation_inv_ns for i in infos], jnp.int64), (s, c)),
+        weight_inv=jnp.broadcast_to(jnp.asarray(
+            [i.weight_inv_ns for i in infos], jnp.int64), (s, c)),
+        limit_inv=jnp.broadcast_to(jnp.asarray(
+            [i.limit_inv_ns for i in infos], jnp.int64), (s, c)),
+    )
+    tracker = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (s,) + a.shape), init_tracker(c))
+    load = ClientLoad(
+        gap_ns=jnp.asarray(gaps, jnp.int64),
+        next_send=jnp.asarray(waits, jnp.int64),
+        sent=jnp.zeros((c,), jnp.int32),
+        total_ops=jnp.asarray(totals, jnp.int32),
+        outstanding=jnp.zeros((c,), jnp.int32),
+        window=jnp.asarray(windows, jnp.int32),
+        cost=jnp.asarray(costs, jnp.int64),
+        sel_base=jnp.asarray(sel_base),
+        sel_range=jnp.asarray(ranges, jnp.int32),
+    )
+    sim = DeviceSim(engine=engine, tracker=tracker, load=load,
+                    served_resv=jnp.zeros((s, c), jnp.int64),
+                    served_prop=jnp.zeros((s, c), jnp.int64),
+                    last_served=jnp.zeros((s, c), jnp.int64),
+                    t=jnp.int64(0))
+    return sim, spec
+
+
+def shard_device_sim(sim: DeviceSim, mesh: Mesh) -> DeviceSim:
+    srv = NamedSharding(mesh, P(SERVER_AXIS))
+    rep = NamedSharding(mesh, P())
+    return DeviceSim(
+        engine=jax.tree.map(lambda a: jax.device_put(a, srv), sim.engine),
+        tracker=jax.tree.map(lambda a: jax.device_put(a, srv),
+                             sim.tracker),
+        load=jax.tree.map(lambda a: jax.device_put(a, rep), sim.load),
+        served_resv=jax.device_put(sim.served_resv, srv),
+        served_prop=jax.device_put(sim.served_prop, srv),
+        last_served=jax.device_put(sim.last_served, srv),
+        t=jax.device_put(sim.t, rep),
+    )
+
+
+def _slice_sends(load: ClientLoad, t0, slice_ns: int, max_sends: int):
+    """How many sends each client performs this slice (bounded by rate,
+    window, and remaining ops), all from slice-start state."""
+    t_end = t0 + slice_ns
+    by_rate = jnp.where(
+        load.next_send < t_end,
+        ((t_end - load.next_send) + load.gap_ns - 1) // load.gap_ns,
+        0).astype(jnp.int32)
+    n = jnp.minimum(jnp.minimum(by_rate, max_sends),
+                    jnp.minimum(load.window - load.outstanding,
+                                load.total_ops - load.sent))
+    return jnp.maximum(n, 0)
+
+
+def _sends_to_server(load: ClientLoad, n, wave: int, server_ids,
+                     n_servers: int):
+    """Does client c's ``wave``-th send this slice target THIS server?
+    (deterministic policy: (sel_base + seq % range) % n_servers).
+    ``n_servers`` is the static GLOBAL count -- server_ids.shape[0]
+    inside shard_map is only the local shard slice."""
+    seq = load.sent + wave
+    target = (load.sel_base
+              + jnp.remainder(seq, load.sel_range)) % n_servers
+    return (n > wave) & (target[None, :] == server_ids[:, None])
+
+
+def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
+                    slices: int) -> DeviceSim:
+    """Advance ``slices`` time slices in one launch (jit this)."""
+    s_total = spec.n_servers
+
+    def shard_fn(engine, tracker, load, served_resv, served_prop,
+                 last_served, t, server_ids):
+        def one_slice(carry, _):
+            engine, tracker, load, sresv, sprop, slast, t = carry
+            # tracker is [S_local, C] inside the shard: the client-global
+            # counters reduce over BOTH the local server slice and the
+            # mesh axis
+            g_delta, g_rho = global_counters(
+                tracker, lambda x: lax.psum(x.sum(axis=0), SERVER_AXIS))
+
+            n = _slice_sends(load, t, spec.slice_ns, spec.max_sends)
+            c = n.shape[0]
+
+            def ingest_wave(carry2, wave):
+                engine, tracker = carry2
+                mine = _sends_to_server(load, n, wave, server_ids,
+                                        s_total)
+
+                def per_server(eng, trk, mine_row):
+                    trk, d_out, r_out = tracker_prepare(
+                        trk, mine_row, g_delta, g_rho)
+                    # one request per client per wave, slots distinct:
+                    # the vectorized wave ingest scales to 100k-client
+                    # slices where the sequential op scan cannot
+                    eng = kernels.ingest_wave(
+                        eng, mine_row, t, load.cost,
+                        jnp.where(mine_row, r_out, 1),
+                        jnp.where(mine_row, d_out, 1),
+                        anticipation_ns=0)
+                    return eng, trk
+
+                engine, tracker = jax.vmap(per_server)(engine, tracker,
+                                                       mine)
+                return (engine, tracker), None
+
+            # python-unrolled waves (max_sends is static and small)
+            for wave in range(spec.max_sends):
+                (engine, tracker), _ = ingest_wave((engine, tracker),
+                                                   wave)
+
+            # serve q decisions per server at the slice boundary
+            t_end = t + spec.slice_ns
+
+            def per_server_run(eng):
+                return kernels.engine_run(
+                    eng, t_end, spec.q_per_slice,
+                    allow_limit_break=spec.allow_limit_break,
+                    anticipation_ns=0, advance_now=False)
+
+            engine, _, decs = jax.vmap(per_server_run)(engine)
+            served = decs.type == kernels.RETURNING
+
+            def per_server_track(trk, d_slot, d_cost, d_phase, d_srv):
+                return tracker_track(trk, d_slot, d_cost, d_phase,
+                                     d_srv)
+
+            tracker = jax.vmap(per_server_track)(
+                tracker, decs.slot, decs.cost, decs.phase, served)
+
+            # stats + completion feedback (one [S_local, q] scatter-add
+            # per phase; q is small)
+            one = jnp.where(served, 1, 0).astype(jnp.int64)
+            idx = jnp.where(served, decs.slot, 0)
+            sresv = jax.vmap(lambda a, i, v: a.at[i].add(v))(
+                sresv, idx, one * (decs.phase == 0))
+            sprop = jax.vmap(lambda a, i, v: a.at[i].add(v))(
+                sprop, idx, one * (decs.phase == 1))
+            t_end_b = t + spec.slice_ns
+            slast = jax.vmap(lambda a, i, v: a.at[i].max(v))(
+                slast, idx, jnp.where(served, t_end_b, 0))
+            done_here = jax.vmap(
+                lambda i, v: jnp.zeros((c,), jnp.int32).at[i].add(
+                    v.astype(jnp.int32)))(idx, one)
+            completions = lax.psum(done_here.sum(axis=0), SERVER_AXIS)
+
+            sends = n  # every shard computed the same [C] send counts
+            load = load._replace(
+                sent=(load.sent + sends).astype(jnp.int32),
+                outstanding=(load.outstanding + sends
+                             - completions).astype(jnp.int32),
+                next_send=load.next_send
+                + sends.astype(jnp.int64) * load.gap_ns,
+            )
+            return (engine, tracker, load, sresv, sprop, slast,
+                    t_end), None
+
+        (engine, tracker, load, served_resv, served_prop, last_served,
+         t), _ = lax.scan(
+            one_slice,
+            (engine, tracker, load, served_resv, served_prop,
+             last_served, t), None, length=slices)
+        return (engine, tracker, load, served_resv, served_prop,
+                last_served, t)
+
+    srv = P(SERVER_AXIS)
+    rep = P()
+    server_ids = jnp.arange(s_total, dtype=jnp.int32)
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(srv, srv, rep, srv, srv, srv, rep, srv),
+        out_specs=(srv, srv, rep, srv, srv, srv, rep),
+        check_vma=False)
+    engine, tracker, load, served_resv, served_prop, last_served, t = \
+        fn(sim.engine, sim.tracker, sim.load, sim.served_resv,
+           sim.served_prop, sim.last_served, sim.t, server_ids)
+    return DeviceSim(engine=engine, tracker=tracker, load=load,
+                     served_resv=served_resv, served_prop=served_prop,
+                     last_served=last_served, t=t)
+
+
+def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
+                   slices_per_launch: int = 64,
+                   max_launches: int = 200):
+    """Run to completion (all clients' ops served) or the launch cap.
+
+    Returns (sim, spec, report_str)."""
+    if mesh is None:
+        mesh = make_mesh()
+        n_dev = len(mesh.devices.flat)
+        # the servers axis must divide the device count; fall back to a
+        # single device otherwise
+        total = sum(g.server_count for g in cfg.srv_group)
+        if total % n_dev != 0:
+            mesh = make_mesh(1)
+    sim, spec = init_device_sim(cfg)
+    sim = shard_device_sim(sim, mesh)
+    step = jax.jit(functools.partial(
+        device_sim_step, spec=spec, mesh=mesh,
+        slices=slices_per_launch))
+    total_ops = int(np.asarray(sim.load.total_ops).sum())
+    launches = 0
+    for launches in range(1, max_launches + 1):
+        sim = step(sim)
+        completed = int(np.asarray(sim.served_resv).sum()
+                        + np.asarray(sim.served_prop).sum())
+        if completed >= total_ops:
+            break
+    return sim, spec, format_report(cfg, sim, spec, launches)
+
+
+def format_report(cfg: SimConfig, sim: DeviceSim, spec: DeviceSimSpec,
+                  launches: int) -> str:
+    sresv = np.asarray(sim.served_resv).sum(axis=0)   # [C]
+    sprop = np.asarray(sim.served_prop).sum(axis=0)
+    t_s = int(sim.t) / NS_PER_SEC
+    lines = ["=== device sim report ===",
+             f"servers: {spec.n_servers}  clients: {spec.n_clients}  "
+             f"slice: {spec.slice_ns} ns x {launches} launches",
+             f"virtual duration: {t_s:.3f} s",
+             f"total ops: {int(sresv.sum() + sprop.sum())} "
+             f"(reservation {int(sresv.sum())}, "
+             f"priority {int(sprop.sum())})"]
+    last = np.asarray(sim.last_served).max(axis=0)  # [C]
+    ci = 0
+    for gi, g in enumerate(cfg.cli_group):
+        sl = slice(ci, ci + g.client_count)
+        ops = int(sresv[sl].sum() + sprop[sl].sum())
+        finish_s = last[sl].max() / NS_PER_SEC
+        rate = ops / finish_s / g.client_count if finish_s else 0.0
+        lines.append(
+            f"group {gi}: {g.client_count} clients  "
+            f"r={g.client_reservation} w={g.client_weight} "
+            f"l={g.client_limit} | ops {ops} "
+            f"(res {int(sresv[sl].sum())} / prop {int(sprop[sl].sum())})"
+            f" | done @ {finish_s:.2f}s | average {rate:.2f} ops/s")
+        ci += g.client_count
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    from .config import parse_config_file
+
+    p = argparse.ArgumentParser(
+        prog="device_sim", description=__doc__.splitlines()[0])
+    p.add_argument("-c", "--conf", required=True)
+    p.add_argument("--slices-per-launch", type=int, default=64)
+    p.add_argument("--max-launches", type=int, default=200)
+    args = p.parse_args(argv)
+    cfg = parse_config_file(args.conf)
+    _sim, _spec, report = run_device_sim(
+        cfg, slices_per_launch=args.slices_per_launch,
+        max_launches=args.max_launches)
+    print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

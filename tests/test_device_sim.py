@@ -1,0 +1,153 @@
+"""Device-resident batch sim: behavioral QoS validation.
+
+The device sim is a batch-synchronous MODEL (see device_sim.py
+docstring), so these tests pin dmClock's defining behaviors --
+weight-proportional sharing, reservation floors, limit caps -- plus
+determinism, rather than event-exact traces (the engine kernels it is
+built from are trace-pinned elsewhere: tests/test_tpu_engine.py,
+test_sim_tpu_fullscale.py, test_parallel.py)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmclock_tpu.sim import device_sim as DS
+from dmclock_tpu.sim.config import ClientGroup, ServerGroup, SimConfig
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return DS.make_mesh(8)
+
+
+def make_cfg(groups, *, iops=160.0, soft_limit=False):
+    return SimConfig(client_groups=len(groups), server_groups=1,
+                     server_random_selection=False,
+                     server_soft_limit=soft_limit,
+                     cli_group=groups,
+                     srv_group=[ServerGroup(server_count=8,
+                                            server_iops=iops,
+                                            server_threads=1)])
+
+
+def run_fixed(cfg, mesh, launches=4, slices=32):
+    sim, spec = DS.init_device_sim(cfg)
+    sim = DS.shard_device_sim(sim, mesh)
+    step = jax.jit(functools.partial(DS.device_sim_step, spec=spec,
+                                     mesh=mesh, slices=slices))
+    for _ in range(launches):
+        sim = step(sim)
+    served = np.asarray(sim.served_resv) + np.asarray(sim.served_prop)
+    return sim, spec, served.sum(axis=0)  # [C] per-client completions
+
+
+def group_slices(groups):
+    out, ci = [], 0
+    for g in groups:
+        out.append(slice(ci, ci + g.client_count))
+        ci += g.client_count
+    return out
+
+
+def test_weight_shares_under_saturation(mesh8):
+    """Backlogged weight-1 vs weight-2 clients split capacity ~1:2
+    (reference pull_weight behavior at sim scale)."""
+    groups = [
+        ClientGroup(client_count=8, client_total_ops=100000,
+                    client_iops_goal=400, client_outstanding_ops=100,
+                    client_reservation=0.0, client_limit=0.0,
+                    client_weight=1.0, client_server_select_range=8),
+        ClientGroup(client_count=8, client_total_ops=100000,
+                    client_iops_goal=400, client_outstanding_ops=100,
+                    client_reservation=0.0, client_limit=0.0,
+                    client_weight=2.0, client_server_select_range=8),
+    ]
+    _sim, _spec, served = run_fixed(make_cfg(groups), mesh8)
+    g = group_slices(groups)
+    r1, r2 = served[g[0]].sum(), served[g[1]].sum()
+    assert r1 > 0 and r2 > 0
+    ratio = r2 / r1
+    assert 1.7 < ratio < 2.3, f"weight 1:2 served ratio {ratio:.2f}"
+
+
+def test_reservation_floor_under_contention(mesh8):
+    """A tiny-weight client group with a reservation keeps its floor
+    while heavy-weight traffic saturates the cluster."""
+    groups = [
+        ClientGroup(client_count=4, client_total_ops=100000,
+                    client_iops_goal=200, client_outstanding_ops=100,
+                    client_reservation=40.0, client_limit=0.0,
+                    client_weight=0.01, client_server_select_range=8),
+        ClientGroup(client_count=12, client_total_ops=100000,
+                    client_iops_goal=400, client_outstanding_ops=100,
+                    client_reservation=0.0, client_limit=0.0,
+                    client_weight=4.0, client_server_select_range=8),
+    ]
+    sim, _spec, served = run_fixed(make_cfg(groups), mesh8)
+    g = group_slices(groups)
+    t_s = int(sim.t) / 1e9
+    floor_rate = served[g[0]].sum() / 4 / t_s
+    assert floor_rate >= 0.8 * 40.0, \
+        f"reserved client rate {floor_rate:.1f} < floor 40"
+
+
+def test_limit_caps_rate(mesh8):
+    """A limited client group is capped near its limit even with spare
+    capacity and demand above it (AtLimit.WAIT).  Rate measured over
+    the run's second half: requests carry the delta from their SEND
+    time (the piggyback protocol), so an initial in-flight window of
+    stale-delta requests legitimately overshoots before the tracker
+    feedback binds -- in the reference too."""
+    groups = [
+        ClientGroup(client_count=8, client_total_ops=100000,
+                    client_iops_goal=120, client_outstanding_ops=16,
+                    client_reservation=0.0, client_limit=40.0,
+                    client_weight=1.0, client_server_select_range=8),
+    ]
+    cfg = make_cfg(groups, iops=400.0)
+    sim, spec = DS.init_device_sim(cfg)
+    sim = DS.shard_device_sim(sim, mesh8)
+    step = jax.jit(functools.partial(DS.device_sim_step, spec=spec,
+                                     mesh=mesh8, slices=32))
+    for _ in range(8):
+        sim = step(sim)
+    t1 = int(sim.t)
+    s1 = (np.asarray(sim.served_resv)
+          + np.asarray(sim.served_prop)).sum()
+    for _ in range(8):
+        sim = step(sim)
+    t2 = int(sim.t)
+    s2 = (np.asarray(sim.served_resv)
+          + np.asarray(sim.served_prop)).sum()
+    rate = (s2 - s1) / 8 / ((t2 - t1) / 1e9)
+    assert rate <= 1.2 * 40.0, f"limited rate {rate:.1f} > cap 40"
+    assert rate >= 0.6 * 40.0, f"limited rate {rate:.1f} far below cap"
+
+
+def test_deterministic(mesh8):
+    groups = [
+        ClientGroup(client_count=8, client_total_ops=500,
+                    client_iops_goal=100, client_outstanding_ops=32,
+                    client_reservation=20.0, client_limit=60.0,
+                    client_weight=1.0, client_server_select_range=4),
+    ]
+    _s1, _sp1, a = run_fixed(make_cfg(groups), mesh8, launches=2)
+    _s2, _sp2, b = run_fixed(make_cfg(groups), mesh8, launches=2)
+    assert (a == b).all()
+
+
+def test_cli_runs(mesh8, capsys):
+    from dmclock_tpu.sim.device_sim import run_device_sim
+    groups = [
+        ClientGroup(client_count=8, client_total_ops=200,
+                    client_iops_goal=100, client_outstanding_ops=32,
+                    client_reservation=20.0, client_limit=0.0,
+                    client_weight=1.0, client_server_select_range=4),
+    ]
+    _sim, _spec, report = run_device_sim(make_cfg(groups), mesh=mesh8)
+    assert "total ops: 1600" in report
