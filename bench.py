@@ -1,24 +1,25 @@
 #!/usr/bin/env python
 """Headline benchmark: dmClock scheduling decisions/sec at 100k clients.
 
-Preloads a 100k-client engine state (uniform reservation, mixed weights
--- BASELINE.json config #3 shape), then times ``scan_fast_epoch``
-(speculative batched serving, bit-identical to the serial engine --
-``tests/test_fastpath.py``) in steady weight-regime state, with the
-production recovery loop: after each epoch the host checks the commit
-mask and, if speculation failed, reruns one exact serial k-batch from
-the stalled state before resuming epochs.  Both the epochs and any
-serial recoveries are inside the timed region.
+Preloads a 100k-client engine state (uniform reservation, mixed
+weights, staggered tag phases -- BASELINE.json config #3 shape), then
+times ``scan_fast_epoch`` (speculative batched serving, bit-identical
+to the serial engine -- ``tests/test_fastpath.py``) in steady
+weight-regime state.  Epochs are chained asynchronously on device with
+a single timed digest sync; commit masks are read back untimed, and
+the decision count comes from them exactly (commit-prefix semantics:
+a stalled epoch makes later epochs no-ops, degrading the reported rate
+honestly -- regime-transition behavior is measured separately in
+benchmark/RESULTS.md).
 
 Timing method: the decision stream is produced into device memory
 (slot/phase/cost arrays per epoch); compute is serialized by a
 device_get of a scalar digest that data-depends on every batch
 (block_until_ready alone has proven unreliable through the tunneled
-runtime).  The per-epoch ok-mask fetch costs one host round-trip; its
-measured latency is subtracted (on non-tunneled hardware it is
-microseconds).  The bulk decision readback is NOT timed: on the
-tunneled dev runtime the host link adds ~100 ms + ~150 ms/MB per
-fetch, which measures the tunnel, not the scheduler.
+runtime); one scalar round-trip latency is subtracted.  The bulk
+decision readback is NOT timed: on the tunneled dev runtime the host
+link adds ~100 ms + ~150 ms/MB per fetch, which measures the tunnel,
+not the scheduler.
 
 Prints ONE json line; ``vs_baseline`` is the ratio to the BASELINE.json
 north-star target of 10M decisions/sec/chip.
@@ -36,8 +37,18 @@ import numpy as np
 
 
 def main() -> None:
+    import argparse
+    import contextlib
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="write a jax profiler (xprof) trace of the "
+                    "timed region to DIR")
+    args = ap.parse_args()
+    trace_ctx = (jax.profiler.trace(args.profile) if args.profile
+                 else contextlib.nullcontext())
+
     from __graft_entry__ import _preloaded_state
-    from dmclock_tpu.engine import kernels
     from dmclock_tpu.engine.fastpath import scan_fast_epoch
     from profile_util import scalar_latency, state_digest
 
@@ -53,44 +64,36 @@ def main() -> None:
     run = jax.jit(functools.partial(
         scan_fast_epoch, m=epoch_m, k=batch, anticipation_ns=0),
         donate_argnums=(0,))
-    serial = jax.jit(lambda s, t: kernels.engine_run(
-        s, t, batch, allow_limit_break=False, anticipation_ns=0,
-        advance_now=False))
 
-    # compile + warm both paths; measure host round-trip latency
-    _ = serial(state, jnp.int64(0))          # compile the recovery path
+    # compile + warm; measure host round-trip latency
     ep = run(state, jnp.int64(0))
     jax.device_get(state_digest(ep.state))
     state = ep.state
     latency = scalar_latency()
 
+    # The epochs are chained ASYNCHRONOUSLY (no mid-run readback): a
+    # per-epoch ok fetch costs one ~100ms tunnel round-trip against
+    # ~100ms of device work, so subtracting it statistically made the
+    # result swing by 2x run to run.  Commit-prefix semantics keep the
+    # decision count exact without mid-run recovery: if an epoch
+    # stalls, later epochs re-attempt from the exact stalled state and
+    # commit nothing new, and the reported rate honestly degrades
+    # (fallback_rate shows it; the steady-state workload here never
+    # stalls -- regime-transition numbers live in benchmark/RESULTS.md).
     t0 = time.perf_counter()
-    ep0 = None          # first epoch kept for the untimed sanity check
-    n_committed = 0
-    n_serial_decisions = 0
-    n_serial = 0
-    n_roundtrips = 0
-    for _ in range(epochs):
-        ep = run(state, jnp.int64(0))
-        state = ep.state
-        if ep0 is None:
-            ep0 = ep
-        ok = jax.device_get(ep.ok)          # one round-trip per epoch
-        n_roundtrips += 1
-        n_committed += int(ok.sum())
-        if not ok.all():
-            # speculation stalled: one exact serial k-batch recovers;
-            # count only decisions that actually RETURNING-served
-            state, _, decs = serial(state, jnp.int64(0))
-            n_serial_decisions += int(
-                jax.device_get((decs.type == kernels.RETURNING).sum()))
-            n_roundtrips += 1
-            n_serial += 1
-    jax.device_get(state_digest(state))
-    n_roundtrips += 1
-    elapsed = time.perf_counter() - t0 - latency * n_roundtrips
+    eps = []
+    with trace_ctx:
+        for _ in range(epochs):
+            ep = run(state, jnp.int64(0))
+            state = ep.state
+            eps.append(ep)
+        jax.device_get(state_digest(state))
+    elapsed = time.perf_counter() - t0 - latency
 
-    total = n_committed * batch + n_serial_decisions
+    ep0 = eps[0]
+    oks = [jax.device_get(ep.ok) for ep in eps]      # untimed
+    n_committed = int(sum(ok.sum() for ok in oks))
+    total = n_committed * batch
     n_batches = epochs * epoch_m
     fallback_rate = 1.0 - n_committed / n_batches
 
@@ -108,10 +111,9 @@ def main() -> None:
     print(json.dumps({
         "metric": "dmclock scheduling decisions/sec @100k clients "
                   f"(k={batch}, m={epoch_m}, {total} decisions, "
-                  f"fallback_rate={fallback_rate:.4f}, "
-                  f"serial_recoveries={n_serial}, device-compute + "
-                  "recovery timed; decision stream resident in HBM, "
-                  "bulk readback untimed)",
+                  f"fallback_rate={fallback_rate:.4f}, epochs chained "
+                  "async on device, one digest sync timed; decision "
+                  "stream resident in HBM, bulk readback untimed)",
         "value": round(dps, 1),
         "unit": "decisions/sec/chip",
         "vs_baseline": round(dps / 10_000_000, 4),

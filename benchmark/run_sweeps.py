@@ -66,14 +66,57 @@ def native_k_sweep(repeat: int):
     return rows
 
 
-def tpu_km_sweep():
+def _timed_epochs(state, now_ns, epochs, k, m, lat, *, recovery=False):
+    """Shared per-epoch-sync timing harness for the sweeps: warm one
+    epoch, time ``epochs`` more with a per-epoch ok readback (latency-
+    corrected), optionally recovering stalls with one exact serial
+    4096-batch.  bench.py's async-chained headline protocol is kept
+    separate by design (see its docstring).  Returns (decisions/sec,
+    fallback_rate, serial_recoveries)."""
     import jax
     import jax.numpy as jnp
+    from dmclock_tpu.engine import kernels
+    from dmclock_tpu.engine.fastpath import scan_fast_epoch
+    from profile_util import state_digest
+
+    run = jax.jit(functools.partial(
+        scan_fast_epoch, m=m, k=k, anticipation_ns=0),
+        donate_argnums=(0,))
+    serial = jax.jit(lambda s, t: kernels.engine_run(
+        s, t, 4096, allow_limit_break=False, anticipation_ns=0,
+        advance_now=False))
+    if recovery:
+        _ = serial(state, jnp.int64(now_ns))       # compile
+    ep = run(state, jnp.int64(now_ns))
+    jax.device_get(state_digest(ep.state))         # warm
+    state = ep.state
+
+    t0 = time.perf_counter()
+    committed = serial_dec = recoveries = trips = 0
+    for _ in range(epochs):
+        ep = run(state, jnp.int64(now_ns))
+        state = ep.state
+        ok = jax.device_get(ep.ok)
+        trips += 1
+        committed += int(ok.sum())
+        if recovery and not ok.all():
+            state, _, decs = serial(state, jnp.int64(now_ns))
+            serial_dec += int(jax.device_get(
+                (decs.type == kernels.RETURNING).sum()))
+            trips += 1
+            recoveries += 1
+    jax.device_get(state_digest(state))
+    trips += 1
+    t = time.perf_counter() - t0 - lat * trips
+    total = committed * k + serial_dec
+    return total / t, 1 - committed / (epochs * m), recoveries
+
+
+def tpu_km_sweep():
     import sys
     sys.path.insert(0, str(REPO))
     from __graft_entry__ import _preloaded_state
-    from dmclock_tpu.engine.fastpath import scan_fast_epoch
-    from profile_util import scalar_latency, state_digest
+    from profile_util import scalar_latency
 
     n, depth = 100_000, 128
     rows = []
@@ -81,23 +124,8 @@ def tpu_km_sweep():
     for k in (8192, 16384, 32768, 49152):
         for m in (8, 32):
             state = _preloaded_state(n, depth, ring=depth)
-            run = jax.jit(functools.partial(
-                scan_fast_epoch, m=m, k=k, anticipation_ns=0),
-                donate_argnums=(0,))
-            ep = run(state, jnp.int64(0))
-            jax.device_get(state_digest(ep.state))  # warm
-            state = ep.state
             epochs = max(1, (1 << 21) // (m * k))
-            t0 = time.perf_counter()
-            committed = 0
-            for _ in range(epochs):
-                ep = run(state, jnp.int64(0))
-                state = ep.state
-                committed += int(jax.device_get(ep.ok.sum()))
-            jax.device_get(state_digest(state))
-            t = time.perf_counter() - t0 - lat * (epochs + 1)
-            dps = committed * k / t
-            fb = 1 - committed / (epochs * m)
+            dps, fb, _rec = _timed_epochs(state, 0, epochs, k, m, lat)
             rows.append((k, m, dps, fb))
             print(f"k={k} m={m}: {dps/1e6:.2f} M dec/s "
                   f"(fallback {fb:.3f})")
@@ -117,7 +145,6 @@ def tpu_regime_sweep():
     sys.path.insert(0, str(REPO))
     from __graft_entry__ import _preloaded_state
     from dmclock_tpu.engine import kernels
-    from dmclock_tpu.engine.fastpath import scan_fast_epoch
     from profile_util import scalar_latency, state_digest
 
     n, depth, k, m = 100_000, 128, 32768, 32
@@ -125,35 +152,8 @@ def tpu_regime_sweep():
     rows = []
 
     def run_epochs(state, now_ns, epochs):
-        run = jax.jit(functools.partial(
-            scan_fast_epoch, m=m, k=k, anticipation_ns=0),
-            donate_argnums=(0,))
-        serial = jax.jit(lambda s, t: kernels.engine_run(
-            s, t, 4096, allow_limit_break=False, anticipation_ns=0,
-            advance_now=False))
-        _ = serial(state, jnp.int64(now_ns))
-        ep = run(state, jnp.int64(now_ns))
-        jax.device_get(state_digest(ep.state))
-        state = ep.state
-        t0 = time.perf_counter()
-        committed = serial_dec = recoveries = trips = 0
-        for _ in range(epochs):
-            ep = run(state, jnp.int64(now_ns))
-            state = ep.state
-            ok = jax.device_get(ep.ok)
-            trips += 1
-            committed += int(ok.sum())
-            if not ok.all():
-                state, _, decs = serial(state, jnp.int64(now_ns))
-                serial_dec += int(jax.device_get(
-                    (decs.type == kernels.RETURNING).sum()))
-                trips += 1
-                recoveries += 1
-        jax.device_get(state_digest(state))
-        trips += 1
-        t = time.perf_counter() - t0 - lat * trips
-        total = committed * k + serial_dec
-        return total / t, 1 - committed / (epochs * m), recoveries
+        return _timed_epochs(state, now_ns, epochs, k, m, lat,
+                             recovery=True)
 
     def resv_state():
         st = _preloaded_state(n, depth, ring=depth)

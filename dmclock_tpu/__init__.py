@@ -10,16 +10,19 @@ stable argmin, multi-server corrections as psum collectives.
 Layers:
   core      -- canonical int64-ns tag algebra + pure-Python oracle
   engine    -- batched TPU scheduler: SoA client state, JAX device
-               kernels (tag update, fused select), speculative fastpath
-  parallel  -- mesh sharding, multi-server cluster sim, psum tracker
-  sim       -- QoS simulation harness (INI-config compatible)
+               kernels (tag update, fused select, wave ingest),
+               speculative fastpath, Tpu Pull/Push queues
+  parallel  -- mesh sharding, multi-server cluster, psum trackers
+               (Orig + Borrowing)
+  sim       -- discrete-event QoS harness (INI-config compatible) +
+               the device-resident batch simulator (device_sim)
   models    -- registered scheduler "models" (dmclock oracle, dmclock
                native C++, dmclock TPU engine, ssched FIFO)
   native    -- ctypes bindings to the C++ host runtime
-  utils     -- periodic tasks, profiling timers
+  utils     -- periodic tasks, profiling timers, orbax checkpointing
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from . import core
 from .core import (AtLimit, ClientInfo, Phase, PullPriorityQueue,

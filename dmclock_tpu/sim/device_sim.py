@@ -196,7 +196,13 @@ def shard_device_sim(sim: DeviceSim, mesh: Mesh) -> DeviceSim:
 
 def _slice_sends(load: ClientLoad, t0, slice_ns: int, max_sends: int):
     """How many sends each client performs this slice (bounded by rate,
-    window, and remaining ops), all from slice-start state."""
+    window, and remaining ops), all from slice-start state.
+
+    Model bound: a client catching up after a window stall emits at
+    most ``max_sends`` per slice even if its rate debt is larger (the
+    wave unroll is static); the debt carries over via ``next_send``, so
+    offered load is deferred, never lost.  _make_spec's assert covers
+    the steady-state rate; this bound only shapes post-stall bursts."""
     t_end = t0 + slice_ns
     by_rate = jnp.where(
         load.next_send < t_end,
@@ -336,6 +342,7 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
 
 
 def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
+                   ring_capacity: int = 256,
                    slices_per_launch: int = 64,
                    max_launches: int = 200):
     """Run to completion (all clients' ops served) or the launch cap.
@@ -349,7 +356,7 @@ def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
         total = sum(g.server_count for g in cfg.srv_group)
         if total % n_dev != 0:
             mesh = make_mesh(1)
-    sim, spec = init_device_sim(cfg)
+    sim, spec = init_device_sim(cfg, ring_capacity=ring_capacity)
     sim = shard_device_sim(sim, mesh)
     step = jax.jit(functools.partial(
         device_sim_step, spec=spec, mesh=mesh,
@@ -401,12 +408,14 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="device_sim", description=__doc__.splitlines()[0])
     p.add_argument("-c", "--conf", required=True)
+    p.add_argument("--ring-capacity", type=int, default=256)
     p.add_argument("--slices-per-launch", type=int, default=64)
     p.add_argument("--max-launches", type=int, default=200)
     args = p.parse_args(argv)
     cfg = parse_config_file(args.conf)
     _sim, _spec, report = run_device_sim(
-        cfg, slices_per_launch=args.slices_per_launch,
+        cfg, ring_capacity=args.ring_capacity,
+        slices_per_launch=args.slices_per_launch,
         max_launches=args.max_launches)
     print(report)
     return 0

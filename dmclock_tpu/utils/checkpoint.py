@@ -1,0 +1,80 @@
+"""Checkpoint / resume for device-resident scheduler and sim state.
+
+The reference has no checkpointing (all state is in-memory and sims run
+to completion; SURVEY.md section 5).  Here every piece of device state
+-- ``EngineState``, the cluster's tracker shards, a whole ``DeviceSim``
+-- is a pytree of arrays, so orbax makes save/restore nearly free, and
+long simulations (or an embedding storage service) can snapshot the
+scheduler mid-flight and resume bit-exactly.
+
+Host-side bookkeeping (client-id maps, payload FIFOs) lives outside the
+pytree; ``TpuPullPriorityQueue`` snapshots it alongside via
+``queue_state_dict``/``restore_queue_state``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    """Write any pytree-of-arrays checkpoint (orbax)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), tree, force=True)
+
+
+def restore_pytree(path: str, like: Any) -> Any:
+    """Restore a checkpoint saved by ``save_pytree``; ``like`` provides
+    the tree structure and array shapes/dtypes (e.g. a freshly built
+    state)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        abstract = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), like)
+        return ckptr.restore(os.path.abspath(path), abstract)
+
+
+def queue_state_dict(q) -> dict:
+    """Host bookkeeping of a TpuPullPriorityQueue as plain data.
+
+    Call this BEFORE ``save_pytree(path, q.state)``: it flushes any
+    buffered ops into the device state, so saving the device state
+    first would serialize a state the returned payload FIFOs are ahead
+    of."""
+    with q.data_mtx:
+        q._flush()
+        return {
+            "slot_of": dict(q._slot_of),
+            "payloads": {s: list(d) for s, d in q._payloads.items()},
+            "free": list(q._free),
+            "next_order": q._next_order,
+            "last_tick": dict(q._last_tick),
+            "tick": q.tick,
+            "counters": (q.reserv_sched_count, q.prop_sched_count,
+                         q.limit_break_sched_count),
+        }
+
+
+def restore_queue_state(q, st: dict) -> None:
+    from collections import deque
+
+    with q.data_mtx:
+        q._pending = []      # drop ops buffered against the old state
+        q._clean_mark_points.clear()
+        q._last_erase_point = 0
+        q._slot_of = dict(st["slot_of"])
+        q._client_of = {s: c for c, s in q._slot_of.items()}
+        q._payloads = {s: deque(d) for s, d in st["payloads"].items()}
+        q._free = list(st["free"])
+        q._next_order = st["next_order"]
+        q._last_tick = dict(st["last_tick"])
+        q.tick = st["tick"]
+        (q.reserv_sched_count, q.prop_sched_count,
+         q.limit_break_sched_count) = st["counters"]

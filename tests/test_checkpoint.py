@@ -1,0 +1,90 @@
+"""Checkpoint/resume: a snapshotted queue or device sim must continue
+bit-exactly from where it left off."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("orbax.checkpoint")
+
+from dmclock_tpu.core import ClientInfo, ReqParams
+from dmclock_tpu.engine import TpuPullPriorityQueue, init_state
+from dmclock_tpu.utils.checkpoint import (queue_state_dict,
+                                          restore_pytree,
+                                          restore_queue_state,
+                                          save_pytree)
+
+S = 10**9
+
+
+def test_queue_checkpoint_resume(tmp_path):
+    infos = {c: ClientInfo(10, 1.0 + c % 3, 0) for c in range(6)}
+
+    def build():
+        return TpuPullPriorityQueue(lambda c: infos[c], capacity=16,
+                                    ring_capacity=16)
+
+    q = build()
+    for i in range(12):
+        q.add_request(("r", i), i % 6, ReqParams(1, 1),
+                      time_ns=(i + 1) * S // 4)
+    # serve a few, snapshot mid-stream
+    pre = [q.pull_request(4 * S) for _ in range(5)]
+    assert all(p.is_retn() for p in pre)
+    host = queue_state_dict(q)          # flushes; MUST precede the
+    save_pytree(tmp_path / "engine", q.state)  # device-state save
+
+    # continue the original
+    rest_orig = [q.pull_request(5 * S) for _ in range(7)]
+
+    # resume a fresh queue from the snapshot
+    q2 = build()
+    q2.state = restore_pytree(tmp_path / "engine", q2.state)
+    restore_queue_state(q2, host)
+    rest_resumed = [q2.pull_request(5 * S) for _ in range(7)]
+
+    for a, b in zip(rest_orig, rest_resumed):
+        assert (a.type, a.client, a.phase, a.cost) == \
+            (b.type, b.client, b.phase, b.cost)
+
+
+def test_device_sim_checkpoint_resume(tmp_path):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from dmclock_tpu.sim import device_sim as DS
+    from dmclock_tpu.sim.config import (ClientGroup, ServerGroup,
+                                        SimConfig)
+
+    cfg = SimConfig(
+        client_groups=1, server_groups=1,
+        server_random_selection=False, server_soft_limit=False,
+        cli_group=[ClientGroup(client_count=8, client_total_ops=10000,
+                               client_iops_goal=100,
+                               client_outstanding_ops=16,
+                               client_reservation=20.0,
+                               client_limit=0.0, client_weight=1.0,
+                               client_server_select_range=4)],
+        srv_group=[ServerGroup(server_count=8, server_iops=160,
+                               server_threads=1)])
+    mesh = DS.make_mesh(8)
+    sim, spec = DS.init_device_sim(cfg)
+    sim = DS.shard_device_sim(sim, mesh)
+    step = jax.jit(functools.partial(DS.device_sim_step, spec=spec,
+                                     mesh=mesh, slices=16))
+    sim = step(sim)
+    save_pytree(tmp_path / "sim", sim)
+
+    cont = step(step(sim))
+
+    fresh, _ = DS.init_device_sim(cfg)
+    fresh = DS.shard_device_sim(fresh, mesh)
+    resumed = restore_pytree(tmp_path / "sim", fresh)
+    resumed = DS.shard_device_sim(resumed, mesh)
+    resumed = step(step(resumed))
+
+    for f in ("served_resv", "served_prop", "t"):
+        assert (np.asarray(getattr(cont, f))
+                == np.asarray(getattr(resumed, f))).all(), f
