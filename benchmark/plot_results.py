@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Plot the recorded sweeps (the reference ``plot_gen.sh`` analog,
+matplotlib instead of gnuplot): parses benchmark/RESULTS.md and writes
+PNGs next to it.
+
+Colors are the validated reference categorical palette (slots 1-2) from
+the dataviz method; single-series charts use one hue and no legend.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import matplotlib
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+HERE = Path(__file__).resolve().parent
+RESULTS = HERE / "RESULTS.md"
+
+INK = "#1a1a19"
+MUTED = "#6b6a5f"
+GRID = "#e5e4dc"
+SERIES = ["#2a78d6", "#eb6834"]  # validated categorical slots 1-2
+
+
+def parse_tables(text: str):
+    """section-title -> list of row tuples (strings)."""
+    tables = {}
+    section = None
+    rows: list[tuple[str, ...]] = []
+    for line in text.splitlines():
+        if line.startswith("## "):
+            if section and rows:
+                tables[section] = rows
+            section, rows = line[3:].strip(), []
+        elif line.startswith("|") and not set(line) <= {"|", "-", " "}:
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            if cells and not cells[0].startswith("---"):
+                rows.append(tuple(cells))
+    if section and rows:
+        tables[section] = rows
+    return tables
+
+
+def style(ax):
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    for side in ("left", "bottom"):
+        ax.spines[side].set_color(GRID)
+    ax.tick_params(colors=MUTED, labelsize=9)
+    ax.yaxis.grid(True, color=GRID, linewidth=0.8)
+    ax.set_axisbelow(True)
+
+
+def plot_k_sweep(rows, out: Path):
+    data = [(int(r[0]), float(r[1])) for r in rows[1:]]
+    ks = [str(k) for k, _ in data]
+    ns = [v for _, v in data]
+    fig, ax = plt.subplots(figsize=(6, 3.2), dpi=150)
+    ax.bar(ks, ns, width=0.62, color=SERIES[0], edgecolor="none")
+    style(ax)
+    ax.set_xlabel("heap branching factor K", color=MUTED, fontsize=9)
+    ax.set_ylabel("add_request ns", color=MUTED, fontsize=9)
+    ax.set_title("Native heap K-sweep (dmc_sim_100_100.conf)",
+                 color=INK, fontsize=11, loc="left")
+    lo = min(ns)
+    i = ns.index(lo)
+    ax.annotate(f"{lo:.0f} ns", (i, lo), textcoords="offset points",
+                xytext=(0, 4), ha="center", color=INK, fontsize=9)
+    fig.tight_layout()
+    fig.savefig(out)
+    plt.close(fig)
+
+
+def plot_km_sweep(rows, out: Path):
+    data = [(int(r[0]), int(r[1]), float(r[2])) for r in rows[1:]]
+    ks = sorted({k for k, _, _ in data})
+    ms = sorted({m for _, m, _ in data})
+    fig, ax = plt.subplots(figsize=(6, 3.2), dpi=150)
+    width = 0.38
+    for si, m in enumerate(ms):
+        vals = [next((v for k2, m2, v in data
+                      if k2 == k and m2 == m), 0.0) for k in ks]
+        xs = [i + (si - (len(ms) - 1) / 2) * (width + 0.03)
+              for i in range(len(ks))]
+        ax.bar(xs, vals, width=width, color=SERIES[si % len(SERIES)],
+               edgecolor="none", label=f"m={m}")
+    style(ax)
+    ax.set_xticks(range(len(ks)))
+    ax.set_xticklabels([str(k) for k in ks])
+    ax.set_xlabel("speculative batch size k", color=MUTED, fontsize=9)
+    ax.set_ylabel("M decisions/sec", color=MUTED, fontsize=9)
+    ax.set_title("TPU epoch k/m sweep (100k clients, one chip)",
+                 color=INK, fontsize=11, loc="left")
+    leg = ax.legend(frameon=False, fontsize=9, labelcolor=INK)
+    for h in leg.legend_handles:
+        h.set_height(7)
+    # zero rows are real data: the speculation boundary
+    for i, k in enumerate(ks):
+        if all(v == 0.0 for k2, _m, v in data if k2 == k):
+            ax.annotate("speculation\nfails", (i, 0),
+                        textcoords="offset points", xytext=(0, 8),
+                        ha="center", color=MUTED, fontsize=8)
+    fig.tight_layout()
+    fig.savefig(out)
+    plt.close(fig)
+
+
+def main():
+    tables = parse_tables(RESULTS.read_text())
+    wrote = []
+    for title, rows in tables.items():
+        if title.startswith("Native heap K-sweep"):
+            plot_k_sweep(rows, HERE / "k_sweep.png")
+            wrote.append("k_sweep.png")
+        elif title.startswith("TPU epoch k/m sweep"):
+            plot_km_sweep(rows, HERE / "tpu_km_sweep.png")
+            wrote.append("tpu_km_sweep.png")
+    print(f"wrote {', '.join(wrote) or 'nothing (no known sections)'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,12 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <atomic>
+#include <chrono>
 #include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
 
 #include "dmclock/scheduler.h"
 #include "microtest.h"
@@ -269,6 +274,69 @@ MT_TEST(display_queues_dump) {
   MT_CHECK(dump.find("1:") != std::string::npos);
   MT_CHECK(dump.find("2:") != std::string::npos);
   MT_CHECK(dump.find("noreq") == std::string::npos);
+}
+
+// ---- push-mode queue (reference PushPriorityQueue :1504-1797) ------
+
+using PushQ = PushPriorityQueue<uint64_t, uint64_t>;
+
+template <typename Pred>
+static bool wait_until(Pred pred, int timeout_ms = 5000) {
+  for (int i = 0; i < timeout_ms / 5; ++i) {
+    if (pred()) return true;
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  return pred();
+}
+
+MT_TEST(push_immediate_dispatch) {
+  g_infos = {{7, ClientInfo(0, 1, 0)}};
+  std::mutex m;
+  std::vector<std::pair<uint64_t, int>> handled;
+  PushQ q(info_of, [] { return true; },
+          [&](const uint64_t& c, uint64_t&&, Phase p, Cost) {
+            std::lock_guard<std::mutex> g(m);
+            handled.emplace_back(c, int(p));
+          },
+          opts());
+  q.add_request(1, 7, ReqParams());
+  MT_CHECK(wait_until([&] {
+    std::lock_guard<std::mutex> g(m);
+    return handled.size() == 1;
+  }));
+  std::lock_guard<std::mutex> g(m);
+  if (handled.empty()) return;  // MT_CHECK above already failed
+  MT_CHECK_EQ(handled[0].first, uint64_t{7});
+  MT_CHECK_EQ(handled[0].second, int(Phase::priority));
+}
+
+MT_TEST(push_can_handle_gates) {
+  g_infos = {{1, ClientInfo(0, 1, 0)}};
+  std::atomic<bool> open{false};
+  std::atomic<int> n{0};
+  PushQ q(info_of, [&] { return open.load(); },
+          [&](const uint64_t&, uint64_t&&, Phase, Cost) { ++n; },
+          opts());
+  q.add_request(1, 1, ReqParams());
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  MT_CHECK_EQ(n.load(), 0);
+  open = true;
+  q.request_completed();  // server signals capacity
+  MT_CHECK(wait_until([&] { return n.load() == 1; }));
+}
+
+MT_TEST(push_sched_ahead_timed_wakeup) {
+  // limit 10/s: the second request becomes eligible ~0.1s later and
+  // must be dispatched by the sched-ahead thread unprompted
+  g_infos = {{1, ClientInfo(0, 1, 10)}};
+  std::atomic<int> n{0};
+  PushQ q(info_of, [] { return true; },
+          [&](const uint64_t&, uint64_t&&, Phase, Cost) { ++n; },
+          opts());
+  int64_t now = get_time_ns();
+  q.add_request(1, 1, ReqParams(), now);
+  q.add_request(2, 1, ReqParams(), now);
+  MT_CHECK(wait_until([&] { return n.load() == 2; }));
 }
 
 MT_MAIN()
