@@ -81,8 +81,14 @@ def plot_km_sweep(rows, out: Path):
     fig, ax = plt.subplots(figsize=(6, 3.2), dpi=150)
     width = 0.38
     for si, m in enumerate(ms):
-        vals = [next((v for k2, m2, v in data
-                      if k2 == k and m2 == m), 0.0) for k in ks]
+        vals = []
+        for k in ks:
+            cell = [v for k2, m2, v in data if k2 == k and m2 == m]
+            if not cell:
+                raise SystemExit(
+                    f"RESULTS.md missing k={k} m={m}: refusing to plot "
+                    "a zero bar for unmeasured data")
+            vals.append(cell[0])
         xs = [i + (si - (len(ms) - 1) / 2) * (width + 0.03)
               for i in range(len(ks))]
         ax.bar(xs, vals, width=width, color=SERIES[si % len(SERIES)],

@@ -39,9 +39,12 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental.pallas import tpu as pltpu
 
 from ..core.timebase import MAX_TAG
 from . import kernels
@@ -128,29 +131,97 @@ class RingWindow(NamedTuple):
     q0: jnp.ndarray     # int32[N] q_head at prefetch time
 
 
+# Pallas row-rotate: the barrel shift runs in VMEM (one HBM read +
+# write per chunk) instead of log2(Q) full HBM passes -- measured 3x
+# the XLA rolls at bench shapes.  Constraints of this TPU stack:
+# gridded pallas_call does not legalize through the remote Mosaic
+# compiler, so the kernel is gridless and the host slices VMEM-sized
+# row chunks; int64 rings are bitcast to int32 lane pairs (a row
+# rotation by 2*q0 on the pair plane is the int64 rotation by q0).
+_ROT_CHUNK = 2048
+
+
+def _rotate_kernel(q_ref, x_ref, o_ref, *, q: int):
+    x = x_ref[...]                       # [chunk, 2Q] int32
+    shifts = q_ref[...]                  # [chunk, 2Q] int32, in [0, Q)
+    one = jnp.int32(1)
+    s = 0
+    while (1 << s) < q:
+        bit2 = ((shifts >> jnp.int32(s)) & one) == one
+        d = jnp.int32((2 * q - 2 * (1 << s)) % (2 * q))
+        x = jnp.where(bit2, pltpu.roll(x, shift=d, axis=1), x)
+        s += 1
+    o_ref[...] = x
+
+
+def _rotate_rows_pallas(ring, q0, wsize: int, *, q0t=None,
+                        interpret: bool = False):
+    """out[w, i] = ring[i, (q0[i]+w) % Q] for w < wsize (int64 ring).
+    ``q0t`` lets callers share the lane-tiled shift plane between the
+    arrival and cost rotations."""
+    from jax.experimental import pallas as pl
+
+    n, q = ring.shape
+    i32 = lax.bitcast_convert_type(ring, jnp.int32).reshape(n, 2 * q)
+    pad = (-n) % _ROT_CHUNK
+    if pad:
+        i32 = jnp.pad(i32, ((0, pad), (0, 0)))
+    if q0t is None:
+        q0t = _tile_shifts(q0, q, n + pad)
+    call = pl.pallas_call(
+        functools.partial(_rotate_kernel, q=q),
+        out_shape=jax.ShapeDtypeStruct((_ROT_CHUNK, 2 * q), jnp.int32),
+        interpret=interpret)
+    # slice each chunk to the window BEFORE concatenating: the full
+    # rotated ring is never materialized in HBM
+    outs = [call(q0t[c:c + _ROT_CHUNK], i32[c:c + _ROT_CHUNK])
+            [:, :2 * wsize]
+            for c in range(0, n + pad, _ROT_CHUNK)]
+    rot = jnp.concatenate(outs, axis=0)
+    win = rot[:n].reshape(n, wsize, 2)
+    return lax.bitcast_convert_type(win, jnp.int64).T
+
+
+def _tile_shifts(q0, q: int, n_padded: int):
+    q0 = jnp.pad(q0, (0, n_padded - q0.shape[0]))
+    return jnp.broadcast_to(q0[:, None],
+                            (n_padded, 2 * q)).astype(jnp.int32)
+
+
+def _rotate_rows_xla(ring, q0, wsize: int):
+    q = ring.shape[1]
+    r = ring
+    s = 0
+    while (1 << s) < q:
+        bit = ((q0 >> s) & 1).astype(bool)
+        r = jnp.where(bit[:, None], jnp.roll(r, -(1 << s), axis=1), r)
+        s += 1
+    return r[:, :wsize].T
+
+
 def ring_window(state: EngineState, m: int) -> RingWindow:
     """Prefetch the next ``min(m, Q)`` ring elements of every client,
     transposed to [w, N] for cheap per-batch row selects.
 
     Built by barrel-shifting each client's ring left by its own
-    ``q_head`` (log2(Q) masked dense rolls), then slicing the leading
-    columns.  TPU gathers with per-row indices serialize (measured 10x
-    the rolls' cost for a 32-wide window; a vmapped dynamic-slice was
-    50x), while rolls are dense contiguous copies the TPU streams at
-    full bandwidth.  Window rows past a client's queued tail carry
-    stale ring values -- reads of them only happen after the client
-    drained, and are masked at commit."""
+    ``q_head``: a Pallas VMEM kernel on TPU, log2(Q) masked dense XLA
+    rolls elsewhere (TPU gathers with per-row indices serialize --
+    measured 10x the rolls' cost for a 32-wide window; a vmapped
+    dynamic-slice was 50x).  Window rows past a client's queued tail
+    carry stale ring values -- reads of them only happen after the
+    client drained, and are masked at commit."""
     q = state.ring_capacity
     q0 = state.q_head
     wsize = min(m, q)
 
-    def rot(r):
-        s = 0
-        while (1 << s) < q:
-            bit = ((q0 >> s) & 1).astype(bool)
-            r = jnp.where(bit[:, None], jnp.roll(r, -(1 << s), axis=1), r)
-            s += 1
-        return r[:, :wsize].T
+    # the Pallas path needs a full lane tile (2q >= 128 int32 lanes)
+    if jax.default_backend() == "tpu" and q >= 64:
+        n = q0.shape[0]
+        q0t = _tile_shifts(q0, q, n + ((-n) % _ROT_CHUNK))
+        rot = functools.partial(_rotate_rows_pallas, q0=q0,
+                                wsize=wsize, q0t=q0t)
+    else:
+        rot = functools.partial(_rotate_rows_xla, q0=q0, wsize=wsize)
     return RingWindow(arr=rot(state.q_arrival), cost=rot(state.q_cost),
                       q0=q0)
 
