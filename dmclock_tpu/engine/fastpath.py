@@ -75,16 +75,20 @@ _SENT32 = (1 << 31) - 1      # non-candidate sentinel (sorts last)
 _ORDER32_LIMIT = jnp.int64(1) << 31
 
 
-def _sorted_selection(key, order, k: int):
+def _sorted_selection(key, order, k: int, cost):
     """Indices of the k lexicographically-smallest (key, order) pairs,
     sorted ascending (= exact serial service order).
 
-    Returns (idx[k], V, max_tied_order, ok) where V is the k-th
-    smallest key and max_tied_order the largest creation order selected
-    at the V boundary.  ``ok`` is False when fewer than k real in-window
-    candidates exist (sentinel keys carry KEY_INF) or a rebase window
-    overflowed at the boundary -- the caller must then fall back to the
-    serial engine.
+    Returns (idx[k], V, max_tied_order, ok, cost[k]) where V is the
+    k-th smallest key and max_tied_order the largest creation order
+    selected at the V boundary.  ``ok`` is False when fewer than k real
+    in-window candidates exist (sentinel keys carry KEY_INF) or a
+    rebase window overflowed at the boundary -- the caller must then
+    fall back to the serial engine.
+
+    ``cost`` (int64[N], non-negative) rides the sort as an int32
+    payload so the decision emit avoids a [k]-sized gather (TPU
+    gathers serialize); a cost that overflows int32 fails ``ok``.
     """
     real = key < KEY_INF
     kmin = jnp.min(jnp.where(real, key, KEY_INF))
@@ -97,16 +101,21 @@ def _sorted_selection(key, order, k: int):
     omin = jnp.min(jnp.where(real, order, jnp.int64(1) << 62))
     o32 = (order - omin).astype(jnp.int32)
     iota = jnp.arange(key.shape[0], dtype=jnp.int32)
-    ks, _, idxs = lax.sort((k32, o32, iota), num_keys=2)
+    ks, _, idxs, cs = lax.sort(
+        (k32, o32, iota, cost.astype(jnp.int32)), num_keys=2)
     vk = ks[k - 1]
     # vk < _CLAMP32 ensures >= k real candidates AND that every
     # selected key fit the rebase window (clamped/sentinel rows sort at
-    # or past _CLAMP32); the order-spread rebase must be exact too.
+    # or past _CLAMP32); the order-spread rebase must be exact too,
+    # and so must the int32 cost payload.
     omax = jnp.max(jnp.where(real, order, omin))
-    ok = (vk < _CLAMP32) & (omax - omin < _ORDER32_LIMIT)
+    # the cost guard masks to real candidates: an oversized cost on an
+    # inactive/non-candidate row must not disable the fastpath forever
+    cost_ok = jnp.max(jnp.where(real, cost, 0)) < (jnp.int64(1) << 31)
+    ok = (vk < _CLAMP32) & (omax - omin < _ORDER32_LIMIT) & cost_ok
     v = kmin + vk.astype(jnp.int64)
     max_tied_order = order[idxs[k - 1]]
-    return idxs[:k], v, max_tied_order, ok
+    return idxs[:k], v, max_tied_order, ok, cs[:k].astype(jnp.int64)
 
 
 def _ready_now(state: EngineState, now):
@@ -373,8 +382,8 @@ def speculate_weight_batch(state: EngineState, now, k: int, *,
     resv_min0 = jnp.min(resv_key)
     cond_entry = resv_min0 > now
 
-    idx, kth, max_tied_order, cond_count = _sorted_selection(
-        key, state.order, k)
+    idx, kth, max_tied_order, cond_count, sel_cost = _sorted_selection(
+        key, state.order, k, cost=state.head_cost)
     mask = _served_mask(key, state.order, kth, max_tied_order)
 
     serve = _dense_serve(state, heads, True, anticipation_ns)
@@ -418,7 +427,7 @@ def speculate_weight_batch(state: EngineState, now, k: int, *,
         type=jnp.zeros((k,), dtype=jnp.int32),
         slot=idx.astype(jnp.int32),
         phase=jnp.ones((k,), dtype=jnp.int32),
-        cost=state.head_cost[idx],
+        cost=sel_cost,
         when=jnp.zeros((k,), dtype=jnp.int64),
         limit_break=jnp.zeros((k,), dtype=bool),
     )
@@ -440,8 +449,8 @@ def speculate_resv_batch(state: EngineState, now, k: int, *,
     has_req = state.active & (state.depth > 0)
     key = jnp.where(has_req, state.head_resv, KEY_INF)
 
-    idx, kth, max_tied_order, cond_count = _sorted_selection(
-        key, state.order, k)
+    idx, kth, max_tied_order, cond_count, sel_cost = _sorted_selection(
+        key, state.order, k, cost=state.head_cost)
     cond_eligible = kth <= now            # all k fire the constraint phase
     mask = _served_mask(key, state.order, kth, max_tied_order)
 
@@ -459,7 +468,7 @@ def speculate_resv_batch(state: EngineState, now, k: int, *,
         type=jnp.zeros((k,), dtype=jnp.int32),
         slot=idx.astype(jnp.int32),
         phase=jnp.zeros((k,), dtype=jnp.int32),
-        cost=state.head_cost[idx],
+        cost=sel_cost,
         when=jnp.zeros((k,), dtype=jnp.int64),
         limit_break=jnp.zeros((k,), dtype=bool),
     )
