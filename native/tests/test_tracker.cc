@@ -61,6 +61,31 @@ MT_TEST(calc_with_borrow_cases) {
   MT_CHECK_EQ(r3.second, Counter{4});
 }
 
+MT_TEST(borrowing_interleaved_two_servers) {
+  // hand-derived delta/rho stream across two servers with mixed costs
+  // (the reference pins the same algebra in
+  // test_dmclock_client.cc:108-225); globals start at 1/1
+  ServiceTracker<uint64_t, BorrowingTracker> st;
+  auto r1 = st.get_req_params(1);              // first contact s1
+  MT_CHECK_EQ(r1.delta, 1u); MT_CHECK_EQ(r1.rho, 1u);
+  st.track_resp(1, Phase::reservation, 2);     // delta 3, rho 3
+  auto r2 = st.get_req_params(2);              // first contact s2
+  MT_CHECK_EQ(r2.delta, 1u); MT_CHECK_EQ(r2.rho, 1u);
+  st.track_resp(2, Phase::priority, 1);        // delta 4
+  auto r3 = st.get_req_params(1);              // (4-1, 3-1) no borrow
+  MT_CHECK_EQ(r3.delta, 3u); MT_CHECK_EQ(r3.rho, 2u);
+  auto r4 = st.get_req_params(1);              // no movement: borrow
+  MT_CHECK_EQ(r4.delta, 1u); MT_CHECK_EQ(r4.rho, 1u);
+  st.track_resp(1, Phase::priority, 1);        // delta 5
+  auto r5 = st.get_req_params(1);              // +1 vs borrow 1 -> 1
+  MT_CHECK_EQ(r5.delta, 1u); MT_CHECK_EQ(r5.rho, 1u);
+  st.track_resp(1, Phase::reservation, 3);     // delta 8, rho 6
+  auto r6 = st.get_req_params(1);              // +3 minus borrow 1 / +3 minus borrow 2
+  MT_CHECK_EQ(r6.delta, 2u); MT_CHECK_EQ(r6.rho, 1u);
+  auto r7 = st.get_req_params(2);              // s2 saw it all: (5, 3)
+  MT_CHECK_EQ(r7.delta, 5u); MT_CHECK_EQ(r7.rho, 3u);
+}
+
 MT_TEST(server_record_gc) {
   // mirrors reference server_erase (:42-105): a server unused past
   // clean_age is forgotten; tracker self-heals on its return
