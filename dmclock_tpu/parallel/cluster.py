@@ -30,8 +30,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine import kernels
 from ..engine.state import EngineState, init_state
-from .tracker import (TrackerState, global_counters, init_tracker,
-                      tracker_prepare, tracker_track)
+from .tracker import (BorrowTrackerState, TrackerState,
+                      borrow_tracker_prepare, borrow_tracker_track,
+                      global_counters, init_borrow_tracker,
+                      init_tracker, tracker_prepare, tracker_track)
 
 SERVER_AXIS = "servers"
 
@@ -41,6 +43,9 @@ class ClusterState(NamedTuple):
 
     engine: EngineState       # [S, ...] scheduler state per server
     tracker: TrackerState     # [S, C] distributed-protocol counters
+    #                           (TrackerState or BorrowTrackerState --
+    #                           the accounting policy plug, reference
+    #                           dmclock_client.h:39-154)
     now: jnp.ndarray          # int64[S] per-server virtual clock
 
 
@@ -52,16 +57,19 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 
 
 def init_cluster(n_servers: int, n_clients: int,
-                 ring_capacity: int = 64) -> ClusterState:
+                 ring_capacity: int = 64,
+                 tracker_kind: str = "orig") -> ClusterState:
     """Host-side construction: capacity ``n_clients`` slots per server
     (slot i == client i cluster-wide, which is what lets completion
-    counters psum by position)."""
+    counters psum by position).  ``tracker_kind``: "orig" or
+    "borrowing" (the reference's two accounting policies)."""
     one = init_state(n_clients, ring_capacity)
     engine = jax.tree.map(
         lambda a: jnp.broadcast_to(a, (n_servers,) + a.shape), one)
+    base = {"orig": init_tracker,
+            "borrowing": init_borrow_tracker}[tracker_kind](n_clients)
     tracker = jax.tree.map(
-        lambda a: jnp.broadcast_to(a, (n_servers,) + a.shape),
-        init_tracker(n_clients))
+        lambda a: jnp.broadcast_to(a, (n_servers,) + a.shape), base)
     return ClusterState(engine=engine, tracker=tracker,
                         now=jnp.zeros((n_servers,), dtype=jnp.int64))
 
@@ -109,7 +117,10 @@ def _one_server_step(engine: EngineState, tracker: TrackerState,
     Phase B: the engine makes ``decisions_per_step`` decisions.
     Phase C: completions fold into the tracker counters.
     """
-    # --- distributed ReqParams via the psum'd global counters
+    # --- distributed ReqParams via the psum'd global counters; the
+    # tracker STATE type picks the accounting policy
+    borrowing = isinstance(tracker, BorrowTrackerState)
+    prepare = borrow_tracker_prepare if borrowing else tracker_prepare
     g_delta, g_rho = global_counters(
         tracker, lambda x: lax.psum(x, SERVER_AXIS))
 
@@ -118,10 +129,11 @@ def _one_server_step(engine: EngineState, tracker: TrackerState,
     for wave in range(max_arrivals):
         requesting = arrivals_per_client > wave
         # waves after a client's first request this round re-mark an
-        # unchanged global counter, so their params are (0, 0) -- the
-        # same stream the host OrigTracker emits for back-to-back
-        # requests with no interleaved completions
-        tracker, delta_out, rho_out = tracker_prepare(
+        # unchanged global counter, so their params are (0, 0) for
+        # Orig / floor at (1, 1) for Borrowing -- the same streams the
+        # host trackers emit for back-to-back requests with no
+        # interleaved completions
+        tracker, delta_out, rho_out = prepare(
             tracker, requesting, g_delta, g_rho)
         ops = kernels.IngestOps(
             kind=jnp.where(requesting, kernels.OP_ADD,
@@ -145,10 +157,11 @@ def _one_server_step(engine: EngineState, tracker: TrackerState,
         allow_limit_break=allow_limit_break,
         anticipation_ns=anticipation_ns, advance_now=True)
 
-    # --- completions -> counters (the response half of the protocol)
+    # --- completions -> counters (the response half of the protocol;
+    # both policies fold completions identically)
     served = decs.type == kernels.RETURNING
-    tracker = tracker_track(tracker, decs.slot, decs.cost, decs.phase,
-                            served)
+    track = borrow_tracker_track if borrowing else tracker_track
+    tracker = track(tracker, decs.slot, decs.cost, decs.phase, served)
     return engine, tracker, now, decs
 
 

@@ -16,7 +16,8 @@ import pytest
 
 from dmclock_tpu.core import Phase
 from dmclock_tpu.core.timebase import rate_to_inv_ns
-from dmclock_tpu.core.tracker import BorrowingTracker, ServiceTracker
+from dmclock_tpu.core.tracker import (BorrowingTracker, OrigTracker,
+                                      ServiceTracker)
 from dmclock_tpu.parallel import (cluster as CL, borrow_tracker_prepare,
                                   borrow_tracker_track,
                                   init_borrow_tracker, init_tracker,
@@ -151,12 +152,14 @@ def test_cluster_step_sharded(mesh8):
     assert cur_delta.max() > 1
 
 
-def test_cluster_step_matches_independent_host_sims(mesh8):
+@pytest.mark.parametrize("tracker_kind", ["orig", "borrowing"])
+def test_cluster_step_matches_independent_host_sims(mesh8, tracker_kind):
     """The whole cluster step equals S independent host oracle queues +
-    per-client host OrigTrackers fed the same arrival schedule: per
-    round, every server's full k-decision stream (type/slot/phase/cost/
-    when), its virtual clock, and the ReqParams flowing into every
-    ingest must match the host composition exactly."""
+    per-client host ServiceTrackers (Orig or Borrowing accounting) fed
+    the same arrival schedule: per round, every server's full
+    k-decision stream (type/slot/phase/cost/when), its virtual clock,
+    and the ReqParams flowing into every ingest must match the host
+    composition exactly."""
     from dmclock_tpu.core import ClientInfo, PullPriorityQueue, ReqParams
     from dmclock_tpu.core.scheduler import NextReqType
 
@@ -165,7 +168,8 @@ def test_cluster_step_matches_independent_host_sims(mesh8):
              for c in range(n_clients)]
 
     # --- device cluster
-    cl = CL.init_cluster(n_servers, n_clients)
+    cl = CL.init_cluster(n_servers, n_clients,
+                         tracker_kind=tracker_kind)
     cl = CL.install_clients(
         cl,
         jnp.asarray([i.reservation_inv_ns for i in infos], jnp.int64),
@@ -181,7 +185,9 @@ def test_cluster_step_matches_independent_host_sims(mesh8):
                                 delayed_tag_calc=True,
                                 run_gc_thread=False)
               for s in range(n_servers)]
-    trackers = [ServiceTracker(run_gc_thread=False)
+    host_cls = {"orig": OrigTracker,
+                "borrowing": BorrowingTracker}[tracker_kind]
+    trackers = [ServiceTracker(tracker_cls=host_cls, run_gc_thread=False)
                 for _ in range(n_clients)]
     host_now = [0] * n_servers
 
