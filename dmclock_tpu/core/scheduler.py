@@ -645,33 +645,47 @@ class PushPriorityQueue(PriorityQueueBase[C, R]):
     ``can_handle_f()`` is true and a request is eligible; timed wakeups
     for future-eligible requests run on a dedicated sched-ahead thread
     (reference run_sched_ahead :1760-1786).
+
+    Virtual-time embedding (the discrete-event sim): pass ``now_ns_f``
+    (the simulated clock) and ``sched_at_f`` (schedules a callback that
+    must invoke ``sched_ahead_fire()`` at the given virtual time -- it
+    disarms the deduplicated deadline before re-evaluating); no
+    sched-ahead thread is spawned then, and scheduling decisions and
+    default arrival stamps read the virtual clock.
     """
 
     def __init__(self, client_info_f: ClientInfoFunc,
                  can_handle_f: Callable[[], bool],
                  handle_f: Callable[[Any, Any, Phase, Cost], None],
+                 now_ns_f: Optional[Callable[[], int]] = None,
+                 sched_at_f: Optional[Callable[[int], None]] = None,
                  **kwargs):
         super().__init__(client_info_f, **kwargs)
         self.can_handle_f = can_handle_f
         self.handle_f = handle_f
+        self._now_ns_f = now_ns_f or _now_ns
+        self._sched_at_f = sched_at_f
         self._sched_ahead_cv = threading.Condition()
         self._sched_ahead_when = TIME_ZERO  # ns
-        self._sched_ahead_thd = threading.Thread(
-            target=self._run_sched_ahead, daemon=True,
-            name="dmclock-sched-ahead")
-        self._sched_ahead_thd.start()
+        self._sched_ahead_thd = None
+        if sched_at_f is None:
+            self._sched_ahead_thd = threading.Thread(
+                target=self._run_sched_ahead, daemon=True,
+                name="dmclock-sched-ahead")
+            self._sched_ahead_thd.start()
 
     def shutdown(self) -> None:
         super().shutdown()
         with self._sched_ahead_cv:
             self._sched_ahead_cv.notify_all()
-        self._sched_ahead_thd.join()
+        if self._sched_ahead_thd is not None:
+            self._sched_ahead_thd.join()
 
     def add_request(self, request: Any, client_id: Any,
                     req_params: ReqParams = ReqParams(),
                     time_ns: Optional[int] = None, cost: int = 1) -> int:
         if time_ns is None:
-            time_ns = _now_ns()
+            time_ns = self._now_ns_f()
         with self.data_mtx:
             r = self._do_add_request(request, client_id, req_params,
                                      time_ns, cost)
@@ -706,21 +720,36 @@ class PushPriorityQueue(PriorityQueueBase[C, R]):
         # (next_request :1729-1737)
         if not self.can_handle_f():
             return
-        nxt = self._do_next_request(_now_ns())
+        nxt = self._do_next_request(self._now_ns_f())
         if nxt.type is NextReqType.RETURNING:
             self._submit_request(nxt.heap_id)
         elif nxt.type is NextReqType.FUTURE:
             self._sched_at(nxt.when_ready)
 
     def _sched_at(self, when_ns: int) -> None:
-        # reference sched_at (:1789-1796)
+        # reference sched_at (:1789-1796); with a virtual sched_at_f
+        # the armed-deadline dedup still applies, and the embedder's
+        # timed callback must invoke sched_ahead_fire()
         with self._sched_ahead_cv:
             if self.finishing:
                 return
             if self._sched_ahead_when == TIME_ZERO or \
                     when_ns < self._sched_ahead_when:
                 self._sched_ahead_when = when_ns
-                self._sched_ahead_cv.notify_all()
+                if self._sched_at_f is not None:
+                    self._sched_at_f(when_ns)
+                else:
+                    self._sched_ahead_cv.notify_all()
+
+    def sched_ahead_fire(self) -> None:
+        """Virtual-time embedding: the ``sched_at_f`` callback landed --
+        disarm and re-evaluate scheduling at the (virtual) now."""
+        with self._sched_ahead_cv:
+            if self.finishing:
+                return
+            self._sched_ahead_when = TIME_ZERO
+        with self.data_mtx:
+            self._schedule_request()
 
     def _run_sched_ahead(self) -> None:
         # reference run_sched_ahead (:1760-1786); the armed deadline is

@@ -22,11 +22,18 @@ from ..sim.ssched import NullServiceTracker, SimpleQueue
 QueueFactory = Callable
 
 _REGISTRY: Dict[str, Tuple[Callable, Callable]] = {}
+_PUSH_REGISTRY: Dict[str, Callable] = {}
 
 
 def register(name: str, queue_factory: Callable,
              tracker_factory: Callable) -> None:
     _REGISTRY[name] = (queue_factory, tracker_factory)
+
+
+def register_push(name: str, queue_factory: Callable) -> None:
+    """Push-mode factory: (server_id, info_f, anticipation_ns, soft, *,
+    can_handle_f, handle_f, now_ns_f, sched_at_f) -> push queue."""
+    _PUSH_REGISTRY[name] = queue_factory
 
 
 def get(name: str) -> Tuple[Callable, Callable]:
@@ -36,8 +43,19 @@ def get(name: str) -> Tuple[Callable, Callable]:
     return _REGISTRY[name]
 
 
+def get_push(name: str) -> Callable:
+    if name not in _PUSH_REGISTRY:
+        raise KeyError(f"model {name!r} has no push-mode queue; "
+                       f"have {sorted(_PUSH_REGISTRY)}")
+    return _PUSH_REGISTRY[name]
+
+
 def names():
     return sorted(_REGISTRY)
+
+
+def push_names():
+    return sorted(_PUSH_REGISTRY)
 
 
 def _dmclock_queue(delayed: bool):
@@ -78,6 +96,26 @@ def _dmclock_native_queue(server_id, client_info_f, anticipation_ns,
         anticipation_timeout_ns=anticipation_ns)
 
 
+def _dmclock_push_queue(delayed: bool):
+    def factory(server_id, client_info_f, anticipation_ns, soft_limit,
+                *, can_handle_f, handle_f, now_ns_f, sched_at_f):
+        from ..core import PushPriorityQueue
+        return PushPriorityQueue(
+            client_info_f, can_handle_f, handle_f,
+            now_ns_f=now_ns_f, sched_at_f=sched_at_f,
+            delayed_tag_calc=delayed,
+            at_limit=AtLimit.ALLOW if soft_limit else AtLimit.WAIT,
+            anticipation_timeout_ns=anticipation_ns,
+            run_gc_thread=False)
+    return factory
+
+
+def _ssched_push_queue(server_id, client_info_f, anticipation_ns,
+                       soft_limit, *, can_handle_f, handle_f, now_ns_f,
+                       sched_at_f):
+    return SimpleQueue(can_handle_f=can_handle_f, handle_f=handle_f)
+
+
 register("dmclock", _dmclock_queue(delayed=False), _dmclock_tracker)
 register("dmclock-delayed", _dmclock_queue(delayed=True), _dmclock_tracker)
 register("dmclock-tpu", _dmclock_tpu_queue, _dmclock_tracker)
@@ -86,3 +124,6 @@ register("ssched",
          lambda server_id, client_info_f, anticipation_ns, soft_limit:
          SimpleQueue(),
          NullServiceTracker)
+register_push("dmclock", _dmclock_push_queue(delayed=False))
+register_push("dmclock-delayed", _dmclock_push_queue(delayed=True))
+register_push("ssched", _ssched_push_queue)

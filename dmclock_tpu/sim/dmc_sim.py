@@ -20,10 +20,15 @@ from .harness import Simulation
 
 
 def run_sim(cfg: SimConfig, model: str = "dmclock", seed: int = 12345,
-            record_trace: bool = False) -> Simulation:
-    queue_factory, tracker_factory = models.get(model)
+            record_trace: bool = False,
+            server_mode: str = "pull") -> Simulation:
+    _pull_factory, tracker_factory = models.get(model)
+    if server_mode == "push":
+        queue_factory = models.get_push(model)
+    else:
+        queue_factory = _pull_factory
     sim = Simulation(cfg, queue_factory, tracker_factory, seed=seed,
-                     record_trace=record_trace)
+                     record_trace=record_trace, server_mode=server_mode)
     sim.run()
     return sim
 
@@ -36,15 +41,25 @@ def main(argv=None) -> int:
     p.add_argument("--model", default="dmclock", choices=models.names(),
                    help="scheduler model to simulate")
     p.add_argument("--seed", type=int, default=12345)
+    p.add_argument("--server-mode", default="pull",
+                   choices=("pull", "push"),
+                   help="drive servers by polling (pull) or let the "
+                   "queue push via handle_f (the reference dmc_sim's "
+                   "mode)")
     p.add_argument("--intervals", action="store_true",
                    help="print per-client per-second op counts")
     args = p.parse_args(argv)
 
+    if args.server_mode == "push" and \
+            args.model not in models.push_names():
+        p.error(f"model {args.model!r} has no push-mode queue "
+                f"(push models: {', '.join(models.push_names())})")
     try:
         cfg = parse_config_file(args.conf) if args.conf else SimConfig()
     except OSError as e:
         p.error(f"cannot read config file: {e}")
-    sim = run_sim(cfg, model=args.model, seed=args.seed)
+    sim = run_sim(cfg, model=args.model, seed=args.seed,
+                  server_mode=args.server_mode)
     print(sim.report().format(show_intervals=args.intervals))
     return 0
 

@@ -76,6 +76,28 @@ class ServerStats:
     request_complete_timer: ProfileTimer = field(default_factory=ProfileTimer)
 
 
+def _op_time_ns(threads: int, iops: float) -> int:
+    """Per-op service time; the reference rounds to whole microseconds
+    (sim_server.h:137-139)."""
+    return int(0.5 + threads * 1e6 / iops) * 1000
+
+
+def _record_service(server, client, phase: Phase, cost: int) -> None:
+    """Shared serve bookkeeping (trace row + per-phase stats) for both
+    server drive modes -- pull/push trace equality depends on the two
+    modes recording identically."""
+    if server.trace is not None:
+        server.trace.append((server.loop.now_ns, server.id, client,
+                             int(phase), cost))
+    phase_idx = server.stats.per_client_phase.setdefault(client, [0, 0])
+    phase_idx[int(phase)] += 1
+    server.stats.ops_completed += 1
+    if phase is Phase.RESERVATION:
+        server.stats.reservation_ops += 1
+    else:
+        server.stats.priority_ops += 1
+
+
 class SimulatedServer:
     """Service station behind a QoS queue
     (reference SimulatedServer, sim_server.h:31-242).
@@ -94,8 +116,7 @@ class SimulatedServer:
         self.loop = loop
         self.client_resp_f = client_resp_f
         self.threads = threads
-        # reference rounds to whole microseconds (sim_server.h:137-139)
-        self.op_time_ns = int(0.5 + threads * 1e6 / iops) * 1000
+        self.op_time_ns = _op_time_ns(threads, iops)
         self.busy = 0
         self.stats = ServerStats()
         self.trace = trace
@@ -132,17 +153,7 @@ class SimulatedServer:
         self._dispatch()
 
     def _start_service(self, pr) -> None:
-        if self.trace is not None:
-            self.trace.append((self.loop.now_ns, self.id, pr.client,
-                               int(pr.phase), pr.cost))
-        phase_idx = self.stats.per_client_phase.setdefault(
-            pr.client, [0, 0])
-        phase_idx[int(pr.phase)] += 1
-        self.stats.ops_completed += 1
-        if pr.phase is Phase.RESERVATION:
-            self.stats.reservation_ops += 1
-        else:
-            self.stats.priority_ops += 1
+        _record_service(self, pr.client, pr.phase, pr.cost)
 
         def complete(client=pr.client, request=pr.request,
                      phase=pr.phase, cost=pr.cost):
@@ -156,6 +167,68 @@ class SimulatedServer:
             self._dispatch()
 
         self.loop.after(self.op_time_ns * pr.cost, complete)
+
+
+class PushSimulatedServer:
+    """Push-mode service station: the QUEUE drives dispatch through
+    ``handle_f`` under a ``can_handle`` gate, with timed wakeups via the
+    queue's sched-ahead seam -- the mode the reference's dmc_sim
+    actually runs (``test_dmclock.h:38-56`` binds PushPriorityQueue;
+    server glue ``sim_server.h:162-241``).
+
+    Dispatch pacing follows the reference: one dispatch per trigger
+    (add, completion, sched-ahead wakeup).  With ``threads == 1`` the
+    decision stream is identical to the pull server's; with more
+    threads a same-instant burst may serve one request per trigger
+    instead of greedily draining, exactly like the reference.
+    """
+
+    def __init__(self, server_id: Any, iops: float, threads: int,
+                 make_queue, loop: EventLoop,
+                 client_resp_f: Callable[[Any, Any, Phase, int, Any], None],
+                 trace: Optional[list] = None):
+        self.id = server_id
+        self.loop = loop
+        self.client_resp_f = client_resp_f
+        self.threads = threads
+        self.op_time_ns = _op_time_ns(threads, iops)
+        self.busy = 0
+        self.stats = ServerStats()
+        self.trace = trace
+        # make_queue(can_handle_f, handle_f, now_ns_f, sched_at_f)
+        self.queue = make_queue(
+            can_handle_f=lambda: self.busy < self.threads,
+            handle_f=self._handle,
+            now_ns_f=lambda: self.loop.now_ns,
+            sched_at_f=self._sched_at)
+
+    def post(self, request: Any, client_id: Any, req_params: ReqParams,
+             cost: int) -> None:
+        t = self.stats.add_request_timer
+        t.start()
+        self.queue.add_request(request, client_id, req_params,
+                               time_ns=self.loop.now_ns, cost=cost)
+        t.stop()
+
+    def _sched_at(self, when_ns: int) -> None:
+        self.loop.at(max(when_ns, self.loop.now_ns),
+                     self.queue.sched_ahead_fire)
+
+    # invoked BY the queue (under its lock) when it dispatches a request
+    def _handle(self, client: Any, request: Any, phase: Phase,
+                cost: int) -> None:
+        self.busy += 1
+        _record_service(self, client, phase, cost)
+
+        def complete():
+            self.busy -= 1
+            self.client_resp_f(client, request, phase, cost, self.id)
+            t = self.stats.request_complete_timer
+            t.start()
+            self.queue.request_completed()
+            t.stop()
+
+        self.loop.after(self.op_time_ns * cost, complete)
 
 
 # ----------------------------------------------------------------------
@@ -264,7 +337,10 @@ class Simulation:
     """
 
     def __init__(self, cfg: SimConfig, queue_factory, tracker_factory,
-                 seed: int = 12345, record_trace: bool = False):
+                 seed: int = 12345, record_trace: bool = False,
+                 server_mode: str = "pull"):
+        assert server_mode in ("pull", "push")
+        self.server_mode = server_mode
         self.cfg = cfg
         self.loop = EventLoop()
         self.trace: Optional[list] = [] if record_trace else None
@@ -295,15 +371,27 @@ class Simulation:
         def client_info_f(c):
             return self._infos[self.client_group_of[c]]
 
-        self.servers: Dict[int, SimulatedServer] = {}
+        self.servers: Dict[int, Any] = {}
         anticipation_ns = int(cfg.anticipation_timeout_s * NS_PER_SEC)
         for s in range(self.n_servers):
             g = cfg.srv_group[self.server_group_of[s]]
-            q = queue_factory(s, client_info_f, anticipation_ns,
-                              cfg.server_soft_limit)
-            self.servers[s] = SimulatedServer(
-                s, g.server_iops, g.server_threads, q, self.loop,
-                self._client_resp, trace=self.trace)
+            if server_mode == "push":
+                # queue_factory here has the push signature:
+                # (server_id, info_f, ant_ns, soft, *, can_handle_f,
+                #  handle_f, now_ns_f, sched_at_f) -> push queue
+                def make_queue(s=s, **cb):
+                    return queue_factory(s, client_info_f,
+                                         anticipation_ns,
+                                         cfg.server_soft_limit, **cb)
+                self.servers[s] = PushSimulatedServer(
+                    s, g.server_iops, g.server_threads, make_queue,
+                    self.loop, self._client_resp, trace=self.trace)
+            else:
+                q = queue_factory(s, client_info_f, anticipation_ns,
+                                  cfg.server_soft_limit)
+                self.servers[s] = SimulatedServer(
+                    s, g.server_iops, g.server_threads, q, self.loop,
+                    self._client_resp, trace=self.trace)
 
         self.clients: Dict[int, SimulatedClient] = {}
         for c in range(self.n_clients):
