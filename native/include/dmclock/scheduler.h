@@ -651,14 +651,31 @@ class PushPriorityQueue : public PriorityQueueBase<C, R> {
   using CanHandleFunc = std::function<bool()>;
   using HandleFunc = std::function<void(const C&, R&&, Phase, Cost)>;
 
+  using NowFunc = std::function<TimeNs()>;
+  using SchedAtFunc = std::function<void(TimeNs)>;
+
   PushPriorityQueue(typename Base::ClientInfoFunc info_f,
                     CanHandleFunc can_handle_f, HandleFunc handle_f,
                     const typename Base::Options& opt)
       : Base(std::move(info_f), opt),
         can_handle_f_(std::move(can_handle_f)),
-        handle_f_(std::move(handle_f)) {
+        handle_f_(std::move(handle_f)),
+        now_f_(get_time_ns) {
     sched_ahead_thd_ = std::thread([this] { run_sched_ahead(); });
   }
+
+  // Virtual-time embedding (the discrete-event sim): scheduling reads
+  // now_f; sched_at_f must arrange a later call to sched_ahead_fire()
+  // at the given virtual time.  No sched-ahead thread is spawned.
+  PushPriorityQueue(typename Base::ClientInfoFunc info_f,
+                    CanHandleFunc can_handle_f, HandleFunc handle_f,
+                    NowFunc now_f, SchedAtFunc sched_at_f,
+                    const typename Base::Options& opt)
+      : Base(std::move(info_f), opt),
+        can_handle_f_(std::move(can_handle_f)),
+        handle_f_(std::move(handle_f)),
+        now_f_(std::move(now_f)),
+        sched_at_f_(std::move(sched_at_f)) {}
 
   ~PushPriorityQueue() override {
     this->finishing_ = true;
@@ -672,7 +689,7 @@ class PushPriorityQueue : public PriorityQueueBase<C, R> {
   int add_request(R request, const C& client,
                   const ReqParams& params = ReqParams(),
                   TimeNs time_ns = -1, Cost cost = 1) {
-    if (time_ns < 0) time_ns = get_time_ns();
+    if (time_ns < 0) time_ns = now_f_();
     std::lock_guard<std::mutex> g(this->data_mtx_);
     int r = this->do_add_request(std::move(request), client, params,
                                  time_ns, cost);
@@ -681,6 +698,18 @@ class PushPriorityQueue : public PriorityQueueBase<C, R> {
   }
 
   void request_completed() {
+    std::lock_guard<std::mutex> g(this->data_mtx_);
+    schedule_request();
+  }
+
+  // virtual-time embedding: the sched_at_f callback landed -- disarm
+  // and re-evaluate at the (virtual) now
+  void sched_ahead_fire() {
+    {
+      std::lock_guard<std::mutex> g(sched_ahead_mtx_);
+      if (this->finishing_) return;
+      sched_ahead_when_ = TIME_ZERO;
+    }
     std::lock_guard<std::mutex> g(this->data_mtx_);
     schedule_request();
   }
@@ -712,7 +741,7 @@ class PushPriorityQueue : public PriorityQueueBase<C, R> {
   // reference schedule_request (:1741-1755); data_mtx held
   void schedule_request() {
     if (!can_handle_f_()) return;
-    TimeNs now = get_time_ns();
+    TimeNs now = now_f_();
     NextReq next = this->do_next_request(now);
     switch (next.type) {
       case NextReqType::returning:
@@ -726,13 +755,15 @@ class PushPriorityQueue : public PriorityQueueBase<C, R> {
     }
   }
 
-  // reference sched_at (:1789-1796)
+  // reference sched_at (:1789-1796); with a virtual sched_at_f the
+  // armed-deadline dedup still applies
   void sched_at(TimeNs when) {
     std::lock_guard<std::mutex> g(sched_ahead_mtx_);
     if (this->finishing_) return;
     if (sched_ahead_when_ == TIME_ZERO || when < sched_ahead_when_) {
       sched_ahead_when_ = when;
-      sched_ahead_cv_.notify_all();
+      if (sched_at_f_) sched_at_f_(when);
+      else sched_ahead_cv_.notify_all();
     }
   }
 
@@ -763,6 +794,8 @@ class PushPriorityQueue : public PriorityQueueBase<C, R> {
 
   CanHandleFunc can_handle_f_;
   HandleFunc handle_f_;
+  NowFunc now_f_;
+  SchedAtFunc sched_at_f_;
   std::mutex sched_ahead_mtx_;
   std::condition_variable sched_ahead_cv_;
   TimeNs sched_ahead_when_ = TIME_ZERO;

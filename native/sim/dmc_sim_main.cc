@@ -28,11 +28,13 @@ using qos_sim::ServerId;
 using qos_sim::SimConfig;
 
 using DmcQueue = dmclock::PullPriorityQueue<ClientId, ReqId>;
+using DmcPushQueue = dmclock::PushPriorityQueue<ClientId, ReqId>;
 using DmcTracker = dmclock::ServiceTracker<ServerId>;
 
 struct Args {
   std::string conf;
   std::string model = "dmclock";
+  std::string server_mode = "pull";
   uint64_t seed = 12345;
   unsigned k_way = 2;  // heap branching (reference K_WAY_HEAP,
                        // sim/CMakeLists.txt:1-10 -- runtime here)
@@ -43,7 +45,8 @@ struct Args {
 int usage(const char* prog) {
   fprintf(stderr,
           "usage: %s -c CONF [--model dmclock|dmclock-delayed|ssched] "
-          "[--seed N] [--k-way K] [--intervals] [--trace]\n",
+          "[--server-mode pull|push] [--seed N] [--k-way K] "
+          "[--intervals] [--trace]\n",
           prog);
   return 2;
 }
@@ -61,23 +64,52 @@ int finish(Sim& sim, const Args& args) {
   return 0;
 }
 
+static DmcQueue::Options make_opts(bool delayed, unsigned k_way,
+                                   int64_t anticipation_ns,
+                                   bool soft_limit) {
+  DmcQueue::Options opt;
+  opt.delayed_tag_calc = delayed;
+  opt.heap_branching = k_way;
+  // soft limit -> Allow, hard -> Wait (reference
+  // test_dmclock_main.cc:190-198 create_queue_f)
+  opt.at_limit = soft_limit ? dmclock::AtLimit::Allow
+                            : dmclock::AtLimit::Wait;
+  opt.anticipation_timeout_ns = anticipation_ns;
+  opt.run_gc_thread = false;
+  return opt;
+}
+
 int run_dmclock(const SimConfig& cfg, const Args& args, bool delayed) {
   unsigned k_way = args.k_way;
+  if (args.server_mode == "push") {
+    qos_sim::Simulation<DmcPushQueue, DmcTracker> sim(
+        cfg, nullptr, [] { return std::make_unique<DmcTracker>(); },
+        args.seed, args.trace,
+        [delayed, k_way](
+            ServerId,
+            std::function<dmclock::ClientInfo(const ClientId&)> info_f,
+            int64_t anticipation_ns, bool soft_limit,
+            std::function<bool()> can_handle,
+            std::function<void(const ClientId&, ReqId&&, dmclock::Phase,
+                               dmclock::Cost)>
+                handle,
+            std::function<int64_t()> now_f,
+            std::function<void(int64_t)> sched_at) {
+          return std::make_unique<DmcPushQueue>(
+              std::move(info_f), std::move(can_handle),
+              std::move(handle), std::move(now_f), std::move(sched_at),
+              make_opts(delayed, k_way, anticipation_ns, soft_limit));
+        });
+    return finish(sim, args);
+  }
   qos_sim::Simulation<DmcQueue, DmcTracker> sim(
       cfg,
       [delayed, k_way](ServerId, std::function<dmclock::ClientInfo(
                                      const ClientId&)> info_f,
                        int64_t anticipation_ns, bool soft_limit) {
-        DmcQueue::Options opt;
-        opt.delayed_tag_calc = delayed;
-        opt.heap_branching = k_way;
-        // soft limit -> Allow, hard -> Wait (reference
-        // test_dmclock_main.cc:190-198 create_queue_f)
-        opt.at_limit = soft_limit ? dmclock::AtLimit::Allow
-                                  : dmclock::AtLimit::Wait;
-        opt.anticipation_timeout_ns = anticipation_ns;
-        opt.run_gc_thread = false;
-        return std::make_unique<DmcQueue>(std::move(info_f), opt);
+        return std::make_unique<DmcQueue>(
+            std::move(info_f),
+            make_opts(delayed, k_way, anticipation_ns, soft_limit));
       },
       [] { return std::make_unique<DmcTracker>(); }, args.seed,
       args.trace);
@@ -85,14 +117,31 @@ int run_dmclock(const SimConfig& cfg, const Args& args, bool delayed) {
 }
 
 int run_ssched(const SimConfig& cfg, const Args& args) {
-  qos_sim::Simulation<qos_sim::SimpleQueue, qos_sim::NullServiceTracker>
-      sim(
-          cfg,
-          [](ServerId,
-             std::function<dmclock::ClientInfo(const ClientId&)>,
-             int64_t, bool) { return std::make_unique<qos_sim::SimpleQueue>(); },
-          [] { return std::make_unique<qos_sim::NullServiceTracker>(); },
-          args.seed, args.trace);
+  using SQ = qos_sim::SimpleQueue;
+  if (args.server_mode == "push") {
+    qos_sim::Simulation<SQ, qos_sim::NullServiceTracker> sim(
+        cfg, nullptr,
+        [] { return std::make_unique<qos_sim::NullServiceTracker>(); },
+        args.seed, args.trace,
+        [](ServerId,
+           std::function<dmclock::ClientInfo(const ClientId&)>, int64_t,
+           bool, std::function<bool()> can_handle,
+           std::function<void(const ClientId&, ReqId&&, dmclock::Phase,
+                              dmclock::Cost)>
+               handle,
+           std::function<int64_t()>, std::function<void(int64_t)>) {
+          return std::make_unique<SQ>(std::move(can_handle),
+                                      std::move(handle));
+        });
+    return finish(sim, args);
+  }
+  qos_sim::Simulation<SQ, qos_sim::NullServiceTracker> sim(
+      cfg,
+      [](ServerId,
+         std::function<dmclock::ClientInfo(const ClientId&)>,
+         int64_t, bool) { return std::make_unique<SQ>(); },
+      [] { return std::make_unique<qos_sim::NullServiceTracker>(); },
+      args.seed, args.trace);
   return finish(sim, args);
 }
 
@@ -110,6 +159,11 @@ int main(int argc, char** argv) {
     } else if (!strcmp(argv[i], "--seed")) {
       if (++i >= argc) return usage(argv[0]);
       args.seed = strtoull(argv[i], nullptr, 10);
+    } else if (!strcmp(argv[i], "--server-mode")) {
+      if (++i >= argc) return usage(argv[0]);
+      args.server_mode = argv[i];
+      if (args.server_mode != "pull" && args.server_mode != "push")
+        return usage(argv[0]);
     } else if (!strcmp(argv[i], "--k-way")) {
       if (++i >= argc) return usage(argv[0]);
       args.k_way = (unsigned)strtoul(argv[i], nullptr, 10);

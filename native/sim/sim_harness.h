@@ -20,6 +20,7 @@
 #include <queue>
 #include <set>
 #include <string>
+#include <type_traits>
 #include <vector>
 
 #include "dmclock/profile.h"
@@ -105,11 +106,38 @@ struct ServerStats {
   ProfileTimer request_complete_timer;
 };
 
+// does the queue type expose the push surface (handle_f dispatch +
+// sched_ahead_fire)?  Guards template instantiation so pull-only queue
+// types never reference push members and vice versa.
+template <typename Q, typename = void>
+struct has_push_surface : std::false_type {};
+template <typename Q>
+struct has_push_surface<
+    Q, std::void_t<decltype(std::declval<Q&>().sched_ahead_fire())>>
+    : std::true_type {};
+
+template <typename Q, typename = void>
+struct has_pull_surface : std::false_type {};
+template <typename Q>
+struct has_pull_surface<
+    Q, std::void_t<decltype(std::declval<Q&>().pull_request(int64_t{}))>>
+    : std::true_type {};
+
+// drive-mode-agnostic server surface (the harness only posts and reads
+// stats), so pull and push servers mix behind one Simulation
+struct ISimServer {
+  virtual ~ISimServer() = default;
+  virtual void post(ReqId request, ClientId client, const ReqParams& rp,
+                    uint32_t cost) = 0;
+  ServerStats stats;
+};
+
+using ClientRespF =
+    std::function<void(ClientId, ReqId, Phase, uint32_t, ServerId)>;
+
 template <typename Queue>
-class SimulatedServer {
+class SimulatedServer : public ISimServer {
  public:
-  using ClientRespF =
-      std::function<void(ClientId, ReqId, Phase, uint32_t, ServerId)>;
 
   SimulatedServer(ServerId id, double iops, int threads,
                   std::unique_ptr<Queue> queue, EventLoop* loop,
@@ -125,7 +153,7 @@ class SimulatedServer {
         trace_(trace) {}
 
   void post(ReqId request, ClientId client, const ReqParams& rp,
-            uint32_t cost) {
+            uint32_t cost) override {
     stats.add_request_timer.start();
     queue_->add_request(request, client, rp, loop_->now_ns, cost);
     stats.add_request_timer.stop();
@@ -133,7 +161,6 @@ class SimulatedServer {
   }
 
   Queue& queue() { return *queue_; }
-  ServerStats stats;
 
  private:
   void dispatch() {
@@ -195,6 +222,87 @@ class SimulatedServer {
   int busy_ = 0;
   bool wake_armed_ = false;
   int64_t wake_at_ = 0;
+};
+
+// ---------------------------------------------------------------------
+// push-mode server (harness.py PushSimulatedServer): the QUEUE drives
+// dispatch through handle_f -- the mode the reference's dmc_sim runs
+// (test_dmclock.h:38-56).  One dispatch per trigger; with threads == 1
+// the decision stream equals the pull server's.
+// ---------------------------------------------------------------------
+
+template <typename Queue>
+class PushSimulatedServer : public ISimServer {
+ public:
+  // make_queue(can_handle_f, handle_f, now_f, sched_at_f)
+  using MakeQueueF = std::function<std::unique_ptr<Queue>(
+      std::function<bool()>,
+      std::function<void(const ClientId&, ReqId&&, Phase, uint32_t)>,
+      std::function<int64_t()>, std::function<void(int64_t)>)>;
+
+  PushSimulatedServer(ServerId id, double iops, int threads,
+                      const MakeQueueF& make_queue, EventLoop* loop,
+                      ClientRespF client_resp_f,
+                      std::vector<TraceOp>* trace)
+      : id_(id),
+        threads_(threads),
+        op_time_ns_(static_cast<int64_t>(0.5 + threads * 1e6 / iops) *
+                    1000),
+        loop_(loop),
+        client_resp_f_(std::move(client_resp_f)),
+        trace_(trace) {
+    queue_ = make_queue(
+        [this] { return busy_ < threads_; },
+        [this](const ClientId& c, ReqId&& r, Phase p, uint32_t cost) {
+          handle(c, std::move(r), p, cost);
+        },
+        [this] { return loop_->now_ns; },
+        [this](int64_t when) {
+          int64_t t = when > loop_->now_ns ? when : loop_->now_ns;
+          loop_->at(t, [this] { queue_->sched_ahead_fire(); });
+        });
+  }
+
+  void post(ReqId request, ClientId client, const ReqParams& rp,
+            uint32_t cost) override {
+    stats.add_request_timer.start();
+    queue_->add_request(request, client, rp, loop_->now_ns, cost);
+    stats.add_request_timer.stop();
+  }
+
+  Queue& queue() { return *queue_; }
+
+ private:
+  // invoked BY the queue (under its lock) when it dispatches
+  void handle(ClientId client, ReqId request, Phase phase,
+              uint32_t cost) {
+    ++busy_;
+    if (trace_)
+      trace_->push_back(TraceOp{loop_->now_ns, id_, client,
+                                static_cast<int>(phase), cost});
+    ++stats.ops_completed;
+    if (phase == Phase::reservation)
+      ++stats.reservation_ops;
+    else
+      ++stats.priority_ops;
+    loop_->after(op_time_ns_ * cost,
+                 [this, client, request, phase, cost] {
+                   --busy_;
+                   client_resp_f_(client, request, phase, cost, id_);
+                   stats.request_complete_timer.start();
+                   queue_->request_completed();
+                   stats.request_complete_timer.stop();
+                 });
+  }
+
+  ServerId id_;
+  int threads_;
+  int64_t op_time_ns_;
+  std::unique_ptr<Queue> queue_;
+  EventLoop* loop_;
+  ClientRespF client_resp_f_;
+  std::vector<TraceOp>* trace_;
+  int busy_ = 0;
 };
 
 // ---------------------------------------------------------------------
@@ -312,10 +420,20 @@ class Simulation {
       int64_t anticipation_ns, bool soft_limit)>;
   using TrackerFactory = std::function<std::unique_ptr<Tracker>()>;
 
+  // push-mode queue factory: like QueueFactory plus the four server
+  // callbacks (can_handle, handle, now, sched_at)
+  using PushQueueFactory = std::function<std::unique_ptr<Queue>(
+      ServerId, std::function<dmclock::ClientInfo(const ClientId&)>,
+      int64_t, bool, std::function<bool()>,
+      std::function<void(const ClientId&, ReqId&&, Phase, uint32_t)>,
+      std::function<int64_t()>, std::function<void(int64_t)>)>;
+
   Simulation(const SimConfig& cfg, QueueFactory queue_factory,
              TrackerFactory tracker_factory, uint64_t seed,
-             bool record_trace)
-      : cfg_(cfg), rng_(seed) {
+             bool record_trace,
+             PushQueueFactory push_queue_factory = nullptr)
+      : cfg_(cfg), rng_(seed),
+        push_queue_factory_(std::move(push_queue_factory)) {
     if (record_trace) trace_ptr_ = &trace;
 
     for (size_t gi = 0; gi < cfg_.cli_group.size(); ++gi)
@@ -340,14 +458,48 @@ class Simulation {
         static_cast<int64_t>(cfg_.anticipation_timeout_s * NS_PER_SEC);
     for (int s = 0; s < n_servers_; ++s) {
       auto& g = cfg_.srv_group[server_group_of_[s]];
-      servers_.push_back(std::make_unique<SimulatedServer<Queue>>(
-          s, g.server_iops, g.server_threads,
-          queue_factory(s, info_f, anticipation_ns, cfg_.server_soft_limit),
-          &loop_,
-          [this](ClientId c, ReqId r, Phase p, uint32_t cost, ServerId sv) {
-            clients_[c]->receive_response(r, p, cost, sv);
-          },
-          trace_ptr_));
+      if (push_queue_factory_) {
+        if constexpr (!has_push_surface<Queue>::value) {
+          fprintf(stderr, "sim: queue type has no push surface\n");
+          abort();
+        } else {
+        auto mk = [this, s, info_f, anticipation_ns](
+                      std::function<bool()> can_handle,
+                      std::function<void(const ClientId&, ReqId&&, Phase,
+                                         uint32_t)>
+                          handle,
+                      std::function<int64_t()> now_f,
+                      std::function<void(int64_t)> sched_at) {
+          return push_queue_factory_(
+              s, info_f, anticipation_ns, cfg_.server_soft_limit,
+              std::move(can_handle), std::move(handle),
+              std::move(now_f), std::move(sched_at));
+        };
+        servers_.push_back(std::make_unique<PushSimulatedServer<Queue>>(
+            s, g.server_iops, g.server_threads, mk, &loop_,
+            [this](ClientId c, ReqId r, Phase p, uint32_t cost,
+                   ServerId sv) {
+              clients_[c]->receive_response(r, p, cost, sv);
+            },
+            trace_ptr_));
+        }
+      } else {
+        if constexpr (!has_pull_surface<Queue>::value) {
+          fprintf(stderr, "sim: queue type has no pull surface\n");
+          abort();
+        } else {
+        servers_.push_back(std::make_unique<SimulatedServer<Queue>>(
+            s, g.server_iops, g.server_threads,
+            queue_factory(s, info_f, anticipation_ns,
+                          cfg_.server_soft_limit),
+            &loop_,
+            [this](ClientId c, ReqId r, Phase p, uint32_t cost,
+                   ServerId sv) {
+              clients_[c]->receive_response(r, p, cost, sv);
+            },
+            trace_ptr_));
+        }
+      }
     }
 
     for (int c = 0; c < n_clients_; ++c) {
@@ -489,7 +641,8 @@ class Simulation {
   std::vector<int> client_group_of_;
   std::vector<int> server_group_of_;
   std::vector<dmclock::ClientInfo> infos_;
-  std::vector<std::unique_ptr<SimulatedServer<Queue>>> servers_;
+  std::vector<std::unique_ptr<ISimServer>> servers_;
+  PushQueueFactory push_queue_factory_;
   std::vector<std::unique_ptr<SimulatedClient<Tracker>>> clients_;
   std::set<ClientId> done_;
   std::vector<TraceOp>* trace_ptr_ = nullptr;

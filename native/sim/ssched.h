@@ -51,6 +51,10 @@ class SimpleQueue {
     if (handle_) schedule_request();
   }
 
+  // FIFO never defers (no FUTURE decisions), so the sched-ahead seam
+  // is a no-op; present so the push sim server template instantiates
+  void sched_ahead_fire() {}
+
   void schedule_request() {
     // at most ONE dispatch per call (reference pacing: one request per
     // add/completion event, ssched_server.h:184-191)

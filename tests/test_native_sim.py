@@ -36,10 +36,10 @@ def dmc_sim_native():
     return exe
 
 
-def native_trace(exe, conf, model, seed):
+def native_trace(exe, conf, model, seed, server_mode="pull"):
     out = subprocess.run(
         [str(exe), "-c", str(conf), "--model", model, "--seed",
-         str(seed), "--trace"],
+         str(seed), "--server-mode", server_mode, "--trace"],
         check=True, capture_output=True, text=True, timeout=300).stdout
     trace = []
     report = []
@@ -68,6 +68,63 @@ def test_trace_parity_native_vs_python(dmc_sim_native, conf, py_model,
     py_trace = [(t, s, c, p, co) for (t, s, c, p, co) in py.trace]
     nat_trace, _ = native_trace(dmc_sim_native, REPO / conf,
                                 native_model, seed)
+    assert len(py_trace) == len(nat_trace) > 0
+    for i, (a, b) in enumerate(zip(py_trace, nat_trace)):
+        assert a == b, f"trace diverges at op {i}: py={a} native={b}"
+
+
+@pytest.mark.parametrize("model", ["dmclock", "dmclock-delayed",
+                                   "ssched"])
+def test_push_trace_parity_native_vs_python(dmc_sim_native, model):
+    """Push-driven servers, cross-language: python --server-mode push
+    and native --server-mode push must produce the same bit-identical
+    trace as each other (and as pull mode, pinned separately)."""
+    conf = "configs/dmc_sim_example.conf"
+    cfg = parse_config_file(str(REPO / conf))
+    py = run_sim(cfg, model=model, seed=7, record_trace=True,
+                 server_mode="push")
+    py_trace = [(t, s, c, p, co) for (t, s, c, p, co) in py.trace]
+    nat_trace, _ = native_trace(dmc_sim_native, REPO / conf, model, 7,
+                                server_mode="push")
+    assert len(py_trace) == len(nat_trace) > 0
+    for i, (a, b) in enumerate(zip(py_trace, nat_trace)):
+        assert a == b, f"trace diverges at op {i}: py={a} native={b}"
+
+
+def test_push_trace_parity_multithread(dmc_sim_native, tmp_path):
+    """threads > 1: push pacing may legitimately diverge from pull, but
+    the python and native PUSH sims must still agree bit for bit."""
+    conf = tmp_path / "mt.conf"
+    conf.write_text("""\
+[global]
+server_groups = 1
+client_groups = 1
+server_random_selection = false
+server_soft_limit = false
+
+[server.0]
+server_count = 2
+server_iops = 160
+server_threads = 3
+
+[client.0]
+client_count = 4
+client_wait = 0
+client_total_ops = 400
+client_server_select_range = 2
+client_iops_goal = 200
+client_outstanding_ops = 16
+client_reservation = 10.0
+client_limit = 0.0
+client_weight = 1.0
+""")
+    cfg = parse_config_file(str(conf))
+    py = run_sim(cfg, model="dmclock-delayed", seed=5,
+                 record_trace=True, server_mode="push")
+    py_trace = [(t, s, c, p, co) for (t, s, c, p, co) in py.trace]
+    nat_trace, _ = native_trace(dmc_sim_native, conf,
+                                "dmclock-delayed", 5,
+                                server_mode="push")
     assert len(py_trace) == len(nat_trace) > 0
     for i, (a, b) in enumerate(zip(py_trace, nat_trace)):
         assert a == b, f"trace diverges at op {i}: py={a} native={b}"
