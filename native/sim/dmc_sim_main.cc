@@ -8,7 +8,8 @@
 // bit-compared against the Python sim by tests/test_native_sim.py).
 //
 // Usage: dmc_sim -c CONF [--model dmclock|dmclock-delayed|ssched]
-//                [--seed N] [--intervals] [--trace]
+//                [--server-mode pull|push] [--seed N] [--k-way K]
+//                [--intervals] [--trace]
 
 #include <cstdio>
 #include <cstring>
