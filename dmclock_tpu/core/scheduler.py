@@ -761,7 +761,8 @@ class PushPriorityQueue(PriorityQueueBase[C, R]):
                 if self._sched_ahead_when == TIME_ZERO:
                     self._sched_ahead_cv.wait()
                     continue
-                delay_s = (self._sched_ahead_when - _now_ns()) / NS_PER_SEC
+                delay_s = (self._sched_ahead_when
+                           - self._now_ns_f()) / NS_PER_SEC
                 if delay_s > 0:
                     self._sched_ahead_cv.wait(timeout=delay_s)
                     continue

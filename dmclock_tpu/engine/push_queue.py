@@ -41,12 +41,20 @@ class TpuPushPriorityQueue:
                  *,
                  capacity_f: Optional[Callable[[], int]] = None,
                  batch_max: int = 64,
+                 now_ns_f: Optional[Callable[[], int]] = None,
+                 sched_at_f: Optional[Callable[[int], None]] = None,
                  **pull_kwargs):
         self._q = TpuPullPriorityQueue(client_info_f, **pull_kwargs)
         self.can_handle_f = can_handle_f
         self.handle_f = handle_f
         self.capacity_f = capacity_f
         self.batch_max = batch_max
+        # virtual-time embedding (see the host PushPriorityQueue): the
+        # injected clock feeds scheduling decisions and default arrival
+        # stamps; sched_at_f must arrange a sched_ahead_fire() call at
+        # the given virtual time, and no sched-ahead thread is spawned
+        self._now_ns_f = now_ns_f or (lambda: sec_to_ns(_walltime.time()))
+        self._sched_at_f = sched_at_f
         self._finishing = False
         # serializes scheduling passes so handle_f invocations are
         # totally ordered (the oracle holds data_mtx across the whole
@@ -54,10 +62,12 @@ class TpuPushPriorityQueue:
         self._dispatch_mtx = threading.Lock()
         self._sched_cv = threading.Condition()
         self._sched_when = TIME_ZERO  # ns; 0 = unarmed
-        self._sched_thd = threading.Thread(
-            target=self._run_sched_ahead, daemon=True,
-            name="dmclock-tpu-sched-ahead")
-        self._sched_thd.start()
+        self._sched_thd = None
+        if sched_at_f is None:
+            self._sched_thd = threading.Thread(
+                target=self._run_sched_ahead, daemon=True,
+                name="dmclock-tpu-sched-ahead")
+            self._sched_thd.start()
 
     # ------------------------------------------------------------------
     # embedder API (mirrors oracle PushPriorityQueue)
@@ -65,6 +75,8 @@ class TpuPushPriorityQueue:
     def add_request(self, request: Any, client_id: Any,
                     req_params: ReqParams = ReqParams(),
                     time_ns: Optional[int] = None, cost: int = 1) -> int:
+        if time_ns is None:
+            time_ns = self._now_ns_f()
         r = self._q.add_request(request, client_id, req_params,
                                 time_ns=time_ns, cost=cost)
         if r == 0:
@@ -80,7 +92,8 @@ class TpuPushPriorityQueue:
         self._finishing = True
         with self._sched_cv:
             self._sched_cv.notify_all()
-        self._sched_thd.join()
+        if self._sched_thd is not None:
+            self._sched_thd.join()
         self._q.shutdown()
 
     # pass-through inspection / maintenance surface
@@ -130,7 +143,7 @@ class TpuPushPriorityQueue:
                     return
             else:
                 n = 1  # consult can_handle_f before every dispatch
-            now_ns = sec_to_ns(_walltime.time())
+            now_ns = self._now_ns_f()
             batch = self._q.pull_batch(now_ns, n)
             dispatched = 0
             for pr in batch:
@@ -151,14 +164,27 @@ class TpuPushPriorityQueue:
             # the can_handle gate before pulling again
 
     def _sched_at(self, when_ns: int) -> None:
-        # reference sched_at (:1789-1796)
+        # reference sched_at (:1789-1796); the armed-deadline dedup
+        # also gates the virtual sched_at_f path
         with self._sched_cv:
             if self._finishing:
                 return
             if self._sched_when == TIME_ZERO or \
                     when_ns < self._sched_when:
                 self._sched_when = when_ns
-                self._sched_cv.notify_all()
+                if self._sched_at_f is not None:
+                    self._sched_at_f(when_ns)
+                else:
+                    self._sched_cv.notify_all()
+
+    def sched_ahead_fire(self) -> None:
+        """Virtual-time embedding: the ``sched_at_f`` callback landed --
+        disarm and re-evaluate scheduling at the (virtual) now."""
+        with self._sched_cv:
+            if self._finishing:
+                return
+            self._sched_when = TIME_ZERO
+        self._schedule_request()
 
     def _run_sched_ahead(self) -> None:
         # reference run_sched_ahead (:1760-1786): the armed deadline is
@@ -168,8 +194,8 @@ class TpuPushPriorityQueue:
                 if self._sched_when == TIME_ZERO:
                     self._sched_cv.wait()
                     continue
-                delay_s = (self._sched_when - sec_to_ns(
-                    _walltime.time())) / NS_PER_SEC
+                delay_s = (self._sched_when
+                           - self._now_ns_f()) / NS_PER_SEC
                 if delay_s > 0:
                     self._sched_cv.wait(timeout=delay_s)
                     continue

@@ -116,6 +116,17 @@ def _ssched_push_queue(server_id, client_info_f, anticipation_ns,
     return SimpleQueue(can_handle_f=can_handle_f, handle_f=handle_f)
 
 
+def _dmclock_tpu_push_queue(server_id, client_info_f, anticipation_ns,
+                            soft_limit, *, can_handle_f, handle_f,
+                            now_ns_f, sched_at_f):
+    from ..engine import TpuPushPriorityQueue
+    return TpuPushPriorityQueue(
+        client_info_f, can_handle_f, handle_f,
+        now_ns_f=now_ns_f, sched_at_f=sched_at_f,
+        at_limit=AtLimit.ALLOW if soft_limit else AtLimit.WAIT,
+        anticipation_timeout_ns=anticipation_ns)
+
+
 register("dmclock", _dmclock_queue(delayed=False), _dmclock_tracker)
 register("dmclock-delayed", _dmclock_queue(delayed=True), _dmclock_tracker)
 register("dmclock-tpu", _dmclock_tpu_queue, _dmclock_tracker)
@@ -126,4 +137,5 @@ register("ssched",
          NullServiceTracker)
 register_push("dmclock", _dmclock_push_queue(delayed=False))
 register_push("dmclock-delayed", _dmclock_push_queue(delayed=True))
+register_push("dmclock-tpu", _dmclock_tpu_push_queue)
 register_push("ssched", _ssched_push_queue)

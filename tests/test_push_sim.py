@@ -57,3 +57,39 @@ def test_push_sched_ahead_wakeup_fires():
     assert st.ops_completed == 40
     # limit 20/s: 40 ops take ~2s of virtual time
     assert st.finish_time_ns >= int(1.8e9)
+
+
+def test_tpu_push_trace_matches_pull():
+    """The TPU engine behind the push surface, in virtual time: the
+    push-mode sim trace must equal the pull-mode TPU sim trace (scaled
+    example shape; the full configs are covered for the pull path by
+    test_sim_tpu_fullscale.py)."""
+    from dmclock_tpu.sim.config import ClientGroup, ServerGroup, SimConfig
+
+    groups = [
+        ClientGroup(client_count=1, client_total_ops=60, client_wait_s=0,
+                    client_iops_goal=200, client_outstanding_ops=32,
+                    client_reservation=0.0, client_limit=0.0,
+                    client_weight=1.0, client_server_select_range=1),
+        ClientGroup(client_count=1, client_total_ops=60, client_wait_s=1,
+                    client_iops_goal=200, client_outstanding_ops=32,
+                    client_reservation=0.0, client_limit=40.0,
+                    client_weight=1.0, client_server_select_range=1),
+        ClientGroup(client_count=1, client_total_ops=40, client_wait_s=0,
+                    client_iops_goal=100, client_outstanding_ops=16,
+                    client_reservation=0.0, client_limit=0.0,
+                    client_weight=2.0, client_req_cost=3,
+                    client_server_select_range=1),
+    ]
+    cfg = SimConfig(client_groups=len(groups), server_groups=1,
+                    server_random_selection=False,
+                    server_soft_limit=False, cli_group=groups,
+                    srv_group=[ServerGroup(server_count=1,
+                                           server_iops=160,
+                                           server_threads=1)])
+    pull = run_sim(cfg, model="dmclock-tpu", seed=7, record_trace=True)
+    push = run_sim(cfg, model="dmclock-tpu", seed=7, record_trace=True,
+                   server_mode="push")
+    assert len(pull.trace) == len(push.trace) > 0
+    for i, (a, b) in enumerate(zip(pull.trace, push.trace)):
+        assert a == b, f"tpu trace diverges at op {i}: pull={a} push={b}"
