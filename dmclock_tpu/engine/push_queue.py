@@ -40,6 +40,15 @@ class TpuPushPriorityQueue:
                  handle_f: Callable[[Any, Any, Phase, int], None],
                  *,
                  capacity_f: Optional[Callable[[], int]] = None,
+                 # capacity_f CONTRACT: when provided, can_handle_f()
+                 # must be equivalent to capacity_f() > 0.  A batch pops
+                 # up to capacity_f() requests from device state before
+                 # the handle_f calls run, re-consulting can_handle_f
+                 # only between batches -- so a gate that can close
+                 # mid-batch for reasons other than slot exhaustion
+                 # would see dispatches it meant to refuse (the
+                 # reference consults can_handle before every dispatch;
+                 # omit capacity_f to get that per-dispatch behavior).
                  batch_max: int = 64,
                  now_ns_f: Optional[Callable[[], int]] = None,
                  sched_at_f: Optional[Callable[[int], None]] = None,

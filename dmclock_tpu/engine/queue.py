@@ -374,14 +374,20 @@ class TpuPullPriorityQueue:
             rows = []
             for cid, slot in self._slot_of.items():
                 has_req = bool(st.active[slot]) and int(st.depth[slot]) > 0
+                # rows carry BOTH the raw proportion tag (displayed, so
+                # dumps diff cleanly against the oracle/native dumps,
+                # which print the raw tag) and the effective tag
+                # (raw + prop_delta, the actual ready-heap sort key)
+                raw_p = int(st.head_prop[slot])
                 rows.append((
                     cid, int(st.order[slot]), has_req,
-                    int(st.head_resv[slot]), int(st.head_prop[slot])
-                    + int(st.prop_delta[slot]), int(st.head_limit[slot]),
-                    bool(st.head_ready[slot])))
+                    int(st.head_resv[slot]),
+                    raw_p + int(st.prop_delta[slot]),
+                    int(st.head_limit[slot]),
+                    bool(st.head_ready[slot]), raw_p))
 
             def fmt(r):
-                cid, _o, has_req, rt, pt, lt, ready = r
+                cid, _o, has_req, rt, _eff, lt, ready, pt = r
                 return f"{cid}:" + (
                     f"R{rt}/P{pt}/L{lt}/{'ready' if ready else 'wait'}"
                     if has_req else "noreq")

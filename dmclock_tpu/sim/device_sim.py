@@ -363,17 +363,21 @@ def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
         slices=slices_per_launch))
     total_ops = int(np.asarray(sim.load.total_ops).sum())
     launches = 0
+    completed = 0
     for launches in range(1, max_launches + 1):
         sim = step(sim)
         completed = int(np.asarray(sim.served_resv).sum()
                         + np.asarray(sim.served_prop).sum())
         if completed >= total_ops:
             break
-    return sim, spec, format_report(cfg, sim, spec, launches)
+    return sim, spec, format_report(cfg, sim, spec, launches,
+                                    completed=completed,
+                                    total_ops=total_ops)
 
 
 def format_report(cfg: SimConfig, sim: DeviceSim, spec: DeviceSimSpec,
-                  launches: int) -> str:
+                  launches: int, *, completed: Optional[int] = None,
+                  total_ops: Optional[int] = None) -> str:
     sresv = np.asarray(sim.served_resv).sum(axis=0)   # [C]
     sprop = np.asarray(sim.served_prop).sum(axis=0)
     t_s = int(sim.t) / NS_PER_SEC
@@ -398,6 +402,11 @@ def format_report(cfg: SimConfig, sim: DeviceSim, spec: DeviceSimSpec,
             f"(res {int(sresv[sl].sum())} / prop {int(sprop[sl].sum())})"
             f" | done @ {finish_s:.2f}s | average {rate:.2f} ops/s")
         ci += g.client_count
+    if completed is not None and total_ops is not None \
+            and completed < total_ops:
+        # partial runs must not read as converged QoS shares
+        lines.append(f"INCOMPLETE: served {completed}/{total_ops} ops "
+                     f"after {launches} launches (raise --max-launches)")
     return "\n".join(lines)
 
 

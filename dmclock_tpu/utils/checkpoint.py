@@ -63,7 +63,39 @@ def queue_state_dict(q) -> dict:
 
 
 def restore_queue_state(q, st: dict) -> None:
+    """Restore host bookkeeping saved by ``queue_state_dict``.
+
+    Restore the device state FIRST (``q.state = restore_pytree(...)``),
+    then call this: cheap consistency checks against the restored
+    device state catch a mismatched pair of snapshots (payload FIFOs
+    desynced from device queue depths would silently hand out wrong
+    request payloads)."""
     from collections import deque
+
+    capacity = int(q.state.capacity)
+    depth = np.asarray(q.state.depth)
+    active = np.asarray(q.state.active)
+    for c, s in st["slot_of"].items():
+        if not 0 <= s < capacity:
+            raise ValueError(
+                f"restore mismatch: client {c!r} maps to slot {s}, "
+                f"device capacity {capacity}")
+    for s, d in st["payloads"].items():
+        if len(d) != int(depth[s]):
+            raise ValueError(
+                f"restore mismatch: slot {s} has {len(d)} payloads but "
+                f"device depth {int(depth[s])} -- device and host "
+                "snapshots are from different moments")
+    # ... and the other direction: every occupied device slot must be
+    # known to the host snapshot (a client admitted after the host
+    # snapshot was taken would otherwise KeyError at dispatch time)
+    occupied = np.flatnonzero(active & (depth > 0))
+    missing = [int(s) for s in occupied if s not in st["payloads"]]
+    if missing:
+        raise ValueError(
+            f"restore mismatch: device slots {missing} hold queued "
+            "requests but have no host payload FIFO -- device and host "
+            "snapshots are from different moments")
 
     with q.data_mtx:
         q._pending = []      # drop ops buffered against the old state

@@ -361,6 +361,10 @@ def test_display_queues_dump():
     # (it was created while client 1 was already active)
     assert ready.startswith("READY: 1:")
     assert "2:" in ready
+    # the displayed proportion tag is the RAW head tag (5e8), not the
+    # prop_delta-shifted effective sort key -- so dumps diff cleanly
+    # against the oracle/native dumps, which print the raw tag
+    assert f"P{5 * 10**8}/" in ready.split("2:")[1]
     # draining client 1 leaves it 'noreq', sorted last in every section
     pr = q.pull_request(now_ns=10**9)
     assert pr.client == 1
