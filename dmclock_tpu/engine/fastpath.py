@@ -48,7 +48,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..core.timebase import MAX_TAG
 from . import kernels
-from .kernels import KEY_INF, Decision, _make_tag, _fold_prev
+from .kernels import (KEY_INF, NONE, RETURNING, Decision, _make_tag,
+                      _fold_prev)
 from .state import EngineState
 
 
@@ -584,6 +585,290 @@ def scan_fast_epoch(state: EngineState, now, m: int, k: int, *,
     state = EngineState(**invariant, **mutable)
     return FastEpoch(state=state, ok=ok, slot=slot, phase=phase,
                      cost=cost)
+
+
+# ----------------------------------------------------------------------
+# prefix-commit speculation (round 3)
+#
+# The full sort in ``_sorted_selection`` already yields the ENTIRE
+# candidate service order, so all-or-nothing validation wastes it: when
+# a batch of k fails, some prefix of the sorted candidates was still
+# exactly what the serial engine would have served.  These entry points
+# compute that longest provably-safe prefix ON DEVICE and commit it --
+# turning every former fallback cliff (regime transitions, k past the
+# re-entry distance, underfull tails) into a shorter committed batch.
+# Guaranteed progress: whenever the serial engine would RETURN a
+# request at ``now``, the prefix is >= 1, so the serial engine is no
+# longer needed for recovery (only for the never-observed global
+# rebase-guard failures, via ``make_prefix_runner``).
+#
+# Exactness argument (differentially tested): candidates are served in
+# sorted (key, order) ascending order -- the serial engine's total
+# order.  Serving candidate p re-enters its client at a new key r_p
+# (its freshly-tagged next head; +inf if it empties or leaves the
+# candidate set).  The speculative order equals the serial order up to
+# position q iff   min_{p<q} r_p  >  (key_q, order_q)   for every
+# position <= q -- the serial engine would have picked the re-entered
+# head first otherwise.  Since keys ascend and the cumulative min only
+# descends, the condition fails monotonically: the first failing
+# position ends the exact prefix.  Regime-exit events (a weight-phase
+# serve making the client's reservation tag eligible, reference
+# do_next_request :1124-1128) are encoded as r_p = -inf, stopping the
+# prefix right after p.
+# ----------------------------------------------------------------------
+
+
+_O32_MASK = jnp.int64(0xFFFFFFFF)
+
+
+def _pack(k32, o32):
+    """Lexicographic (key, order) as one int64: key in the high word,
+    order (nonneg; masked against sign-extension for the garbage orders
+    of sentinel rows) in the low word."""
+    return (k32.astype(jnp.int64) << 32) | (o32.astype(jnp.int64)
+                                            & _O32_MASK)
+
+
+class PrefixBatch(NamedTuple):
+    """Result of one prefix-commit attempt."""
+
+    state: EngineState
+    count: jnp.ndarray     # int32: decisions committed (exact serial
+    #                        prefix; 0 = nothing eligible at `now`)
+    guards_ok: jnp.ndarray  # bool: rebase-window guards held; when
+    #                         False count is 0 and the caller must use
+    #                         the serial engine for this batch
+    decisions: Decision    # [k]; slots -1 / type NONE past `count`
+
+
+def _prefix_select(key, order, k: int, cost, reentry):
+    """Longest-exact-prefix selection over sorted (key, order).
+
+    ``key``     int64[N], KEY_INF for non-candidates.
+    ``reentry`` int64[N]: the key at which the client re-enters the
+                candidate order after one serve; KEY_INF when it leaves
+                the batch's candidate set; any negative value to force
+                the prefix to stop right after serving this client
+                (regime-exit blocker).
+    ``cost``    int64[N] (>= 0), ridden through the sort as int32.
+
+    Returns (idx, sel_cost, pk, pk_dense, elig_key, count_fn,
+    guards_ok) where ``idx``/``sel_cost``/``pk`` are the [k] sorted
+    candidate slots, costs and packed boundary keys, ``pk_dense`` is
+    the [N] packed key per client (for the dense commit-mask compare),
+    ``elig_key`` is the [k] absolute key per position (for eligibility
+    gates like resv <= now), and ``count_fn(elig_ok)`` finishes the
+    prefix computation given the per-position eligibility mask.
+    """
+    real = key < KEY_INF
+    kmin = jnp.min(jnp.where(real, key, KEY_INF))
+    krel = key - kmin
+    fits = real & (krel < _CLAMP32)
+    k32 = jnp.where(fits, krel,
+                    jnp.where(real, _CLAMP32, _SENT32)).astype(jnp.int32)
+    omin = jnp.min(jnp.where(real, order, jnp.int64(1) << 62))
+    o32 = (order - omin).astype(jnp.int32)
+    # re-entry key in the same rebased space: values past the window
+    # clamp high (harmless: every committable boundary is < _CLAMP32,
+    # and packed comparisons stay strict); blockers stay negative.  The
+    # KEY_INF sentinel is mapped before the subtraction (which would
+    # wrap for it); a genuine reentry below kmin cannot occur (tags are
+    # monotone under a serve) but would clamp to 0, which only shortens
+    # the committed prefix -- conservative, never inexact.
+    rrel = jnp.clip(reentry - kmin, 0, jnp.int64(_SENT32))
+    r32 = jnp.where(reentry < 0, jnp.int32(-1),
+                    jnp.where(reentry >= KEY_INF, jnp.int32(_SENT32),
+                              rrel.astype(jnp.int32)))
+    iota = jnp.arange(key.shape[0], dtype=jnp.int32)
+    ks, os_, idxs, cs, rs = lax.sort(
+        (k32, o32, iota, cost.astype(jnp.int32), r32), num_keys=2)
+    ks, os_, idxs, cs, rs = ks[:k], os_[:k], idxs[:k], cs[:k], rs[:k]
+
+    pk_dense = _pack(k32, o32)
+    pk = _pack(ks, os_)
+    rpk = jnp.where(rs < 0, jnp.int64(-1), _pack(rs, os_))
+    # exclusive cumulative min of re-entry keys over the sorted order
+    cm = lax.associative_scan(jnp.minimum, rpk)
+    cm_excl = jnp.concatenate(
+        [jnp.full((1,), (jnp.int64(1) << 62), dtype=jnp.int64), cm[:-1]])
+
+    omax = jnp.max(jnp.where(real, order, omin))
+    cost_ok = jnp.max(jnp.where(real, cost, 0)) < (jnp.int64(1) << 31)
+    guards_ok = (omax - omin < _ORDER32_LIMIT) & cost_ok
+
+    in_window = ks < _CLAMP32
+    elig_key = kmin + ks.astype(jnp.int64)
+
+    def count_fn(elig_ok):
+        ok_q = in_window & elig_ok & (cm_excl > pk)
+        count = jnp.where(jnp.all(ok_q), jnp.int32(k),
+                          jnp.argmax(~ok_q).astype(jnp.int32))
+        return jnp.where(guards_ok, count, jnp.int32(0))
+
+    return (idxs, cs.astype(jnp.int64), pk, pk_dense, elig_key,
+            count_fn, guards_ok)
+
+
+def _commit_prefix(state: EngineState, serve: DenseServe, pk_dense,
+                   count, pk) -> tuple[EngineState, jnp.ndarray]:
+    """Commit the first ``count`` sorted candidates: dense membership is
+    ``packed(key) <= packed boundary`` (packed keys are unique)."""
+    boundary = jnp.where(
+        count > 0, pk[jnp.maximum(count - 1, 0)], jnp.int64(-1))
+    mask = pk_dense <= boundary
+    return _commit_serves(state, mask, serve, jnp.bool_(True)), mask
+
+
+def speculate_prefix_batch(state: EngineState, now, k: int, *,
+                           anticipation_ns: int,
+                           heads=None) -> PrefixBatch:
+    """One prefix-commit batch: regime picked exactly as the serial
+    engine's first decision would (reservation phase iff the lowest
+    reservation tag is eligible, reference :1124-1128), then the
+    longest exact prefix of that regime's sorted candidates commits."""
+    if heads is None:
+        heads = _default_heads(state)
+    has_req = state.active & (state.depth > 0)
+    resv_key = jnp.where(has_req, state.head_resv, KEY_INF)
+    resv_regime = jnp.min(resv_key) <= now
+
+    def resv_branch(_):
+        key = resv_key
+        serve = _dense_serve(state, heads, False, anticipation_ns)
+        reentry = jnp.where(has_req & serve.has_more, serve.head_resv,
+                            KEY_INF)
+        (idxs, sel_cost, pk, pk_dense, elig_key, count_fn,
+         guards) = _prefix_select(key, state.order, k, state.head_cost,
+                                  reentry)
+        count = count_fn(elig_key <= now)
+        new_state, _ = _commit_prefix(state, serve, pk_dense, count, pk)
+        return new_state, count, guards, idxs, sel_cost, jnp.int32(0)
+
+    def weight_branch(_):
+        ready = has_req & _ready_now(state, now)
+        cand = ready & (state.head_prop < MAX_TAG)
+        key = jnp.where(cand, state.head_prop + state.prop_delta,
+                        KEY_INF)
+        serve = _dense_serve(state, heads, True, anticipation_ns)
+        new_eff = serve.head_prop + state.prop_delta
+        new_ready = (serve.head_limit <= now) & \
+            (serve.head_prop < MAX_TAG)
+        # regime-exit blocker: a weight serve whose reservation tag
+        # (post weight-debt reduction) becomes eligible forces the next
+        # serial decision into the constraint phase
+        blocked = cand & serve.has_more & (serve.head_resv <= now)
+        reentry = jnp.where(
+            blocked, jnp.int64(-1),
+            jnp.where(cand & serve.has_more & new_ready, new_eff,
+                      KEY_INF))
+        (idxs, sel_cost, pk, pk_dense, _elig, count_fn,
+         guards) = _prefix_select(key, state.order, k, state.head_cost,
+                                  reentry)
+        count = count_fn(jnp.ones((k,), dtype=bool))
+        new_state, _ = _commit_prefix(state, serve, pk_dense, count, pk)
+
+        # stored-flag parity (promote loop, reference :1135-1144): every
+        # weight decision promotes current heads with limit <= now; the
+        # head popped by the LAST committed decision was never seen by a
+        # later promote pass.  With count == 0 no serial decision ran,
+        # so the flags stay untouched.
+        has_req_after = new_state.active & (new_state.depth > 0)
+        promoted = new_state.head_ready | \
+            (has_req_after & (new_state.head_limit <= now))
+        last_client = idxs[jnp.maximum(count - 1, 0)]
+        promoted = promoted & (
+            jnp.arange(state.capacity, dtype=jnp.int32) != last_client)
+        new_state = new_state._replace(head_ready=jnp.where(
+            count > 0, promoted, new_state.head_ready))
+        return new_state, count, guards, idxs, sel_cost, jnp.int32(1)
+
+    new_state, count, guards, idxs, sel_cost, phase = lax.cond(
+        resv_regime, resv_branch, weight_branch, operand=None)
+
+    j = jnp.arange(k, dtype=jnp.int32)
+    served = j < count
+    decisions = Decision(
+        type=jnp.where(served, RETURNING, NONE).astype(jnp.int32),
+        slot=jnp.where(served, idxs, -1).astype(jnp.int32),
+        phase=jnp.full((k,), phase, dtype=jnp.int32),
+        cost=jnp.where(served, sel_cost, 0),
+        when=jnp.zeros((k,), dtype=jnp.int64),
+        limit_break=jnp.zeros((k,), dtype=bool),
+    )
+    return PrefixBatch(state=new_state, count=count, guards_ok=guards,
+                       decisions=decisions)
+
+
+class PrefixEpoch(NamedTuple):
+    """M prefix-commit batches' output, compact for one readback."""
+
+    state: EngineState     # after ALL committed prefixes
+    count: jnp.ndarray     # int32[M] decisions committed per batch
+    guards_ok: jnp.ndarray  # bool[M]
+    slot: jnp.ndarray      # int32[M, k] serial-order winners (-1 pad)
+    phase: jnp.ndarray     # int8[M]    regime of batch i
+    cost: jnp.ndarray      # int32[M, k]
+
+
+def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
+                      anticipation_ns: int) -> PrefixEpoch:
+    """Run m prefix-commit batches of up to k decisions on device.
+
+    Unlike ``scan_fast_epoch`` there is no commit-prefix-of-batches
+    semantics to manage: EVERY batch commits its own exact prefix, so
+    the concatenated per-batch prefixes are always the serial decision
+    stream at ``now``.  Batches after the workload drains commit 0 and
+    spin harmlessly.  Callers MUST check ``guards_ok``: a rare global
+    rebase-guard failure (creation-order spread or served cost past
+    2^31) zeroes that batch and every later one without committing --
+    rerun from the returned state via ``make_prefix_runner``'s serial
+    fallback in that case.
+    """
+    invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
+    mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+    window = ring_window(state, m)
+
+    def body(mut, _):
+        st = EngineState(**invariant, **mut)
+        batch = speculate_prefix_batch(
+            st, now, k, anticipation_ns=anticipation_ns,
+            heads=_window_heads(st, window))
+        out = (batch.count, batch.guards_ok,
+               batch.decisions.slot,
+               batch.decisions.phase[0].astype(jnp.int8),
+               batch.decisions.cost.astype(jnp.int32))
+        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
+        return new_mut, out
+
+    mutable, (count, guards, slot, phase, cost) = lax.scan(
+        body, mutable0, None, length=m)
+    state = EngineState(**invariant, **mutable)
+    return PrefixEpoch(state=state, count=count, guards_ok=guards,
+                       slot=slot, phase=phase, cost=cost)
+
+
+def make_prefix_runner(k: int, *, anticipation_ns: int = 0):
+    """Host-orchestrated prefix runner: (state, now) -> (state,
+    decisions, n_committed).  The serial engine is needed only when the
+    global rebase guards fail (creation-order spread or a served cost
+    past 2^31 -- never observed in practice); a zero count with guards
+    intact means nothing is eligible at ``now`` (serial FUTURE/NONE).
+    """
+    attempt = jax.jit(functools.partial(
+        speculate_prefix_batch, k=k, anticipation_ns=anticipation_ns))
+    exact = jax.jit(lambda s, t: kernels.engine_run(
+        s, t, k, allow_limit_break=False,
+        anticipation_ns=anticipation_ns, advance_now=False))
+
+    def run(state: EngineState, now):
+        batch = attempt(state, now)
+        if not bool(batch.guards_ok):
+            st, _, decs = exact(state, now)
+            d = jax.device_get(decs)
+            return st, decs, int((d.type == RETURNING).sum())
+        return batch.state, batch.decisions, int(batch.count)
+
+    return run
 
 
 def make_fast_runner(k: int, *, anticipation_ns: int = 0):

@@ -81,6 +81,10 @@ def restore_queue_state(q, st: dict) -> None:
                 f"restore mismatch: client {c!r} maps to slot {s}, "
                 f"device capacity {capacity}")
     for s, d in st["payloads"].items():
+        if not 0 <= s < capacity:
+            raise ValueError(
+                f"restore mismatch: payload FIFO for slot {s} is "
+                f"outside device capacity {capacity}")
         if len(d) != int(depth[s]):
             raise ValueError(
                 f"restore mismatch: slot {s} has {len(d)} payloads but "

@@ -1,0 +1,359 @@
+"""Differential tests for prefix-commit speculation.
+
+``speculate_prefix_batch`` promises: the committed ``count`` decisions
+are EXACTLY the first ``count`` decisions the serial engine
+(``kernels.engine_run`` under AtLimit::Wait, fixed ``now``) would make,
+and the resulting state is bit-identical to the serial engine's state
+after those ``count`` decisions.  Unlike the all-or-nothing fastpath
+there is no fallback: every batch commits its longest exact prefix, and
+whenever the serial engine would RETURN a request the prefix is >= 1
+(guaranteed progress).  These tests pin that contract on the cases the
+all-or-nothing path could not handle: single-client runs, regime
+transitions mid-batch, underfull candidate sets, boundary ties, and the
+k-past-the-cliff shapes that used to fall off to the serial engine.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmclock_tpu.core import ClientInfo, ReqParams
+from dmclock_tpu.core.timebase import NS_PER_SEC
+from dmclock_tpu.engine import TpuPullPriorityQueue, kernels
+from dmclock_tpu.engine.fastpath import (make_prefix_runner,
+                                         scan_prefix_epoch,
+                                         speculate_prefix_batch)
+
+from test_fastpath import (assert_states_equal, build_state, deep_state,
+                           serial_run)
+
+S = NS_PER_SEC
+
+
+def check_prefix_vs_serial(state, now, k, *, anticipation_ns=0,
+                           expect_count=None):
+    """One prefix batch vs the serial engine run for `count` steps."""
+    batch = speculate_prefix_batch(state, jnp.int64(now), k,
+                                   anticipation_ns=anticipation_ns)
+    assert bool(batch.guards_ok)
+    c = int(batch.count)
+    if expect_count is not None:
+        assert c == expect_count, f"count {c} != expected {expect_count}"
+    fd = jax.device_get(batch.decisions)
+    # pad correctness
+    assert (fd.slot[c:] == -1).all()
+    assert (fd.type[c:] == kernels.NONE).all()
+    if c == 0:
+        assert_states_equal(batch.state, state)
+        # nothing eligible: the serial engine must NOT return a request
+        _, ser_decs = serial_run(state, now, 1)
+        assert ser_decs.type[0] != kernels.RETURNING, \
+            "prefix committed 0 but serial engine would serve"
+        return batch.state, 0
+    ser_state, ser_decs = serial_run(state, now, c)
+    assert (ser_decs.type == kernels.RETURNING).all()
+    assert np.array_equal(fd.slot[:c], ser_decs.slot)
+    assert np.array_equal(fd.cost[:c], ser_decs.cost)
+    assert np.array_equal(fd.phase[:c], ser_decs.phase)
+    assert_states_equal(batch.state, ser_state)
+    return batch.state, c
+
+
+def drive_to_exhaustion(state, now, k, *, max_batches=200,
+                        anticipation_ns=0):
+    """Prefix-batch until nothing is eligible; every batch checked
+    against the serial engine.  Returns the total decision count and
+    the per-batch counts."""
+    counts = []
+    st = state
+    for _ in range(max_batches):
+        st, c = check_prefix_vs_serial(st, now, k,
+                                       anticipation_ns=anticipation_ns)
+        counts.append(c)
+        if c == 0:
+            break
+    return st, counts
+
+
+# ----------------------------------------------------------------------
+# the former fallback cliffs
+# ----------------------------------------------------------------------
+
+def test_single_client_deep_queue_progresses():
+    """One client with many requests: all-or-nothing speculation always
+    failed here (one-serve-per-client); prefix commit must serve one
+    request per batch and never stall."""
+    infos = {0: ClientInfo(0, 1, 0)}
+    adds = [(0, 1 * S, 1, 1, 1) for _ in range(10)]
+    state = build_state(infos, adds, capacity=8)
+    st, counts = drive_to_exhaustion(state, 100 * S, 8)
+    assert counts[:10] == [1] * 10
+    assert int(jnp.max(st.depth)) == 0
+
+
+def test_underfull_commits_remaining():
+    """Fewer real candidates than k: the prefix is exactly the
+    remaining eligible set (the round-1 advisor's corruption shape)."""
+    infos = {c: ClientInfo(0, 1, 0) for c in range(3)}
+    adds = [(c, 1 * S, 1, 1, 1) for c in range(3)]
+    state = build_state(infos, adds, capacity=8)
+    st, c = check_prefix_vs_serial(state, 1000 * S, 8, expect_count=3)
+    assert int(jnp.min(st.depth)) >= 0
+    check_prefix_vs_serial(st, 1000 * S, 8, expect_count=0)
+
+
+def test_regime_flip_resv_to_weight_mid_batch():
+    """Reservation backlog drains mid-batch: the prefix stops exactly
+    at the transition; the next batch serves the weight regime."""
+    infos = {c: ClientInfo(2, 1, 0) for c in range(8)}
+    state = deep_state(infos, depth=8)
+    now = 4 * S
+    st, counts = drive_to_exhaustion(state, now, 16, max_batches=40)
+    # both regimes must have been exercised with multi-decision batches
+    assert max(counts) > 1
+    assert sum(counts) == 8 * 8
+    assert int(jnp.max(st.depth)) == 0
+
+
+def test_weight_to_resv_blocker():
+    """A weight serve whose reservation tag becomes eligible (via the
+    weight-debt reduction keeping resv near now) must stop the prefix
+    right after it -- the serial engine switches to the constraint
+    phase there."""
+    # moderate reservations, now far enough that early resv tags are
+    # eligible; interleaving of phases is decided by the serial engine,
+    # and the prefix runner must track it exactly
+    infos = {c: ClientInfo(1, 2, 0) for c in range(6)}
+    state = deep_state(infos, depth=10)
+    st, counts = drive_to_exhaustion(state, 3 * S, 8, max_batches=80)
+    assert sum(counts) == 6 * 10
+    assert int(jnp.max(st.depth)) == 0
+
+
+def test_ties_at_every_boundary():
+    """Equal weights + equal arrivals: every batch boundary is a pure
+    creation-order tie group."""
+    infos = {c: ClientInfo(0, 2, 0) for c in range(12)}
+    state = deep_state(infos, depth=6)
+    st = state
+    total = 0
+    for _ in range(10):
+        st, c = check_prefix_vs_serial(st, 8 * S, 8)
+        total += c
+        if c == 0:
+            break
+    assert total == 12 * 6
+
+
+def test_k_larger_than_population():
+    """k far beyond the candidate count (the old k-cliff shape): the
+    prefix commits what exists, repeatedly, with no cliff."""
+    infos = {c: ClientInfo(0, 1 + (c % 3), 0) for c in range(8)}
+    state = deep_state(infos, depth=4)
+    st, counts = drive_to_exhaustion(state, 50 * S, 64, max_batches=20)
+    assert sum(counts) == 8 * 4
+    # with one-serve-per-client, each batch is capped at the population
+    assert max(counts) <= 8
+
+
+def test_limited_clients_excluded_from_weight_prefix():
+    infos = {}
+    for c in range(12):
+        if c < 6:
+            infos[c] = ClientInfo(0, 1, 0)
+        else:
+            infos[c] = ClientInfo(0, 1, 1000.0)
+    state = deep_state(infos, depth=4)
+    st = state
+    for _ in range(8):
+        st, c = check_prefix_vs_serial(st, 2 * S, 8)
+        if c == 0:
+            break
+
+
+def test_nothing_eligible_commits_zero():
+    infos = {c: ClientInfo(5, 0, 0) for c in range(4)}
+    adds = [(c, 100 * S, 1, 1, 1) for c in range(4)]
+    state = build_state(infos, adds, capacity=8)
+    # now is before any reservation tag: serial returns FUTURE
+    check_prefix_vs_serial(state, 1, 4, expect_count=0)
+
+
+def test_empty_state_commits_zero():
+    infos = {0: ClientInfo(0, 1, 0)}
+    state = build_state(infos, [], capacity=8)
+    check_prefix_vs_serial(state, 1 * S, 4, expect_count=0)
+
+
+# ----------------------------------------------------------------------
+# epoch scan
+# ----------------------------------------------------------------------
+
+def test_prefix_epoch_concatenation_is_serial_stream():
+    """The concatenated per-batch prefixes of an epoch must equal one
+    serial decision stream, through a workload that drains mid-epoch."""
+    infos = {c: ClientInfo(0, 1 + (c % 2), 0) for c in range(8)}
+    state = deep_state(infos, depth=5)       # 40 requests
+    m, k = 10, 8
+    ep = scan_prefix_epoch(state, jnp.int64(30 * S), m, k,
+                           anticipation_ns=0)
+    counts = jax.device_get(ep.count)
+    assert jax.device_get(ep.guards_ok).all()
+    assert int(counts.sum()) == 40
+    st = state
+    slots = jax.device_get(ep.slot)
+    costs = jax.device_get(ep.cost)
+    phases = jax.device_get(ep.phase)
+    for i in range(m):
+        c = int(counts[i])
+        if c == 0:
+            continue
+        ser_state, ser_decs = serial_run(st, 30 * S, c)
+        assert np.array_equal(slots[i][:c], ser_decs.slot)
+        assert np.array_equal(costs[i][:c], ser_decs.cost)
+        assert (ser_decs.phase == int(phases[i])).all()
+        assert (slots[i][c:] == -1).all()
+        st = ser_state
+    assert_states_equal(ep.state, st)
+
+
+def test_prefix_epoch_regime_transition():
+    """An epoch spanning a resv->weight transition: batches before the
+    flip are reservation-phase, after are weight-phase, stream exact."""
+    infos = {c: ClientInfo(2, 1, 0) for c in range(6)}
+    state = deep_state(infos, depth=12)
+    m, k = 12, 8
+    now = 5 * S
+    ep = scan_prefix_epoch(state, jnp.int64(now), m, k,
+                           anticipation_ns=0)
+    counts = jax.device_get(ep.count)
+    phases = jax.device_get(ep.phase)
+    st = state
+    for i in range(m):
+        c = int(counts[i])
+        if c == 0:
+            continue
+        ser_state, ser_decs = serial_run(st, now, c)
+        assert np.array_equal(jax.device_get(ep.slot)[i][:c],
+                              ser_decs.slot)
+        assert (ser_decs.phase == int(phases[i])).all()
+        st = ser_state
+    assert_states_equal(ep.state, st)
+    served_phases = {int(phases[i]) for i in range(m) if counts[i]}
+    assert served_phases == {0, 1}, \
+        f"epoch never crossed the transition: {served_phases}"
+
+
+# ----------------------------------------------------------------------
+# runner + randomized differential fuzz
+# ----------------------------------------------------------------------
+
+def test_prefix_runner_matches_serial_stream():
+    infos = {c: ClientInfo(0, 1 + c % 3, 0) for c in range(10)}
+    state = deep_state(infos, depth=6)
+    run = make_prefix_runner(8)
+    st = state
+    now = 20 * S
+    total = 0
+    for _ in range(20):
+        ser_state0 = st
+        st, decs, n = run(st, jnp.int64(now))
+        if n == 0:
+            break
+        ser_state, ser_decs = serial_run(ser_state0, now, n)
+        fd = jax.device_get(decs)
+        assert np.array_equal(fd.slot[:n], ser_decs.slot)
+        assert_states_equal(st, ser_state)
+        total += n
+    assert total == 10 * 6
+
+
+@pytest.mark.parametrize("seed", [31, 32, 33, 34, 35, 36])
+def test_fuzz_prefix_matches_serial(seed):
+    """Random QoS mixes, arrival histories, ks and nows: every batch's
+    committed prefix must replay serially, bit-exact, including states
+    where the old fastpath always fell back."""
+    rng = random.Random(seed)
+    n_clients = rng.randint(2, 24)
+    infos = {}
+    for c in range(n_clients):
+        kind = rng.randrange(5)
+        if kind == 0:
+            infos[c] = ClientInfo(rng.uniform(0.5, 4), 0, 0)
+        elif kind == 1:
+            infos[c] = ClientInfo(0, rng.uniform(0.5, 4), 0)
+        elif kind == 2:
+            infos[c] = ClientInfo(rng.uniform(0.5, 2),
+                                  rng.uniform(0.5, 4),
+                                  rng.uniform(3, 8))
+        elif kind == 3:
+            infos[c] = ClientInfo(0, 2, 0)
+        else:
+            infos[c] = ClientInfo(rng.uniform(0.5, 3),
+                                  rng.uniform(0.5, 3), 0)
+    adds = []
+    t = 1 * S
+    for step in range(rng.randint(10, 150)):
+        # heavy skew: some clients get long runs (the serial-ish shapes)
+        c = rng.randrange(n_clients) if rng.random() < 0.7 else 0
+        t += rng.randint(0, S // 4)
+        delta = rng.randint(1, 5)
+        adds.append((c, t, rng.randint(1, 3), delta,
+                     rng.randint(1, delta)))
+    state = build_state(infos, adds, capacity=32)
+
+    k = rng.choice([2, 4, 8, 16])
+    now = t + rng.randint(0, 10) * S
+    st = state
+    for _ in range(12):
+        st, c = check_prefix_vs_serial(st, now, k)
+        if c == 0:
+            now += rng.randint(1, 5) * S
+    assert int(jnp.min(st.depth)) >= 0
+
+
+def test_fuzz_epoch_vs_batches():
+    """The epoch scan must produce exactly the same stream as repeated
+    single prefix batches."""
+    rng = random.Random(77)
+    infos = {c: ClientInfo(rng.choice([0, 1, 2]), rng.choice([1, 2, 3]),
+                           0) for c in range(12)}
+    for c in infos:
+        if infos[c].reservation == 0 and infos[c].weight == 0:
+            infos[c] = ClientInfo(0, 1, 0)
+    state = deep_state(infos, depth=rng.randint(2, 8), capacity=32)
+    m, k = 6, 8
+    now = rng.randint(2, 500) * S
+    ep = scan_prefix_epoch(state, jnp.int64(now), m, k,
+                           anticipation_ns=0)
+    st = state
+    for i in range(m):
+        batch = speculate_prefix_batch(st, jnp.int64(now), k,
+                                       anticipation_ns=0)
+        assert int(batch.count) == int(jax.device_get(ep.count)[i])
+        assert np.array_equal(jax.device_get(batch.decisions.slot),
+                              jax.device_get(ep.slot)[i])
+        st = batch.state
+    assert_states_equal(ep.state, st)
+
+
+def test_anticipation_prefix_differential():
+    rng = random.Random(19)
+    ant = S // 2
+    infos = {c: ClientInfo(0, 1.0 + c % 3, 0) for c in range(8)}
+    adds = []
+    t = S
+    for i in range(80):
+        c = rng.randrange(8)
+        t += rng.choice([ant // 4, ant // 3, 2 * ant])
+        adds.append((c, t, rng.randint(1, 3), rng.randint(1, 4), 1))
+    state = build_state(infos, adds, capacity=16, ring=32,
+                        anticipation_ns=ant)
+    now = t + 1000 * S
+    st, counts = drive_to_exhaustion(state, now, 8,
+                                     anticipation_ns=ant)
+    assert sum(counts) == 80
+    assert int(jnp.max(st.depth)) == 0
