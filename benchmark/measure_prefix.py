@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Quick TPU measurement: prefix-commit epoch vs the all-or-nothing
+fastpath on the headline, transition, and past-the-cliff shapes."""
+import functools
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from __graft_entry__ import _preloaded_state
+from dmclock_tpu.engine.fastpath import scan_prefix_epoch
+from profile_util import scalar_latency, state_digest
+
+
+def resv_state(n, depth):
+    st = _preloaded_state(n, depth, ring=depth)
+    c = np.arange(n)
+    phase = ((c * 2654435761) & 0xFFFFF) / float(1 << 20)
+    rinv = np.asarray(st.resv_inv)
+    jit = (phase * 2.0 * rinv).astype(np.int64)
+    return st._replace(head_resv=jnp.asarray(rinv + jit))
+
+
+def run_case(name, state, now_ns, k, m, epochs, lat):
+    run = jax.jit(functools.partial(
+        scan_prefix_epoch, m=m, k=k, anticipation_ns=0),
+        donate_argnums=(0,))
+    ep = run(state, jnp.int64(now_ns))
+    jax.device_get(state_digest(ep.state))      # warm/compile
+    state = ep.state
+    t0 = time.perf_counter()
+    counts = []
+    for _ in range(epochs):
+        ep = run(state, jnp.int64(now_ns))
+        state = ep.state
+        counts.append(ep.count)
+    jax.device_get(state_digest(state))
+    t = time.perf_counter() - t0 - lat
+    total = int(sum(int(jax.device_get(c).sum()) for c in counts))
+    full = epochs * m * k
+    print(f"{name}: {total/t/1e6:8.2f} M dec/s  "
+          f"({total} dec in {t*1e3:.0f} ms, fill {total/full:.3f})")
+    return total / t
+
+
+def main():
+    n, depth = 100_000, 128
+    lat = scalar_latency()
+    print(f"latency {lat*1e3:.1f} ms")
+
+    # headline: weight steady state
+    run_case("weight steady (k=32768,m=32)",
+             _preloaded_state(n, depth, ring=depth), 0, 32768, 32, 6,
+             lat)
+    # reservation backlog
+    run_case("resv backlog (k=32768,m=32)", resv_state(n, depth),
+             10**15, 32768, 32, 4, lat)
+    # transition: only ~3 batches of resv eligible then weight
+    st = resv_state(n, depth)
+    now = int(np.asarray(st.head_resv).min()) + 2 * 10**7
+    run_case("resv->weight transition", st, now, 32768, 32, 4, lat)
+    # past the old cliff
+    run_case("k=49152 (old cliff)",
+             _preloaded_state(n, depth, ring=depth), 0, 49152, 21, 4,
+             lat)
+    run_case("k=65536", _preloaded_state(n, depth, ring=depth), 0,
+             65536, 16, 4, lat)
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,39 @@ _SENT32 = (1 << 31) - 1      # non-candidate sentinel (sorts last)
 _ORDER32_LIMIT = jnp.int64(1) << 31
 
 
+class _Rebase(NamedTuple):
+    """Shared 32-bit rebase of (key, order) + the global exactness
+    guards.  This is the overflow-sensitive core both selection paths
+    (all-or-nothing and prefix-commit) must agree on."""
+
+    real: jnp.ndarray      # bool[N] key < KEY_INF
+    kmin: jnp.ndarray      # int64 scalar: min real key (rebase origin)
+    k32: jnp.ndarray       # int32[N] rebased key; _CLAMP32 = real but
+    #                        out of window; _SENT32 = non-candidate
+    o32: jnp.ndarray       # int32[N] rebased creation order
+    guards_ok: jnp.ndarray  # bool: order spread + cost payload fit
+
+
+def _rebase32(key, order, cost) -> _Rebase:
+    real = key < KEY_INF
+    kmin = jnp.min(jnp.where(real, key, KEY_INF))
+    krel = key - kmin
+    fits = real & (krel < _CLAMP32)
+    k32 = jnp.where(fits, krel,
+                    jnp.where(real, _CLAMP32, _SENT32)).astype(jnp.int32)
+    # order rebased like the keys: creation indices grow without bound,
+    # so the int32 cast must be of the spread, not the absolute value
+    omin = jnp.min(jnp.where(real, order, jnp.int64(1) << 62))
+    o32 = (order - omin).astype(jnp.int32)
+    omax = jnp.max(jnp.where(real, order, omin))
+    # the cost guard masks to real candidates: an oversized cost on an
+    # inactive/non-candidate row must not disable the fastpath forever
+    cost_ok = jnp.max(jnp.where(real, cost, 0)) < (jnp.int64(1) << 31)
+    guards_ok = (omax - omin < _ORDER32_LIMIT) & cost_ok
+    return _Rebase(real=real, kmin=kmin, k32=k32, o32=o32,
+                   guards_ok=guards_ok)
+
+
 def _sorted_selection(key, order, k: int, cost):
     """Indices of the k lexicographically-smallest (key, order) pairs,
     sorted ascending (= exact serial service order).
@@ -91,30 +124,16 @@ def _sorted_selection(key, order, k: int, cost):
     payload so the decision emit avoids a [k]-sized gather (TPU
     gathers serialize); a cost that overflows int32 fails ``ok``.
     """
-    real = key < KEY_INF
-    kmin = jnp.min(jnp.where(real, key, KEY_INF))
-    krel = key - kmin
-    fits = real & (krel < _CLAMP32)
-    k32 = jnp.where(fits, krel,
-                    jnp.where(real, _CLAMP32, _SENT32)).astype(jnp.int32)
-    # order rebased like the keys: creation indices grow without bound,
-    # so the int32 cast must be of the spread, not the absolute value
-    omin = jnp.min(jnp.where(real, order, jnp.int64(1) << 62))
-    o32 = (order - omin).astype(jnp.int32)
+    rb = _rebase32(key, order, cost)
     iota = jnp.arange(key.shape[0], dtype=jnp.int32)
     ks, _, idxs, cs = lax.sort(
-        (k32, o32, iota, cost.astype(jnp.int32)), num_keys=2)
+        (rb.k32, rb.o32, iota, cost.astype(jnp.int32)), num_keys=2)
     vk = ks[k - 1]
     # vk < _CLAMP32 ensures >= k real candidates AND that every
     # selected key fit the rebase window (clamped/sentinel rows sort at
-    # or past _CLAMP32); the order-spread rebase must be exact too,
-    # and so must the int32 cost payload.
-    omax = jnp.max(jnp.where(real, order, omin))
-    # the cost guard masks to real candidates: an oversized cost on an
-    # inactive/non-candidate row must not disable the fastpath forever
-    cost_ok = jnp.max(jnp.where(real, cost, 0)) < (jnp.int64(1) << 31)
-    ok = (vk < _CLAMP32) & (omax - omin < _ORDER32_LIMIT) & cost_ok
-    v = kmin + vk.astype(jnp.int64)
+    # or past _CLAMP32); the rebase guards must hold too.
+    ok = (vk < _CLAMP32) & rb.guards_ok
+    v = rb.kmin + vk.astype(jnp.int64)
     max_tied_order = order[idxs[k - 1]]
     return idxs[:k], v, max_tied_order, ok, cs[:k].astype(jnp.int64)
 
@@ -148,7 +167,13 @@ class RingWindow(NamedTuple):
 # compiler, so the kernel is gridless and the host slices VMEM-sized
 # row chunks; int64 rings are bitcast to int32 lane pairs (a row
 # rotation by 2*q0 on the pair plane is the int64 rotation by q0).
-_ROT_CHUNK = 2048
+# The chunk scales inversely with ring width to stay inside the 16MB
+# scoped-VMEM budget (2048 rows was tuned at Q=128 = 256 lanes).
+_ROT_LANE_BUDGET = 2048 * 256
+
+
+def _rot_chunk(q: int) -> int:
+    return max(8, (_ROT_LANE_BUDGET // (2 * q)) // 8 * 8)
 
 
 def _rotate_kernel(q_ref, x_ref, o_ref, *, q: int):
@@ -172,21 +197,22 @@ def _rotate_rows_pallas(ring, q0, wsize: int, *, q0t=None,
     from jax.experimental import pallas as pl
 
     n, q = ring.shape
+    chunk = _rot_chunk(q)
     i32 = lax.bitcast_convert_type(ring, jnp.int32).reshape(n, 2 * q)
-    pad = (-n) % _ROT_CHUNK
+    pad = (-n) % chunk
     if pad:
         i32 = jnp.pad(i32, ((0, pad), (0, 0)))
     if q0t is None:
         q0t = _tile_shifts(q0, q, n + pad)
     call = pl.pallas_call(
         functools.partial(_rotate_kernel, q=q),
-        out_shape=jax.ShapeDtypeStruct((_ROT_CHUNK, 2 * q), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((chunk, 2 * q), jnp.int32),
         interpret=interpret)
     # slice each chunk to the window BEFORE concatenating: the full
     # rotated ring is never materialized in HBM
-    outs = [call(q0t[c:c + _ROT_CHUNK], i32[c:c + _ROT_CHUNK])
+    outs = [call(q0t[c:c + chunk], i32[c:c + chunk])
             [:, :2 * wsize]
-            for c in range(0, n + pad, _ROT_CHUNK)]
+            for c in range(0, n + pad, chunk)]
     rot = jnp.concatenate(outs, axis=0)
     win = rot[:n].reshape(n, wsize, 2)
     return lax.bitcast_convert_type(win, jnp.int64).T
@@ -227,7 +253,7 @@ def ring_window(state: EngineState, m: int) -> RingWindow:
     # the Pallas path needs a full lane tile (2q >= 128 int32 lanes)
     if jax.default_backend() == "tpu" and q >= 64:
         n = q0.shape[0]
-        q0t = _tile_shifts(q0, q, n + ((-n) % _ROT_CHUNK))
+        q0t = _tile_shifts(q0, q, n + ((-n) % _rot_chunk(q)))
         rot = functools.partial(_rotate_rows_pallas, q0=q0,
                                 wsize=wsize, q0t=q0t)
     else:
@@ -660,14 +686,7 @@ def _prefix_select(key, order, k: int, cost, reentry):
     gates like resv <= now), and ``count_fn(elig_ok)`` finishes the
     prefix computation given the per-position eligibility mask.
     """
-    real = key < KEY_INF
-    kmin = jnp.min(jnp.where(real, key, KEY_INF))
-    krel = key - kmin
-    fits = real & (krel < _CLAMP32)
-    k32 = jnp.where(fits, krel,
-                    jnp.where(real, _CLAMP32, _SENT32)).astype(jnp.int32)
-    omin = jnp.min(jnp.where(real, order, jnp.int64(1) << 62))
-    o32 = (order - omin).astype(jnp.int32)
+    rb = _rebase32(key, order, cost)
     # re-entry key in the same rebased space: values past the window
     # clamp high (harmless: every committable boundary is < _CLAMP32,
     # and packed comparisons stay strict); blockers stay negative.  The
@@ -675,16 +694,16 @@ def _prefix_select(key, order, k: int, cost, reentry):
     # wrap for it); a genuine reentry below kmin cannot occur (tags are
     # monotone under a serve) but would clamp to 0, which only shortens
     # the committed prefix -- conservative, never inexact.
-    rrel = jnp.clip(reentry - kmin, 0, jnp.int64(_SENT32))
+    rrel = jnp.clip(reentry - rb.kmin, 0, jnp.int64(_SENT32))
     r32 = jnp.where(reentry < 0, jnp.int32(-1),
                     jnp.where(reentry >= KEY_INF, jnp.int32(_SENT32),
                               rrel.astype(jnp.int32)))
     iota = jnp.arange(key.shape[0], dtype=jnp.int32)
     ks, os_, idxs, cs, rs = lax.sort(
-        (k32, o32, iota, cost.astype(jnp.int32), r32), num_keys=2)
+        (rb.k32, rb.o32, iota, cost.astype(jnp.int32), r32), num_keys=2)
     ks, os_, idxs, cs, rs = ks[:k], os_[:k], idxs[:k], cs[:k], rs[:k]
 
-    pk_dense = _pack(k32, o32)
+    pk_dense = _pack(rb.k32, rb.o32)
     pk = _pack(ks, os_)
     rpk = jnp.where(rs < 0, jnp.int64(-1), _pack(rs, os_))
     # exclusive cumulative min of re-entry keys over the sorted order
@@ -692,12 +711,9 @@ def _prefix_select(key, order, k: int, cost, reentry):
     cm_excl = jnp.concatenate(
         [jnp.full((1,), (jnp.int64(1) << 62), dtype=jnp.int64), cm[:-1]])
 
-    omax = jnp.max(jnp.where(real, order, omin))
-    cost_ok = jnp.max(jnp.where(real, cost, 0)) < (jnp.int64(1) << 31)
-    guards_ok = (omax - omin < _ORDER32_LIMIT) & cost_ok
-
+    guards_ok = rb.guards_ok
     in_window = ks < _CLAMP32
-    elig_key = kmin + ks.astype(jnp.int64)
+    elig_key = rb.kmin + ks.astype(jnp.int64)
 
     def count_fn(elig_ok):
         ok_q = in_window & elig_ok & (cm_excl > pk)

@@ -16,6 +16,9 @@ echo "== simulator smoke =="
 python -m dmclock_tpu.sim.dmc_sim -c configs/dmc_sim_example.conf | tail -3
 native/build/dmc_sim_native -c configs/dmc_sim_example.conf | tail -3
 
+echo "== full-scale TPU parity (100x100 acceptance config) =="
+python scripts/run_fullscale.py
+
 echo "== graft entry compile check =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

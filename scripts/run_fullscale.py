@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""CI entry for the full-scale TPU parity gates.
+
+Runs the env-gated 100x100 acceptance-config parity test
+(``tests/test_sim_tpu_fullscale.py``) with ``DMCLOCK_FULLSCALE=1`` set,
+on the virtual CPU mesh (same backend selection as the test suite).
+Kept as a separate entry point so the default ``pytest tests/`` stays
+fast; ``scripts/ci.sh`` invokes this after the main suite.
+
+Usage: python scripts/run_fullscale.py [extra pytest args]
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ, DMCLOCK_FULLSCALE="1")
+    cmd = [sys.executable, "-m", "pytest",
+           os.path.join(REPO, "tests", "test_sim_tpu_fullscale.py"),
+           "-q", *sys.argv[1:]]
+    return subprocess.call(cmd, cwd=REPO, env=env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
