@@ -7,10 +7,11 @@
    reference pipeline greps (``simulate.h:306-349``).  The reference's
    rule of thumb ("<= 6 elements: K small; otherwise K=3",
    benchmark/README.md:17-19) is what this reproduces with runtime K.
-2. TPU k/m sweep: ``scan_fast_epoch`` decisions/sec at 100k clients
-   across speculative batch size k and epoch length m (the analog of
-   the K_WAY_HEAP study for the batch engine: k trades selection-sort
-   amortization against speculation-window validity).
+2. TPU k/m sweep: ``scan_prefix_epoch`` decisions/sec at 100k clients
+   across batch size k and epoch length m (the analog of the
+   K_WAY_HEAP study for the batch engine: k amortizes the selection
+   sort; prefix-commit makes k past the re-entry distance a fill
+   degradation instead of a cliff).
 
 Writes benchmark/RESULTS.md.  Usage:
     python benchmark/run_sweeps.py [--skip-native] [--skip-tpu]
@@ -66,50 +67,48 @@ def native_k_sweep(repeat: int):
     return rows
 
 
-def _timed_epochs(state, now_ns, epochs, k, m, lat, *, recovery=False):
-    """Shared per-epoch-sync timing harness for the sweeps: warm one
-    epoch, time ``epochs`` more with a per-epoch ok readback (latency-
-    corrected), optionally recovering stalls with one exact serial
-    4096-batch.  bench.py's async-chained headline protocol is kept
-    separate by design (see its docstring).  Returns (decisions/sec,
-    fallback_rate, serial_recoveries)."""
+def _timed_prefix_epochs(state, now_ns, epochs, k, m, lat):
+    """Per-epoch-sync timing on the prefix-commit engine: every batch
+    commits its longest exact serial prefix, so there is no fallback or
+    recovery path -- the decision count is the sum of per-batch commit
+    counts.  Returns (decisions/sec, fill)."""
     import jax
     import jax.numpy as jnp
-    from dmclock_tpu.engine import kernels
-    from dmclock_tpu.engine.fastpath import scan_fast_epoch
+    from dmclock_tpu.engine.fastpath import scan_prefix_epoch
     from profile_util import state_digest
 
     run = jax.jit(functools.partial(
-        scan_fast_epoch, m=m, k=k, anticipation_ns=0),
+        scan_prefix_epoch, m=m, k=k, anticipation_ns=0),
         donate_argnums=(0,))
-    serial = jax.jit(lambda s, t: kernels.engine_run(
-        s, t, 4096, allow_limit_break=False, anticipation_ns=0,
-        advance_now=False))
-    if recovery:
-        _ = serial(state, jnp.int64(now_ns))       # compile
-    ep = run(state, jnp.int64(now_ns))
-    jax.device_get(state_digest(ep.state))         # warm
+    # the tunneled remote-compile endpoint occasionally drops a
+    # response mid-read; one retry covers it (the cache makes the
+    # second attempt cheap).  Retry ONLY if the donated input buffer
+    # survived -- a post-dispatch failure consumes it, and retrying
+    # would mask the original error with a deleted-buffer error.
+    for attempt in (0, 1):
+        try:
+            ep = run(state, jnp.int64(now_ns))
+            break
+        except Exception:
+            if attempt or any(
+                    getattr(x, "is_deleted", lambda: False)()
+                    for x in jax.tree_util.tree_leaves(state)):
+                raise
+            time.sleep(2)
+    jax.device_get(state_digest(ep.state))
     state = ep.state
 
     t0 = time.perf_counter()
-    committed = serial_dec = recoveries = trips = 0
+    total = trips = 0
     for _ in range(epochs):
         ep = run(state, jnp.int64(now_ns))
         state = ep.state
-        ok = jax.device_get(ep.ok)
+        total += int(jax.device_get(ep.count).sum())
         trips += 1
-        committed += int(ok.sum())
-        if recovery and not ok.all():
-            state, _, decs = serial(state, jnp.int64(now_ns))
-            serial_dec += int(jax.device_get(
-                (decs.type == kernels.RETURNING).sum()))
-            trips += 1
-            recoveries += 1
     jax.device_get(state_digest(state))
     trips += 1
     t = time.perf_counter() - t0 - lat * trips
-    total = committed * k + serial_dec
-    return total / t, 1 - committed / (epochs * m), recoveries
+    return total / t, total / (epochs * m * k)
 
 
 def tpu_km_sweep():
@@ -121,22 +120,23 @@ def tpu_km_sweep():
     n, depth = 100_000, 128
     rows = []
     lat = scalar_latency()
-    for k in (8192, 16384, 32768, 49152):
+    for k in (8192, 16384, 32768, 49152, 65536, 98304):
         for m in (8, 32):
             state = _preloaded_state(n, depth, ring=depth)
             epochs = max(1, (1 << 21) // (m * k))
-            dps, fb, _rec = _timed_epochs(state, 0, epochs, k, m, lat)
-            rows.append((k, m, dps, fb))
+            dps, fill = _timed_prefix_epochs(state, 0, epochs, k, m, lat)
+            rows.append((k, m, dps, fill))
             print(f"k={k} m={m}: {dps/1e6:.2f} M dec/s "
-                  f"(fallback {fb:.3f})")
+                  f"(fill {fill:.3f})")
     return rows
 
 
 def tpu_regime_sweep():
-    """Decisions/sec by REGIME, beyond the headline's weight-only
-    steady state: pure reservation backlog (constraint phase every
-    decision), a reservation->weight transition (forces speculation
-    failures + serial recovery at the boundary), and the exact serial
+    """Decisions/sec by REGIME on the prefix-commit engine: pure
+    reservation backlog (constraint phase every decision), a
+    reservation->weight transition mid-run (the prefix stops exactly at
+    the flip and the next batch switches regime -- formerly the serial-
+    recovery cliff), the weight steady state, and the exact serial
     engine as the floor."""
     import jax
     import jax.numpy as jnp
@@ -147,13 +147,9 @@ def tpu_regime_sweep():
     from dmclock_tpu.engine import kernels
     from profile_util import scalar_latency, state_digest
 
-    n, depth, k, m = 100_000, 128, 32768, 32
+    n, depth, k, m = 100_000, 128, 49152, 21
     lat = scalar_latency()
     rows = []
-
-    def run_epochs(state, now_ns, epochs):
-        return _timed_epochs(state, now_ns, epochs, k, m, lat,
-                             recovery=True)
 
     def resv_state():
         st = _preloaded_state(n, depth, ring=depth)
@@ -165,25 +161,23 @@ def tpu_regime_sweep():
         return st._replace(head_resv=jnp.asarray(rinv + jit))
 
     # pure reservation regime: now far beyond every reservation tag
-    dps, fb, rec = run_epochs(resv_state(), 10**15, 4)
-    rows.append(("reservation backlog", dps, fb, rec))
-    print(f"reservation: {dps/1e6:.2f} M dec/s fallback {fb:.3f}")
+    dps, fill = _timed_prefix_epochs(resv_state(), 10**15, 4, k, m, lat)
+    rows.append(("reservation backlog", dps, fill))
+    print(f"reservation: {dps/1e6:.2f} M dec/s fill {fill:.3f}")
 
-    # transition: only ~3 batches of reservation serves are eligible,
-    # then the regime flips to weight mid-run (speculation must fail
-    # and serially recover at the boundary)
+    # transition: only a few batches of reservation serves are
+    # eligible, then the regime flips to weight mid-epoch
     st = resv_state()
     now = int(np.asarray(st.head_resv).min()) + 2 * 10**7
-    dps, fb, rec = run_epochs(st, now, 4)
-    rows.append(("resv->weight transition", dps, fb, rec))
-    print(f"transition: {dps/1e6:.2f} M dec/s fallback {fb:.3f} "
-          f"recoveries {rec}")
+    dps, fill = _timed_prefix_epochs(st, now, 4, k, m, lat)
+    rows.append(("resv->weight transition", dps, fill))
+    print(f"transition: {dps/1e6:.2f} M dec/s fill {fill:.3f}")
 
     # weight regime baseline at the same epoch budget
-    dps, fb, rec = run_epochs(_preloaded_state(n, depth, ring=depth),
-                              0, 4)
-    rows.append(("weight steady state", dps, fb, rec))
-    print(f"weight: {dps/1e6:.2f} M dec/s fallback {fb:.3f}")
+    dps, fill = _timed_prefix_epochs(
+        _preloaded_state(n, depth, ring=depth), 0, 4, k, m, lat)
+    rows.append(("weight steady state", dps, fill))
+    print(f"weight: {dps/1e6:.2f} M dec/s fill {fill:.3f}")
 
     # exact serial engine floor
     state = _preloaded_state(n, depth, ring=depth)
@@ -196,8 +190,29 @@ def tpu_regime_sweep():
     state, _, decs = serial(state, jnp.int64(0))
     jax.device_get(state_digest(state))
     t = time.perf_counter() - t0 - lat
-    rows.append(("exact serial engine", 4096 / t, 0.0, 0))
+    rows.append(("exact serial engine", 4096 / t, 1.0))
     print(f"serial exact: {4096/t/1e3:.1f} k dec/s")
+    return rows
+
+
+def tpu_sustained_sweep():
+    """BASELINE configs #3/#4: the sustained closed loop (Poisson
+    superwave ingest + prefix epochs) as measured by bench.py."""
+    import sys
+    sys.path.insert(0, str(REPO))
+    from bench import bench_sustained
+
+    rows = []
+    r3 = bench_sustained(10_000, 4096, 32, 20, zipf=False,
+                         resv_rate=100.0, dt_round_ns=100_000_000,
+                         ring=256, depth0=128)
+    rows.append(("cfg3: 10k clients, uniform QoS, Poisson", r3))
+    print(f"cfg3: {r3['dps']/1e6:.2f} M dec/s")
+    r4 = bench_sustained(100_000, 49152, 21, 10, zipf=True,
+                         resv_rate=100.0, dt_round_ns=50_000_000)
+    rows.append(("cfg4: 100k clients, Zipf weights, resv-constrained",
+                 r4))
+    print(f"cfg4: {r4['dps']/1e6:.2f} M dec/s")
     return rows
 
 
@@ -214,6 +229,7 @@ def main():
     native_part = here / ".native_section.md"
     tpu_part = here / ".tpu_section.md"
     regime_part = here / ".regime_section.md"
+    sustained_part = here / ".sustained_section.md"
 
     if not args.skip_native:
         lines = ["## Native heap K-sweep (dmc_sim_100_100.conf, "
@@ -226,27 +242,37 @@ def main():
     if not args.skip_tpu:
         import jax
         plat = jax.devices()[0].platform
-        lines = [f"## TPU epoch k/m sweep (100k clients, platform="
-                 f"{plat})", "",
-                 "| k | m | M dec/s | fallback rate |", "|---|---|---|---|"]
-        for k, m, dps, fb in tpu_km_sweep():
-            lines.append(f"| {k} | {m} | {dps/1e6:.2f} | {fb:.3f} |")
+        lines = [f"## TPU prefix-epoch k/m sweep (100k clients, "
+                 f"platform={plat})", "",
+                 "| k | m | M dec/s | fill |", "|---|---|---|---|"]
+        for k, m, dps, fill in tpu_km_sweep():
+            lines.append(f"| {k} | {m} | {dps/1e6:.2f} | {fill:.3f} |")
         lines.append("")
         tpu_part.write_text("\n".join(lines))
     if args.regimes:
-        lines = ["## Regime coverage (100k clients, k=32768, m=32)", "",
-                 "| scenario | M dec/s | fallback rate | serial "
-                 "recoveries |", "|---|---|---|---|"]
-        for name, dps, fb, rec in tpu_regime_sweep():
-            lines.append(f"| {name} | {dps/1e6:.2f} | {fb:.3f} | "
-                         f"{rec} |")
+        lines = ["## Regime coverage (prefix engine, 100k clients, "
+                 "k=49152, m=21)", "",
+                 "| scenario | M dec/s | fill |", "|---|---|---|"]
+        for name, dps, fill in tpu_regime_sweep():
+            lines.append(f"| {name} | {dps/1e6:.2f} | {fill:.3f} |")
         lines.append("")
         regime_part.write_text("\n".join(lines))
+        lines = ["## Sustained closed loop, arrivals included "
+                 "(BASELINE configs #3/#4)", "",
+                 "| workload | M dec/s | fill | resv phase | mean "
+                 "depth |", "|---|---|---|---|---|"]
+        for name, r in tpu_sustained_sweep():
+            lines.append(
+                f"| {name} | {r['dps']/1e6:.2f} | {r['fill']:.3f} | "
+                f"{r['resv_phase_frac']:.2f} | {r['mean_depth']:.0f} |")
+        lines.append("")
+        sustained_part.write_text("\n".join(lines))
 
     head = ["# Benchmark sweeps", "",
             "Produced by `python benchmark/run_sweeps.py` "
             "(see its docstring).", ""]
-    body = [p.read_text() for p in (native_part, tpu_part, regime_part)
+    body = [p.read_text() for p in (native_part, tpu_part, regime_part,
+                                    sustained_part)
             if p.exists()]
     RESULTS.write_text("\n".join(head + body))
     print(f"wrote {RESULTS}")

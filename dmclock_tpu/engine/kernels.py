@@ -280,7 +280,7 @@ def engine_step(state: EngineState, now: jnp.ndarray, *,
 
 def engine_run(state: EngineState, now: jnp.ndarray, steps: int, *,
                allow_limit_break: bool, anticipation_ns: int,
-               advance_now: bool = False):
+               advance_now: bool = False, with_horizon: bool = False):
     """``steps`` scheduling decisions in one launch via lax.scan.
 
     With a fixed ``now`` this equals ``steps`` successive pulls at the
@@ -288,19 +288,51 @@ def engine_run(state: EngineState, now: jnp.ndarray, steps: int, *,
     later decisions repeat it).  With ``advance_now`` the virtual clock
     jumps to each FUTURE's wake-up time -- an infinitely-fast server,
     which is the decisions/sec benchmark mode.
+
+    With ``with_horizon`` a 4th value is returned: the earliest
+    reservation or (non-ready) limit tag STRICTLY past ``now`` present
+    in any intermediate state of the run.  Decisions depend on ``now``
+    only through the threshold tests ``resv <= now`` and ``limit <=
+    now`` (reference do_next_request :1115-1186), so for any t in
+    [now, horizon) this exact decision sequence is what pulls at t
+    would also have produced -- the validity window for speculative
+    decision buffers.  Conservative: tags replaced mid-run count via
+    the initial-state minimum, created tags via per-step minima.
     """
 
+    def tag_horizon(st, t):
+        has_req = st.active & (st.depth > 0)
+        hr = jnp.min(jnp.where(has_req & (st.head_resv > t),
+                               st.head_resv, TIME_MAX))
+        nonready = has_req & ~st.head_ready & (st.head_limit > t)
+        hl = jnp.min(jnp.where(nonready, st.head_limit, TIME_MAX))
+        return jnp.minimum(hr, hl)
+
     def body(carry, _):
-        st, t = carry
+        st, t, h = carry
         st, dec = engine_step(st, t,
                               allow_limit_break=allow_limit_break,
                               anticipation_ns=anticipation_ns)
+        if with_horizon:
+            # the served client's freshly-created head tags are the only
+            # tags not present in the PREVIOUS state; fold them in
+            w = jnp.maximum(dec.slot, 0)
+            nr = st.head_resv[w]
+            nl = st.head_limit[w]
+            served = dec.slot >= 0
+            h = jnp.where(served & (nr > t), jnp.minimum(h, nr), h)
+            h = jnp.where(served & ~st.head_ready[w] & (nl > t),
+                          jnp.minimum(h, nl), h)
         if advance_now:
             t = jnp.where(dec.type == FUTURE, dec.when, t)
-        return (st, t), dec
+        return (st, t, h), dec
 
-    (state, now), decisions = lax.scan(body, (state, now), None,
-                                       length=steps)
+    h0 = tag_horizon(state, now) if with_horizon \
+        else jnp.int64(TIME_MAX)
+    (state, now, horizon), decisions = lax.scan(
+        body, (state, now, h0), None, length=steps)
+    if with_horizon:
+        return state, now, decisions, horizon
     return state, now, decisions
 
 

@@ -46,23 +46,64 @@ ClientInfoFunc = Callable[[Any], Optional[ClientInfo]]
 _JIT_CACHE: Dict[Tuple, Callable] = {}
 
 
+def _unpack_ops(packed) -> IngestOps:
+    """In-graph split of the packed [10, B] int64 op buffer.  The host
+    uploads ONE array per flush instead of ten (each host->device
+    transfer costs a device_put; at one flush per sim event the ten
+    transfers dominated the TPU-model sim's wall time)."""
+    return IngestOps(
+        kind=packed[0].astype(jnp.int32),
+        slot=packed[1].astype(jnp.int32),
+        time=packed[2], cost=packed[3], rho=packed[4],
+        delta=packed[5], resv_inv=packed[6], weight_inv=packed[7],
+        limit_inv=packed[8], order=packed[9])
+
+
 def _shared_jit_ingest(anticipation_ns: int):
     key = ("ingest", anticipation_ns)
     if key not in _JIT_CACHE:
-        _JIT_CACHE[key] = jax.jit(functools.partial(
-            kernels.ingest, anticipation_ns=anticipation_ns))
+        def ingest_packed(s, packed):
+            return kernels.ingest(s, _unpack_ops(packed),
+                                  anticipation_ns=anticipation_ns)
+        _JIT_CACHE[key] = jax.jit(ingest_packed)
     return _JIT_CACHE[key]
+
+
+def _pack_decisions(dec) -> jnp.ndarray:
+    """One int64 [6, steps] array per launch instead of a 6-array
+    pytree: each device->host array fetch pays fixed overhead, and the
+    sims fetch decisions once per service event."""
+    return jnp.stack([
+        dec.type.astype(jnp.int64), dec.slot.astype(jnp.int64),
+        dec.phase.astype(jnp.int64), dec.cost,
+        dec.when, dec.limit_break.astype(jnp.int64)])
 
 
 def _shared_jit_run(steps: int, advance_now: bool, allow: bool,
                     anticipation_ns: int):
     key = ("run", steps, advance_now, allow, anticipation_ns)
     if key not in _JIT_CACHE:
-        _JIT_CACHE[key] = jax.jit(
-            lambda s, t: kernels.engine_run(
+        def run(s, t):
+            s, _, dec = kernels.engine_run(
                 s, t, steps, allow_limit_break=allow,
                 anticipation_ns=anticipation_ns,
-                advance_now=advance_now))
+                advance_now=advance_now)
+            return s, _pack_decisions(dec)
+        _JIT_CACHE[key] = jax.jit(run)
+    return _JIT_CACHE[key]
+
+
+def _shared_jit_run_horizon(steps: int, allow: bool,
+                            anticipation_ns: int):
+    key = ("run_h", steps, allow, anticipation_ns)
+    if key not in _JIT_CACHE:
+        def run(s, t):
+            s, _, dec, hz = kernels.engine_run(
+                s, t, steps, allow_limit_break=allow,
+                anticipation_ns=anticipation_ns,
+                advance_now=False, with_horizon=True)
+            return s, _pack_decisions(dec), hz
+        _JIT_CACHE[key] = jax.jit(run)
     return _JIT_CACHE[key]
 
 
@@ -72,11 +113,13 @@ def _shared_jit_ingest_run(steps: int, advance_now: bool, allow: bool,
     if key not in _JIT_CACHE:
         ant = anticipation_ns
 
-        def fused(s, ops, t):
-            s = kernels.ingest(s, ops, anticipation_ns=ant)
-            return kernels.engine_run(
+        def fused(s, packed, t):
+            s = kernels.ingest(s, _unpack_ops(packed),
+                               anticipation_ns=ant)
+            s, _, dec = kernels.engine_run(
                 s, t, steps, allow_limit_break=allow,
                 anticipation_ns=ant, advance_now=advance_now)
+            return s, _pack_decisions(dec)
         _JIT_CACHE[key] = jax.jit(fused)
     return _JIT_CACHE[key]
 
@@ -95,12 +138,21 @@ class TpuPullPriorityQueue:
                  *,
                  at_limit: AtLimit = AtLimit.WAIT,
                  anticipation_timeout_ns: int = 0,
-                 capacity: int = 1024,
-                 ring_capacity: int = 64,
+                 # initial sizes only -- both grow by doubling on
+                 # demand.  Small defaults matter: every launch is a
+                 # dense pass over [capacity] (+ rings), so a 100-client
+                 # sim server at capacity 1024 pays 8x the compute of
+                 # capacity 128 per decision
+                 capacity: int = 128,
+                 ring_capacity: int = 16,
                  delayed_tag_calc: bool = True,
                  idle_age_s: float = 300.0,
                  erase_age_s: float = 600.0,
                  erase_max: int = 2000,
+                 # speculative decision buffer: pull_request() serves
+                 # from a prefetched batch of this size while provably
+                 # valid (see _pull_spec); 0 = one launch per pull
+                 speculative_batch: int = 0,
                  monotonic_clock: Callable[[], float] =
                  _walltime.monotonic):
         assert delayed_tag_calc, \
@@ -141,6 +193,20 @@ class TpuPullPriorityQueue:
         self.prop_sched_count = 0
         self.limit_break_sched_count = 0
 
+        # speculative decision buffer (see _pull_spec)
+        self._spec = int(speculative_batch)
+        self._spec_size = 1 if self._spec else 0  # adaptive, <= _spec
+        self.spec_hits = 0        # pulls served launch-free
+        self.spec_refills = 0
+        self.spec_settles = 0     # invalidations with unconsumed tail
+        self._buf: Deque[Tuple] = deque()
+        self._buf_slots: Dict[int, int] = {}
+        self._buf_horizon = 0
+        self._spec_pre: Optional[EngineState] = None
+        self._spec_t0 = 0
+        self._spec_consumed = 0
+        self._host_idle: set = set()
+
 
     # ------------------------------------------------------------------
     # jit plumbing
@@ -164,6 +230,7 @@ class TpuPullPriorityQueue:
     # capacity management
     # ------------------------------------------------------------------
     def _grow_capacity(self) -> None:
+        self._settle_spec()
         st = self.state
         old_n, new_n = st.capacity, st.capacity * 2
         self.state = EngineState(
@@ -197,6 +264,7 @@ class TpuPullPriorityQueue:
     def _grow_ring(self) -> None:
         """Double ring capacity, unrolling each row so q_head becomes 0
         (ring positions are modulo ring_capacity, which changes)."""
+        self._settle_spec()
         self._flush()
         st = self.state
         q = st.ring_capacity
@@ -214,8 +282,10 @@ class TpuPullPriorityQueue:
     # ------------------------------------------------------------------
     # op buffering
     # ------------------------------------------------------------------
-    def _build_ops(self) -> Optional[IngestOps]:
-        """Drain buffered rows into a padded IngestOps (None if empty)."""
+    def _build_ops(self):
+        """Drain buffered rows into ONE packed [10, padded] int64 array
+        (None if empty); the jitted consumers split it in-graph
+        (``_unpack_ops``).  A single host->device transfer per flush."""
         if not self._pending:
             return None
         rows = self._pending
@@ -225,17 +295,9 @@ class TpuPullPriorityQueue:
         padded = 1
         while padded < n:
             padded *= 2
-        cols = list(zip(*rows))
-        arrs = [np.zeros(padded, dtype=np.int64) for _ in range(10)]
-        for i, col in enumerate(cols):
-            arrs[i][:n] = col
-        return IngestOps(
-            kind=jnp.asarray(arrs[0], dtype=jnp.int32),
-            slot=jnp.asarray(arrs[1], dtype=jnp.int32),
-            time=jnp.asarray(arrs[2]), cost=jnp.asarray(arrs[3]),
-            rho=jnp.asarray(arrs[4]), delta=jnp.asarray(arrs[5]),
-            resv_inv=jnp.asarray(arrs[6]), weight_inv=jnp.asarray(arrs[7]),
-            limit_inv=jnp.asarray(arrs[8]), order=jnp.asarray(arrs[9]))
+        packed = np.zeros((10, padded), dtype=np.int64)
+        packed[:, :n] = np.asarray(rows, dtype=np.int64).T
+        return jnp.asarray(packed)
 
     def _flush(self) -> None:
         ops = self._build_ops()
@@ -253,7 +315,8 @@ class TpuPullPriorityQueue:
         with self.data_mtx:
             self.tick += 1
             slot = self._slot_of.get(client_id)
-            if slot is None:
+            created = slot is None
+            if created:
                 info = self.client_info_f(client_id)
                 assert info is not None
                 if not self._free:
@@ -274,6 +337,15 @@ class TpuPullPriorityQueue:
             self._pending.append(
                 (OP_ADD, slot, time_ns, cost, req_params.rho,
                  req_params.delta, 0, 0, 0, 0))
+            if self._buf:
+                # interference check (see the speculative-buffer notes):
+                # only a pure tail append to a non-idle client with no
+                # remaining buffered serve keeps the buffer valid
+                fresh = created or len(self._payloads[slot]) == 1
+                if fresh or slot in self._buf_slots or \
+                        slot in self._host_idle:
+                    self._settle_spec()
+            self._host_idle.discard(slot)
             return 0
 
     def _decision_to_pullreq(self, dtype: int, dslot: int, dphase: int,
@@ -301,17 +373,113 @@ class TpuPullPriorityQueue:
         if now_ns is None:
             now_ns = sec_to_ns(_walltime.time())
         with self.data_mtx:
+            if self._spec:
+                return self._pull_spec(now_ns)
             ops = self._build_ops()
             if ops is None:
-                self.state, _, dec = self._jit_run(1, False)(
-                    self.state, jnp.int64(now_ns))
+                self.state, dec = self._jit_run(1, False)(
+                    self.state, now_ns)
             else:
-                self.state, _, dec = self._jit_ingest_run(1, False)(
-                    self.state, ops, jnp.int64(now_ns))
+                self.state, dec = self._jit_ingest_run(1, False)(
+                    self.state, ops, now_ns)
             d = jax.device_get(dec)
             return self._decision_to_pullreq(
-                int(d.type[0]), int(d.slot[0]), int(d.phase[0]),
-                int(d.cost[0]), int(d.when[0]), bool(d.limit_break[0]))
+                int(d[0, 0]), int(d[1, 0]), int(d[2, 0]),
+                int(d[3, 0]), int(d[4, 0]), bool(d[5, 0]))
+
+    # ------------------------------------------------------------------
+    # speculative decision buffer
+    #
+    # One device launch computes a BATCH of decisions at time t0 plus a
+    # validity horizon: the earliest reservation/limit tag strictly past
+    # t0 present in any intermediate state (engine_run with_horizon).
+    # Decisions depend on `now` only through `tag <= now` threshold
+    # tests, so for any later pull at t in [t0, horizon) the buffered
+    # decision IS the decision a fresh launch would return -- zero
+    # launches for buffer hits.  Everything else falls back to exact
+    # recomputation:
+    #
+    # - `self.state` holds the POST-batch device state; `_spec_pre` the
+    #   pre-batch state (immutable arrays -- keeping it is free).  When
+    #   the buffer must be dropped with unconsumed entries,
+    #   _settle_spec replays exactly the consumed prefix from _spec_pre
+    #   (same t0, serial engine), so the logical state never includes a
+    #   serve that was not handed to the caller.
+    # - adds invalidate the buffer UNLESS provably non-interfering: a
+    #   tail append (client already queued) for a client with no
+    #   remaining buffered serve and not idle-marked commutes with
+    #   every buffered serve (it touches only that client's ring tail /
+    #   cur rho-delta, which no remaining buffered decision reads).
+    # - every other mutator / state reader settles first.
+    # ------------------------------------------------------------------
+    def _pull_spec(self, now_ns: int) -> PullReq:
+        if self._buf and self._spec_t0 <= now_ns < self._buf_horizon:
+            self.spec_hits += 1
+            d = self._buf.popleft()
+            self._spec_consumed += 1
+            slot = d[1]
+            left = self._buf_slots.get(slot, 0) - 1
+            if left <= 0:
+                self._buf_slots.pop(slot, None)
+            else:
+                self._buf_slots[slot] = left
+            return self._decision_to_pullreq(*d)
+        self.spec_refills += 1
+        # adaptive sizing: a fully-drained buffer doubles the next
+        # prefetch (up to speculative_batch); an early invalidation
+        # resets to 1 (see _settle_spec) so workloads whose every add
+        # interferes degrade to exactly the launch-per-pull path with
+        # no settle-replay cost
+        if self._spec_pre is not None and not self._buf:
+            self._spec_size = min(self._spec_size * 2, self._spec)
+        self._settle_spec()
+        self._flush()
+        pre = self.state
+        st, dec, hz = _shared_jit_run_horizon(
+            self._spec_size, self.at_limit is AtLimit.ALLOW,
+            self.anticipation_timeout_ns)(pre, now_ns)
+        self.state = st
+        d, horizon = jax.device_get((dec, hz))
+        first = (int(d[0, 0]), int(d[1, 0]), int(d[2, 0]),
+                 int(d[3, 0]), int(d[4, 0]), bool(d[5, 0]))
+        self._spec_pre = pre
+        self._spec_t0 = now_ns
+        self._spec_consumed = 1 if first[0] == RETURNING else 0
+        self._buf_horizon = int(horizon)
+        for i in range(1, d.shape[1]):
+            if int(d[0, i]) != RETURNING:
+                break
+            slot = int(d[1, i])
+            self._buf.append((RETURNING, slot, int(d[2, i]),
+                              int(d[3, i]), int(d[4, i]),
+                              bool(d[5, i])))
+            self._buf_slots[slot] = self._buf_slots.get(slot, 0) + 1
+        return self._decision_to_pullreq(*first)
+
+    def _settle_spec(self) -> None:
+        """Restore `self.state` to the logical state: the pre-batch
+        state advanced by exactly the consumed decisions."""
+        if self._spec_pre is not None and self._buf:
+            self.spec_settles += 1
+            self._spec_size = 1
+            if self._spec_consumed:
+                st, _ = self._jit_run(self._spec_consumed, False)(
+                    self._spec_pre, self._spec_t0)
+                self.state = st
+            else:
+                self.state = self._spec_pre
+        self._spec_pre = None
+        self._spec_consumed = 0
+        self._buf.clear()
+        self._buf_slots.clear()
+        self._buf_horizon = 0
+
+    def settle(self) -> None:
+        """Public: make `self.state` exactly reflect every decision
+        handed out so far (drops any speculative prefetch).  Call
+        before reading `state` externally (checkpointing does)."""
+        with self.data_mtx:
+            self._settle_spec()
 
     def pull_batch(self, now_ns: int, max_decisions: int,
                    advance_now: bool = False) -> List[PullReq]:
@@ -322,22 +490,41 @@ class TpuPullPriorityQueue:
         (with ``advance_now`` the clock jumps over FUTUREs instead, so
         only a trailing NONE terminates)."""
         with self.data_mtx:
+            out: List[PullReq] = []
+            if self._spec and not advance_now:
+                # drain the still-valid speculative prefix first: these
+                # are exactly the pulls a launch at this now would
+                # return, and a fully-drained buffer makes the settle
+                # below free (no replay)
+                while (len(out) < max_decisions and self._buf and
+                       self._spec_t0 <= now_ns < self._buf_horizon):
+                    self.spec_hits += 1
+                    d = self._buf.popleft()
+                    self._spec_consumed += 1
+                    slot = d[1]
+                    left = self._buf_slots.get(slot, 0) - 1
+                    if left <= 0:
+                        self._buf_slots.pop(slot, None)
+                    else:
+                        self._buf_slots[slot] = left
+                    out.append(self._decision_to_pullreq(*d))
+                if len(out) == max_decisions:
+                    return out
+            max_decisions -= len(out)
+            self._settle_spec()
             ops = self._build_ops()
             if ops is None:
-                self.state, _, dec = self._jit_run(
-                    max_decisions, advance_now)(self.state,
-                                                jnp.int64(now_ns))
+                self.state, dec = self._jit_run(
+                    max_decisions, advance_now)(self.state, now_ns)
             else:
-                self.state, _, dec = self._jit_ingest_run(
+                self.state, dec = self._jit_ingest_run(
                     max_decisions, advance_now)(self.state, ops,
-                                                jnp.int64(now_ns))
+                                                now_ns)
             d = jax.device_get(dec)
-            out: List[PullReq] = []
-            for i in range(len(d.type)):
+            for i in range(d.shape[1]):
                 pr = self._decision_to_pullreq(
-                    int(d.type[i]), int(d.slot[i]), int(d.phase[i]),
-                    int(d.cost[i]), int(d.when[i]),
-                    bool(d.limit_break[i]))
+                    int(d[0, i]), int(d[1, i]), int(d[2, i]),
+                    int(d[3, i]), int(d[4, i]), bool(d[5, i]))
                 if pr.is_retn():
                     out.append(pr)
                 elif advance_now and pr.is_future():
@@ -369,6 +556,7 @@ class TpuPullPriorityQueue:
         'heap', clients sorted by that heap's total order, showing the
         head tag as R/P/L/ready."""
         with self.data_mtx:
+            self._settle_spec()
             self._flush()
             st = jax.device_get(self.state)
             rows = []
@@ -421,6 +609,7 @@ class TpuPullPriorityQueue:
                 return
             # flush first: a buffered OP_CREATE for this slot would
             # otherwise replay stale inverses over the update
+            self._settle_spec()
             self._flush()
             info = self.client_info_f(client_id)
             st = self.state
@@ -440,6 +629,7 @@ class TpuPullPriorityQueue:
             slot = self._slot_of.get(client)
             if slot is None:
                 return
+            self._settle_spec()
             self._flush()
             q = self._payloads[slot]
             items = list(reversed(q)) if reverse else list(q)
@@ -455,6 +645,7 @@ class TpuPullPriorityQueue:
         """Filtered removal (reference :567-605).  Rare/administrative,
         so it syncs the affected clients' queues host<->device."""
         with self.data_mtx:
+            self._settle_spec()
             self._flush()
             any_removed = False
             for slot, q in self._payloads.items():
@@ -515,6 +706,7 @@ class TpuPullPriorityQueue:
         reference :1206-1255), freeing slots for reuse."""
         now = self._monotonic()
         with self.data_mtx:
+            self._settle_spec()
             self._flush()
             self._clean_mark_points.append((now, self.tick))
 
@@ -545,6 +737,9 @@ class TpuPullPriorityQueue:
             if idle_slots:
                 self.state = kernels.mark_idle(
                     self.state, jnp.asarray(idle_slots, dtype=jnp.int32))
+                # a later add to an idle client reactivates (prop_delta
+                # shift) -- the speculative buffer must not survive it
+                self._host_idle.update(idle_slots)
             if erase_slots:
                 self.state = kernels.deactivate(
                     self.state, jnp.asarray(erase_slots, dtype=jnp.int32))
@@ -553,6 +748,7 @@ class TpuPullPriorityQueue:
                     del self._slot_of[client]
                     del self._payloads[slot]
                     del self._last_tick[slot]
+                    self._host_idle.discard(slot)
                     self._free.append(slot)
             if len(erase_slots) < self.erase_max:
                 self._last_erase_point = 0

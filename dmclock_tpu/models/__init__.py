@@ -77,12 +77,17 @@ def _dmclock_tracker():
 
 def _dmclock_tpu_queue(server_id, client_info_f, anticipation_ns,
                        soft_limit):
-    # imported lazily so the CPU-only models don't pull in jax
+    # imported lazily so the CPU-only models don't pull in jax.
+    # speculative_batch: the sim pulls one decision per service event,
+    # so per-launch dispatch dominates; the buffer serves provably-
+    # valid prefetched decisions launch-free (exactness is covered by
+    # the oracle-vs-TPU trace parity suites, which run this factory)
     from ..engine import TpuPullPriorityQueue
     return TpuPullPriorityQueue(
         client_info_f,
         at_limit=AtLimit.ALLOW if soft_limit else AtLimit.WAIT,
-        anticipation_timeout_ns=anticipation_ns)
+        anticipation_timeout_ns=anticipation_ns,
+        speculative_batch=4)
 
 
 def _dmclock_native_queue(server_id, client_info_f, anticipation_ns,
@@ -98,7 +103,10 @@ def _dmclock_native_queue(server_id, client_info_f, anticipation_ns,
 
 def _dmclock_push_queue(delayed: bool):
     def factory(server_id, client_info_f, anticipation_ns, soft_limit,
-                *, can_handle_f, handle_f, now_ns_f, sched_at_f):
+                *, can_handle_f, handle_f, now_ns_f, sched_at_f,
+                capacity_f=None):
+        # host queue consults can_handle before EVERY dispatch (the
+        # reference's pacing); the free-slot count is unused
         from ..core import PushPriorityQueue
         return PushPriorityQueue(
             client_info_f, can_handle_f, handle_f,
@@ -112,17 +120,23 @@ def _dmclock_push_queue(delayed: bool):
 
 def _ssched_push_queue(server_id, client_info_f, anticipation_ns,
                        soft_limit, *, can_handle_f, handle_f, now_ns_f,
-                       sched_at_f):
+                       sched_at_f, capacity_f=None):
     return SimpleQueue(can_handle_f=can_handle_f, handle_f=handle_f)
 
 
 def _dmclock_tpu_push_queue(server_id, client_info_f, anticipation_ns,
                             soft_limit, *, can_handle_f, handle_f,
-                            now_ns_f, sched_at_f):
+                            now_ns_f, sched_at_f, capacity_f=None):
+    # capacity_f (the sim server's free-slot count, reference
+    # has_avail_thread sim_server.h:179) sizes each dispatch batch so
+    # one device launch serves a whole burst of free threads; with
+    # threads == 1 batches are size 1 and the decision stream is
+    # identical to the host push queue's one-per-trigger pacing
     from ..engine import TpuPushPriorityQueue
     return TpuPushPriorityQueue(
         client_info_f, can_handle_f, handle_f,
         now_ns_f=now_ns_f, sched_at_f=sched_at_f,
+        capacity_f=capacity_f,
         at_limit=AtLimit.ALLOW if soft_limit else AtLimit.WAIT,
         anticipation_timeout_ns=anticipation_ns)
 

@@ -135,17 +135,31 @@ class SimulatedServer:
 
     def _dispatch(self) -> None:
         while self.busy < self.threads:
-            pr = self.queue.pull_request(self.loop.now_ns)
-            if pr.is_retn():
-                self.busy += 1
-                self._start_service(pr)
-            elif pr.is_future():
-                when = pr.when_ready
-                if self._wake_at is None or when < self._wake_at:
-                    self._wake_at = when
-                    self.loop.at(max(when, self.loop.now_ns), self._wake)
-                break
+            free = self.threads - self.busy
+            if free > 1 and hasattr(self.queue, "pull_batch"):
+                # batched consumption: pull_batch(now, n) is defined as
+                # n successive pulls at the SAME now -- exactly this
+                # loop -- so the trace is identical with fewer device
+                # launches (reference free-slot count has_avail_thread,
+                # sim_server.h:179)
+                batch = self.queue.pull_batch(self.loop.now_ns, free)
             else:
+                batch = [self.queue.pull_request(self.loop.now_ns)]
+            done = False
+            for pr in batch:
+                if pr.is_retn():
+                    self.busy += 1
+                    self._start_service(pr)
+                elif pr.is_future():
+                    when = pr.when_ready
+                    if self._wake_at is None or when < self._wake_at:
+                        self._wake_at = when
+                        self.loop.at(max(when, self.loop.now_ns),
+                                     self._wake)
+                    done = True
+                else:
+                    done = True
+            if done:
                 break
 
     def _wake(self) -> None:
@@ -195,12 +209,16 @@ class PushSimulatedServer:
         self.busy = 0
         self.stats = ServerStats()
         self.trace = trace
-        # make_queue(can_handle_f, handle_f, now_ns_f, sched_at_f)
+        # make_queue(can_handle_f, handle_f, now_ns_f, sched_at_f,
+        # capacity_f); capacity_f is the free-slot count (reference
+        # has_avail_thread, sim_server.h:179) -- batch-capable queues
+        # (TPU) size a dispatch pass by it, host queues ignore it
         self.queue = make_queue(
             can_handle_f=lambda: self.busy < self.threads,
             handle_f=self._handle,
             now_ns_f=lambda: self.loop.now_ns,
-            sched_at_f=self._sched_at)
+            sched_at_f=self._sched_at,
+            capacity_f=lambda: self.threads - self.busy)
 
     def post(self, request: Any, client_id: Any, req_params: ReqParams,
              cost: int) -> None:

@@ -49,6 +49,10 @@ def queue_state_dict(q) -> dict:
     first would serialize a state the returned payload FIFOs are ahead
     of."""
     with q.data_mtx:
+        # drop any speculative prefetch so q.state is the logical
+        # state (exactly the decisions handed out so far), then flush
+        if hasattr(q, "_settle_spec"):
+            q._settle_spec()
         q._flush()
         return {
             "slot_of": dict(q._slot_of),
@@ -103,6 +107,17 @@ def restore_queue_state(q, st: dict) -> None:
 
     with q.data_mtx:
         q._pending = []      # drop ops buffered against the old state
+        # discard any speculative prefetch computed against the old
+        # state WITHOUT settling (settle would replay pre-restore
+        # decisions over the freshly restored device state)
+        q._buf.clear()
+        q._buf_slots.clear()
+        q._buf_horizon = 0
+        q._spec_pre = None
+        q._spec_consumed = 0
+        q._host_idle.clear()
+        if q._spec:
+            q._spec_size = 1
         q._clean_mark_points.clear()
         q._last_erase_point = 0
         q._slot_of = dict(st["slot_of"])

@@ -15,6 +15,11 @@ import time
 import jax
 import jax.numpy as jnp
 
+# the tag algebra is int64 ns end to end; enable x64 before any scalar
+# below is created so callers importing this module first (the sweep
+# scripts) don't silently truncate to int32
+jax.config.update("jax_enable_x64", True)
+
 
 @jax.jit
 def state_digest(st):

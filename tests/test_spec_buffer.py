@@ -1,0 +1,169 @@
+"""Speculative decision buffer: bit-exact vs the launch-per-pull queue.
+
+``TpuPullPriorityQueue(speculative_batch=k)`` prefetches a batch of
+decisions with a validity horizon and serves later pulls from it
+launch-free; adds invalidate unless provably non-interfering.  These
+tests drive random interleavings of adds and pulls (monotone now) on a
+buffered queue and an unbuffered twin and require the full decision
+stream -- client, phase, cost, FUTURE times -- to match, including
+around idle-marking, client creation mid-run, head installs, and
+update_client_info.
+"""
+
+import random
+
+import pytest
+
+from dmclock_tpu.core import ClientInfo, ReqParams
+from dmclock_tpu.core.timebase import NS_PER_SEC
+from dmclock_tpu.engine import TpuPullPriorityQueue
+
+S = NS_PER_SEC
+
+
+def pull_to_tuple(pr):
+    if pr.is_retn():
+        return ("RETN", pr.client, pr.request, pr.phase.name, pr.cost)
+    if pr.is_future():
+        return ("FUTURE", pr.when_ready)
+    return ("NONE",)
+
+
+def run_interleaving(seed, spec, n_clients=8, steps=300,
+                     infos=None):
+    rng = random.Random(seed)
+    if infos is None:
+        infos = {}
+        for c in range(n_clients):
+            kind = rng.randrange(4)
+            if kind == 0:
+                infos[c] = ClientInfo(rng.uniform(0.5, 3), 0, 0)
+            elif kind == 1:
+                infos[c] = ClientInfo(0, rng.uniform(0.5, 3), 0)
+            elif kind == 2:
+                infos[c] = ClientInfo(rng.uniform(0.5, 2),
+                                      rng.uniform(0.5, 3),
+                                      rng.uniform(2, 6))
+            else:
+                infos[c] = ClientInfo(0, 2, 0)
+    q = TpuPullPriorityQueue(lambda c: infos[c], capacity=16,
+                             ring_capacity=16,
+                             speculative_batch=spec)
+    out = []
+    t = S
+    seq = 0
+    for _ in range(steps):
+        t += rng.randint(0, S // 3)
+        op = rng.random()
+        if op < 0.45:
+            c = rng.randrange(n_clients)
+            delta = rng.randint(1, 5)
+            q.add_request(("r", c, seq), c,
+                          ReqParams(delta, rng.randint(1, delta)),
+                          time_ns=t, cost=rng.randint(1, 3))
+            seq += 1
+        elif op < 0.95:
+            out.append(pull_to_tuple(q.pull_request(t)))
+        else:
+            q.update_client_info(rng.randrange(n_clients))
+    # drain what's left at a far-future now
+    t += 10_000 * S
+    for _ in range(n_clients * 40):
+        pr = q.pull_request(t)
+        out.append(pull_to_tuple(pr))
+        if not pr.is_retn():
+            break
+    counters = (q.reserv_sched_count, q.prop_sched_count,
+                q.limit_break_sched_count)
+    counts = (q.client_count(), q.request_count(), q.empty())
+    return out, counters, counts
+
+
+@pytest.mark.parametrize("seed", [41, 42, 43, 44, 45, 46, 47, 48])
+def test_spec_buffer_stream_matches_unbuffered(seed):
+    a = run_interleaving(seed, spec=0)
+    b = run_interleaving(seed, spec=8)
+    assert a == b, f"seed {seed}: buffered stream diverges"
+
+
+def test_spec_buffer_heavy_single_client():
+    """Single deep client: every buffered serve retags the same client,
+    so the one-client interleavings stress consumed-prefix settling."""
+    infos = {0: ClientInfo(0, 1, 0), 1: ClientInfo(0, 3, 0)}
+    runs = []
+    for spec in (0, 8):
+        q = TpuPullPriorityQueue(lambda c: infos[c], capacity=8,
+                                 ring_capacity=32,
+                                 speculative_batch=spec)
+        out = []
+        t = S
+        for i in range(20):
+            q.add_request(("r", 0, i), 0, ReqParams(1, 1),
+                          time_ns=t, cost=1)
+        for i in range(30):
+            t += S // 10
+            if i == 10:
+                # mid-stream add for the OTHER client: new head install
+                # must invalidate the buffer
+                q.add_request(("r", 1, 0), 1, ReqParams(1, 1),
+                              time_ns=t, cost=1)
+            out.append(pull_to_tuple(q.pull_request(t)))
+        runs.append(out)
+    assert runs[0] == runs[1]
+
+
+def test_spec_buffer_idle_reactivation():
+    """do_clean idle-marks a client; its next add reactivates with a
+    prop_delta shift -- the buffer must not serve stale decisions."""
+    infos = {c: ClientInfo(0, 1 + c % 2, 0) for c in range(4)}
+    runs = []
+    for spec in (0, 8):
+        clock = [0.0]
+        q = TpuPullPriorityQueue(lambda c: infos[c], capacity=8,
+                                 ring_capacity=16,
+                                 speculative_batch=spec,
+                                 idle_age_s=10.0, erase_age_s=1e6,
+                                 monotonic_clock=lambda: clock[0])
+        out = []
+        t = S
+        for i in range(6):
+            for c in range(4):
+                q.add_request(("r", c, i), c, ReqParams(1, 1),
+                              time_ns=t, cost=1)
+        # drain client tags apart, then idle-mark via aged mark points
+        for _ in range(12):
+            t += S // 5
+            out.append(pull_to_tuple(q.pull_request(t)))
+        q.do_clean()
+        clock[0] += 20.0
+        q.do_clean()          # marks everything idle
+        t += 100 * S
+        q.add_request(("r", 0, 99), 0, ReqParams(1, 1), time_ns=t,
+                      cost=1)
+        for _ in range(16):
+            t += S // 5
+            pr = q.pull_request(t)
+            out.append(pull_to_tuple(pr))
+        runs.append(out)
+    assert runs[0] == runs[1]
+
+
+def test_spec_buffer_checkpoint_settles():
+    """queue_state_dict mid-buffer must produce a consistent snapshot
+    (payload FIFOs == logical device depths)."""
+    from dmclock_tpu.utils.checkpoint import queue_state_dict
+
+    infos = {c: ClientInfo(0, 1, 0) for c in range(4)}
+    q = TpuPullPriorityQueue(lambda c: infos[c], capacity=8,
+                             ring_capacity=16, speculative_batch=8)
+    t = S
+    for i in range(5):
+        for c in range(4):
+            q.add_request(("r", c, i), c, ReqParams(1, 1), time_ns=t,
+                          cost=1)
+    q.pull_request(2 * S)          # primes the buffer
+    st = queue_state_dict(q)
+    import numpy as np
+    depth = np.asarray(q.state.depth)
+    for s, d in st["payloads"].items():
+        assert len(d) == int(depth[s])
