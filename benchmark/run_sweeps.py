@@ -98,16 +98,21 @@ def _timed_prefix_epochs(state, now_ns, epochs, k, m, lat):
     jax.device_get(state_digest(ep.state))
     state = ep.state
 
+    # epochs chained ASYNC (no mid-run readback): one digest sync is
+    # timed and one latency subtracted; commit counts are fetched
+    # untimed afterwards.  (A per-epoch sync'd variant subtracted
+    # lat*trips, which overwhelms short chains through the ~110ms
+    # tunnel and can go negative.)
     t0 = time.perf_counter()
-    total = trips = 0
+    counts = []
     for _ in range(epochs):
         ep = run(state, jnp.int64(now_ns))
         state = ep.state
-        total += int(jax.device_get(ep.count).sum())
-        trips += 1
+        counts.append(ep.count)
     jax.device_get(state_digest(state))
-    trips += 1
-    t = time.perf_counter() - t0 - lat * trips
+    t = time.perf_counter() - t0 - lat
+    total = int(sum(int(jax.device_get(c).sum()) for c in counts))
+    assert t > 0, f"timing underflow: {t:.4f}s for {epochs} epochs"
     return total / t, total / (epochs * m * k)
 
 
@@ -123,7 +128,7 @@ def tpu_km_sweep():
     for k in (8192, 16384, 32768, 49152, 65536, 98304):
         for m in (8, 32):
             state = _preloaded_state(n, depth, ring=depth)
-            epochs = max(1, (1 << 21) // (m * k))
+            epochs = max(2, (1 << 23) // (m * k))
             dps, fill = _timed_prefix_epochs(state, 0, epochs, k, m, lat)
             rows.append((k, m, dps, fill))
             print(f"k={k} m={m}: {dps/1e6:.2f} M dec/s "
@@ -161,7 +166,7 @@ def tpu_regime_sweep():
         return st._replace(head_resv=jnp.asarray(rinv + jit))
 
     # pure reservation regime: now far beyond every reservation tag
-    dps, fill = _timed_prefix_epochs(resv_state(), 10**15, 4, k, m, lat)
+    dps, fill = _timed_prefix_epochs(resv_state(), 10**15, 8, k, m, lat)
     rows.append(("reservation backlog", dps, fill))
     print(f"reservation: {dps/1e6:.2f} M dec/s fill {fill:.3f}")
 
@@ -169,13 +174,13 @@ def tpu_regime_sweep():
     # eligible, then the regime flips to weight mid-epoch
     st = resv_state()
     now = int(np.asarray(st.head_resv).min()) + 2 * 10**7
-    dps, fill = _timed_prefix_epochs(st, now, 4, k, m, lat)
+    dps, fill = _timed_prefix_epochs(st, now, 8, k, m, lat)
     rows.append(("resv->weight transition", dps, fill))
     print(f"transition: {dps/1e6:.2f} M dec/s fill {fill:.3f}")
 
     # weight regime baseline at the same epoch budget
     dps, fill = _timed_prefix_epochs(
-        _preloaded_state(n, depth, ring=depth), 0, 4, k, m, lat)
+        _preloaded_state(n, depth, ring=depth), 0, 8, k, m, lat)
     rows.append(("weight steady state", dps, fill))
     print(f"weight: {dps/1e6:.2f} M dec/s fill {fill:.3f}")
 

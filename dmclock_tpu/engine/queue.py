@@ -412,18 +412,24 @@ class TpuPullPriorityQueue:
     #   cur rho-delta, which no remaining buffered decision reads).
     # - every other mutator / state reader settles first.
     # ------------------------------------------------------------------
+    def _consume_buf_entry(self) -> PullReq:
+        """Pop one buffered decision: consumed-prefix and per-slot
+        bookkeeping (the interference check and settle replay both
+        depend on these counts staying exact)."""
+        self.spec_hits += 1
+        d = self._buf.popleft()
+        self._spec_consumed += 1
+        slot = d[1]
+        left = self._buf_slots.get(slot, 0) - 1
+        if left <= 0:
+            self._buf_slots.pop(slot, None)
+        else:
+            self._buf_slots[slot] = left
+        return self._decision_to_pullreq(*d)
+
     def _pull_spec(self, now_ns: int) -> PullReq:
         if self._buf and self._spec_t0 <= now_ns < self._buf_horizon:
-            self.spec_hits += 1
-            d = self._buf.popleft()
-            self._spec_consumed += 1
-            slot = d[1]
-            left = self._buf_slots.get(slot, 0) - 1
-            if left <= 0:
-                self._buf_slots.pop(slot, None)
-            else:
-                self._buf_slots[slot] = left
-            return self._decision_to_pullreq(*d)
+            return self._consume_buf_entry()
         self.spec_refills += 1
         # adaptive sizing: a fully-drained buffer doubles the next
         # prefetch (up to speculative_batch); an early invalidation
@@ -498,16 +504,7 @@ class TpuPullPriorityQueue:
                 # below free (no replay)
                 while (len(out) < max_decisions and self._buf and
                        self._spec_t0 <= now_ns < self._buf_horizon):
-                    self.spec_hits += 1
-                    d = self._buf.popleft()
-                    self._spec_consumed += 1
-                    slot = d[1]
-                    left = self._buf_slots.get(slot, 0) - 1
-                    if left <= 0:
-                        self._buf_slots.pop(slot, None)
-                    else:
-                        self._buf_slots[slot] = left
-                    out.append(self._decision_to_pullreq(*d))
+                    out.append(self._consume_buf_entry())
                 if len(out) == max_decisions:
                     return out
             max_decisions -= len(out)

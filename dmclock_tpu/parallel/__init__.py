@@ -12,8 +12,9 @@ pod simulates an N-server storage cluster in a single program (SURVEY.md
 section 2, parallelism table).
 """
 
-from .cluster import (ClusterState, init_cluster, cluster_step,
-                      make_mesh, shard_cluster)
+from .cluster import (ClusterState, create_clients, init_cluster,
+                      cluster_step, install_clients, make_mesh,
+                      shard_cluster)
 from .tracker import (BorrowTrackerState, TrackerState,
                       borrow_tracker_prepare, borrow_tracker_track,
                       init_borrow_tracker, init_tracker,
@@ -21,7 +22,7 @@ from .tracker import (BorrowTrackerState, TrackerState,
 
 __all__ = [
     "ClusterState", "init_cluster", "cluster_step", "make_mesh",
-    "shard_cluster",
+    "shard_cluster", "create_clients", "install_clients",
     "TrackerState", "init_tracker", "tracker_prepare", "tracker_track",
     "BorrowTrackerState", "init_borrow_tracker",
     "borrow_tracker_prepare", "borrow_tracker_track",

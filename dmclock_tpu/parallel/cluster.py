@@ -82,18 +82,22 @@ def shard_cluster(cluster: ClusterState, mesh: Mesh) -> ClusterState:
 
 
 def install_clients(cluster: ClusterState, resv_inv, weight_inv,
-                    limit_inv) -> ClusterState:
+                    limit_inv, active_mask=None) -> ClusterState:
     """Register the same client population on every server (QoS inverses
     are [C] int64 arrays).  Creation order = client index, making the
-    cross-backend tie-break deterministic."""
+    cross-backend tie-break deterministic.  ``active_mask`` bool[C]
+    restricts the initial population (slots left inactive join later
+    via ``create_clients``); default: all C slots."""
     n_servers = cluster.now.shape[0]
     c = resv_inv.shape[0]
+    if active_mask is None:
+        active_mask = jnp.ones((c,), dtype=bool)
 
     def bcast(a):
         return jnp.broadcast_to(a, (n_servers, c))
 
     eng = cluster.engine._replace(
-        active=jnp.ones((n_servers, c), dtype=bool),
+        active=bcast(active_mask),
         order=bcast(jnp.arange(c, dtype=jnp.int64)),
         resv_inv=bcast(resv_inv), weight_inv=bcast(weight_inv),
         limit_inv=bcast(limit_inv),
@@ -126,6 +130,9 @@ def _one_server_step(engine: EngineState, tracker: TrackerState,
 
     c = arrivals_per_client.shape[0]
     slots = jnp.arange(c, dtype=jnp.int32)
+    cost_c = jnp.broadcast_to(cost, (c,))   # per-client costs ([C] or
+    #                                         scalar; heterogeneous
+    #                                         multi-tenant rounds)
     for wave in range(max_arrivals):
         requesting = arrivals_per_client > wave
         # waves after a client's first request this round re-mark an
@@ -140,7 +147,7 @@ def _one_server_step(engine: EngineState, tracker: TrackerState,
                            kernels.OP_NOP).astype(jnp.int32),
             slot=slots,
             time=jnp.broadcast_to(now, (c,)),
-            cost=jnp.broadcast_to(cost, (c,)),
+            cost=cost_c,
             rho=jnp.where(requesting, rho_out, 1),
             delta=jnp.where(requesting, delta_out, 1),
             resv_inv=jnp.zeros((c,), dtype=jnp.int64),
@@ -166,20 +173,29 @@ def _one_server_step(engine: EngineState, tracker: TrackerState,
 
 
 def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
-                 cost: int, mesh: Mesh, *,
+                 cost, mesh: Mesh, *,
                  decisions_per_step: int,
                  max_arrivals: int = 1,
                  anticipation_ns: int = 0,
-                 allow_limit_break: bool = False):
+                 allow_limit_break: bool = False,
+                 advance_ns: int = 0):
     """Advance the whole cluster: ``arrivals`` is int32[S, C] request
     counts (honored up to the static ``max_arrivals`` per client per
     round, wave-major order -- see _one_server_step), sharded over
-    servers.  Returns (cluster, decisions) with decisions' leaves
-    [S, k]-shaped.
+    servers.  ``cost`` is a scalar or an int64[C] per-client cost
+    vector (heterogeneous multi-tenant rounds; reference requests carry
+    per-request Cost, sim_recs.h:84).  Returns (cluster, decisions)
+    with decisions' leaves [S, k]-shaped.
 
     Jit this (it is pure); under jit XLA turns the psum into one ICI
     all-reduce per step.
+
+    ``advance_ns`` moves every server's virtual clock forward at round
+    start (the real time elapsing between arrival waves; without it a
+    weight-dominated cluster never advances past its reservation tags
+    and the constraint phase never engages).
     """
+    cost = jnp.asarray(cost, dtype=jnp.int64)
 
     def shard_fn(engine, tracker, now, arr):
         step = functools.partial(
@@ -190,8 +206,7 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
             max_arrivals=max_arrivals)
         # shards carry a leading [1] server axis; vmap it away
         engine, tracker, now, decs = jax.vmap(
-            lambda e, t, n, a: step(e, t, n, a,
-                                    cost=jnp.int64(cost)),
+            lambda e, t, n, a: step(e, t, n, a, cost=cost),
         )(engine, tracker, now, arr)
         return engine, tracker, now, decs
 
@@ -201,6 +216,45 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
         in_specs=(spec, spec, spec, spec),
         out_specs=(spec, spec, spec, spec),
         check_vma=False)
+    now0 = cluster.now + jnp.int64(advance_ns)
     engine, tracker, now, decs = fn(cluster.engine, cluster.tracker,
-                                    cluster.now, arrivals)
+                                    now0, arrivals)
     return ClusterState(engine=engine, tracker=tracker, now=now), decs
+
+
+def create_clients(cluster: ClusterState, new_mask: jnp.ndarray,
+                   resv_inv: jnp.ndarray, weight_inv: jnp.ndarray,
+                   limit_inv: jnp.ndarray, mesh: Mesh) -> ClusterState:
+    """Mid-run client creation, cluster-wide (the reference admits new
+    clients at their first request, dmclock_server.h:920-932; here
+    creation is an explicit sharded OP_CREATE ingest so slot==client
+    stays a cluster invariant).
+
+    ``new_mask`` bool[C] picks the slots to install; the QoS inverse
+    arrays are [C] (only masked entries are read).  Creation order =
+    slot index, preserving the cluster-wide deterministic tie-break.
+    New clients join every server; their tracker counters start fresh.
+    """
+    c = new_mask.shape[0]
+    slots = jnp.arange(c, dtype=jnp.int32)
+    ops = kernels.IngestOps(
+        kind=jnp.where(new_mask, kernels.OP_CREATE,
+                       kernels.OP_NOP).astype(jnp.int32),
+        slot=slots,
+        time=jnp.zeros((c,), dtype=jnp.int64),
+        cost=jnp.ones((c,), dtype=jnp.int64),
+        rho=jnp.ones((c,), dtype=jnp.int64),
+        delta=jnp.ones((c,), dtype=jnp.int64),
+        resv_inv=resv_inv, weight_inv=weight_inv, limit_inv=limit_inv,
+        order=slots.astype(jnp.int64),
+    )
+
+    def shard_fn(engine):
+        return jax.vmap(lambda e: kernels.ingest(
+            e, ops, anticipation_ns=0))(engine)
+
+    spec = P(SERVER_AXIS)
+    engine = jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(spec,), out_specs=spec,
+        check_vma=False)(cluster.engine)
+    return cluster._replace(engine=engine)

@@ -109,15 +109,17 @@ def restore_queue_state(q, st: dict) -> None:
         q._pending = []      # drop ops buffered against the old state
         # discard any speculative prefetch computed against the old
         # state WITHOUT settling (settle would replay pre-restore
-        # decisions over the freshly restored device state)
-        q._buf.clear()
-        q._buf_slots.clear()
-        q._buf_horizon = 0
-        q._spec_pre = None
-        q._spec_consumed = 0
-        q._host_idle.clear()
-        if q._spec:
-            q._spec_size = 1
+        # decisions over the freshly restored device state); guarded
+        # like the save side so non-speculative queue types round-trip
+        if hasattr(q, "_buf"):
+            q._buf.clear()
+            q._buf_slots.clear()
+            q._buf_horizon = 0
+            q._spec_pre = None
+            q._spec_consumed = 0
+            q._host_idle.clear()
+            if q._spec:
+                q._spec_size = 1
         q._clean_mark_points.clear()
         q._last_erase_point = 0
         q._slot_of = dict(st["slot_of"])

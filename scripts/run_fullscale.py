@@ -1,9 +1,12 @@
 #!/usr/bin/env python
 """CI entry for the full-scale TPU parity gates.
 
-Runs the env-gated 100x100 acceptance-config parity test
-(``tests/test_sim_tpu_fullscale.py``) with ``DMCLOCK_FULLSCALE=1`` set,
-on the virtual CPU mesh (same backend selection as the test suite).
+Runs the env-gated minutes-long parity tests with
+``DMCLOCK_FULLSCALE=1`` set, on the virtual CPU mesh (same backend
+selection as the test suite): the 100x100 acceptance-config sim parity
+(``tests/test_sim_tpu_fullscale.py``) and the 8x1000-client cluster
+parity for both tracker policies
+(``tests/test_cluster_realism.py::test_cluster_parity_fullscale``).
 Kept as a separate entry point so the default ``pytest tests/`` stays
 fast; ``scripts/ci.sh`` invokes this after the main suite.
 
@@ -21,6 +24,7 @@ def main() -> int:
     env = dict(os.environ, DMCLOCK_FULLSCALE="1")
     cmd = [sys.executable, "-m", "pytest",
            os.path.join(REPO, "tests", "test_sim_tpu_fullscale.py"),
+           os.path.join(REPO, "tests", "test_cluster_realism.py"),
            "-q", *sys.argv[1:]]
     return subprocess.call(cmd, cwd=REPO, env=env)
 
