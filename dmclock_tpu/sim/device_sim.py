@@ -19,9 +19,15 @@ throughput:
   window at the slice start; completions feed back with one-slice
   latency (outstanding decreases at the end of the slice that served
   them).
-- Server selection is the harness's deterministic policy
-  (``Simulation._make_server_select`` non-random branch); random
-  selection needs the host's RNG stream and stays host-side.
+- Server selection: the harness's deterministic policy
+  (``Simulation._make_server_select`` non-random branch), or -- with
+  ``server_random_selection`` -- a device-side counter RNG
+  (splitmix64 hash of (client, send-sequence), reference random policy
+  ``simulate.h:401-444``): stateless, reproducible, identical on every
+  shard.
+- Multi-thread servers serve ``threads * q`` requests per slice (the
+  harness's aggregate-rate model: op_time = threads/iops,
+  ``sim_server.h:136-139``).
 
 QoS semantics (tags, phases, AtLimit, idle-reactivation, the tracker
 algebra) are exactly the engine's -- inherited from ``kernels.ingest``
@@ -89,19 +95,23 @@ class DeviceSimSpec:
     max_sends: int             # per client per slice (static bound)
     slice_ns: int
     allow_limit_break: bool
+    random_select: bool = False
+    force_scan: bool = False   # test hook: disable the prefix serve
 
 
 def _make_spec(cfg: SimConfig, q_per_slice: int = 4) -> DeviceSimSpec:
-    assert not cfg.server_random_selection, \
-        "device_sim uses the deterministic server-select policy"
     iops = {g.server_iops for g in cfg.srv_group}
     threads = {g.server_threads for g in cfg.srv_group}
-    assert len(iops) == 1 and threads == {1}, \
-        "device_sim v1: uniform single-thread servers"
+    assert len(iops) == 1 and len(threads) == 1, \
+        "device_sim: uniform server groups (iops and threads)"
     n_servers = sum(g.server_count for g in cfg.srv_group)
     n_clients = sum(g.client_count for g in cfg.cli_group)
-    op_time_ns = int(0.5 + 1e6 / iops.pop()) * 1000
+    n_threads = threads.pop()
+    # aggregate service rate stays iops: T threads each at op_time =
+    # T/iops (sim_server.h:136-139) -> T*q serves per q*op_time slice
+    op_time_ns = int(0.5 + n_threads * 1e6 / iops.pop()) * 1000
     slice_ns = op_time_ns * q_per_slice
+    q_per_slice = q_per_slice * n_threads
     # static bound on sends per client per slice; refuse configs whose
     # offered load cannot be expressed (a silent clamp would misreport
     # a simulator artifact as a QoS limit)
@@ -116,7 +126,8 @@ def _make_spec(cfg: SimConfig, q_per_slice: int = 4) -> DeviceSimSpec:
         n_servers=n_servers, n_clients=n_clients,
         op_time_ns=op_time_ns, q_per_slice=q_per_slice,
         max_sends=max_sends, slice_ns=slice_ns,
-        allow_limit_break=cfg.server_soft_limit)
+        allow_limit_break=cfg.server_soft_limit,
+        random_select=cfg.server_random_selection)
 
 
 def init_device_sim(cfg: SimConfig, ring_capacity: int = 256
@@ -214,15 +225,35 @@ def _slice_sends(load: ClientLoad, t0, slice_ns: int, max_sends: int):
     return jnp.maximum(n, 0)
 
 
+def _splitmix64(x):
+    """Stateless counter hash (splitmix64 finalizer): the device-side
+    RNG for random server selection -- same value on every shard for a
+    given (client, sequence), no carried RNG state."""
+    x = (x + jnp.int64(-7046029254386353131))      # 0x9E3779B97F4A7C15
+    z = x
+    z = (z ^ (z >> 30)) * jnp.int64(-4658895280553007687)
+    z = (z ^ (z >> 27)) * jnp.int64(-7723592293110705685)
+    return z ^ (z >> 31)
+
+
 def _sends_to_server(load: ClientLoad, n, wave: int, server_ids,
-                     n_servers: int):
+                     n_servers: int, random_select: bool):
     """Does client c's ``wave``-th send this slice target THIS server?
-    (deterministic policy: (sel_base + seq % range) % n_servers).
+    Deterministic policy: (sel_base + seq % range) % n_servers; random
+    policy: sel_base + hash(client, seq) % range (the reference picks
+    uniformly within the client's server window, simulate.h:401-444).
     ``n_servers`` is the static GLOBAL count -- server_ids.shape[0]
     inside shard_map is only the local shard slice."""
     seq = load.sent + wave
-    target = (load.sel_base
-              + jnp.remainder(seq, load.sel_range)) % n_servers
+    if random_select:
+        c = seq.shape[0]
+        h = _splitmix64(seq.astype(jnp.int64) * jnp.int64(1 << 20)
+                        + jnp.arange(c, dtype=jnp.int64))
+        pick = jnp.remainder(jnp.abs(h), load.sel_range.astype(jnp.int64))
+        target = (load.sel_base + pick.astype(jnp.int32)) % n_servers
+    else:
+        target = (load.sel_base
+                  + jnp.remainder(seq, load.sel_range)) % n_servers
     return (n > wave) & (target[None, :] == server_ids[:, None])
 
 
@@ -247,7 +278,7 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
             def ingest_wave(carry2, wave):
                 engine, tracker = carry2
                 mine = _sends_to_server(load, n, wave, server_ids,
-                                        s_total)
+                                        s_total, spec.random_select)
 
                 def per_server(eng, trk, mine_row):
                     trk, d_out, r_out = tracker_prepare(
@@ -271,16 +302,40 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                 (engine, tracker), _ = ingest_wave((engine, tracker),
                                                    wave)
 
-            # serve q decisions per server at the slice boundary
+            # serve q decisions per server at the slice boundary.
+            # Large q (throughput shapes) uses the prefix-commit batch:
+            # one sort-and-commit pass instead of a q-step serial scan,
+            # committing the exact serial prefix (any re-entry
+            # shortfall rolls into the next slice -- the server serves
+            # at MOST its rate, never out of order).  AtLimit::Allow
+            # needs the serial engine's limit-break path, so it keeps
+            # the scan.
             t_end = t + spec.slice_ns
+            # prefix batches need k <= client count (the selection
+            # sort yields one row per client)
+            use_prefix = (256 <= spec.q_per_slice <= spec.n_clients
+                          and not spec.allow_limit_break
+                          and not spec.force_scan)
 
-            def per_server_run(eng):
-                return kernels.engine_run(
-                    eng, t_end, spec.q_per_slice,
-                    allow_limit_break=spec.allow_limit_break,
-                    anticipation_ns=0, advance_now=False)
+            if use_prefix:
+                from ..engine.fastpath import speculate_prefix_batch
 
-            engine, _, decs = jax.vmap(per_server_run)(engine)
+                def per_server_run(eng):
+                    batch = speculate_prefix_batch(
+                        eng, t_end, spec.q_per_slice,
+                        anticipation_ns=0)
+                    return batch.state, batch.decisions
+
+                engine, decs = jax.vmap(per_server_run)(engine)
+            else:
+                def per_server_run(eng):
+                    eng, _, d = kernels.engine_run(
+                        eng, t_end, spec.q_per_slice,
+                        allow_limit_break=spec.allow_limit_break,
+                        anticipation_ns=0, advance_now=False)
+                    return eng, d
+
+                engine, decs = jax.vmap(per_server_run)(engine)
             served = decs.type == kernels.RETURNING
 
             def per_server_track(trk, d_slot, d_cost, d_phase, d_srv):

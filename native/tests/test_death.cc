@@ -1,0 +1,166 @@
+// Death tests: invalid configurations must abort, fork-based (the
+// reference gtest suite death-tests the same contracts with
+// EXPECT_DEATH + a PrCtl coredump guard,
+// /root/reference/test/test_dmclock_server.cc:51-97 + test/dmcPrCtl.h;
+// gtest is unavailable here, so each case runs in a forked child and
+// the parent asserts on SIGABRT).  Also a heap fuzz against a sorted
+// model (reference test_indirect_intrusive_heap.cc:266-465 territory,
+// extended with an oracle).
+
+#include <sys/prctl.h>
+#include <sys/resource.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <map>
+#include <memory>
+#include <random>
+#include <vector>
+
+#include "dmclock/indirect_heap.h"
+#include "dmclock/scheduler.h"
+#include "microtest.h"
+
+using namespace dmclock;
+
+using Q = PullPriorityQueue<uint64_t, uint64_t>;
+constexpr int64_t S = NS_PER_SEC;
+
+// Runs fn() in a forked child with coredumps disabled; returns true
+// iff the child died with SIGABRT.
+template <typename Fn>
+static bool dies_with_abort(Fn&& fn) {
+  pid_t pid = fork();
+  if (pid == 0) {
+    // no coredump, no stderr spam from the expected abort message
+    prctl(PR_SET_DUMPABLE, 0);
+    struct rlimit rl {0, 0};
+    setrlimit(RLIMIT_CORE, &rl);
+    freopen("/dev/null", "w", stderr);
+    fn();
+    _exit(0);  // survived: NOT a death
+  }
+  int status = 0;
+  waitpid(pid, &status, 0);
+  return WIFSIGNALED(status) && WTERMSIG(status) == SIGABRT;
+}
+
+MT_TEST(zero_reservation_and_weight_aborts) {
+  // reference bad_tag_deathtest client1: r=0 w=0 l=0
+  MT_CHECK(dies_with_abort([] {
+    Q q([](const uint64_t&) { return ClientInfo(0.0, 0.0, 0.0); },
+        Q::Options{});
+    q.add_request(1, 17, ReqParams(1, 1), 1 * S, 1);
+  }));
+}
+
+MT_TEST(zero_rw_with_limit_still_aborts) {
+  // reference bad_tag_deathtest client2: r=0 w=0 l=1 -- a limit alone
+  // cannot make a client schedulable
+  MT_CHECK(dies_with_abort([] {
+    Q q([](const uint64_t&) { return ClientInfo(0.0, 0.0, 1.0); },
+        Q::Options{});
+    q.add_request(1, 18, ReqParams(1, 1), 1 * S, 1);
+  }));
+}
+
+MT_TEST(reject_with_delayed_tags_aborts) {
+  // reference: Queue(client_info_f, AtLimit::Reject) with delayed
+  // calc must die (reference :856-857 static assert analog)
+  MT_CHECK(dies_with_abort([] {
+    Q::Options o;
+    o.delayed_tag_calc = true;
+    o.at_limit = AtLimit::Reject;
+    Q q([](const uint64_t&) { return ClientInfo(1.0, 1.0, 0.0); }, o);
+  }));
+}
+
+MT_TEST(valid_configs_do_not_abort) {
+  // negative control: the harness must distinguish death from life
+  MT_CHECK(!dies_with_abort([] {
+    Q q([](const uint64_t&) { return ClientInfo(1.0, 1.0, 0.0); },
+        Q::Options{});
+    q.add_request(1, 17, ReqParams(1, 1), 1 * S, 1);
+    (void)q.pull_request(2 * S);
+  }));
+  MT_CHECK(!dies_with_abort([] {
+    // Reject with IMMEDIATE tags is the supported combination
+    Q::Options o;
+    o.delayed_tag_calc = false;
+    o.at_limit = AtLimit::Reject;
+    o.reject_threshold_ns = S;
+    Q q([](const uint64_t&) { return ClientInfo(1.0, 1.0, 2.0); }, o);
+  }));
+}
+
+// ---------------------------------------------------------------------
+// heap fuzz vs a sorted model: every operation interleaving must keep
+// top() == model minimum, and the final drain must come out sorted
+// ---------------------------------------------------------------------
+
+struct FElem {
+  int key;
+  size_t pos = dmclock::HEAP_NOT_IN;
+  explicit FElem(int k) : key(k) {}
+};
+struct FCmp {
+  bool operator()(const FElem& a, const FElem& b) const {
+    return a.key < b.key;
+  }
+};
+using FHeap = IndirectHeap<FElem, FCmp, &FElem::pos>;
+
+MT_TEST(heap_fuzz_vs_sorted_model) {
+  std::mt19937 rng(1234);
+  for (unsigned k : {2u, 3u, 5u, 8u}) {
+    FHeap h(k);
+    std::vector<std::unique_ptr<FElem>> owner;
+    std::vector<FElem*> live;  // model: membership list
+    int unique = 0;
+    for (int step = 0; step < 4000; ++step) {
+      int op = int(rng() % 100);
+      if (op < 40 || live.empty()) {          // push
+        owner.push_back(std::make_unique<FElem>(
+            int((rng() % 100000) << 8 | (unique++ & 0xFF))));
+        live.push_back(owner.back().get());
+        h.push(owner.back().get());
+      } else if (op < 60) {                   // pop-min
+        FElem* top = &h.top();
+        auto it = std::min_element(
+            live.begin(), live.end(),
+            [](FElem* a, FElem* b) { return a->key < b->key; });
+        MT_CHECK(top == *it);                 // exact element identity
+        h.pop();
+        live.erase(std::find(live.begin(), live.end(), top));
+      } else if (op < 80) {                   // adjust (rekey in place)
+        FElem* e = live[rng() % live.size()];
+        e->key = int((rng() % 100000) << 8 | (unique++ & 0xFF));
+        h.adjust(e);
+      } else {                                // remove arbitrary
+        FElem* e = live[rng() % live.size()];
+        h.remove(e);
+        live.erase(std::find(live.begin(), live.end(), e));
+      }
+      if (!live.empty()) {
+        auto it = std::min_element(
+            live.begin(), live.end(),
+            [](FElem* a, FElem* b) { return a->key < b->key; });
+        MT_CHECK(h.top().key == (*it)->key);
+      } else {
+        MT_CHECK(h.empty());
+      }
+    }
+    // drain: must come out in sorted order and match the model set
+    std::vector<int> drained, expect;
+    for (FElem* e : live) expect.push_back(e->key);
+    std::sort(expect.begin(), expect.end());
+    while (!h.empty()) {
+      drained.push_back(h.top().key);
+      h.pop();
+    }
+    MT_CHECK(drained == expect);
+  }
+}
+
+int main() { return microtest::run_all(); }
