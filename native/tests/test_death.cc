@@ -136,10 +136,10 @@ MT_TEST(heap_fuzz_vs_sorted_model) {
       } else if (op < 80) {                   // adjust (rekey in place)
         FElem* e = live[rng() % live.size()];
         e->key = int((rng() % 100000) << 8 | (unique++ & 0xFF));
-        h.adjust(e);
+        h.adjust(*e);
       } else {                                // remove arbitrary
         FElem* e = live[rng() % live.size()];
-        h.remove(e);
+        h.remove(*e);
         live.erase(std::find(live.begin(), live.end(), e));
       }
       if (!live.empty()) {
