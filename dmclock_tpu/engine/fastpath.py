@@ -1,32 +1,38 @@
-"""Speculative batched serving: thousands of decisions per O(N) pass.
+"""Prefix-commit speculative serving: thousands of decisions per O(N) pass.
 
 The exact engine (`kernels.engine_step`) pays an O(N) masked-argmin per
 decision -- semantically perfect, bandwidth-bound at scale.  This module
 exploits the structure of dmClock steady states: with a deep backlog,
 consecutive decisions serve DISTINCT clients (each serve advances that
 client's virtual time by ~inv, far past the tag spacing between
-clients), and serves of distinct clients commute.  So a batch of k
-decisions is just the k smallest candidate tags -- one `top_k` plus
-O(k) vectorized serves -- *provided* the speculation is validated.
+clients), and serves of distinct clients commute.  A full lexicographic
+sort of the candidate (tag, creation-order) keys yields the ENTIRE
+candidate service order in one pass, and the engine commits the longest
+prefix of it that is provably what the serial engine would have served
+-- computed ON DEVICE, so there is no fallback cliff.
 
-Two speculative regimes, each with an on-device validity check that
-compares against what the serial engine would have done (`engine_run`):
+Exactness argument (differentially tested against `engine_run`):
+candidates are served in sorted (key, order) ascending order -- the
+serial engine's total order.  Serving candidate p re-enters its client
+at a new key r_p (its freshly-tagged next head; +inf if it empties or
+leaves the candidate set).  The speculative order equals the serial
+order up to position q iff ``min_{p<q} r_p > (key_q, order_q)`` at every
+position <= q -- the serial engine would have picked the re-entered head
+first otherwise.  Since keys ascend and the cumulative min only
+descends, the condition fails monotonically: the first failing position
+ends the exact prefix.  Regime-exit events (a weight-phase serve making
+the client's reservation tag eligible, reference do_next_request
+:1124-1128) are encoded as r_p = -inf, stopping the prefix right
+after p.  Guaranteed progress: whenever the serial engine would RETURN
+a request at ``now``, the prefix is >= 1; the serial engine is needed
+only for the never-observed global rebase-guard failures (see
+``make_prefix_runner``).
 
-- **weight regime** (reference weight phase, do_next_request :1146-1151):
-  no reservation tag is eligible (resv_min > now) and stays so through
-  the batch; candidates are effectively-ready clients by
-  (proportion + prop_delta, order).
-- **reservation regime** (constraint phase, :1124-1128): every served
-  tag is <= now (deep reservation backlog); weight phase is never
-  reached, so no promotion side-effects occur.
-
-Checks performed AFTER the vectorized serve (cheap, [k]-sized):
-one-serve-per-client (each new head tag must leave the served window),
-phase stability (reservation tags stay ineligible in the weight regime /
-served tags all eligible in the reservation regime), and strict key
-separation at the batch boundary (tie safety).  On failure the caller
-falls back to the exact serial engine for that batch -- results are
-therefore always bit-identical to `engine_run` (differentially tested).
+The regime of each batch is picked exactly as the serial engine's first
+decision would (reservation phase iff the lowest reservation tag is
+eligible, :1124-1128); weight-phase candidates are effectively-ready
+clients ordered by (proportion + prop_delta, order), reservation-phase
+candidates by (reservation tag, order).
 
 Restrictions (checked by the caller): AtLimit::Wait, monotonic `now`,
 fixed `now` within a batch.  The stored `ready` flags are superseded by
@@ -53,15 +59,6 @@ from .kernels import (KEY_INF, NONE, RETURNING, Decision, _make_tag,
 from .state import EngineState
 
 
-class FastBatch(NamedTuple):
-    """Result of one speculative attempt."""
-
-    state: EngineState
-    ok: jnp.ndarray        # bool: speculation valid; else state is the
-    #                        INPUT state (caller reruns serially)
-    decisions: Decision    # [k] arrays, valid where ok
-
-
 # Selection = ONE full lexicographic sort on 32-bit rebased keys.  TPUs
 # emulate int64 as register pairs, so sorting (key-key_min) as int32 with
 # a second int32 creation-order key is ~4x cheaper than a packed-int64
@@ -78,8 +75,7 @@ _ORDER32_LIMIT = jnp.int64(1) << 31
 
 class _Rebase(NamedTuple):
     """Shared 32-bit rebase of (key, order) + the global exactness
-    guards.  This is the overflow-sensitive core both selection paths
-    (all-or-nothing and prefix-commit) must agree on."""
+    guards.  This is the overflow-sensitive core of prefix selection."""
 
     real: jnp.ndarray      # bool[N] key < KEY_INF
     kmin: jnp.ndarray      # int64 scalar: min real key (rebase origin)
@@ -107,35 +103,6 @@ def _rebase32(key, order, cost) -> _Rebase:
     guards_ok = (omax - omin < _ORDER32_LIMIT) & cost_ok
     return _Rebase(real=real, kmin=kmin, k32=k32, o32=o32,
                    guards_ok=guards_ok)
-
-
-def _sorted_selection(key, order, k: int, cost):
-    """Indices of the k lexicographically-smallest (key, order) pairs,
-    sorted ascending (= exact serial service order).
-
-    Returns (idx[k], V, max_tied_order, ok, cost[k]) where V is the
-    k-th smallest key and max_tied_order the largest creation order
-    selected at the V boundary.  ``ok`` is False when fewer than k real
-    in-window candidates exist (sentinel keys carry KEY_INF) or a
-    rebase window overflowed at the boundary -- the caller must then
-    fall back to the serial engine.
-
-    ``cost`` (int64[N], non-negative) rides the sort as an int32
-    payload so the decision emit avoids a [k]-sized gather (TPU
-    gathers serialize); a cost that overflows int32 fails ``ok``.
-    """
-    rb = _rebase32(key, order, cost)
-    iota = jnp.arange(key.shape[0], dtype=jnp.int32)
-    ks, _, idxs, cs = lax.sort(
-        (rb.k32, rb.o32, iota, cost.astype(jnp.int32)), num_keys=2)
-    vk = ks[k - 1]
-    # vk < _CLAMP32 ensures >= k real candidates AND that every
-    # selected key fit the rebase window (clamped/sentinel rows sort at
-    # or past _CLAMP32); the rebase guards must hold too.
-    ok = (vk < _CLAMP32) & rb.guards_ok
-    v = rb.kmin + vk.astype(jnp.int64)
-    max_tied_order = order[idxs[k - 1]]
-    return idxs[:k], v, max_tied_order, ok, cs[:k].astype(jnp.int64)
 
 
 def _ready_now(state: EngineState, now):
@@ -376,188 +343,9 @@ def _commit_serves(state: EngineState, mask, serve: DenseServe,
     )
 
 
-def _served_mask(key, order, v, max_tied_order):
-    """Dense membership of the k-smallest (key, order) set: strictly
-    below the kth key V, or tied at V with creation order within the
-    selected tie prefix (orders are unique, so ``order <=
-    max_tied_order`` picks exactly the chosen ties)."""
-    real = key < KEY_INF
-    return real & ((key < v) |
-                   ((key == v) & (order <= max_tied_order)))
-
-
 def _default_heads(state: EngineState):
     """Single-batch ring-head read (the m=1 window)."""
     return _window_heads(state, ring_window(state, 1))
-
-
-def speculate_weight_batch(state: EngineState, now, k: int, *,
-                           anticipation_ns: int,
-                           enabled=True,
-                           heads=None) -> FastBatch:
-    """k weight-phase serves in one pass; state untouched when the
-    speculation fails (ok=False) or `enabled` is False."""
-    if heads is None:
-        heads = _default_heads(state)
-    has_req = state.active & (state.depth > 0)
-    ready = has_req & _ready_now(state, now)
-    eff = state.head_prop + state.prop_delta
-    key = jnp.where(ready & (state.head_prop < MAX_TAG), eff, KEY_INF)
-
-    # entry condition: reservation phase must not fire (:1124-1128)
-    resv_key = jnp.where(has_req, state.head_resv, KEY_INF)
-    resv_min0 = jnp.min(resv_key)
-    cond_entry = resv_min0 > now
-
-    idx, kth, max_tied_order, cond_count, sel_cost = _sorted_selection(
-        key, state.order, k, cost=state.head_cost)
-    mask = _served_mask(key, state.order, kth, max_tied_order)
-
-    serve = _dense_serve(state, heads, True, anticipation_ns)
-
-    # one-serve-per-client: each served client must leave the window --
-    # its new head either empty, not ready at `now`, keyed strictly past
-    # the boundary V, or tied at V but ordered after every served tie
-    # (so the serial engine would also leave it unserved)
-    new_eff = serve.head_prop + state.prop_delta
-    new_ready = (serve.head_limit <= now) & (serve.head_prop < MAX_TAG)
-    beyond = (new_eff > kth) | \
-        ((new_eff == kth) & (state.order > max_tied_order))
-    cond_once = jnp.all(~mask | ~serve.has_more | ~new_ready | beyond)
-    # phase stability: no served client's new reservation tag becomes
-    # eligible (unserved clients' tags didn't move; entry checked them)
-    cond_resv = jnp.all(~mask | ~serve.has_more |
-                        (serve.head_resv > now))
-
-    ok = cond_entry & cond_count & cond_once & cond_resv
-    gate = ok & enabled
-
-    new_state = _commit_serves(state, mask, serve, gate)
-
-    # idx is already in exact serial order: (key, order) ascending
-
-    # Stored-flag parity with the serial engine: every weight decision
-    # runs the promote loop first (reference :1135-1144), so at batch
-    # end every current head with limit <= now carries ready=True --
-    # except the head popped by the LAST decision, which no later
-    # promotion pass ever saw.
-    has_req_after = new_state.active & (new_state.depth > 0)
-    promoted = new_state.head_ready | \
-        (has_req_after & (new_state.head_limit <= now))
-    last_client = idx[k - 1]
-    promoted = promoted & (
-        jnp.arange(state.capacity, dtype=jnp.int32) != last_client)
-    new_state = new_state._replace(head_ready=jnp.where(
-        gate, promoted, new_state.head_ready))
-
-    decisions = Decision(
-        type=jnp.zeros((k,), dtype=jnp.int32),
-        slot=idx.astype(jnp.int32),
-        phase=jnp.ones((k,), dtype=jnp.int32),
-        cost=sel_cost,
-        when=jnp.zeros((k,), dtype=jnp.int64),
-        limit_break=jnp.zeros((k,), dtype=bool),
-    )
-    return FastBatch(state=new_state, ok=ok, decisions=decisions)
-
-
-def speculate_resv_batch(state: EngineState, now, k: int, *,
-                         anticipation_ns: int,
-                         enabled=True,
-                         heads=None) -> FastBatch:
-    """k reservation-phase serves in one pass; state untouched when the
-    speculation fails or `enabled` is False.
-
-    Valid when the k smallest reservation tags are all <= now (deep
-    constraint backlog): phase 1 fires every time, so no promotion or
-    weight-phase side effects occur (reference :1124-1128)."""
-    if heads is None:
-        heads = _default_heads(state)
-    has_req = state.active & (state.depth > 0)
-    key = jnp.where(has_req, state.head_resv, KEY_INF)
-
-    idx, kth, max_tied_order, cond_count, sel_cost = _sorted_selection(
-        key, state.order, k, cost=state.head_cost)
-    cond_eligible = kth <= now            # all k fire the constraint phase
-    mask = _served_mask(key, state.order, kth, max_tied_order)
-
-    serve = _dense_serve(state, heads, False, anticipation_ns)
-
-    # one-serve-per-client: the new head tag must leave the window
-    beyond = (serve.head_resv > kth) | \
-        ((serve.head_resv == kth) & (state.order > max_tied_order))
-    cond_once = jnp.all(~mask | ~serve.has_more | beyond)
-
-    ok = cond_eligible & cond_count & cond_once
-    new_state = _commit_serves(state, mask, serve, ok & enabled)
-
-    decisions = Decision(
-        type=jnp.zeros((k,), dtype=jnp.int32),
-        slot=idx.astype(jnp.int32),
-        phase=jnp.zeros((k,), dtype=jnp.int32),
-        cost=sel_cost,
-        when=jnp.zeros((k,), dtype=jnp.int64),
-        limit_break=jnp.zeros((k,), dtype=bool),
-    )
-    return FastBatch(state=new_state, ok=ok, decisions=decisions)
-
-
-def attempt_fast_batch(state: EngineState, now, k: int, *,
-                       anticipation_ns: int,
-                       enabled=True,
-                       weight_first=False,
-                       window: RingWindow | None = None) -> FastBatch:
-    """One speculative attempt: one regime, then the other on failure.
-
-    Both speculations are cheap (one sort + O(N) elementwise serves), so
-    the branch is a small device cond.  The caller checks ``ok`` on the
-    host (or via the epoch scan's commit mask) and falls back to the
-    exact serial engine when speculation fails -- keeping the expensive
-    O(k*N) fallback OUT of this compiled program.  With `enabled` False
-    the state passes through untouched.  ``weight_first`` orders the
-    attempts -- steady states stay in one regime for long stretches, so
-    trying last batch's regime first skips a wasted speculation.
-    """
-    # read the ring heads ONCE, outside the regime branches: both
-    # regimes pop the same next element, and cond branches materialize
-    # captured arrays as operands (capturing the [m, N] window here was
-    # measured at ~7x the whole batch cost)
-    heads = _default_heads(state) if window is None \
-        else _window_heads(state, window)
-
-    def resv(_):
-        return speculate_resv_batch(state, now, k,
-                                    anticipation_ns=anticipation_ns,
-                                    enabled=enabled, heads=heads)
-
-    def weight(_):
-        return speculate_weight_batch(state, now, k,
-                                      anticipation_ns=anticipation_ns,
-                                      enabled=enabled, heads=heads)
-
-    def ordered(first, second):
-        def go(_):
-            fb = first(None)
-            return lax.cond(fb.ok, lambda _: fb, second, operand=None)
-        return go
-
-    return lax.cond(weight_first, ordered(weight, resv),
-                    ordered(resv, weight), operand=None)
-
-
-class FastEpoch(NamedTuple):
-    """M speculative batches' worth of output, compact for readback.
-
-    The tunneled single-chip runtime pays ~100ms round-trip latency per
-    host readback CALL regardless of size, so an epoch returns all M
-    batches' decisions in one pytree: one device_get per epoch.
-    """
-
-    state: EngineState     # after the last COMMITTED batch
-    ok: jnp.ndarray        # bool[M]: batch i committed
-    slot: jnp.ndarray      # int32[M, k] serial-order winners
-    phase: jnp.ndarray     # int8[M, k]
-    cost: jnp.ndarray      # int32[M, k]
 
 
 # state fields the speculative serve path never writes: rings are only
@@ -570,78 +358,6 @@ _EPOCH_INVARIANT = ("active", "idle", "order", "resv_inv", "weight_inv",
                     "q_arrival", "q_cost")
 _EPOCH_MUTABLE = tuple(f for f in EngineState._fields
                        if f not in _EPOCH_INVARIANT)
-
-
-def scan_fast_epoch(state: EngineState, now, m: int, k: int, *,
-                    anticipation_ns: int) -> FastEpoch:
-    """Run up to m speculative batches of k decisions, entirely on
-    device.  Commit-prefix semantics: the first failed speculation
-    stops the epoch (its state is NOT applied, and no later batch is),
-    so the returned state is always an exact serial prefix -- the host
-    reruns from it with the exact engine, then resumes epochs.
-    """
-    invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
-    mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
-    # one dense ring read for the whole epoch (see RingWindow)
-    window = ring_window(state, m)
-
-    def body(carry, _):
-        mut, dead, weight_hint = carry
-        st = EngineState(**invariant, **mut)
-        batch = attempt_fast_batch(st, now, k,
-                                   anticipation_ns=anticipation_ns,
-                                   enabled=~dead,
-                                   weight_first=weight_hint,
-                                   window=window)
-        commit = batch.ok & ~dead
-        # batch.state is bit-identical to st when not committed (the
-        # serve scatters are gated), so no whole-state select is needed
-        out = (commit,
-               batch.decisions.slot,
-               batch.decisions.phase.astype(jnp.int8),
-               batch.decisions.cost.astype(jnp.int32))
-        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
-        weight_hint = jnp.where(batch.ok, batch.decisions.phase[0] == 1,
-                                weight_hint)
-        return (new_mut, dead | ~batch.ok, weight_hint), out
-
-    (mutable, _dead, _hint), (ok, slot, phase, cost) = lax.scan(
-        body, (mutable0, jnp.bool_(False), jnp.bool_(False)), None,
-        length=m)
-    state = EngineState(**invariant, **mutable)
-    return FastEpoch(state=state, ok=ok, slot=slot, phase=phase,
-                     cost=cost)
-
-
-# ----------------------------------------------------------------------
-# prefix-commit speculation (round 3)
-#
-# The full sort in ``_sorted_selection`` already yields the ENTIRE
-# candidate service order, so all-or-nothing validation wastes it: when
-# a batch of k fails, some prefix of the sorted candidates was still
-# exactly what the serial engine would have served.  These entry points
-# compute that longest provably-safe prefix ON DEVICE and commit it --
-# turning every former fallback cliff (regime transitions, k past the
-# re-entry distance, underfull tails) into a shorter committed batch.
-# Guaranteed progress: whenever the serial engine would RETURN a
-# request at ``now``, the prefix is >= 1, so the serial engine is no
-# longer needed for recovery (only for the never-observed global
-# rebase-guard failures, via ``make_prefix_runner``).
-#
-# Exactness argument (differentially tested): candidates are served in
-# sorted (key, order) ascending order -- the serial engine's total
-# order.  Serving candidate p re-enters its client at a new key r_p
-# (its freshly-tagged next head; +inf if it empties or leaves the
-# candidate set).  The speculative order equals the serial order up to
-# position q iff   min_{p<q} r_p  >  (key_q, order_q)   for every
-# position <= q -- the serial engine would have picked the re-entered
-# head first otherwise.  Since keys ascend and the cumulative min only
-# descends, the condition fails monotonically: the first failing
-# position ends the exact prefix.  Regime-exit events (a weight-phase
-# serve making the client's reservation tag eligible, reference
-# do_next_request :1124-1128) are encoded as r_p = -inf, stopping the
-# prefix right after p.
-# ----------------------------------------------------------------------
 
 
 _O32_MASK = jnp.int64(0xFFFFFFFF)
@@ -830,10 +546,9 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
                       anticipation_ns: int) -> PrefixEpoch:
     """Run m prefix-commit batches of up to k decisions on device.
 
-    Unlike ``scan_fast_epoch`` there is no commit-prefix-of-batches
-    semantics to manage: EVERY batch commits its own exact prefix, so
-    the concatenated per-batch prefixes are always the serial decision
-    stream at ``now``.  Batches after the workload drains commit 0 and
+    EVERY batch commits its own exact prefix, so the concatenated
+    per-batch prefixes are always the serial decision stream at
+    ``now``.  Batches after the workload drains commit 0 and
     spin harmlessly.  Callers MUST check ``guards_ok``: a rare global
     rebase-guard failure (creation-order spread or served cost past
     2^31) zeroes that batch and every later one without committing --
@@ -883,36 +598,5 @@ def make_prefix_runner(k: int, *, anticipation_ns: int = 0):
             d = jax.device_get(decs)
             return st, decs, int((d.type == RETURNING).sum())
         return batch.state, batch.decisions, int(batch.count)
-
-    return run
-
-
-def make_fast_runner(k: int, *, anticipation_ns: int = 0):
-    """Host-orchestrated runner: (state, now) -> (state, decisions,
-    used_fast).  Bit-identical to ``kernels.engine_run(...,
-    advance_now=False)`` under AtLimit::Wait with monotonic now
-    (differential tests pin this): speculation is validated on device,
-    and on failure the exact serial engine reruns the batch from the
-    untouched input state.
-
-    The one-scalar ``ok`` sync per batch costs ~launch latency, far
-    below the serial fallback it avoids compiling into the hot program.
-    """
-    import functools
-
-    import jax
-
-    attempt = jax.jit(functools.partial(
-        attempt_fast_batch, k=k, anticipation_ns=anticipation_ns))
-    exact = jax.jit(lambda s, t: kernels.engine_run(
-        s, t, k, allow_limit_break=False,
-        anticipation_ns=anticipation_ns, advance_now=False))
-
-    def run(state: EngineState, now):
-        batch = attempt(state, now)
-        if bool(batch.ok):
-            return batch.state, batch.decisions, True
-        st, _, decs = exact(state, now)
-        return st, decs, False
 
     return run

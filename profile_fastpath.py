@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Component profile of the speculative fastpath (the tool behind
+"""Component profile of the prefix-commit engine (the tool behind
 PROFILE.md).
 
 Timing protocol: the tunneled single-chip runtime adds large, VARIABLE
@@ -21,11 +21,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from __graft_entry__ import _preloaded_state
-from dmclock_tpu.engine import fastpath, kernels
+from dmclock_tpu.engine import fastpath
 from profile_util import scalar_latency, state_digest
 
 N = 100_000
-K = 32768
+K = 49152
 M_LO, M_HI = 8, 32
 
 
@@ -41,9 +41,9 @@ def _time_call(f, *args, reps=3):
 
 
 def measure_epoch(name, state, m_lo=M_LO, m_hi=M_HI, k=K):
-    f_lo = jax.jit(functools.partial(fastpath.scan_fast_epoch,
+    f_lo = jax.jit(functools.partial(fastpath.scan_prefix_epoch,
                                      m=m_lo, k=k, anticipation_ns=0))
-    f_hi = jax.jit(functools.partial(fastpath.scan_fast_epoch,
+    f_hi = jax.jit(functools.partial(fastpath.scan_prefix_epoch,
                                      m=m_hi, k=k, anticipation_ns=0))
     now = jnp.int64(0)
     jax.device_get(state_digest(f_lo(state, now).state))
@@ -80,23 +80,32 @@ def main():
     state = _preloaded_state(N, 128, ring=128)
 
     # -- whole epoch at bench shape
-    measure_epoch("scan_fast_epoch (k=32768, ring=128)", state)
+    measure_epoch(f"scan_prefix_epoch (k={K}, ring=128)", state)
 
-    # -- selection: full 2-key int32 sort (the shipped design)
+    # -- selection core of _prefix_select: the 5-array 2-key i32 sort
+    # plus the cumulative-min prefix validation
     def sel_sort(state):
         iota = jnp.arange(N, dtype=jnp.int32)
         o32 = state.order.astype(jnp.int32)
+        c32 = state.head_cost.astype(jnp.int32)
 
         def body(c, _):
             t, _x = c
             key = state.head_prop + state.prop_delta + t
             kmin = jnp.min(key)
             k32 = jnp.clip(key - kmin, 0, (1 << 31) - 2).astype(jnp.int32)
-            ks, os_, idxs = lax.sort((k32, o32, iota), num_keys=2)
-            return (t + idxs[0].astype(jnp.int64) + 1, _x), ks[K - 1]
+            r32 = k32 + jnp.int32(1)         # stand-in reentry payload
+            ks, os_, idxs, cs, rs = lax.sort(
+                (k32, o32, iota, c32, r32), num_keys=2)
+            pk = (ks[:K].astype(jnp.int64) << 32) | \
+                (os_[:K].astype(jnp.int64) & 0xFFFFFFFF)
+            rpk = (rs[:K].astype(jnp.int64) << 32)
+            cm = lax.associative_scan(jnp.minimum, rpk)
+            count = jnp.argmax(~(cm > pk)).astype(jnp.int32)
+            return (t + idxs[0].astype(jnp.int64) + 1, _x), count
         return body
-    measure_scan("selection: 2-key i32 full sort", sel_sort, state,
-                 jnp.int32(0))
+    measure_scan("selection: 5-array 2-key i32 sort + cummin",
+                 sel_sort, state, jnp.int32(0))
 
     # -- serve: dense elementwise retag (no ring access)
     def serve(state):

@@ -26,13 +26,14 @@ echo "== bench smoke (one small epoch) =="
 python - <<'EOF'
 import functools, jax, jax.numpy as jnp
 from __graft_entry__ import _preloaded_state
-from dmclock_tpu.engine.fastpath import scan_fast_epoch
+from dmclock_tpu.engine.fastpath import scan_prefix_epoch
 state = _preloaded_state(4096, 16, ring=16)
-ep = jax.jit(functools.partial(scan_fast_epoch, m=4, k=256,
+ep = jax.jit(functools.partial(scan_prefix_epoch, m=4, k=256,
                                anticipation_ns=0))(state, jnp.int64(0))
-ok = int(jax.device_get(ep.ok.sum()))
-assert ok == 4, f"bench smoke: only {ok}/4 batches committed"
-print(f"bench smoke ok ({ok}/4 batches committed)")
+assert bool(jax.device_get(ep.guards_ok).all()), "rebase guards failed"
+n = int(jax.device_get(ep.count).sum())
+assert n == 4 * 256, f"bench smoke: only {n}/{4*256} decisions committed"
+print(f"bench smoke ok ({n} decisions committed over 4 batches)")
 EOF
 
 echo "CI PASSED"

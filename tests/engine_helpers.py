@@ -1,0 +1,51 @@
+"""Shared helpers for the TPU-engine differential test suites.
+
+Ordering spec being checked everywhere = the oracle's total order
+(``core/scheduler.py``), itself pinned to reference
+``dmclock_server.h:1115-1186`` by the oracle test suite.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from dmclock_tpu.core import ReqParams
+from dmclock_tpu.core.timebase import NS_PER_SEC
+from dmclock_tpu.engine import TpuPullPriorityQueue, kernels
+from dmclock_tpu.engine.state import EngineState
+
+S = NS_PER_SEC
+
+
+def assert_states_equal(a: EngineState, b: EngineState):
+    for name, x, y in zip(EngineState._fields, a, b):
+        assert bool(jnp.array_equal(x, y)), \
+            f"state field {name} diverged:\n{x}\nvs\n{y}"
+
+
+def serial_run(state, now, k, anticipation_ns=0):
+    st, _, decs = kernels.engine_run(
+        state, jnp.int64(now), k, allow_limit_break=False,
+        anticipation_ns=anticipation_ns, advance_now=False)
+    return st, jax.device_get(decs)
+
+
+def build_state(infos, adds, *, capacity=64, ring=64,
+                anticipation_ns=0) -> EngineState:
+    """EngineState populated via the queue's own ingest path.
+
+    ``adds`` = list of (client, time_ns, cost, delta, rho).
+    """
+    q = TpuPullPriorityQueue(lambda c: infos[c],
+                             anticipation_timeout_ns=anticipation_ns,
+                             capacity=capacity, ring_capacity=ring)
+    for client, t, cost, delta, rho in adds:
+        q.add_request(("r", client, t), client, ReqParams(delta, rho),
+                      time_ns=t, cost=cost)
+    with q.data_mtx:
+        q._flush()
+    return q.state
+
+
+def deep_state(infos, depth, t=1 * S, capacity=64):
+    adds = [(c, t, 1, 1, 1) for _ in range(depth) for c in infos]
+    return build_state(infos, adds, capacity=capacity)

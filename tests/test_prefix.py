@@ -27,8 +27,8 @@ from dmclock_tpu.engine.fastpath import (make_prefix_runner,
                                          scan_prefix_epoch,
                                          speculate_prefix_batch)
 
-from test_fastpath import (assert_states_equal, build_state, deep_state,
-                           serial_run)
+from engine_helpers import (assert_states_equal, build_state, deep_state,
+                            serial_run)
 
 S = NS_PER_SEC
 
@@ -338,6 +338,23 @@ def test_fuzz_epoch_vs_batches():
                               jax.device_get(ep.slot)[i])
         st = batch.state
     assert_states_equal(ep.state, st)
+
+
+def test_pallas_rotate_matches_xla():
+    """The Pallas ring-rotate kernel (interpret mode off-TPU) must be
+    bit-identical to the XLA barrel shift for random rings/offsets."""
+    from dmclock_tpu.engine.fastpath import (_rotate_rows_pallas,
+                                             _rotate_rows_xla)
+
+    rng = np.random.default_rng(9)
+    for n, q, w in ((700, 16, 5), (2500, 128, 32), (100, 64, 64)):
+        ring = jnp.asarray(rng.integers(-(1 << 50), 1 << 50, (n, q)),
+                           jnp.int64)
+        q0 = jnp.asarray(rng.integers(0, q, n), jnp.int32)
+        a = _rotate_rows_xla(ring, q0, w)
+        b = _rotate_rows_pallas(ring, q0, w, interpret=True)
+        assert a.shape == b.shape == (w, n)
+        assert (np.asarray(a) == np.asarray(b)).all(), (n, q, w)
 
 
 def test_anticipation_prefix_differential():

@@ -20,7 +20,7 @@ from dmclock_tpu.core import ClientInfo
 from dmclock_tpu.core.timebase import NS_PER_SEC
 from dmclock_tpu.engine import kernels
 
-from test_fastpath import assert_states_equal, build_state, serial_run
+from engine_helpers import assert_states_equal, build_state, serial_run
 
 S = NS_PER_SEC
 
