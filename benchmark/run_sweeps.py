@@ -82,14 +82,17 @@ def _timed_prefix_epochs(state, now_ns, epochs, k, m, lat):
         donate_argnums=(0,))
     # the tunneled remote-compile endpoint occasionally drops a
     # response mid-read; one retry covers it (the cache makes the
-    # second attempt cheap).  Retry ONLY if the donated input buffer
-    # survived -- a post-dispatch failure consumes it, and retrying
-    # would mask the original error with a deleted-buffer error.
+    # second attempt cheap).  Only runtime/transport errors are
+    # retried -- a trace-time programming error (TypeError, shape
+    # mismatch) must fail fast with its original traceback.  Retry
+    # ONLY if the donated input buffer survived: a post-dispatch
+    # failure consumes it, and retrying would mask the original error
+    # with a deleted-buffer error.
     for attempt in (0, 1):
         try:
             ep = run(state, jnp.int64(now_ns))
             break
-        except Exception:
+        except jax.errors.JaxRuntimeError:
             if attempt or any(
                     getattr(x, "is_deleted", lambda: False)()
                     for x in jax.tree_util.tree_leaves(state)):

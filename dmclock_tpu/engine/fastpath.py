@@ -453,13 +453,23 @@ def _commit_prefix(state: EngineState, serve: DenseServe, pk_dense,
 
 def speculate_prefix_batch(state: EngineState, now, k: int, *,
                            anticipation_ns: int,
-                           heads=None) -> PrefixBatch:
+                           heads=None,
+                           max_count=None) -> PrefixBatch:
     """One prefix-commit batch: regime picked exactly as the serial
     engine's first decision would (reservation phase iff the lowest
     reservation tag is eligible, reference :1124-1128), then the
-    longest exact prefix of that regime's sorted candidates commits."""
+    longest exact prefix of that regime's sorted candidates commits.
+
+    ``max_count`` (optional int32 scalar, may be traced) caps the
+    committed prefix: a shorter prefix of an exact prefix is still
+    exact, so callers can budget decisions (e.g. a simulator serving
+    at most its remaining slice capacity) without losing parity."""
     if heads is None:
         heads = _default_heads(state)
+
+    def capped(count):
+        return count if max_count is None \
+            else jnp.minimum(count, jnp.int32(max_count))
     has_req = state.active & (state.depth > 0)
     resv_key = jnp.where(has_req, state.head_resv, KEY_INF)
     resv_regime = jnp.min(resv_key) <= now
@@ -472,7 +482,7 @@ def speculate_prefix_batch(state: EngineState, now, k: int, *,
         (idxs, sel_cost, pk, pk_dense, elig_key, count_fn,
          guards) = _prefix_select(key, state.order, k, state.head_cost,
                                   reentry)
-        count = count_fn(elig_key <= now)
+        count = capped(count_fn(elig_key <= now))
         new_state, _ = _commit_prefix(state, serve, pk_dense, count, pk)
         return new_state, count, guards, idxs, sel_cost, jnp.int32(0)
 
@@ -496,7 +506,7 @@ def speculate_prefix_batch(state: EngineState, now, k: int, *,
         (idxs, sel_cost, pk, pk_dense, _elig, count_fn,
          guards) = _prefix_select(key, state.order, k, state.head_cost,
                                   reentry)
-        count = count_fn(jnp.ones((k,), dtype=bool))
+        count = capped(count_fn(jnp.ones((k,), dtype=bool)))
         new_state, _ = _commit_prefix(state, serve, pk_dense, count, pk)
 
         # stored-flag parity (promote loop, reference :1135-1144): every

@@ -151,7 +151,12 @@ class TpuPullPriorityQueue:
                  erase_max: int = 2000,
                  # speculative decision buffer: pull_request() serves
                  # from a prefetched batch of this size while provably
-                 # valid (see _pull_spec); 0 = one launch per pull
+                 # valid (see _pull_spec); 0 = one launch per pull.
+                 # Compile-count coupling: the adaptive prefetch sizes
+                 # (powers of two up to this value) and the settle
+                 # replay chunks each compile one engine_run program,
+                 # so the shared jit cache grows O(log2(batch)), not
+                 # O(batch)
                  speculative_batch: int = 0,
                  monotonic_clock: Callable[[], float] =
                  _walltime.monotonic):
@@ -205,6 +210,7 @@ class TpuPullPriorityQueue:
         self._spec_pre: Optional[EngineState] = None
         self._spec_t0 = 0
         self._spec_consumed = 0
+        self._spec_exact = True   # post-batch state == handed-out state
         self._host_idle: set = set()
 
 
@@ -401,10 +407,12 @@ class TpuPullPriorityQueue:
     #
     # - `self.state` holds the POST-batch device state; `_spec_pre` the
     #   pre-batch state (immutable arrays -- keeping it is free).  When
-    #   the buffer must be dropped with unconsumed entries,
-    #   _settle_spec replays exactly the consumed prefix from _spec_pre
-    #   (same t0, serial engine), so the logical state never includes a
-    #   serve that was not handed to the caller.
+    #   the buffer is dropped with unconsumed entries -- or drained
+    #   after a MIXED batch whose trailing FUTURE/NONE steps performed
+    #   never-handed-out promotions (`_spec_exact`) -- _settle_spec
+    #   replays exactly the consumed prefix from _spec_pre (same t0,
+    #   serial engine), so the logical state never includes an effect
+    #   that was not handed to the caller.
     # - adds invalidate the buffer UNLESS provably non-interfering: a
     #   tail append (client already queued) for a client with no
     #   remaining buffered serve and not idle-marked commutes with
@@ -452,9 +460,19 @@ class TpuPullPriorityQueue:
         self._spec_t0 = now_ns
         self._spec_consumed = 1 if first[0] == RETURNING else 0
         self._buf_horizon = int(horizon)
-        for i in range(1, d.shape[1]):
-            if int(d[0, i]) != RETURNING:
-                break
+        n_ret = 0
+        while n_ret < d.shape[1] and int(d[0, n_ret]) == RETURNING:
+            n_ret += 1
+        # the post-batch device state equals the handed-out state only
+        # when the batch has no RETURNING/non-RETURNING boundary inside
+        # it: all RETURNING (a full drain hands everything out), or
+        # non-RETURNING from step 0 (the first FUTURE/NONE is handed
+        # out and the later steps are idempotent repeats at fixed t0).
+        # A MIXED batch's trailing FUTURE/NONE steps perform head_ready
+        # promotions that are never handed to the caller -- _settle_spec
+        # must then replay the consumed prefix even after a full drain.
+        self._spec_exact = n_ret in (0, d.shape[1])
+        for i in range(1, n_ret):
             slot = int(d[1, i])
             self._buf.append((RETURNING, slot, int(d[2, i]),
                               int(d[3, i]), int(d[4, i]),
@@ -464,18 +482,32 @@ class TpuPullPriorityQueue:
 
     def _settle_spec(self) -> None:
         """Restore `self.state` to the logical state: the pre-batch
-        state advanced by exactly the consumed decisions."""
-        if self._spec_pre is not None and self._buf:
-            self.spec_settles += 1
-            self._spec_size = 1
-            if self._spec_consumed:
-                st, _ = self._jit_run(self._spec_consumed, False)(
-                    self._spec_pre, self._spec_t0)
+        state advanced by exactly the handed-out decisions.
+
+        Replay is needed when buffered entries remain unconsumed, and
+        also when a MIXED batch drained fully (see ``_spec_exact``):
+        there the post-batch state carries promotions from trailing
+        never-handed-out FUTURE/NONE steps.  The replay runs in
+        power-of-two chunks (engine_run at fixed t0 composes exactly),
+        bounding the jit cache to log2(speculative_batch) replay
+        programs instead of one per distinct consumed length."""
+        if self._spec_pre is not None:
+            if self._buf:
+                # early invalidation with an unconsumed tail: reset the
+                # adaptive prefetch size
+                self.spec_settles += 1
+                self._spec_size = 1
+            if self._buf or not self._spec_exact:
+                st = self._spec_pre
+                n = self._spec_consumed
+                while n:
+                    p = 1 << (n.bit_length() - 1)
+                    st, _ = self._jit_run(p, False)(st, self._spec_t0)
+                    n -= p
                 self.state = st
-            else:
-                self.state = self._spec_pre
         self._spec_pre = None
         self._spec_consumed = 0
+        self._spec_exact = True
         self._buf.clear()
         self._buf_slots.clear()
         self._buf_horizon = 0

@@ -51,6 +51,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import NS_PER_SEC, ClientInfo
 from ..engine import kernels
+# module-level on purpose: importing fastpath inside a traced function
+# would stage its module-level jnp constants into the caller's trace
+# (cached in module globals -> UnexpectedTracerError on reuse)
+from ..engine.fastpath import speculate_prefix_batch
 from ..engine.state import EngineState, init_state
 from ..parallel.cluster import SERVER_AXIS, make_mesh
 from ..parallel.tracker import (TrackerState, global_counters,
@@ -138,6 +142,15 @@ def init_device_sim(cfg: SimConfig, ring_capacity: int = 256
     assert max_window <= ring_capacity, (
         f"client_outstanding_ops {max_window} can exceed a per-client "
         f"ring of {ring_capacity}; raise ring_capacity")
+    # the prefix serve path's rebase guards depend on request cost and
+    # creation-order spread; both are static here (costs from config,
+    # order = arange(C) fixed at init), so validating cost once makes a
+    # guard failure impossible by construction -- the serve loop relies
+    # on this to skip the per-batch guards_ok check
+    max_cost = max(g.client_req_cost for g in cfg.cli_group)
+    assert 0 < max_cost < (1 << 31), (
+        f"client_req_cost {max_cost} overflows the int32 sort payload "
+        "of the prefix serve path")
 
     infos, gaps, waits, totals, windows, costs, ranges = \
         [], [], [], [], [], [], []
@@ -303,28 +316,66 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                                                    wave)
 
             # serve q decisions per server at the slice boundary.
-            # Large q (throughput shapes) uses the prefix-commit batch:
-            # one sort-and-commit pass instead of a q-step serial scan,
-            # committing the exact serial prefix (any re-entry
-            # shortfall rolls into the next slice -- the server serves
-            # at MOST its rate, never out of order).  AtLimit::Allow
-            # needs the serial engine's limit-break path, so it keeps
-            # the scan.
+            # Large q (throughput shapes) uses prefix-commit batches:
+            # sort-and-commit passes instead of a q-step serial scan.
+            # A single batch serves each client at most once, so a
+            # server whose eligible population is smaller than q
+            # (select-range windows, drained/idle clients) would lose
+            # the rest of its slice capacity; batches therefore LOOP --
+            # each capped at the remaining slice budget, which keeps
+            # the concatenated stream the exact serial prefix -- until
+            # the budget is met or a batch commits nothing.
+            # AtLimit::Allow needs the serial engine's limit-break
+            # path, so it keeps the scan.
             t_end = t + spec.slice_ns
-            # prefix batches need k <= client count (the selection
-            # sort yields one row per client)
-            use_prefix = (256 <= spec.q_per_slice <= spec.n_clients
+            use_prefix = (spec.q_per_slice >= 256
                           and not spec.allow_limit_break
                           and not spec.force_scan)
 
             if use_prefix:
-                from ..engine.fastpath import speculate_prefix_batch
+                q = spec.q_per_slice
+                # the selection sort yields one row per client, so a
+                # batch is at most n_clients wide; the loop covers q
+                kb = min(q, spec.n_clients)
 
                 def per_server_run(eng):
-                    batch = speculate_prefix_batch(
-                        eng, t_end, spec.q_per_slice,
-                        anticipation_ns=0)
-                    return batch.state, batch.decisions
+                    d0 = kernels.Decision(
+                        type=jnp.full((q,), kernels.NONE, jnp.int32),
+                        slot=jnp.full((q,), -1, jnp.int32),
+                        phase=jnp.zeros((q,), jnp.int32),
+                        cost=jnp.zeros((q,), jnp.int64),
+                        when=jnp.zeros((q,), jnp.int64),
+                        limit_break=jnp.zeros((q,), bool))
+
+                    def cond(carry):
+                        _eng, total, last, _d = carry
+                        return (total < q) & (last > 0)
+
+                    def body(carry):
+                        eng, total, _last, dbuf = carry
+                        # guards_ok is unchecked by design: its only
+                        # dynamic inputs (cost, creation-order spread)
+                        # are static in this sim and validated at
+                        # init_device_sim, so it cannot fail here
+                        batch = speculate_prefix_batch(
+                            eng, t_end, kb, anticipation_ns=0,
+                            max_count=q - total)
+                        # pack the committed prefix at the buffer
+                        # offset (invalid rows scatter out of range
+                        # and drop)
+                        j = jnp.arange(kb, dtype=jnp.int32)
+                        pos = jnp.where(j < batch.count, total + j, q)
+                        dbuf = jax.tree.map(
+                            lambda buf, vals:
+                            buf.at[pos].set(vals, mode="drop"),
+                            dbuf, batch.decisions)
+                        return (batch.state, total + batch.count,
+                                batch.count, dbuf)
+
+                    eng, _total, _last, dbuf = lax.while_loop(
+                        cond, body,
+                        (eng, jnp.int32(0), jnp.int32(1), d0))
+                    return eng, dbuf
 
                 engine, decs = jax.vmap(per_server_run)(engine)
             else:

@@ -148,6 +148,49 @@ def test_spec_buffer_idle_reactivation():
     assert runs[0] == runs[1]
 
 
+def test_spec_buffer_mixed_batch_settle_state_parity():
+    """A MIXED prefetch batch (RETURNING prefix then FUTURE steps) that
+    drains fully: settle() must leave the device state BIT-IDENTICAL to
+    the launch-per-pull twin's.  The trailing never-handed-out FUTURE
+    steps promote head_ready for the limited zero-weight client Z
+    (limit <= t0, proportion pinned MAX_TAG so it is never served);
+    the twin's pulls are all reservation-phase serves, which skip the
+    promote loop entirely -- so keeping the post-batch state would leak
+    a promotion no handed-out decision performed."""
+    from engine_helpers import assert_states_equal
+
+    infos = {
+        "Z": ClientInfo(0.1, 0, 10),   # resv-only, limited
+        "A": ClientInfo(1, 0, 0),
+        "B": ClientInfo(1, 0, 0),
+    }
+    t0 = 5 * S
+    results = []
+    for spec in (0, 8):
+        q = TpuPullPriorityQueue(lambda c: infos[c], capacity=8,
+                                 ring_capacity=16,
+                                 speculative_batch=spec)
+        for c in ("Z", "A", "B"):          # creation order: Z first
+            for i in range(2):
+                q.add_request(("r", c, i), c, ReqParams(1, 1),
+                              time_ns=S, cost=1)
+        # adaptive refills at fixed t0=5s (A/B resv tags 3s,5s; Z resv
+        # 21s, Z limit ~1s): size 1 [A], size 2 [B, A], then the MIXED
+        # size-4 batch [B, FUTURE, FUTURE, FUTURE] whose trailing
+        # FUTURE steps promote Z.  Settle right after its RETURNING
+        # prefix is consumed -- a further pull would launch another
+        # promoting step in both queues and mask the divergence.
+        out = [pull_to_tuple(q.pull_request(t0)) for _ in range(4)]
+        q.settle()
+        results.append((q.state, out, q._slot_of["Z"]))
+    (state_a, out_a, slot_a), (state_b, out_b, slot_b) = results
+    assert out_a == out_b
+    assert [o[1] for o in out_a] == ["A", "B", "A", "B"]
+    # the twin never promotes Z (every handed-out pull is a resv serve)
+    assert not bool(state_a.head_ready[slot_a])
+    assert_states_equal(state_a, state_b)
+
+
 def test_spec_buffer_checkpoint_settles():
     """queue_state_dict mid-buffer must produce a consistent snapshot
     (payload FIFOs == logical device depths)."""
