@@ -19,6 +19,9 @@ native/build/dmc_sim_native -c configs/dmc_sim_example.conf | tail -3
 echo "== full-scale TPU parity (100x100 acceptance config) =="
 python scripts/run_fullscale.py
 
+echo "== on-silicon parity gate (skips on cpu-only boxes) =="
+python scripts/silicon_parity.py
+
 echo "== graft entry compile check =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

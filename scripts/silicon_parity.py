@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""On-silicon parity gate: oracle vs TPU-engine traces on the REAL chip.
+
+Every parity suite under tests/ pins jax to the virtual CPU backend
+(tests/conftest.py), so the bit-exactness story there is program-level.
+This script closes the gap demanded by BASELINE.json's north-star
+clause: it runs scaled dmc_sim acceptance shapes through BOTH the
+oracle scheduler and the TPU engine ON WHATEVER PLATFORM JAX BOOTS
+(the axon-tunneled TPU chip in this image), requires the full service
+traces -- (virtual time, server, client, phase, cost) per op -- to
+match exactly, and records the evidence in SILICON_PARITY.json.
+
+Run directly or via scripts/ci.sh:
+    python scripts/silicon_parity.py
+Exits 0 with {"skipped": true} when no accelerator platform is
+available (nothing to prove beyond what the CPU-pinned tests pin).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+ARTIFACT = REPO / "SILICON_PARITY.json"
+
+
+def make_shapes():
+    from dmclock_tpu.sim.config import (ClientGroup, ServerGroup,
+                                        SimConfig)
+
+    def cfg(clients, servers, **kw):
+        return SimConfig(client_groups=len(clients),
+                         server_groups=len(servers),
+                         cli_group=clients, srv_group=servers, **kw)
+
+    # scaled dmc_sim_example.conf: 4 QoS groups incl. limited and
+    # weighted clients (reference sim/dmc_sim_example.conf)
+    example = cfg([
+        ClientGroup(client_count=1, client_total_ops=60, client_wait_s=0,
+                    client_iops_goal=200, client_outstanding_ops=32,
+                    client_reservation=0.0, client_limit=0.0,
+                    client_weight=1.0, client_server_select_range=1),
+        ClientGroup(client_count=1, client_total_ops=60, client_wait_s=1,
+                    client_iops_goal=200, client_outstanding_ops=32,
+                    client_reservation=0.0, client_limit=40.0,
+                    client_weight=1.0, client_server_select_range=1),
+        ClientGroup(client_count=1, client_total_ops=60, client_wait_s=2,
+                    client_iops_goal=200, client_outstanding_ops=32,
+                    client_reservation=0.0, client_limit=50.0,
+                    client_weight=2.0, client_server_select_range=1),
+        ClientGroup(client_count=1, client_total_ops=40, client_wait_s=0,
+                    client_iops_goal=100, client_outstanding_ops=16,
+                    client_reservation=0.0, client_limit=0.0,
+                    client_weight=1.0, client_req_cost=3,
+                    client_server_select_range=1),
+    ], [ServerGroup(server_count=1, server_iops=160, server_threads=1)],
+        server_soft_limit=False)
+
+    # scaled dmc_sim_100th.conf: reservation-heavy with a cost-3
+    # client, soft limit (AtLimit.ALLOW)
+    hundredth = cfg([
+        ClientGroup(client_count=2, client_total_ops=50,
+                    client_iops_goal=100, client_outstanding_ops=16,
+                    client_reservation=20.0, client_limit=60.0,
+                    client_weight=1.0, client_server_select_range=1),
+        ClientGroup(client_count=1, client_total_ops=40,
+                    client_iops_goal=100, client_outstanding_ops=16,
+                    client_reservation=10.0, client_limit=0.0,
+                    client_weight=2.0, client_req_cost=3,
+                    client_server_select_range=1),
+    ], [ServerGroup(server_count=1, server_iops=120, server_threads=1)],
+        server_soft_limit=True)
+
+    # wider weighted mix to push the total past 1k decisions
+    wide = cfg([
+        ClientGroup(client_count=4, client_total_ops=100,
+                    client_iops_goal=300, client_outstanding_ops=32,
+                    client_reservation=0.0, client_limit=0.0,
+                    client_weight=1.0, client_server_select_range=2),
+        ClientGroup(client_count=4, client_total_ops=100,
+                    client_iops_goal=300, client_outstanding_ops=32,
+                    client_reservation=5.0, client_limit=0.0,
+                    client_weight=3.0, client_server_select_range=2),
+    ], [ServerGroup(server_count=2, server_iops=400, server_threads=1)],
+        server_soft_limit=False)
+
+    return [("example", example), ("100th", hundredth), ("wide", wide)]
+
+
+def main() -> int:
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        ARTIFACT.write_text(json.dumps({
+            "skipped": True,
+            "reason": "no accelerator platform; CPU parity is already "
+                      "pinned by tests/",
+            "platform": platform}, indent=1))
+        print("silicon parity: skipped (cpu-only environment)")
+        return 0
+
+    from dmclock_tpu.sim.dmc_sim import run_sim
+
+    report = {"platform": platform,
+              "device": str(jax.devices()[0]),
+              "shapes": [], "total_decisions": 0, "match": True}
+    t0 = time.perf_counter()
+    for name, cfg in make_shapes():
+        oracle = run_sim(cfg, model="dmclock-delayed", seed=7,
+                         record_trace=True)
+        tpu = run_sim(cfg, model="dmclock-tpu", seed=7,
+                      record_trace=True)
+        n = len(oracle.trace)
+        assert n == len(tpu.trace) > 0, \
+            f"{name}: trace lengths differ ({n} vs {len(tpu.trace)})"
+        for i, (a, b) in enumerate(zip(oracle.trace, tpu.trace)):
+            assert a == b, (f"{name}: trace diverges at op {i}: "
+                            f"oracle={a} tpu={b}")
+        for cid in oracle.clients:
+            ca = oracle.clients[cid].stats
+            cb = tpu.clients[cid].stats
+            assert (ca.reservation_ops, ca.priority_ops) == \
+                (cb.reservation_ops, cb.priority_ops), \
+                f"{name}: phase split differs for client {cid}"
+        report["shapes"].append({"name": name, "decisions": n})
+        report["total_decisions"] += n
+        print(f"silicon parity: {name}: {n} decisions bit-exact")
+    report["wall_s"] = round(time.perf_counter() - t0, 1)
+    ARTIFACT.write_text(json.dumps(report, indent=1))
+    print(f"silicon parity: OK -- {report['total_decisions']} decisions "
+          f"bit-exact on {platform} ({report['wall_s']}s); "
+          f"wrote {ARTIFACT.name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
