@@ -8,6 +8,7 @@
 #include <algorithm>
 #include <memory>
 #include <random>
+#include <sstream>
 #include <vector>
 
 #include "dmclock/indirect_heap.h"
@@ -201,4 +202,43 @@ MT_TEST(cross_k_consistency_random_ops) {
   for (size_t i = 1; i < popped_by_k.size(); ++i)
     MT_CHECK(popped_by_k[i] == popped_by_k[0]);
   MT_CHECK(popped_by_k[0].size() > 100);  // enough coverage
+}
+
+MT_TEST(iteration_and_display_sorted) {
+  // iterators walk raw storage; display_sorted emits ascending order
+  // without disturbing the heap (reference iterators :68-203 and
+  // display_sorted :399-424)
+  HeapA h(3);
+  std::vector<std::unique_ptr<Elem>> owner;
+  std::mt19937 rng(5);
+  std::vector<int> keys;
+  for (int i = 0; i < 40; ++i) {
+    keys.push_back(int(rng() % 500) * 2);  // even, distinct enough
+    owner.push_back(std::make_unique<Elem>(keys.back()));
+    h.push(owner.back().get());
+  }
+  // begin/end cover every element exactly once
+  std::vector<int> seen;
+  for (auto it = h.begin(); it != h.end(); ++it)
+    seen.push_back((*it)->key);
+  std::sort(seen.begin(), seen.end());
+  std::vector<int> expect = keys;
+  std::sort(expect.begin(), expect.end());
+  MT_CHECK(seen == expect);
+  // contains() reflects membership via the intrusive slot
+  for (auto& e : owner) MT_CHECK(h.contains(*e));
+  Elem outside(1);
+  MT_CHECK(!h.contains(outside));
+  // display_sorted: ascending, all elements, heap untouched
+  std::ostringstream os;
+  h.display_sorted(os, [](std::ostream& o, const Elem& e) {
+    o << e.key << "\n";
+  });
+  std::istringstream in(os.str());
+  std::vector<int> dumped;
+  int v;
+  while (in >> v) dumped.push_back(v);
+  MT_CHECK(dumped == expect);
+  MT_CHECK_EQ(h.size(), size_t{40});
+  MT_CHECK_EQ(h.top().key, expect.front());
 }

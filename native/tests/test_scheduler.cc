@@ -224,6 +224,135 @@ MT_TEST(gc_idle_then_erase) {
   MT_CHECK_EQ(q.client_count(), uint64_t{0});
 }
 
+MT_TEST(wait_at_limit_starvation_and_exact_future) {
+  // Wait mode holds a limited client while unlimited work proceeds,
+  // then reports the EXACT future wake-up time (reference
+  // pull_wait_at_limit :1363-1471, exact `old_time + 2.0` at :1458).
+  g_infos = {{1, ClientInfo(0, 1, 1)},    // A: weight 1, limit 1/s
+             {2, ClientInfo(0, 1, 0)}};   // B: weight 1, no limit
+  Q q(info_of, opts());
+  int64_t t = 40 * S;
+  for (uint64_t i = 0; i < 3; ++i) q.add_request(100 + i, 1,
+                                                 ReqParams(), t);
+  for (uint64_t i = 0; i < 3; ++i) q.add_request(200 + i, 2,
+                                                 ReqParams(), t);
+  // first pull: tags tie at t; A wins by creation order
+  auto p = q.pull_request(t);
+  MT_CHECK(p.is_retn());
+  MT_CHECK_EQ(p.client, uint64_t{1});
+  // A is now over-limit until t+1s; B drains meanwhile
+  for (int i = 0; i < 3; ++i) {
+    p = q.pull_request(t);
+    MT_CHECK(p.is_retn());
+    MT_CHECK_EQ(p.client, uint64_t{2});
+  }
+  p = q.pull_request(t);
+  MT_CHECK(p.is_future());
+  MT_CHECK_EQ(p.when_ready, t + 1 * S);
+  p = q.pull_request(t + 1 * S);
+  MT_CHECK(p.is_retn());
+  MT_CHECK_EQ(p.client, uint64_t{1});
+  p = q.pull_request(t + 1 * S);
+  MT_CHECK(p.is_future());
+  MT_CHECK_EQ(p.when_ready, t + 2 * S);
+  p = q.pull_request(t + 2 * S);
+  MT_CHECK(p.is_retn());
+  MT_CHECK_EQ(p.client, uint64_t{1});
+  MT_CHECK(q.pull_request(t + 2 * S).is_none());
+}
+
+MT_TEST(dynamic_cli_info_refetches_every_use) {
+  // U1 axis (reference dynamic_cli_info_f :1021-1114): with
+  // dynamic_cli_info the embedder callback is consulted on every use,
+  // so a QoS change takes effect WITHOUT update_client_info.  Delayed
+  // tag calc so queued-but-untagged requests pick the new info up as
+  // they reach the head (immediate mode tags at arrival).
+  g_infos = {{1, ClientInfo(0, 1, 0)}, {2, ClientInfo(0, 1, 0)}};
+  Q::Options o = opts(true);
+  o.dynamic_cli_info = true;
+  Q q(info_of, o);
+  int64_t t = 5 * S;
+  for (uint64_t i = 0; i < 8; ++i) {
+    q.add_request(100 + i, 1, ReqParams(), t);
+    q.add_request(200 + i, 2, ReqParams(), t);
+  }
+  std::map<uint64_t, int> counts;
+  for (int i = 0; i < 4; ++i) ++counts[q.pull_request(t + S).client];
+  MT_CHECK_EQ(counts[1], 2);
+  MT_CHECK_EQ(counts[2], 2);
+  g_infos[2].update(0, 3, 0);     // no update_client_info call
+  counts.clear();
+  for (int i = 0; i < 8; ++i) ++counts[q.pull_request(t + S).client];
+  MT_CHECK(counts[2] > counts[1]);
+}
+
+MT_TEST(remove_by_req_filter_visit_order) {
+  // forward vs backwards traversal hands requests to the accumulator
+  // in the documented order (reference remove_by_req_filter_ordering
+  // :373-605)
+  g_infos = {{1, ClientInfo(0, 1, 0)}};
+  for (bool backwards : {false, true}) {
+    Q q(info_of, opts());
+    for (uint64_t i = 0; i < 6; ++i)
+      q.add_request(100 + i, 1, ReqParams(), 2 * S);
+    std::vector<uint64_t> got;
+    q.remove_by_req_filter(
+        [&](uint64_t&& r) { got.push_back(r); return true; },
+        backwards);
+    MT_CHECK_EQ(got.size(), size_t{6});
+    MT_CHECK_EQ(got.front(), backwards ? uint64_t{105} : uint64_t{100});
+    MT_CHECK_EQ(got.back(), backwards ? uint64_t{100} : uint64_t{105});
+    MT_CHECK_EQ(q.request_count(), uint64_t{0});
+    MT_CHECK(q.empty());
+  }
+  // reverse accumulation for remove_by_client (reference
+  // remove_by_client :608-681)
+  Q q(info_of, opts());
+  for (uint64_t i = 0; i < 4; ++i)
+    q.add_request(100 + i, 1, ReqParams(), 2 * S);
+  std::vector<uint64_t> got;
+  q.remove_by_client(1, true, [&](uint64_t&& r) { got.push_back(r); });
+  MT_CHECK_EQ(got.front(), uint64_t{103});
+  MT_CHECK_EQ(got.back(), uint64_t{100});
+}
+
+MT_TEST(ready_and_under_limit_phases) {
+  // phase state machine (reference ready_and_under_limit :1120-1181):
+  // a reservation client is served from the constraint phase while a
+  // limited weight client alternates ready/waiting
+  g_infos = {{1, ClientInfo(1, 0, 0)},    // R: reservation only
+             {2, ClientInfo(0, 1, 1)}};   // W: weight 1, limit 1/s
+  Q q(info_of, opts());
+  int64_t t = 20 * S;
+  for (uint64_t i = 0; i < 2; ++i) {
+    q.add_request(100 + i, 1, ReqParams(), t);
+    q.add_request(200 + i, 2, ReqParams(), t);
+  }
+  // R's first reservation tag is eligible at t: constraint phase
+  auto p = q.pull_request(t);
+  MT_CHECK(p.is_retn());
+  MT_CHECK_EQ(p.client, uint64_t{1});
+  MT_CHECK(p.phase == Phase::reservation);
+  // weight phase serves W's first request (ready at arrival)
+  p = q.pull_request(t);
+  MT_CHECK(p.is_retn());
+  MT_CHECK_EQ(p.client, uint64_t{2});
+  MT_CHECK(p.phase == Phase::priority);
+  // R's second reservation tag: t + 1s; W over-limit until t + 1s
+  p = q.pull_request(t);
+  MT_CHECK(p.is_future());
+  MT_CHECK_EQ(p.when_ready, t + 1 * S);
+  p = q.pull_request(t + 1 * S);
+  MT_CHECK(p.is_retn());
+  MT_CHECK_EQ(p.client, uint64_t{1});
+  MT_CHECK(p.phase == Phase::reservation);
+  p = q.pull_request(t + 1 * S);
+  MT_CHECK(p.is_retn());
+  MT_CHECK_EQ(p.client, uint64_t{2});
+  MT_CHECK(p.phase == Phase::priority);
+  MT_CHECK(q.pull_request(t + 1 * S).is_none());
+}
+
 // fork-based death check (the reference's gtest death tests,
 // test_dmclock_server.cc:51-97, with dmcPrCtl.h's core-dump disable)
 template <typename Fn>

@@ -4,8 +4,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== python test suite =="
-python -m pytest tests/ -x -q
+echo "== python test suite (per-file process isolation) =="
+bash scripts/run_tests.sh
 
 echo "== native build + ctest =="
 cmake -S native -B native/build >/dev/null

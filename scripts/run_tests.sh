@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Suite runner with per-file process isolation.
+#
+# A single long-lived pytest process accumulates XLA CPU compile state;
+# before the conftest-level cache clearing this stalled late-suite
+# tests (17+ min for a 2-min test) and eventually segfaulted the
+# compiler mid-suite.  One process per test file bounds the blast
+# radius either way, reports per-file wall time, and fails fast.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+total_start=$(date +%s)
+status=0
+for f in tests/test_*.py; do
+  t0=$(date +%s)
+  if ! python -m pytest "$f" -q -p no:cacheprovider; then
+    echo "FAILED: $f"
+    status=1
+    break
+  fi
+  echo "-- $f: $(( $(date +%s) - t0 ))s"
+done
+echo "total: $(( $(date +%s) - total_start ))s"
+exit $status

@@ -110,26 +110,35 @@ def main() -> int:
               "device": str(jax.devices()[0]),
               "shapes": [], "total_decisions": 0, "match": True}
     t0 = time.perf_counter()
-    for name, cfg in make_shapes():
-        oracle = run_sim(cfg, model="dmclock-delayed", seed=7,
-                         record_trace=True)
-        tpu = run_sim(cfg, model="dmclock-tpu", seed=7,
-                      record_trace=True)
-        n = len(oracle.trace)
-        assert n == len(tpu.trace) > 0, \
-            f"{name}: trace lengths differ ({n} vs {len(tpu.trace)})"
-        for i, (a, b) in enumerate(zip(oracle.trace, tpu.trace)):
-            assert a == b, (f"{name}: trace diverges at op {i}: "
-                            f"oracle={a} tpu={b}")
-        for cid in oracle.clients:
-            ca = oracle.clients[cid].stats
-            cb = tpu.clients[cid].stats
-            assert (ca.reservation_ops, ca.priority_ops) == \
-                (cb.reservation_ops, cb.priority_ops), \
-                f"{name}: phase split differs for client {cid}"
-        report["shapes"].append({"name": name, "decisions": n})
-        report["total_decisions"] += n
-        print(f"silicon parity: {name}: {n} decisions bit-exact")
+    try:
+        for name, cfg in make_shapes():
+            oracle = run_sim(cfg, model="dmclock-delayed", seed=7,
+                             record_trace=True)
+            tpu = run_sim(cfg, model="dmclock-tpu", seed=7,
+                          record_trace=True)
+            n = len(oracle.trace)
+            assert n == len(tpu.trace) > 0, \
+                f"{name}: trace lengths differ ({n} vs {len(tpu.trace)})"
+            for i, (a, b) in enumerate(zip(oracle.trace, tpu.trace)):
+                assert a == b, (f"{name}: trace diverges at op {i}: "
+                                f"oracle={a} tpu={b}")
+            for cid in oracle.clients:
+                ca = oracle.clients[cid].stats
+                cb = tpu.clients[cid].stats
+                assert (ca.reservation_ops, ca.priority_ops) == \
+                    (cb.reservation_ops, cb.priority_ops), \
+                    f"{name}: phase split differs for client {cid}"
+            report["shapes"].append({"name": name, "decisions": n})
+            report["total_decisions"] += n
+            print(f"silicon parity: {name}: {n} decisions bit-exact")
+    except AssertionError as e:
+        # the artifact must never keep claiming success after a
+        # mismatch: record the failure evidence, then fail the gate
+        report["match"] = False
+        report["error"] = str(e)
+        report["wall_s"] = round(time.perf_counter() - t0, 1)
+        ARTIFACT.write_text(json.dumps(report, indent=1))
+        raise
     report["wall_s"] = round(time.perf_counter() - t0, 1)
     ARTIFACT.write_text(json.dumps(report, indent=1))
     print(f"silicon parity: OK -- {report['total_decisions']} decisions "

@@ -8,8 +8,24 @@ backend is used.  x64 stays enabled because the canonical tag algebra is
 int64 nanoseconds.
 """
 
+import gc
+
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """One long pytest process accumulates XLA CPU compile state until
+    late-suite tests stall for tens of minutes or the compiler
+    segfaults (observed at ~140 tests in).  Dropping every compiled
+    program between modules keeps each module's footprint fresh; the
+    shared-kernel recompiles this forces are far cheaper than the
+    stall."""
+    yield
+    jax.clear_caches()
+    gc.collect()
