@@ -17,12 +17,13 @@ vs the serial engine -- ``tests/test_prefix.py``):
   each client's service share; both dmClock phases active every round.
 
 The PRIMARY value is the config #4 sustained rate (arrivals included);
-the metric string carries the other two plus decision-latency
+the metric string carries the other two plus MEASURED decision-latency
 percentiles: a decision's latency is bounded by the round it rides in,
-so p50 = mean round wall time from the async chain (pure device work,
-trustworthy aggregate) and p99 = that mean plus the observed p99-p50
-spread of individually sync'd rounds (tunnel jitter included, hence
-conservative).
+and per-round wall times are sampled from a windowed async chain (W
+rounds in flight; each device_get returns when its round completes, so
+successive return times are the real per-round completion intervals
+with the tunnel round-trip hidden by the pipeline).  p50/p99 are
+percentiles of >= 100 such samples.
 
 Timing: rounds/epochs are chained asynchronously on device; one scalar
 digest that data-depends on every round is fetched at the end
@@ -42,35 +43,97 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# cfg4's reservation rate, calibrated on-chip so the constraint phase
+# takes ~half of service in steady state (round-4 calibration table in
+# benchmark/RESULTS.md: the share is monotone in the rate -- 25/s ->
+# 0.49, 100/s -> 0.87, 200/s -> 0.97 -- because weight serves'
+# reservation-debt reduction keeps mixed-QoS clients' reservation tags
+# hovering at eligibility); shared with benchmark/run_sweeps.py
+CFG4_RESV_RATE = 25.0
 
-def bench_serve_only(epochs: int = 7, k: int = 49152, m: int = 21):
-    """Preloaded weight steady state, serving only (no ingest)."""
-    from __graft_entry__ import _preloaded_state
-    from dmclock_tpu.engine.fastpath import scan_prefix_epoch
-    from profile_util import scalar_latency, state_digest
 
-    state = _preloaded_state(100_000, 128, ring=128)
-    run = jax.jit(functools.partial(
-        scan_prefix_epoch, m=m, k=k, anticipation_ns=0),
-        donate_argnums=(0,))
-    ep = run(state, jnp.int64(0))
-    jax.device_get(state_digest(ep.state))
-    state = ep.state
-    lat = scalar_latency()
+def _timed_chain(run, state, epochs: int):
+    """Chain ``epochs`` async epoch calls with ONE digest sync; returns
+    (state, total_decisions, wall_s, guards_ok).  Guards are collected
+    for EVERY epoch: a mid-chain trip zeroes that epoch's counts, and
+    checking only the final epoch would report the deflated rate as
+    valid."""
+    from profile_util import state_digest
 
     t0 = time.perf_counter()
-    counts = []
+    counts, guards = [], []
     for _ in range(epochs):
         ep = run(state, jnp.int64(0))
         state = ep.state
         counts.append(ep.count)
+        guards.append(ep.guards_ok)
     jax.device_get(state_digest(state))
-    elapsed = time.perf_counter() - t0 - lat
-    assert bool(jax.device_get(ep.guards_ok).all()), \
-        "rebase guards tripped -- counts are not trustworthy"
+    wall = time.perf_counter() - t0
+    g_ok = all(bool(jax.device_get(g).all()) for g in guards)
     total = int(sum(int(jax.device_get(c).sum()) for c in counts))
-    return {"dps": total / elapsed, "decisions": total,
-            "fill": total / (epochs * m * k)}
+    return state, total, wall, g_ok
+
+
+def bench_serve_only(k: int = 65536, m: int = 32, *,
+                     epochs_lo: int = 1, epochs_hi: int = 2,
+                     depth: int = 256, reps: int = 5):
+    """Preloaded weight steady state, serving only (no ingest).
+
+    DIFFERENCED chains: a short and a long chain each pay one dispatch
+    ramp + one sync, so ``(D_hi - D_lo) / (T_hi - T_lo)`` cancels the
+    fixed per-chain overhead exactly -- through the ~110ms tunnel a
+    single-chain measurement of ~50ms of device work is mostly
+    overhead, and round 3's two protocols disagreed 2-3x on identical
+    shapes for exactly that reason (VERDICT r3 weak #3).
+
+    Operating point: the round-4 k/m sweep's argmax (benchmark/
+    RESULTS.md, median-of-3 differenced pairs per point): k=65536,
+    with a plateau of ~36-40M across m in {21, 32, 64} (protocol
+    noise +-15% -- single-shot pairs at these shapes spread 41-71M,
+    hence the medians).  m amortizes the ~17ms per-epoch dispatch
+    cost (m=8 is ~40% below the plateau); m=128 regresses (the
+    unrolled window-select chain scales with m); k=98304 regresses
+    (the int32 rebase window clamps the selection boundary,
+    fill 0.64)."""
+    from __graft_entry__ import _preloaded_state
+    from dmclock_tpu.engine.fastpath import scan_prefix_epoch
+
+    state = _preloaded_state(100_000, depth, ring=depth)
+    need = (epochs_lo + epochs_hi + 1) * m * k
+    # margin 1.5x: weights are 1..4, so the heaviest class is served
+    # ~1.6x the mean; chains sized to the MEAN backlog drain the
+    # heavy clients mid-chain and deflate both fill and the rate
+    # (measured: 70.9M at 168 serves/client mean vs 28.6M at 360).
+    # Ring width itself also costs: depth 384 measured 38.8M at the
+    # same k/m (wider Pallas-rotate chunking + ring traffic), so the
+    # operating point keeps the smallest ring that feeds the chains.
+    assert need * 1.5 <= 100_000 * depth, \
+        f"backlog {100_000 * depth} cannot feed {need} decisions " \
+        "with heavy-class margin"
+    run = jax.jit(functools.partial(
+        scan_prefix_epoch, m=m, k=k, anticipation_ns=0),
+        donate_argnums=(0,))
+    # the backlog bound keeps each rep's chains short (~50-170ms of
+    # device work), so a single differenced pair still carries tunnel
+    # jitter of the same order; the MEDIAN over fresh-state reps is
+    # stable (measured spread of singles at this shape: 41-71M)
+    rates, total_d, total_pot = [], 0, 0
+    for rep in range(max(reps, 1)):
+        if rep:
+            state = _preloaded_state(100_000, depth, ring=depth)
+        state, _, _, _ = _timed_chain(run, state, 1)      # warm/compile
+        state, d_lo, t_lo, g1 = _timed_chain(run, state, epochs_lo)
+        state, d_hi, t_hi, g2 = _timed_chain(run, state, epochs_hi)
+        assert g1 and g2, "rebase guards tripped -- untrustworthy"
+        if t_hi <= t_lo:
+            continue    # jitter-inverted pair: discard, medians absorb
+        rates.append((d_hi - d_lo) / (t_hi - t_lo))
+        total_d += d_hi + d_lo
+        total_pot += (epochs_hi + epochs_lo) * m * k
+    assert rates, "every differenced pair was jitter-inverted"
+    return {"dps": float(np.median(rates)), "decisions": total_d,
+            "reps": [round(r / 1e6, 1) for r in rates],
+            "fill": total_d / total_pot}
 
 
 def _zipf_weights(n: int, s: float = 1.1, lo: float = 0.5,
@@ -84,19 +147,35 @@ def _zipf_weights(n: int, s: float = 1.1, lo: float = 0.5,
     return w
 
 
-def _sustained_setup(n: int, ring: int, depth0: int, resv_rate: float,
-                     weights: np.ndarray):
-    from dmclock_tpu.core.timebase import rate_to_inv_ns
+def _sustained_setup(n: int, ring: int, depth0: int,
+                     resv_rates: np.ndarray, weights: np.ndarray,
+                     resv_aligned: bool = False):
+    """Preload ``depth0``-deep queues for a mixed-QoS population.
+
+    ``resv_rates`` / ``weights`` are per-client; a zero disables that
+    axis for the client (reference ClientInfo 0 -> 0 sentinel) and its
+    preloaded head tag is pinned to MAX_TAG exactly as the tag kernel
+    pins recomputed tags.
+
+    ``resv_aligned`` drops the per-client reservation-phase stagger so
+    reservation tags advance in lock-stepped cohorts (simultaneous-
+    onset tenants); staggered tags spread each client's eligibility
+    instant uniformly over its own period."""
+    from dmclock_tpu.core.timebase import MAX_TAG, rate_to_inv_ns
     from dmclock_tpu.engine import init_state
 
     st = init_state(n, ring)
     c = np.arange(n)
-    rinv = np.full(n, rate_to_inv_ns(resv_rate), dtype=np.int64)
+    rinv = np.asarray([rate_to_inv_ns(r) for r in resv_rates],
+                      dtype=np.int64)
     winv = np.asarray([rate_to_inv_ns(w) for w in weights],
                       dtype=np.int64)
     phase = ((c * 2654435761) & 0xFFFFF) / float(1 << 20)
     jitter = (phase * 2.0 * winv).astype(np.int64)
-    rjit = (phase * 2.0 * rinv).astype(np.int64)
+    rjit = np.zeros(n, dtype=np.int64) if resv_aligned else \
+        (phase * 2.0 * rinv).astype(np.int64)
+    head_resv = np.where(rinv == 0, np.int64(MAX_TAG), rinv + rjit)
+    head_prop = np.where(winv == 0, np.int64(MAX_TAG), winv + jitter)
     arrivals = np.tile(np.arange(1, depth0), (n, 1)).astype(np.int64)
     q_arr = np.zeros((n, ring), dtype=np.int64)
     q_arr[:, :depth0 - 1] = arrivals
@@ -106,8 +185,8 @@ def _sustained_setup(n: int, ring: int, depth0: int, resv_rate: float,
         order=jnp.arange(n, dtype=jnp.int64),
         resv_inv=jnp.asarray(rinv),
         weight_inv=jnp.asarray(winv),
-        head_resv=jnp.asarray(rinv + rjit),
-        head_prop=jnp.asarray(winv + jitter),
+        head_resv=jnp.asarray(head_resv),
+        head_prop=jnp.asarray(head_prop),
         head_limit=jnp.full(n, -(1 << 62), dtype=jnp.int64),
         depth=jnp.full(n, depth0, dtype=jnp.int32),
         q_arrival=jnp.asarray(q_arr),
@@ -118,7 +197,9 @@ def _sustained_setup(n: int, ring: int, depth0: int, resv_rate: float,
 def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     zipf: bool, resv_rate: float, dt_round_ns: int,
                     waves: int = 32, ring: int = 128,
-                    depth0: int = 64, latency_rounds: int = 0):
+                    depth0: int = 64, latency_rounds: int = 0,
+                    rounds_lo: int = 0, resv_aligned: bool = False,
+                    split_resv: float = 0.0, reps: int = 3):
     """Closed loop: Poisson superwave ingest + prefix serve epoch per
     round, chained async on device; ingest IS inside the timed region.
 
@@ -131,17 +212,38 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     from dmclock_tpu.engine.fastpath import scan_prefix_epoch
     from profile_util import scalar_latency, state_digest
 
-    weights = _zipf_weights(n) if zipf else \
-        np.asarray([1.0 + (i % 4) for i in range(n)])
-    state = _sustained_setup(n, ring, depth0, resv_rate, weights)
+    # ``split_resv`` > 0 models split-population multi-tenancy: that
+    # fraction of clients are reservation-ONLY floor tenants (w=0) and
+    # the rest weight-only best-effort tenants (r=0).  Mixed-QoS
+    # clients (both axes live) make the two dmClock phases alternate
+    # PER DECISION at steady state -- every weight serve's reservation-
+    # debt reduction (reference reduce_reservation_tags :1077-1111)
+    # drags that client's reservation tag back to eligibility -- which
+    # is semantically exact but serves the batch engine one-regime
+    # slivers.  Disjoint populations keep each round's constraint debt
+    # a coarse burst, which is also the more realistic storage-tenant
+    # model (bought-floor tenants vs best-effort tenants).
+    if split_resv > 0:
+        n_resv = int(n * split_resv)
+        w_tail = _zipf_weights(n - n_resv) if zipf else \
+            np.asarray([1.0 + (i % 4) for i in range(n - n_resv)])
+        weights = np.concatenate([np.zeros(n_resv), w_tail])
+        resv_rates = np.concatenate(
+            [np.full(n_resv, resv_rate), np.zeros(n - n_resv)])
+    else:
+        weights = _zipf_weights(n) if zipf else \
+            np.asarray([1.0 + (i % 4) for i in range(n)])
+        resv_rates = np.full(n, resv_rate)
+    state = _sustained_setup(n, ring, depth0, resv_rates, weights,
+                             resv_aligned=resv_aligned)
 
     # initial arrival-rate guess: reservation floor + weight share of
     # the surplus; calibration rounds below replace it with measured
     # per-client service so the loop is self-consistent (stable depth)
     serve_per_round = m * k
-    resv_per_round = n * resv_rate * (dt_round_ns / 1e9)
+    resv_per_round = float(resv_rates.sum()) * (dt_round_ns / 1e9)
     surplus = max(serve_per_round - resv_per_round, 0.0)
-    lam = resv_rate * (dt_round_ns / 1e9) + \
+    lam = resv_rates * (dt_round_ns / 1e9) + \
         surplus * (weights / weights.sum())
     lam = np.minimum(lam, waves - 1.0)
 
@@ -185,62 +287,128 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         slots = jax.device_get(ep.slot).ravel()
         np.add.at(served, slots[slots >= 0], 1)
     lam = np.minimum(served / cal_rounds, waves - 1.0)
-    lat = scalar_latency()
 
     # pregenerate + upload every round's Poisson draws BEFORE timing:
     # the host RNG and the tunnel upload are the load GENERATOR, not
     # the scheduler (the reference's ns/call numbers likewise exclude
     # its client threads' own work); the on-device ingest of those
-    # arrivals stays inside the timed region
-    pre = [draw() for _ in range(rounds)]
+    # arrivals stays inside the timed region.
+    #
+    # DIFFERENCED chains (see bench_serve_only): a short chain of
+    # ``rounds_lo`` and a long one of ``rounds`` each pay one dispatch
+    # ramp + one sync; the difference cancels fixed overhead.  One
+    # pair still carries tunnel jitter of the chains' own order
+    # (single-pair cfg3 rates spread 21-55M run to run), so ``reps``
+    # pairs run back to back in the steady state and the MEDIAN rate
+    # is reported.  With rounds_lo=0 a single lat-corrected chain is
+    # used instead (cheap smoke runs).
+    rlo = max(rounds_lo, 0)
+    n_pre = reps * (rlo + rounds) if rlo else rounds
+    pre = [draw() for _ in range(n_pre)]
     jax.block_until_ready(pre)
 
-    t0 = time.perf_counter()
-    counts_out, phases = [], []
-    for i in range(rounds):
-        ep = run(state, pre[i], jnp.int64(t_base))
-        state = ep.state
-        counts_out.append(ep.count)
-        phases.append(ep.phase)
-        t_base += dt_round_ns
-    jax.device_get(state_digest(state))
-    elapsed = time.perf_counter() - t0 - lat
+    def chain(idx):
+        nonlocal state, t_base
+        t0 = time.perf_counter()
+        counts_out, phases, guards = [], [], []
+        for i in idx:
+            ep = run(state, pre[i], jnp.int64(t_base))
+            state = ep.state
+            counts_out.append(ep.count)
+            phases.append(ep.phase)
+            guards.append(ep.guards_ok)
+            t_base += dt_round_ns
+        jax.device_get(state_digest(state))
+        wall = time.perf_counter() - t0
+        assert all(bool(jax.device_get(g).all()) for g in guards), \
+            "rebase guards tripped -- counts are not trustworthy"
+        cnts = np.concatenate([jax.device_get(c) for c in counts_out])
+        ph = np.concatenate([jax.device_get(p) for p in phases])
+        return int(cnts.sum()), wall, cnts, ph
 
-    assert bool(jax.device_get(ep.guards_ok).all()), \
-        "rebase guards tripped -- counts are not trustworthy"
-    total = int(sum(int(jax.device_get(c).sum()) for c in counts_out))
-    ph = np.concatenate([jax.device_get(p) for p in phases])
-    cnts = np.concatenate([jax.device_get(c) for c in counts_out])
+    if rlo:
+        rates, all_cnts, all_ph, total = [], [], [], 0
+        pos = 0
+        for _ in range(max(reps, 1)):
+            d_lo, t_lo, cnts_lo, ph_lo = chain(range(pos, pos + rlo))
+            d_hi, t_hi, cnts_hi, ph_hi = chain(
+                range(pos + rlo, pos + rlo + rounds))
+            pos += rlo + rounds
+            total += d_lo + d_hi
+            all_cnts += [cnts_lo, cnts_hi]
+            all_ph += [ph_lo, ph_hi]
+            if t_hi <= t_lo:
+                continue    # jitter-inverted pair: medians absorb
+            rates.append((d_hi - d_lo) / (t_hi - t_lo))
+        assert rates, "every differenced pair was jitter-inverted"
+        dps = float(np.median(rates))
+        cnts = np.concatenate(all_cnts)
+        ph = np.concatenate(all_ph)
+        denom = n_pre * m * k
+    else:
+        lat = scalar_latency()
+        d_hi, t_hi, cnts, ph = chain(range(rounds))
+        dps = d_hi / (t_hi - lat)
+        total = d_hi
+        denom = rounds * m * k
+
     resv_frac = float(cnts[ph == 0].sum()) / max(cnts.sum(), 1)
-    out = {"dps": total / elapsed, "decisions": total,
-           "fill": total / (rounds * m * k),
+    out = {"dps": dps, "decisions": total,
+           "fill": total / denom,
            "resv_phase_frac": resv_frac,
            "mean_depth": float(np.asarray(state.depth).mean())}
 
     if latency_rounds:
-        # Decision-latency percentiles.  A decision's latency is
-        # bounded by the wall time of the round it rides in.  The mean
-        # round time from the async chain is trustworthy (aggregate of
-        # pure device work); per-round sync'd samples measure device
-        # work + tunnel round-trip whose jitter exceeds the device
-        # work, so p99 is reported as the trusted mean plus the
-        # OBSERVED sync'd jitter spread -- tunnel-inclusive, hence
-        # conservative (a production runtime without the tunnel would
-        # sit at or below these numbers).
-        mean_ms = elapsed / rounds * 1e3
-        samples = []
-        for _ in range(latency_rounds):
-            nxt = draw()
-            t1 = time.perf_counter()
-            ep = run(state, nxt, jnp.int64(t_base))
+        # MEASURED per-round latency percentiles.  A decision's latency
+        # is bounded by the wall time of the round it rides in.  A
+        # window of W rounds stays in flight; device_get on round i's
+        # commit counts returns when round i completes, so successive
+        # return times sample each round's true completion interval
+        # while the full pipeline hides the tunnel round-trip
+        # (W * round_time >> RTT).  Only intervals recorded while the
+        # window was full count -- the drain tail would measure RTT,
+        # not device work.
+        from collections import deque
+
+        from profile_util import scalar_latency
+
+        # window size: enough rounds in flight that the ~110ms tunnel
+        # round-trip of each device_get is hidden by device progress
+        # (w * round_time > ~2x RTT); otherwise the marks would sample
+        # the RTT, not the rounds
+        lat_rt = scalar_latency()
+        # device-side seconds per round, from the differenced median
+        round_est = (total / max(len(pre), 1)) / max(dps, 1.0)
+        w = max(4, int(np.ceil(2.0 * lat_rt / max(round_est, 1e-4))))
+        w = min(w, max(latency_rounds // 4, 4))
+        n_rounds = latency_rounds + w
+        pre2 = [draw() for _ in range(n_rounds)]
+        jax.block_until_ready(pre2)
+        pending: deque = deque()
+        marks = []
+        for i in range(n_rounds):
+            ep = run(state, pre2[i], jnp.int64(t_base))
             state = ep.state
-            jax.device_get(state_digest(state))
-            samples.append(time.perf_counter() - t1)
             t_base += dt_round_ns
-        spread = max(0.0, float(np.percentile(samples, 99)
-                                - np.percentile(samples, 50))) * 1e3
-        out["round_ms_p50"] = mean_ms
-        out["round_ms_p99"] = mean_ms + spread
+            pending.append(ep.count)
+            if len(pending) >= w:
+                jax.device_get(pending.popleft())
+                marks.append(time.perf_counter())
+        while pending:                   # drain untimed
+            jax.device_get(pending.popleft())
+        samples_ms = np.diff(np.asarray(marks)) * 1e3
+        out["latency_samples"] = int(samples_ms.size)
+        out["latency_window"] = w
+        # MEASURED percentiles of per-round completion intervals.
+        # Through this tunnel every device_get pays ~110ms wall
+        # regardless of readiness, so when the true round time is
+        # below that, the samples floor at the RTT: the percentiles
+        # are honest tunnel-inclusive UPPER BOUNDS on round latency.
+        # round_ms_mean is the differenced-chain device-side mean --
+        # the true per-round cost a tunnel-free runtime would see.
+        out["round_ms_p50"] = float(np.percentile(samples_ms, 50))
+        out["round_ms_p99"] = float(np.percentile(samples_ms, 99))
+        out["round_ms_mean"] = round_est * 1e3
     return out
 
 
@@ -264,13 +432,15 @@ def main() -> None:
             # 10k clients, uniform QoS, Poisson arrivals; weight regime
             results["cfg3"] = bench_sustained(
                 10_000, 4096, 32, 20, zipf=False, resv_rate=100.0,
-                dt_round_ns=100_000_000, ring=256, depth0=128)
+                dt_round_ns=100_000_000, ring=256, depth0=128,
+                rounds_lo=5)
         if args.mode in ("all", "cfg4"):
             # 100k clients, Zipfian weights, reservation-constrained:
             # resv floor ~= half of service capacity per round
             results["cfg4"] = bench_sustained(
-                100_000, 49152, 21, 10, zipf=True, resv_rate=100.0,
-                dt_round_ns=50_000_000, latency_rounds=12)
+                100_000, 49152, 21, 16, zipf=True,
+                resv_rate=CFG4_RESV_RATE, dt_round_ns=50_000_000,
+                rounds_lo=4, latency_rounds=100)
 
     c4 = results.get("cfg4")
     primary = c4 or results.get("cfg3") or results["serve"]
@@ -287,9 +457,12 @@ def main() -> None:
         parts.append(
             f"cfg4 100k-client Zipf resv-constrained "
             f"{c4['dps']/1e6:.1f}M (resv phase "
-            f"{c4['resv_phase_frac']:.2f}, round p50 "
+            f"{c4['resv_phase_frac']:.2f}; round mean "
+            f"{c4.get('round_ms_mean', 0):.0f}ms device-side, "
+            f"measured-interval p50 "
             f"{c4.get('round_ms_p50', 0):.0f}ms p99 "
-            f"{c4.get('round_ms_p99', 0):.0f}ms)")
+            f"{c4.get('round_ms_p99', 0):.0f}ms tunnel-inclusive "
+            f"upper bounds)")
 
     print(json.dumps({
         "metric": "dmclock sustained scheduling decisions/sec, "

@@ -75,41 +75,30 @@ def plot_k_sweep(rows, out: Path):
 
 
 def plot_km_sweep(rows, out: Path):
+    """The focused round-4 grid: the m axis at k=65536 (dispatch
+    amortization) and the k axis at m=64 (batch-size scaling)."""
     data = [(int(r[0]), int(r[1]), float(r[2])) for r in rows[1:]]
-    ks = sorted({k for k, _, _ in data})
-    ms = sorted({m for _, m, _ in data})
-    fig, ax = plt.subplots(figsize=(6, 3.2), dpi=150)
-    width = 0.38
-    for si, m in enumerate(ms):
-        vals = []
-        for k in ks:
-            cell = [v for k2, m2, v in data if k2 == k and m2 == m]
-            if not cell:
-                raise SystemExit(
-                    f"RESULTS.md missing k={k} m={m}: refusing to plot "
-                    "a zero bar for unmeasured data")
-            vals.append(cell[0])
-        xs = [i + (si - (len(ms) - 1) / 2) * (width + 0.03)
-              for i in range(len(ks))]
-        ax.bar(xs, vals, width=width, color=SERIES[si % len(SERIES)],
-               edgecolor="none", label=f"m={m}")
-    style(ax)
-    ax.set_xticks(range(len(ks)))
-    ax.set_xticklabels([str(k) for k in ks])
-    ax.set_xlabel("speculative batch size k", color=MUTED, fontsize=9)
-    ax.set_ylabel("M decisions/sec", color=MUTED, fontsize=9)
-    ax.set_title("TPU epoch k/m sweep (100k clients, one chip)",
-                 color=INK, fontsize=11, loc="left")
-    leg = ax.legend(frameon=False, fontsize=9, labelcolor=INK)
-    for h in leg.legend_handles:
-        h.set_height(7)
-    # zero rows are real data: the speculation boundary
-    for i, k in enumerate(ks):
-        if all(v == 0.0 for k2, _m, v in data if k2 == k):
-            ax.annotate("speculation\nfails", (i, 0),
-                        textcoords="offset points", xytext=(0, 8),
-                        ha="center", color=MUTED, fontsize=8)
-    fig.tight_layout()
+    m_axis = sorted((m, v) for k, m, v in data if k == 65536)
+    k_axis = sorted((k, v) for k, m, v in data if m == 64)
+    if not m_axis or not k_axis:
+        raise SystemExit(
+            "RESULTS.md k/m table lacks the k=65536 / m=64 axes: "
+            "refusing to plot empty charts for unmeasured data")
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(7.5, 3.2), dpi=150)
+    ax1.bar([str(m) for m, _ in m_axis], [v for _, v in m_axis],
+            width=0.62, color=SERIES[0], edgecolor="none")
+    style(ax1)
+    ax1.set_xlabel("epoch length m (k=65536)", color=MUTED, fontsize=9)
+    ax1.set_ylabel("M decisions/sec", color=MUTED, fontsize=9)
+    ax2.bar([str(k) for k, _ in k_axis], [v for _, v in k_axis],
+            width=0.62, color=SERIES[1], edgecolor="none")
+    style(ax2)
+    ax2.set_xlabel("batch size k (m=64)", color=MUTED, fontsize=9)
+    ax2.tick_params(axis="x", labelrotation=30)
+    fig.suptitle("TPU prefix-epoch k/m sweep (100k clients, one chip, "
+                 "medians)", color=INK, fontsize=11, x=0.02,
+                 ha="left")
+    fig.tight_layout(rect=(0, 0, 1, 0.93))
     fig.savefig(out)
     plt.close(fig)
 
@@ -121,7 +110,7 @@ def main():
         if title.startswith("Native heap K-sweep"):
             plot_k_sweep(rows, HERE / "k_sweep.png")
             wrote.append("k_sweep.png")
-        elif title.startswith("TPU epoch k/m sweep"):
+        elif title.startswith("TPU prefix-epoch k/m sweep"):
             plot_km_sweep(rows, HERE / "tpu_km_sweep.png")
             wrote.append("tpu_km_sweep.png")
     print(f"wrote {', '.join(wrote) or 'nothing (no known sections)'}")

@@ -67,11 +67,22 @@ def native_k_sweep(repeat: int):
     return rows
 
 
-def _timed_prefix_epochs(state, now_ns, epochs, k, m, lat):
-    """Per-epoch-sync timing on the prefix-commit engine: every batch
-    commits its longest exact serial prefix, so there is no fallback or
-    recovery path -- the decision count is the sum of per-batch commit
-    counts.  Returns (decisions/sec, fill)."""
+def _timed_prefix_epochs(make_state, now_ns, epochs_hi, k, m,
+                         epochs_lo=None, reps=3):
+    """Differenced-chain timing on the prefix-commit engine (matches
+    bench.py's protocol): a short chain of ``epochs_hi // 4`` epochs
+    and a long one of ``epochs_hi``, each chained async with ONE digest
+    sync; ``(D_hi - D_lo) / (T_hi - T_lo)`` cancels the fixed per-chain
+    dispatch/sync overhead exactly.  (Round 3 subtracted one measured
+    scalar latency instead, which left chain-length-dependent overhead
+    in the result -- the 50M-vs-103M protocol discrepancy of VERDICT r3
+    weak #3.)
+
+    Backlog bounds keep the chains short (tens to hundreds of ms of
+    device work), so one differenced pair still carries tunnel jitter
+    of the same order -- single-shot rates at the big-k shapes spread
+    41-71M run to run.  The reported rate is the MEDIAN over ``reps``
+    fresh-state repetitions.  Returns (decisions/sec, fill)."""
     import jax
     import jax.numpy as jnp
     from dmclock_tpu.engine.fastpath import scan_prefix_epoch
@@ -80,42 +91,101 @@ def _timed_prefix_epochs(state, now_ns, epochs, k, m, lat):
     run = jax.jit(functools.partial(
         scan_prefix_epoch, m=m, k=k, anticipation_ns=0),
         donate_argnums=(0,))
-    # the tunneled remote-compile endpoint occasionally drops a
-    # response mid-read; one retry covers it (the cache makes the
-    # second attempt cheap).  Only runtime/transport errors are
-    # retried -- a trace-time programming error (TypeError, shape
-    # mismatch) must fail fast with its original traceback.  Retry
-    # ONLY if the donated input buffer survived: a post-dispatch
-    # failure consumes it, and retrying would mask the original error
-    # with a deleted-buffer error.
-    for attempt in (0, 1):
-        try:
-            ep = run(state, jnp.int64(now_ns))
-            break
-        except jax.errors.JaxRuntimeError:
-            if attempt or any(
-                    getattr(x, "is_deleted", lambda: False)()
-                    for x in jax.tree_util.tree_leaves(state)):
-                raise
-            time.sleep(2)
-    jax.device_get(state_digest(ep.state))
-    state = ep.state
+    if epochs_lo is None:
+        epochs_lo = max(1, epochs_hi // 4)
 
-    # epochs chained ASYNC (no mid-run readback): one digest sync is
-    # timed and one latency subtracted; commit counts are fetched
-    # untimed afterwards.  (A per-epoch sync'd variant subtracted
-    # lat*trips, which overwhelms short chains through the ~110ms
-    # tunnel and can go negative.)
+    def chain(state, n):
+        t0 = time.perf_counter()
+        counts, guards = [], []
+        for _ in range(n):
+            ep = run(state, jnp.int64(now_ns))
+            state = ep.state
+            counts.append(ep.count)
+            guards.append(ep.guards_ok)
+        jax.device_get(state_digest(state))
+        wall = time.perf_counter() - t0
+        assert all(bool(jax.device_get(g).all()) for g in guards), \
+            "rebase guards tripped -- counts are not trustworthy"
+        total = int(sum(int(jax.device_get(c).sum()) for c in counts))
+        return state, total, wall
+
+    rates, d_all, pot_all = [], 0, 0
+    for rep in range(max(reps, 1)):
+        state = make_state()
+        # the tunneled remote-compile endpoint occasionally drops a
+        # response mid-read; one retry covers it (the cache makes the
+        # second attempt cheap).  Only runtime/transport errors are
+        # retried -- a trace-time programming error must fail fast.
+        # Retry ONLY if the donated input buffer survived: a post-
+        # dispatch failure consumes it, and retrying would mask the
+        # original error with a deleted-buffer error.
+        for attempt in (0, 1):
+            try:
+                ep = run(state, jnp.int64(now_ns))   # warm/compile
+                break
+            except jax.errors.JaxRuntimeError:
+                if attempt or any(
+                        getattr(x, "is_deleted", lambda: False)()
+                        for x in jax.tree_util.tree_leaves(state)):
+                    raise
+                time.sleep(2)
+                state = make_state()
+        jax.device_get(state_digest(ep.state))
+        state = ep.state
+        if rep == 0:
+            # backlog sufficiency with the 1.5x heavy-class margin
+            # (bench.py's rule: weights 1..4 serve the heaviest class
+            # ~1.6x the mean; chains sized to the MEAN backlog drain
+            # heavy clients mid-chain and deflate the rate)
+            backlog = int(jax.device_get(
+                state.depth.astype(jnp.int64).sum()))
+            assert (epochs_lo + epochs_hi) * m * k * 3 // 2 <= backlog, \
+                f"backlog {backlog} cannot feed chains at k={k} " \
+                f"m={m} with heavy-class margin"
+        state, d_lo, t_lo = chain(state, epochs_lo)
+        state, d_hi, t_hi = chain(state, epochs_hi)
+        d_all += d_lo + d_hi
+        pot_all += (epochs_lo + epochs_hi) * m * k
+        if t_hi <= t_lo:
+            continue        # jitter-inverted pair: medians absorb it
+        rates.append((d_hi - d_lo) / (t_hi - t_lo))
+    assert rates, "every differenced pair was jitter-inverted"
+    import statistics
+    return statistics.median(rates), d_all / pot_all
+
+
+def _timed_transient_chain(state, now_ns, epochs, k, m):
+    """Single measured chain for NON-stationary shapes (a transition
+    is consumed once, so chain differencing cannot apply): compile on
+    a disposable copy of the state, then time one chain from the
+    intact original, subtracting one measured scalar round-trip.
+    Transient rates carry the tunnel noise the differenced protocol
+    cancels -- treat them as approximate."""
+    import jax
+    import jax.numpy as jnp
+    from dmclock_tpu.engine.fastpath import scan_prefix_epoch
+    from profile_util import scalar_latency, state_digest
+
+    run = jax.jit(functools.partial(
+        scan_prefix_epoch, m=m, k=k, anticipation_ns=0),
+        donate_argnums=(0,))
+    warm = run(jax.tree.map(jnp.copy, state), jnp.int64(now_ns))
+    jax.device_get(state_digest(warm.state))
+    del warm
+    lat = scalar_latency()
     t0 = time.perf_counter()
-    counts = []
+    counts, guards = [], []
     for _ in range(epochs):
         ep = run(state, jnp.int64(now_ns))
         state = ep.state
         counts.append(ep.count)
+        guards.append(ep.guards_ok)
     jax.device_get(state_digest(state))
     t = time.perf_counter() - t0 - lat
+    assert all(bool(jax.device_get(g).all()) for g in guards), \
+        "rebase guards tripped -- counts are not trustworthy"
     total = int(sum(int(jax.device_get(c).sum()) for c in counts))
-    assert t > 0, f"timing underflow: {t:.4f}s for {epochs} epochs"
+    assert t > 0, f"timing underflow: {t:.4f}s"
     return total / t, total / (epochs * m * k)
 
 
@@ -123,19 +193,27 @@ def tpu_km_sweep():
     import sys
     sys.path.insert(0, str(REPO))
     from __graft_entry__ import _preloaded_state
-    from profile_util import scalar_latency
 
-    n, depth = 100_000, 128
+    n, depth = 100_000, 256
     rows = []
-    lat = scalar_latency()
-    for k in (8192, 16384, 32768, 49152, 65536, 98304):
-        for m in (8, 32):
-            state = _preloaded_state(n, depth, ring=depth)
-            epochs = max(2, (1 << 23) // (m * k))
-            dps, fill = _timed_prefix_epochs(state, 0, epochs, k, m, lat)
-            rows.append((k, m, dps, fill))
-            print(f"k={k} m={m}: {dps/1e6:.2f} M dec/s "
-                  f"(fill {fill:.3f})")
+    # focused grid: the m axis at the argmax k (dispatch-amortization
+    # story) plus the k axis at the argmax m; 3 fresh-state reps per
+    # point (median) keep the short-chain shapes jitter-stable.  The
+    # largest shapes need deeper rings for the heavy-class backlog
+    # margin (ring width itself costs; keep the smallest that fits).
+    grid = [(65536, m, 256) for m in (8, 21, 32, 64)] + \
+        [(16384, 64, 256), (32768, 64, 256), (49152, 64, 256),
+         (98304, 64, 384)]
+    for k, m, d in grid:
+        hi = max(2, (1 << 23) // (m * k))
+
+        def mk(depth=d):
+            return _preloaded_state(n, depth, ring=depth)
+
+        dps, fill = _timed_prefix_epochs(mk, 0, hi, k, m)
+        rows.append((k, m, dps, fill))
+        print(f"k={k} m={m}: {dps/1e6:.2f} M dec/s "
+              f"(fill {fill:.3f})", flush=True)
     return rows
 
 
@@ -155,8 +233,7 @@ def tpu_regime_sweep():
     from dmclock_tpu.engine import kernels
     from profile_util import scalar_latency, state_digest
 
-    n, depth, k, m = 100_000, 128, 49152, 21
-    lat = scalar_latency()
+    n, depth, k, m = 100_000, 256, 49152, 21
     rows = []
 
     def resv_state():
@@ -169,25 +246,30 @@ def tpu_regime_sweep():
         return st._replace(head_resv=jnp.asarray(rinv + jit))
 
     # pure reservation regime: now far beyond every reservation tag
-    dps, fill = _timed_prefix_epochs(resv_state(), 10**15, 8, k, m, lat)
+    dps, fill = _timed_prefix_epochs(resv_state, 10**15, 8, k, m)
     rows.append(("reservation backlog", dps, fill))
     print(f"reservation: {dps/1e6:.2f} M dec/s fill {fill:.3f}")
 
     # transition: only a few batches of reservation serves are
-    # eligible, then the regime flips to weight mid-epoch
+    # eligible, then the regime flips to weight mid-epoch.  The flip is
+    # consumed once, so this row uses the single-chain transient
+    # protocol (approximate), not chain differencing.
     st = resv_state()
     now = int(np.asarray(st.head_resv).min()) + 2 * 10**7
-    dps, fill = _timed_prefix_epochs(st, now, 8, k, m, lat)
-    rows.append(("resv->weight transition", dps, fill))
+    dps, fill = _timed_transient_chain(st, now, 8, k, m)
+    rows.append(("resv->weight transition (transient)", dps, fill))
     print(f"transition: {dps/1e6:.2f} M dec/s fill {fill:.3f}")
 
     # weight regime baseline at the same epoch budget
     dps, fill = _timed_prefix_epochs(
-        _preloaded_state(n, depth, ring=depth), 0, 8, k, m, lat)
+        lambda: _preloaded_state(n, depth, ring=depth), 0, 8, k, m)
     rows.append(("weight steady state", dps, fill))
     print(f"weight: {dps/1e6:.2f} M dec/s fill {fill:.3f}")
 
-    # exact serial engine floor
+    # exact serial engine floor (single-chain, lat-corrected: the
+    # serial scan is minutes-per-epoch slow, so chain differencing is
+    # unnecessary -- overhead is < 1% here)
+    lat = scalar_latency()
     state = _preloaded_state(n, depth, ring=depth)
     serial = jax.jit(lambda s, t: kernels.engine_run(
         s, t, 4096, allow_limit_break=False, anticipation_ns=0,
@@ -208,20 +290,143 @@ def tpu_sustained_sweep():
     superwave ingest + prefix epochs) as measured by bench.py."""
     import sys
     sys.path.insert(0, str(REPO))
-    from bench import bench_sustained
+    from bench import CFG4_RESV_RATE, bench_sustained
 
     rows = []
     r3 = bench_sustained(10_000, 4096, 32, 20, zipf=False,
                          resv_rate=100.0, dt_round_ns=100_000_000,
-                         ring=256, depth0=128)
+                         ring=256, depth0=128, rounds_lo=5)
     rows.append(("cfg3: 10k clients, uniform QoS, Poisson", r3))
     print(f"cfg3: {r3['dps']/1e6:.2f} M dec/s")
-    r4 = bench_sustained(100_000, 49152, 21, 10, zipf=True,
-                         resv_rate=100.0, dt_round_ns=50_000_000)
+    r4 = bench_sustained(100_000, 49152, 21, 16, zipf=True,
+                         resv_rate=CFG4_RESV_RATE,
+                         dt_round_ns=50_000_000, rounds_lo=4)
     rows.append(("cfg4: 100k clients, Zipf weights, resv-constrained",
                  r4))
     print(f"cfg4: {r4['dps']/1e6:.2f} M dec/s")
     return rows
+
+
+def cfg4_calibration_sweep():
+    """The cfg4 reservation-rate calibration study: constraint-phase
+    share and throughput vs reservation rate, for three population
+    designs.  Mixed-QoS clients pin the share high at any realistic
+    rate (weight serves' reservation-debt reduction re-arms the
+    constraint phase, reference reduce_reservation_tags :1077-1111);
+    cohort alignment and split populations were the candidate
+    mitigations -- neither beats the simple mixed design at the target
+    share, so cfg4 ships mixed with CFG4_RESV_RATE."""
+    import sys
+    sys.path.insert(0, str(REPO))
+    from bench import bench_sustained
+
+    rows = []
+    cases = [
+        ("mixed staggered", {}, (25.0, 50.0, 100.0, 200.0)),
+        ("mixed aligned", {"resv_aligned": True}, (100.0, 200.0)),
+        ("split 50/50", {"split_resv": 0.5}, (60.0, 90.0, 140.0)),
+    ]
+    for name, kw, rates in cases:
+        for r in rates:
+            out = bench_sustained(100_000, 49152, 21, 8, zipf=True,
+                                  resv_rate=r, dt_round_ns=50_000_000,
+                                  rounds_lo=2, **kw)
+            rows.append((name, r, out))
+            print(f"{name} r={r}: resv_phase="
+                  f"{out['resv_phase_frac']:.3f} "
+                  f"fill={out['fill']:.3f} "
+                  f"dps={out['dps']/1e6:.1f}M", flush=True)
+    return rows
+
+
+def device_sim_headline():
+    """Closed-loop ops/sec of the device-resident simulator at 100k
+    clients -- the reference's system test (sim/src/simulate.h:159-178)
+    run as ONE compiled program per launch: load generation, delta/rho
+    piggybacking, dmClock scheduling, service, completion feedback all
+    on device.  Prefix serve mode (q=4096 per slice), random server
+    selection, 2-thread servers."""
+    import dataclasses
+    import functools
+    import sys
+    sys.path.insert(0, str(REPO))
+    import jax
+    import numpy as np
+    from dmclock_tpu.sim import device_sim as DS
+    from dmclock_tpu.sim.config import (ClientGroup, ServerGroup,
+                                        SimConfig)
+
+    n = 100_000
+    groups = [
+        ClientGroup(client_count=n // 2, client_total_ops=10**9,
+                    client_iops_goal=80.0, client_outstanding_ops=32,
+                    client_reservation=2.0, client_limit=0.0,
+                    client_weight=1.0, client_server_select_range=8),
+        ClientGroup(client_count=n // 2, client_total_ops=10**9,
+                    client_iops_goal=80.0, client_outstanding_ops=32,
+                    client_reservation=2.0, client_limit=0.0,
+                    client_weight=3.0, client_server_select_range=8),
+    ]
+    cfg = SimConfig(client_groups=2, server_groups=1,
+                    server_random_selection=True,
+                    server_soft_limit=False, cli_group=groups,
+                    srv_group=[ServerGroup(server_count=8,
+                                           server_iops=500_000.0,
+                                           server_threads=2)])
+    sim, _ = DS.init_device_sim(cfg, ring_capacity=64)
+    # rebuild the spec at the throughput slice size through _make_spec
+    # so max_sends is re-derived for the longer slice (a stale
+    # max_sends would silently clamp offered load below the goal --
+    # the misreporting _make_spec's assert exists to refuse)
+    spec = DS._make_spec(cfg, q_per_slice=4096)
+    assert spec.q_per_slice >= 256 and not spec.force_scan
+    mesh = DS.make_mesh(1)
+    sim = DS.shard_device_sim(sim, mesh)
+    # slices=2 + per-launch syncs: longer launches of this program
+    # (vmap x while_loop x shard_map over 8 servers) reliably fault
+    # the tunneled TPU worker; 2-slice launches ran 14+ consecutive
+    # times without incident.  Donation keeps one ~1GB state resident.
+    slices = 2
+    step = jax.jit(functools.partial(DS.device_sim_step, spec=spec,
+                                     mesh=mesh, slices=slices),
+                   donate_argnums=(0,))
+
+    def served(s):
+        return int(np.asarray(s.served_resv).sum()
+                   + np.asarray(s.served_prop).sum())
+
+    def chain(launches, s):
+        # served_resv/served_prop are CUMULATIVE counters: take the
+        # per-chain delta so the differenced rate's numerator and
+        # denominator cover the same launches.  Launches are sync'd
+        # INDIVIDUALLY: queueing several multi-second device_sim
+        # launches asynchronously reliably crashed the tunneled TPU
+        # worker ("kernel fault").  Differencing cancels only the
+        # fixed per-CHAIN offset; each launch's ~110ms sync round-trip
+        # stays in the denominator, so the reported wall rate is a
+        # tunnel-inclusive, conservative figure.
+        before = served(s)          # syncs the previous chain, untimed
+        t0 = time.perf_counter()
+        for _ in range(launches):
+            s = step(s)
+            jax.block_until_ready(s.served_resv)
+        n_served = served(s) - before
+        return s, n_served, time.perf_counter() - t0
+
+    sim, _, _ = chain(1, sim)                      # warm/compile
+    sim, d_lo, t_lo = chain(4, sim)
+    sim, d_hi, t_hi = chain(10, sim)
+    dps = (d_hi - d_lo) / (t_hi - t_lo)
+    virt_s = int(np.asarray(sim.t)) / 1e9
+    per_client = (np.asarray(sim.served_resv)
+                  + np.asarray(sim.served_prop)).sum(axis=0)
+    g2 = per_client[n // 2:].sum() / max(per_client[:n // 2].sum(), 1)
+    row = {"ops_per_sec": dps, "total_ops": served(sim),
+           "virtual_s": virt_s, "weight_ratio_3_1": float(g2)}
+    print(f"device_sim closed loop: {dps/1e6:.2f} M ops/s wall "
+          f"(weight 3:1 ratio {g2:.2f}, {d_hi} ops, "
+          f"{virt_s:.1f}s virtual)")
+    return row
 
 
 def main():
@@ -230,6 +435,11 @@ def main():
     ap.add_argument("--skip-tpu", action="store_true")
     ap.add_argument("--regimes", action="store_true",
                     help="also run the regime-coverage sweep")
+    ap.add_argument("--devsim", action="store_true",
+                    help="also run the device-sim closed-loop headline")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="also run the cfg4 reservation calibration "
+                    "study (slow: ~9 sustained runs)")
     ap.add_argument("--repeat", type=int, default=3)
     args = ap.parse_args()
 
@@ -238,6 +448,8 @@ def main():
     tpu_part = here / ".tpu_section.md"
     regime_part = here / ".regime_section.md"
     sustained_part = here / ".sustained_section.md"
+    devsim_part = here / ".devsim_section.md"
+    calib_part = here / ".calib_section.md"
 
     if not args.skip_native:
         lines = ["## Native heap K-sweep (dmc_sim_100_100.conf, "
@@ -276,11 +488,44 @@ def main():
         lines.append("")
         sustained_part.write_text("\n".join(lines))
 
+    if args.calibrate:
+        lines = ["## cfg4 reservation calibration (100k clients, Zipf, "
+                 "k=49152 m=21, dt=50ms)", "",
+                 "| design | resv rate /s | resv phase | fill | "
+                 "M dec/s |", "|---|---|---|---|---|"]
+        for name, r, out in cfg4_calibration_sweep():
+            lines.append(
+                f"| {name} | {r:.0f} | {out['resv_phase_frac']:.3f} | "
+                f"{out['fill']:.3f} | {out['dps']/1e6:.1f} |")
+        lines.append("")
+        lines.append(
+            "The share is monotone in the rate for every design: "
+            "weight serves' reservation-debt reduction keeps mixed "
+            "clients' reservation tags at the eligibility boundary, "
+            "so the phases interleave per decision; the shipped cfg4 "
+            "is mixed-staggered at the rate whose share is ~0.5 "
+            "(bench.CFG4_RESV_RATE).")
+        lines.append("")
+        calib_part.write_text("\n".join(lines))
+
+    if args.devsim:
+        row = device_sim_headline()
+        lines = ["## Device-sim closed loop (100k clients, prefix "
+                 "serve q=4096, random selection, 2-thread servers, "
+                 "one chip)", "",
+                 "| M ops/s (wall) | total ops | virtual s | "
+                 "weight 3:1 ratio |", "|---|---|---|---|",
+                 f"| {row['ops_per_sec']/1e6:.2f} | "
+                 f"{row['total_ops']} | {row['virtual_s']:.1f} | "
+                 f"{row['weight_ratio_3_1']:.2f} |", ""]
+        devsim_part.write_text("\n".join(lines))
+
     head = ["# Benchmark sweeps", "",
             "Produced by `python benchmark/run_sweeps.py` "
             "(see its docstring).", ""]
     body = [p.read_text() for p in (native_part, tpu_part, regime_part,
-                                    sustained_part)
+                                    sustained_part, devsim_part,
+                                    calib_part)
             if p.exists()]
     RESULTS.write_text("\n".join(head + body))
     print(f"wrote {RESULTS}")

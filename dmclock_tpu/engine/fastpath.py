@@ -202,7 +202,8 @@ def _rotate_rows_xla(ring, q0, wsize: int):
     return r[:, :wsize].T
 
 
-def ring_window(state: EngineState, m: int) -> RingWindow:
+def ring_window(state: EngineState, m: int,
+                use_pallas: bool | None = None) -> RingWindow:
     """Prefetch the next ``min(m, Q)`` ring elements of every client,
     transposed to [w, N] for cheap per-batch row selects.
 
@@ -212,13 +213,20 @@ def ring_window(state: EngineState, m: int) -> RingWindow:
     measured 10x the rolls' cost for a 32-wide window; a vmapped
     dynamic-slice was 50x).  Window rows past a client's queued tail
     carry stale ring values -- reads of them only happen after the
-    client drained, and are masked at commit."""
+    client drained, and are masked at commit.
+
+    ``use_pallas`` overrides the backend auto-pick: callers that wrap
+    this in ``vmap`` must pass False -- batching adds a grid dimension
+    to the (deliberately gridless) kernel, and gridded pallas_calls do
+    not legalize through this environment's remote Mosaic compiler."""
     q = state.ring_capacity
     q0 = state.q_head
     wsize = min(m, q)
 
     # the Pallas path needs a full lane tile (2q >= 128 int32 lanes)
-    if jax.default_backend() == "tpu" and q >= 64:
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and q >= 64
+    if use_pallas:
         n = q0.shape[0]
         q0t = _tile_shifts(q0, q, n + ((-n) % _rot_chunk(q)))
         rot = functools.partial(_rotate_rows_pallas, q0=q0,

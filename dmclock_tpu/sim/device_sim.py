@@ -54,7 +54,8 @@ from ..engine import kernels
 # module-level on purpose: importing fastpath inside a traced function
 # would stage its module-level jnp constants into the caller's trace
 # (cached in module globals -> UnexpectedTracerError on reuse)
-from ..engine.fastpath import speculate_prefix_batch
+from ..engine.fastpath import (_window_heads, ring_window,
+                               speculate_prefix_batch)
 from ..engine.state import EngineState, init_state
 from ..parallel.cluster import SERVER_AXIS, make_mesh
 from ..parallel.tracker import (TrackerState, global_counters,
@@ -356,10 +357,17 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                         # guards_ok is unchecked by design: its only
                         # dynamic inputs (cost, creation-order spread)
                         # are static in this sim and validated at
-                        # init_device_sim, so it cannot fail here
+                        # init_device_sim, so it cannot fail here.
+                        # The ring-head read forces the XLA rotate:
+                        # this whole body runs under vmap (servers),
+                        # which would grid the gridless Pallas kernel
+                        # -- ungridded is all the remote Mosaic
+                        # compiler accepts.
+                        heads = _window_heads(eng, ring_window(
+                            eng, 1, use_pallas=False))
                         batch = speculate_prefix_batch(
                             eng, t_end, kb, anticipation_ns=0,
-                            max_count=q - total)
+                            max_count=q - total, heads=heads)
                         # pack the committed prefix at the buffer
                         # offset (invalid rows scatter out of range
                         # and drop)
@@ -466,7 +474,7 @@ def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
     sim = shard_device_sim(sim, mesh)
     step = jax.jit(functools.partial(
         device_sim_step, spec=spec, mesh=mesh,
-        slices=slices_per_launch))
+        slices=slices_per_launch), donate_argnums=(0,))
     total_ops = int(np.asarray(sim.load.total_ops).sum())
     launches = 0
     completed = 0
