@@ -276,7 +276,7 @@ class DenseServe(NamedTuple):
 
 
 def _dense_serve(state: EngineState, heads,
-                 phase_is_ready: bool,
+                 phase_is_ready,
                  anticipation_ns: int) -> DenseServe:
     """The vectorized pop+retag (pop_process_request / update_next_tag /
     reduce_reservation_tags, reference :1021-1111) computed for EVERY
@@ -284,10 +284,10 @@ def _dense_serve(state: EngineState, heads,
     commit.
 
     ``heads`` = (narr, ncost): every client's next tail element (the
-    new head after a pop), precomputed by the caller OUTSIDE any
-    ``lax.cond`` -- large arrays captured by cond branches are
-    materialized as branch operands every call, so only these two [N]
-    arrays may cross the regime branch, never the [m, N] window."""
+    new head after a pop), precomputed by the caller so the per-epoch
+    ring-window prefetch is shared across batches instead of re-read
+    per batch.  ``phase_is_ready`` is a python bool or traced scalar
+    (the cond-free prefix batch passes the regime flag through)."""
     # rows with depth <= 1 carry stale ring values -- masked at commit
     narr, ncost = heads
 
@@ -297,10 +297,12 @@ def _dense_serve(state: EngineState, heads,
         state.limit_inv, state.cur_delta, state.cur_rho, narr, ncost,
         anticipation_ns)
 
-    if phase_is_ready:
-        offset = state.resv_inv * (state.head_cost + state.head_rho)
-    else:
-        offset = jnp.zeros_like(state.head_resv)
+    # phase_is_ready may be a python bool or a traced scalar (the
+    # cond-free prefix batch passes the regime flag through)
+    offset = jnp.where(
+        phase_is_ready,
+        state.resv_inv * (state.head_cost + state.head_rho),
+        jnp.zeros_like(state.head_resv))
 
     new_depth = state.depth - 1
     has_more = new_depth > 0
@@ -480,67 +482,65 @@ def speculate_prefix_batch(state: EngineState, now, k: int, *,
             else jnp.minimum(count, jnp.int32(max_count))
     has_req = state.active & (state.depth > 0)
     resv_key = jnp.where(has_req, state.head_resv, KEY_INF)
-    resv_regime = jnp.min(resv_key) <= now
+    resv_regime = jnp.min(resv_key) <= now      # traced scalar bool
 
-    def resv_branch(_):
-        key = resv_key
-        serve = _dense_serve(state, heads, False, anticipation_ns)
-        reentry = jnp.where(has_req & serve.has_more, serve.head_resv,
-                            KEY_INF)
-        (idxs, sel_cost, pk, pk_dense, elig_key, count_fn,
-         guards) = _prefix_select(key, state.order, k, state.head_cost,
-                                  reentry)
-        count = capped(count_fn(elig_key <= now))
-        new_state, _ = _commit_prefix(state, serve, pk_dense, count, pk)
-        return new_state, count, guards, idxs, sel_cost, jnp.int32(0)
+    # COND-FREE regime dispatch: both regimes share one dense serve
+    # and ONE sort; the regime flag where-selects keys, re-entries and
+    # the eligibility gate.  A lax.cond here materialized each
+    # branch's operand set per batch and walled off fusion -- measured
+    # ~1.9 ms/batch of unattributed cost at k=49152 (PROFILE.md r4).
+    ready = has_req & _ready_now(state, now)
+    cand_w = ready & (state.head_prop < MAX_TAG)
+    key_w = jnp.where(cand_w, state.head_prop + state.prop_delta,
+                      KEY_INF)
+    key = jnp.where(resv_regime, resv_key, key_w)
 
-    def weight_branch(_):
-        ready = has_req & _ready_now(state, now)
-        cand = ready & (state.head_prop < MAX_TAG)
-        key = jnp.where(cand, state.head_prop + state.prop_delta,
-                        KEY_INF)
-        serve = _dense_serve(state, heads, True, anticipation_ns)
-        new_eff = serve.head_prop + state.prop_delta
-        new_ready = (serve.head_limit <= now) & \
-            (serve.head_prop < MAX_TAG)
-        # regime-exit blocker: a weight serve whose reservation tag
-        # (post weight-debt reduction) becomes eligible forces the next
-        # serial decision into the constraint phase
-        blocked = cand & serve.has_more & (serve.head_resv <= now)
-        reentry = jnp.where(
-            blocked, jnp.int64(-1),
-            jnp.where(cand & serve.has_more & new_ready, new_eff,
-                      KEY_INF))
-        (idxs, sel_cost, pk, pk_dense, _elig, count_fn,
-         guards) = _prefix_select(key, state.order, k, state.head_cost,
-                                  reentry)
-        count = capped(count_fn(jnp.ones((k,), dtype=bool)))
-        new_state, _ = _commit_prefix(state, serve, pk_dense, count, pk)
+    serve = _dense_serve(state, heads, ~resv_regime, anticipation_ns)
 
-        # stored-flag parity (promote loop, reference :1135-1144): every
-        # weight decision promotes current heads with limit <= now; the
-        # head popped by the LAST committed decision was never seen by a
-        # later promote pass.  With count == 0 no serial decision ran,
-        # so the flags stay untouched.
-        has_req_after = new_state.active & (new_state.depth > 0)
-        promoted = new_state.head_ready | \
-            (has_req_after & (new_state.head_limit <= now))
-        last_client = idxs[jnp.maximum(count - 1, 0)]
-        promoted = promoted & (
-            jnp.arange(state.capacity, dtype=jnp.int32) != last_client)
-        new_state = new_state._replace(head_ready=jnp.where(
-            count > 0, promoted, new_state.head_ready))
-        return new_state, count, guards, idxs, sel_cost, jnp.int32(1)
+    # re-entry per regime.  Weight regime: a serve whose reservation
+    # tag (post weight-debt reduction) becomes eligible forces the
+    # next serial decision into the constraint phase (blocker = -1).
+    reentry_r = jnp.where(has_req & serve.has_more, serve.head_resv,
+                          KEY_INF)
+    new_eff = serve.head_prop + state.prop_delta
+    new_ready = (serve.head_limit <= now) & (serve.head_prop < MAX_TAG)
+    blocked = cand_w & serve.has_more & (serve.head_resv <= now)
+    reentry_w = jnp.where(
+        blocked, jnp.int64(-1),
+        jnp.where(cand_w & serve.has_more & new_ready, new_eff,
+                  KEY_INF))
+    reentry = jnp.where(resv_regime, reentry_r, reentry_w)
 
-    new_state, count, guards, idxs, sel_cost, phase = lax.cond(
-        resv_regime, resv_branch, weight_branch, operand=None)
+    (idxs, sel_cost, pk, pk_dense, elig_key, count_fn,
+     guards) = _prefix_select(key, state.order, k, state.head_cost,
+                              reentry)
+    # constraint phase serves only tags <= now; the weight phase has
+    # no eligibility gate (readiness is already in the candidate set)
+    elig_ok = jnp.where(resv_regime, elig_key <= now, True)
+    count = capped(count_fn(elig_ok))
+    new_state, _ = _commit_prefix(state, serve, pk_dense, count, pk)
 
+    # stored-flag parity (promote loop, reference :1135-1144), weight
+    # regime only: every weight decision promotes current heads with
+    # limit <= now; the head popped by the LAST committed decision was
+    # never seen by a later promote pass.  With count == 0 no serial
+    # decision ran, so the flags stay untouched.
+    has_req_after = new_state.active & (new_state.depth > 0)
+    promoted = new_state.head_ready | \
+        (has_req_after & (new_state.head_limit <= now))
+    last_client = idxs[jnp.maximum(count - 1, 0)]
+    promoted = promoted & (
+        jnp.arange(state.capacity, dtype=jnp.int32) != last_client)
+    new_state = new_state._replace(head_ready=jnp.where(
+        ~resv_regime & (count > 0), promoted, new_state.head_ready))
+
+    phase = jnp.where(resv_regime, jnp.int32(0), jnp.int32(1))
     j = jnp.arange(k, dtype=jnp.int32)
     served = j < count
     decisions = Decision(
         type=jnp.where(served, RETURNING, NONE).astype(jnp.int32),
         slot=jnp.where(served, idxs, -1).astype(jnp.int32),
-        phase=jnp.full((k,), phase, dtype=jnp.int32),
+        phase=jnp.broadcast_to(phase, (k,)),
         cost=jnp.where(served, sel_cost, 0),
         when=jnp.zeros((k,), dtype=jnp.int64),
         limit_break=jnp.zeros((k,), dtype=bool),
