@@ -429,11 +429,14 @@ def main() -> None:
         if args.mode in ("all", "serve"):
             results["serve"] = bench_serve_only()
         if args.mode in ("all", "cfg3"):
-            # 10k clients, uniform QoS, Poisson arrivals; weight regime
+            # 10k clients, uniform QoS, Poisson arrivals; weight
+            # regime.  Rounds are small (~130k decisions, ~7ms), so
+            # the chains must be long for the differenced pairs to
+            # clear tunnel jitter
             results["cfg3"] = bench_sustained(
-                10_000, 4096, 32, 20, zipf=False, resv_rate=100.0,
+                10_000, 4096, 32, 60, zipf=False, resv_rate=100.0,
                 dt_round_ns=100_000_000, ring=256, depth0=128,
-                rounds_lo=5)
+                rounds_lo=15)
         if args.mode in ("all", "cfg4"):
             # 100k clients, Zipfian weights, reservation-constrained:
             # resv floor ~= half of service capacity per round

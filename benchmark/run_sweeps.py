@@ -293,9 +293,9 @@ def tpu_sustained_sweep():
     from bench import CFG4_RESV_RATE, bench_sustained
 
     rows = []
-    r3 = bench_sustained(10_000, 4096, 32, 20, zipf=False,
+    r3 = bench_sustained(10_000, 4096, 32, 60, zipf=False,
                          resv_rate=100.0, dt_round_ns=100_000_000,
-                         ring=256, depth0=128, rounds_lo=5)
+                         ring=256, depth0=128, rounds_lo=15)
     rows.append(("cfg3: 10k clients, uniform QoS, Poisson", r3))
     print(f"cfg3: {r3['dps']/1e6:.2f} M dec/s")
     r4 = bench_sustained(100_000, 49152, 21, 16, zipf=True,

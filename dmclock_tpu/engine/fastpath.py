@@ -486,9 +486,10 @@ def speculate_prefix_batch(state: EngineState, now, k: int, *,
 
     # COND-FREE regime dispatch: both regimes share one dense serve
     # and ONE sort; the regime flag where-selects keys, re-entries and
-    # the eligibility gate.  A lax.cond here materialized each
-    # branch's operand set per batch and walled off fusion -- measured
-    # ~1.9 ms/batch of unattributed cost at k=49152 (PROFILE.md r4).
+    # the eligibility gate.  A lax.cond here materialized the selected
+    # branch's operand set per batch and walled off fusion -- removing
+    # it measured 2576 -> 1494 us/batch at k=49152 (PROFILE.md r4
+    # finding 9).
     ready = has_req & _ready_now(state, now)
     cand_w = ready & (state.head_prop < MAX_TAG)
     key_w = jnp.where(cand_w, state.head_prop + state.prop_delta,
