@@ -454,9 +454,13 @@ def _prefix_select(key, order, k: int, cost, reentry):
 def _commit_prefix(state: EngineState, serve: DenseServe, pk_dense,
                    count, pk) -> tuple[EngineState, jnp.ndarray]:
     """Commit the first ``count`` sorted candidates: dense membership is
-    ``packed(key) <= packed boundary`` (packed keys are unique)."""
-    boundary = jnp.where(
-        count > 0, pk[jnp.maximum(count - 1, 0)], jnp.int64(-1))
+    ``packed(key) <= packed boundary`` (packed keys are unique).
+
+    The boundary pk[count-1] is read as a masked max over the sorted
+    prefix, not a dynamic gather -- scalar gathers from vectors
+    serialize on this stack (PROFILE.md findings 4/8)."""
+    j = jnp.arange(pk.shape[0], dtype=jnp.int32)
+    boundary = jnp.max(jnp.where(j < count, pk, jnp.int64(-1)))
     mask = pk_dense <= boundary
     return _commit_serves(state, mask, serve, jnp.bool_(True)), mask
 
@@ -529,14 +533,15 @@ def speculate_prefix_batch(state: EngineState, now, k: int, *,
     has_req_after = new_state.active & (new_state.depth > 0)
     promoted = new_state.head_ready | \
         (has_req_after & (new_state.head_limit <= now))
-    last_client = idxs[jnp.maximum(count - 1, 0)]
+    # idxs[count-1] as a masked reduction, not a dynamic scalar gather
+    j = jnp.arange(k, dtype=jnp.int32)
+    last_client = jnp.max(jnp.where(j == count - 1, idxs, -1))
     promoted = promoted & (
         jnp.arange(state.capacity, dtype=jnp.int32) != last_client)
     new_state = new_state._replace(head_ready=jnp.where(
         ~resv_regime & (count > 0), promoted, new_state.head_ready))
 
     phase = jnp.where(resv_regime, jnp.int32(0), jnp.int32(1))
-    j = jnp.arange(k, dtype=jnp.int32)
     served = j < count
     decisions = Decision(
         type=jnp.where(served, RETURNING, NONE).astype(jnp.int32),
