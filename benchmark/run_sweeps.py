@@ -82,17 +82,29 @@ def _timed_prefix_epochs(make_state, now_ns, epochs_hi, k, m,
     device work), so one differenced pair still carries tunnel jitter
     of the same order -- single-shot rates at the big-k shapes spread
     41-71M run to run.  The reported rate is the MEDIAN over ``reps``
-    fresh-state repetitions.  Returns (decisions/sec, fill)."""
+    fresh-state repetitions.
+
+    BOTH chains must be device-bound: wall = max(device, sync RTT), so
+    a lo chain under the ~100ms RTT floor truncates the difference's
+    denominator and the rate explodes.  The lo chain is sized to hold
+    >= 2^22 decisions (~150ms+ of device work at the plateau rates)
+    and reps whose lo wall still sits at the floor are discarded.
+    Returns (decisions/sec, fill)."""
     import jax
     import jax.numpy as jnp
     from dmclock_tpu.engine.fastpath import scan_prefix_epoch
-    from profile_util import state_digest
+    from profile_util import scalar_latency, state_digest
 
     run = jax.jit(functools.partial(
         scan_prefix_epoch, m=m, k=k, anticipation_ns=0),
         donate_argnums=(0,))
     if epochs_lo is None:
-        epochs_lo = max(1, epochs_hi // 4)
+        # >= 3*2^21 decisions ~= 160ms+ of device work at the plateau
+        # rates (matches bench.py's serve-only lo-chain sizing)
+        epochs_lo = max(1, epochs_hi // 4,
+                        -((3 << 21) // -(m * k)))      # ceil div
+    epochs_hi = max(epochs_hi, epochs_lo + 1)
+    lat = scalar_latency()
 
     def chain(state, n):
         t0 = time.perf_counter()
@@ -146,10 +158,11 @@ def _timed_prefix_epochs(make_state, now_ns, epochs_hi, k, m,
         state, d_hi, t_hi = chain(state, epochs_hi)
         d_all += d_lo + d_hi
         pot_all += (epochs_lo + epochs_hi) * m * k
-        if t_hi <= t_lo:
-            continue        # jitter-inverted pair: medians absorb it
+        if t_hi <= t_lo or t_lo < 1.2 * lat:
+            continue    # jitter-inverted or RTT-floor-bound lo chain
         rates.append((d_hi - d_lo) / (t_hi - t_lo))
-    assert rates, "every differenced pair was jitter-inverted"
+    assert rates, \
+        "no valid pair: chains too short for the tunnel RTT floor"
     import statistics
     return statistics.median(rates), d_all / pot_all
 
@@ -201,8 +214,9 @@ def tpu_km_sweep():
     # point (median) keep the short-chain shapes jitter-stable.  The
     # largest shapes need deeper rings for the heavy-class backlog
     # margin (ring width itself costs; keep the smallest that fits).
-    grid = [(65536, m, 256) for m in (8, 21, 32, 64)] + \
-        [(16384, 64, 256), (32768, 64, 256), (49152, 64, 256),
+    grid = [(65536, m, 320) for m in (8, 21, 32)] + \
+        [(65536, 64, 384),
+         (16384, 64, 256), (32768, 64, 256), (49152, 64, 384),
          (98304, 64, 384)]
     for k, m, d in grid:
         hi = max(2, (1 << 23) // (m * k))
@@ -295,12 +309,12 @@ def tpu_sustained_sweep():
     rows = []
     r3 = bench_sustained(10_000, 4096, 32, 60, zipf=False,
                          resv_rate=100.0, dt_round_ns=100_000_000,
-                         ring=256, depth0=128, rounds_lo=15)
+                         ring=256, depth0=128, rounds_lo=20)
     rows.append(("cfg3: 10k clients, uniform QoS, Poisson", r3))
     print(f"cfg3: {r3['dps']/1e6:.2f} M dec/s")
-    r4 = bench_sustained(100_000, 49152, 21, 16, zipf=True,
+    r4 = bench_sustained(100_000, 49152, 21, 24, zipf=True,
                          resv_rate=CFG4_RESV_RATE,
-                         dt_round_ns=50_000_000, rounds_lo=4)
+                         dt_round_ns=50_000_000, rounds_lo=8)
     rows.append(("cfg4: 100k clients, Zipf weights, resv-constrained",
                  r4))
     print(f"cfg4: {r4['dps']/1e6:.2f} M dec/s")
@@ -328,9 +342,9 @@ def cfg4_calibration_sweep():
     ]
     for name, kw, rates in cases:
         for r in rates:
-            out = bench_sustained(100_000, 49152, 21, 8, zipf=True,
+            out = bench_sustained(100_000, 49152, 21, 16, zipf=True,
                                   resv_rate=r, dt_round_ns=50_000_000,
-                                  rounds_lo=2, **kw)
+                                  rounds_lo=8, **kw)
             rows.append((name, r, out))
             print(f"{name} r={r}: resv_phase="
                   f"{out['resv_phase_frac']:.3f} "
