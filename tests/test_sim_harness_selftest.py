@@ -1,0 +1,119 @@
+"""Harness self-test: the load generator against a fake server.
+
+The reference tests its simulator's own client with a lambda standing
+in for the server (test_test_client.cc:51-134 -- instant and delayed
+responders, asserting op counts and the time envelope).  Same pattern
+here, on virtual time instead of wall sleeps: the SimulatedClient's
+``submit_f`` seam is bound to hand-written responders and the client's
+rate limiting, outstanding-window blocking, completion accounting and
+finish detection are pinned without any queue or tracker in the loop.
+"""
+
+from dmclock_tpu.core import NS_PER_SEC, Phase
+from dmclock_tpu.sim.config import ClientGroup
+from dmclock_tpu.sim.harness import EventLoop, SimulatedClient
+from dmclock_tpu.sim.ssched import NullServiceTracker
+
+S = NS_PER_SEC
+
+
+def make_client(loop, group, submit_f, done):
+    return SimulatedClient(
+        0, group, NullServiceTracker(), loop,
+        server_select_f=lambda seq: "srv",
+        submit_f=submit_f,
+        on_done=lambda cid: done.append(cid))
+
+
+def test_instant_responder_rate_limited():
+    """An instantly-responding fake server: the client is limited only
+    by its own iops goal, so the run spans (N-1) inter-request gaps
+    (reference test_client_full_bore_timing :51-73)."""
+    loop = EventLoop()
+    group = ClientGroup(client_count=1, client_total_ops=100,
+                        client_iops_goal=1000, client_wait_s=0,
+                        client_outstanding_ops=10)
+    done = []
+    served = []
+
+    def submit_f(server, request, client_id, rp, cost):
+        served.append(request)
+        # respond within the same virtual instant
+        loop.after(0, lambda: client.receive_response(
+            request, Phase.PRIORITY, cost, server))
+
+    client = make_client(loop, group, submit_f, done)
+    loop.run()
+    assert done == [0]
+    assert client.stats.ops_requested == 100
+    assert client.stats.ops_completed == 100
+    assert client.stats.priority_ops == 100
+    # rate envelope: 99 gaps of 1ms (gap rounds to whole us)
+    assert client.stats.finish_time_ns == 99 * (S // 1000)
+
+
+def test_paused_responder_blocks_at_window():
+    """A responder that holds replies: the client must stop at its
+    outstanding window, then finish after the server releases
+    (reference test_client_paused_timing :93-134)."""
+    loop = EventLoop()
+    group = ClientGroup(client_count=1, client_total_ops=50,
+                        client_iops_goal=100000, client_wait_s=0,
+                        client_outstanding_ops=8)
+    done = []
+    held = []
+
+    def submit_f(server, request, client_id, rp, cost):
+        held.append((request, cost, server))
+
+    client = make_client(loop, group, submit_f, done)
+    # release replies only after 1s of virtual time
+    pending_checked = {}
+
+    def check_blocked():
+        pending_checked["outstanding"] = client.outstanding
+        pending_checked["sent"] = client.sent
+
+    loop.at(S // 2, check_blocked)
+
+    def release_all():
+        while held:
+            request, cost, server = held.pop(0)
+            client.receive_response(request, Phase.RESERVATION, cost,
+                                    server)
+
+    def drain():
+        release_all()
+        if client.sent < group.client_total_ops or held:
+            loop.after(1000, drain)
+
+    loop.at(S, drain)
+    loop.run()
+    # at 0.5s the window was saturated: exactly 8 in flight, 8 sent
+    assert pending_checked == {"outstanding": 8, "sent": 8}
+    assert done == [0]
+    assert client.stats.ops_completed == 50
+    assert client.stats.reservation_ops == 50
+    assert client.stats.finish_time_ns >= S
+
+
+def test_initial_wait_defers_first_request():
+    """client_wait_s delays the first send (reference CliInst wait,
+    sim_client.h:40-70)."""
+    loop = EventLoop()
+    group = ClientGroup(client_count=1, client_total_ops=3,
+                        client_iops_goal=1000, client_wait_s=2.0,
+                        client_outstanding_ops=4)
+    done = []
+    first_send_ns = []
+
+    def submit_f(server, request, client_id, rp, cost):
+        if not first_send_ns:
+            first_send_ns.append(loop.now_ns)
+        loop.after(0, lambda: client.receive_response(
+            request, Phase.PRIORITY, cost, server))
+
+    client = make_client(loop, group, submit_f, done)
+    loop.run()
+    assert first_send_ns == [2 * S]
+    assert done == [0]
