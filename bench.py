@@ -210,7 +210,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     waves: int = 32, ring: int = 128,
                     depth0: int = 64, latency_rounds: int = 0,
                     rounds_lo: int = 0, resv_aligned: bool = False,
-                    split_resv: float = 0.0, reps: int = 3):
+                    split_resv: float = 0.0, reps: int = 3,
+                    chain_depth: int = 1):
     """Closed loop: Poisson superwave ingest + prefix serve epoch per
     round, chained async on device; ingest IS inside the timed region.
 
@@ -220,7 +221,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     Admission is clamped to ring headroom on device (the AtLimit
     Reject/EAGAIN analog, reference dmclock_server.h:989-993)."""
     from dmclock_tpu.engine import kernels
-    from dmclock_tpu.engine.fastpath import scan_prefix_epoch
+    from dmclock_tpu.engine.fastpath import (scan_chain_epoch,
+                                             scan_prefix_epoch)
     from profile_util import scalar_latency, state_digest
 
     # ``split_resv`` > 0 models split-population multi-tenancy: that
@@ -270,9 +272,30 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         st = kernels.ingest_superwave(
             st, counts, wave_times, cost, cost, cost,
             anticipation_ns=0)
-        ep = scan_prefix_epoch(st, t_base + dt_round_ns, m, k,
-                               anticipation_ns=0)
-        return ep
+        now = t_base + dt_round_ns
+        # returns (state, count[m], guards[m], resv_decisions[m],
+        # slot[m,k], length[m,k]): the phase split reduces ON DEVICE
+        # so per-round readbacks stay O(m) scalars; slot/length are
+        # fetched only by the untimed calibration rounds (unfetched
+        # device arrays cost nothing).
+        if chain_depth > 1:
+            ep = scan_chain_epoch(st, now, m, k,
+                                  chain_depth=chain_depth,
+                                  anticipation_ns=0)
+            units = ep.slot >= 0
+            lens = ep.length.astype(jnp.int32)
+            # a unit's entry serve is weight-phase iff class >= 1;
+            # its induced serves are all constraint-phase
+            resv = jnp.sum(jnp.where(units,
+                                     lens - (ep.cls >= 1), 0),
+                           axis=1).astype(jnp.int32)
+        else:
+            ep = scan_prefix_epoch(st, now, m, k, anticipation_ns=0)
+            srv_pos = ep.slot >= 0
+            resv = jnp.sum(srv_pos & (ep.phase == 0),
+                           axis=1).astype(jnp.int32)
+            lens = srv_pos.astype(jnp.int32)
+        return ep.state, ep.count, ep.guards_ok, resv, ep.slot, lens
 
     run = jax.jit(round_fn, donate_argnums=(0,))
     rng = np.random.default_rng(11)
@@ -285,18 +308,19 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     # two rounds and set each client's arrival rate to its measured
     # share -- arrivals == service, so the sustained loop neither
     # drains nor hits the admission clamp (untimed)
-    ep = run(state, draw(), jnp.int64(0))
-    jax.device_get(state_digest(ep.state))
-    state = ep.state
+    state, _, _, _, _, _ = run(state, draw(), jnp.int64(0))
+    jax.device_get(state_digest(state))
     t_base = dt_round_ns
     served = np.zeros(n, dtype=np.int64)
     cal_rounds = 2
     for _ in range(cal_rounds):
-        ep = run(state, draw(), jnp.int64(t_base))
-        state = ep.state
+        state, _, _, _, slot, lens = run(state, draw(),
+                                         jnp.int64(t_base))
         t_base += dt_round_ns
-        slots = jax.device_get(ep.slot).ravel()
-        np.add.at(served, slots[slots >= 0], 1)
+        slots = jax.device_get(slot).ravel()
+        cnt = jax.device_get(lens).ravel()
+        ok = slots >= 0
+        np.add.at(served, slots[ok], cnt[ok])
     lam = np.minimum(served / cal_rounds, waves - 1.0)
 
     # pregenerate + upload every round's Poisson draws BEFORE timing:
@@ -321,34 +345,34 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     def chain(idx):
         nonlocal state, t_base
         t0 = time.perf_counter()
-        counts_out, phases, guards = [], [], []
+        counts_out, resv_out, guards = [], [], []
         for i in idx:
-            ep = run(state, pre[i], jnp.int64(t_base))
-            state = ep.state
-            counts_out.append(ep.count)
-            phases.append(ep.phase)
-            guards.append(ep.guards_ok)
+            state, cnt, g, resv, _, _ = run(state, pre[i],
+                                            jnp.int64(t_base))
+            counts_out.append(cnt)
+            resv_out.append(resv)
+            guards.append(g)
             t_base += dt_round_ns
         jax.device_get(state_digest(state))
         wall = time.perf_counter() - t0
         assert all(bool(jax.device_get(g).all()) for g in guards), \
             "rebase guards tripped -- counts are not trustworthy"
         cnts = np.concatenate([jax.device_get(c) for c in counts_out])
-        ph = np.concatenate([jax.device_get(p) for p in phases])
-        return int(cnts.sum()), wall, cnts, ph
+        rs = np.concatenate([jax.device_get(r) for r in resv_out])
+        return int(cnts.sum()), wall, cnts, rs
 
     if rlo:
         lat = scalar_latency()
-        rates, all_cnts, all_ph, total = [], [], [], 0
+        rates, all_cnts, all_rs, total = [], [], [], 0
         pos = 0
         for _ in range(max(reps, 1)):
-            d_lo, t_lo, cnts_lo, ph_lo = chain(range(pos, pos + rlo))
-            d_hi, t_hi, cnts_hi, ph_hi = chain(
+            d_lo, t_lo, cnts_lo, rs_lo = chain(range(pos, pos + rlo))
+            d_hi, t_hi, cnts_hi, rs_hi = chain(
                 range(pos + rlo, pos + rlo + rounds))
             pos += rlo + rounds
             total += d_lo + d_hi
             all_cnts += [cnts_lo, cnts_hi]
-            all_ph += [ph_lo, ph_hi]
+            all_rs += [rs_lo, rs_hi]
             if t_hi <= t_lo or t_lo < 1.2 * lat:
                 # jitter-inverted, or the lo chain sat at the tunnel
                 # RTT floor (wall = max(device, RTT)): the difference
@@ -359,16 +383,16 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
             "no valid pair: chains too short for the tunnel RTT floor"
         dps = float(np.median(rates))
         cnts = np.concatenate(all_cnts)
-        ph = np.concatenate(all_ph)
+        rs = np.concatenate(all_rs)
         denom = n_pre * m * k
     else:
         lat = scalar_latency()
-        d_hi, t_hi, cnts, ph = chain(range(rounds))
+        d_hi, t_hi, cnts, rs = chain(range(rounds))
         dps = d_hi / (t_hi - lat)
         total = d_hi
         denom = rounds * m * k
 
-    resv_frac = float(cnts[ph == 0].sum()) / max(cnts.sum(), 1)
+    resv_frac = float(rs.sum()) / max(cnts.sum(), 1)
     out = {"dps": dps, "decisions": total,
            "fill": total / denom,
            "resv_phase_frac": resv_frac,
@@ -403,10 +427,10 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         pending: deque = deque()
         marks = []
         for i in range(n_rounds):
-            ep = run(state, pre2[i], jnp.int64(t_base))
-            state = ep.state
+            state, cnt, _, _, _, _ = run(state, pre2[i],
+                                         jnp.int64(t_base))
             t_base += dt_round_ns
-            pending.append(ep.count)
+            pending.append(cnt)
             if len(pending) >= w:
                 jax.device_get(pending.popleft())
                 marks.append(time.perf_counter())
@@ -485,6 +509,11 @@ def main() -> None:
             f"{c4.get('round_ms_p99', 0):.0f}ms tunnel-inclusive "
             f"upper bounds)")
 
+    try:
+        _record_history(results)
+    except OSError as e:      # telemetry must never eat the results
+        print(f"# history record failed: {e}",
+              file=__import__('sys').stderr)
     print(json.dumps({
         "metric": "dmclock sustained scheduling decisions/sec, "
                   "ARRIVALS INCLUDED (Poisson superwave ingest on "
@@ -495,6 +524,32 @@ def main() -> None:
         "unit": "decisions/sec/chip",
         "vs_baseline": round(primary["dps"] / 10_000_000, 4),
     }))
+
+
+def _record_history(results: dict) -> None:
+    """Append this session's rates to benchmark/history/ for the
+    drift-aware regression guard (scripts/bench_guard.py).  Only real
+    accelerator sessions count -- a CPU run would poison the medians
+    the guard compares against."""
+    from pathlib import Path
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu" or not results:
+        return
+    hist = Path(__file__).resolve().parent / "benchmark" / "history"
+    hist.mkdir(parents=True, exist_ok=True)
+    rec = {
+        "platform": platform,
+        "device": str(jax.devices()[0]),
+        "workloads": {
+            wl: {k: v for k, v in row.items()
+                 if isinstance(v, (int, float))}
+            for wl, row in results.items()},
+    }
+    out = hist / f"bench_{int(time.time())}.json"
+    out.write_text(json.dumps(rec, indent=1))
+    print(f"# recorded {out.relative_to(hist.parent.parent)}",
+          file=__import__('sys').stderr)
 
 
 if __name__ == "__main__":

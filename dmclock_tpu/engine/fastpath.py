@@ -2,41 +2,64 @@
 
 The exact engine (`kernels.engine_step`) pays an O(N) masked-argmin per
 decision -- semantically perfect, bandwidth-bound at scale.  This module
-exploits the structure of dmClock steady states: with a deep backlog,
-consecutive decisions serve DISTINCT clients (each serve advances that
-client's virtual time by ~inv, far past the tag spacing between
-clients), and serves of distinct clients commute.  A full lexicographic
-sort of the candidate (tag, creation-order) keys yields the ENTIRE
-candidate service order in one pass, and the engine commits the longest
-prefix of it that is provably what the serial engine would have served
--- computed ON DEVICE, so there is no fallback cliff.
+exploits a structural fact about dmClock's decision rule: at a fixed
+``now`` the serial engine always serves the MINIMUM of one unified
+lexicographic key space over clients,
+
+    class 0  reservation-eligible  (head_resv <= now)     key = resv tag
+    class 1  ready weight          (effective-ready,      key = prop tag
+                                    prop < MAX)                 + delta
+    class 2  limit-break           (AtLimit::Allow only)  key = prop tag
+                                                                + delta
+
+because the constraint phase takes absolute priority over the weight
+phase (reference do_next_request :1124-1151), and the Allow fallback
+only fires when both are empty (:1157-1165).  A full sort of the
+per-client (class, key, creation-order) triples therefore yields the
+ENTIRE candidate service order -- across regime boundaries -- in one
+pass, and the engine commits the longest prefix of it that is provably
+what the serial engine would have served, computed ON DEVICE.
 
 Exactness argument (differentially tested against `engine_run`):
-candidates are served in sorted (key, order) ascending order -- the
-serial engine's total order.  Serving candidate p re-enters its client
-at a new key r_p (its freshly-tagged next head; +inf if it empties or
+candidates are served in sorted (class, key, order) ascending order.
+Serving candidate p re-enters its client at its EXIT key x_p -- the
+unified key of its freshly-tagged next head (+inf if it empties or
 leaves the candidate set).  The speculative order equals the serial
-order up to position q iff ``min_{p<q} r_p > (key_q, order_q)`` at every
-position <= q -- the serial engine would have picked the re-entered head
-first otherwise.  Since keys ascend and the cumulative min only
-descends, the condition fails monotonically: the first failing position
-ends the exact prefix.  Regime-exit events (a weight-phase serve making
-the client's reservation tag eligible, reference do_next_request
-:1124-1128) are encoded as r_p = -inf, stopping the prefix right
-after p.  Guaranteed progress: whenever the serial engine would RETURN
-a request at ``now``, the prefix is >= 1; the serial engine is needed
-only for the never-observed global rebase-guard failures (see
-``make_prefix_runner``).
+order up to position q iff ``min_{p<q} x_p > (class_q, key_q, order_q)``
+at every position <= q -- the serial engine would have picked the
+re-entered head first otherwise.  Since entry keys ascend and the
+cumulative min only descends, the condition fails monotonically: the
+first failing position ends the exact prefix.  Guaranteed progress:
+whenever the serial engine would RETURN a request at ``now``, the
+prefix is >= 1.
 
-The regime of each batch is picked exactly as the serial engine's first
-decision would (reservation phase iff the lowest reservation tag is
-eligible, :1124-1128); weight-phase candidates are effectively-ready
-clients ordered by (proportion + prop_delta, order), reservation-phase
-candidates by (reservation tag, order).
+**Serve chains** (``chain_depth`` > 1) are what make interleaved-regime
+workloads batch: a weight serve's reservation-debt reduction (reference
+reduce_reservation_tags :1077-1111) often drags the served client's
+next reservation tag back under ``now``.  At that serial moment the
+client is the ONLY class-0 candidate (a weight serve happens only when
+no reservation tag was eligible, and no other client's state changed),
+so the serial engine provably serves THAT client's reservation
+requests next, until its tag climbs past ``now`` again.  The chain
+pre-computes this whole run -- one weight serve plus its induced
+constraint serves, up to ``chain_depth`` total -- as ONE sort unit
+whose exit key is back in weight space, so per-decision phase flips
+(the reference's balanced mixed-QoS steady state) no longer cut the
+committed prefix.  A chain that would exceed ``chain_depth`` exits at
+its exact class-0 key, which stops the prefix right after the unit --
+conservative, never inexact.
 
-Restrictions (checked by the caller): AtLimit::Wait, monotonic `now`,
-fixed `now` within a batch.  The stored `ready` flags are superseded by
-the computed `limit <= now` (equivalent under monotonic now, since a
+AtLimit::Allow (``allow_limit_break``) adds class 2: clients past their
+limit, served lowest-proportion-first when classes 0/1 are empty, with
+``limit_break`` flagged.  Restriction (checked by the caller): every
+active client has weight > 0.  With a weight-0 (prop == MAX_TAG)
+client that is ready, the reference's Allow fallback switches to
+reservation order globally (the ready-heap top pins at MAX,
+:1157-1165), which per-client classification cannot express.
+
+Restrictions (checked by the caller): monotonic `now`, fixed `now`
+within a batch.  The stored `ready` flags are superseded by the
+computed `limit <= now` (equivalent under monotonic now, since a
 promotion that serial processing would perform later in the batch is
 performed here eagerly and verified sound).
 """
@@ -59,50 +82,30 @@ from .kernels import (KEY_INF, NONE, RETURNING, Decision, _make_tag,
 from .state import EngineState
 
 
-# Selection = ONE full lexicographic sort on 32-bit rebased keys.  TPUs
-# emulate int64 as register pairs, so sorting (key-key_min) as int32 with
-# a second int32 creation-order key is ~4x cheaper than a packed-int64
-# top_k -- and a full sort yields the ENTIRE service order, letting the
-# batch size k grow to tens of thousands of decisions per O(N) pass.
-# Rebase-window overflow clamps to _CLAMP32: harmless for candidates
-# strictly beyond the selection boundary (never selectable), and the
-# boundary check ``vk < _CLAMP32`` fails speculation otherwise, so
-# exactness is never at risk (the serial engine takes the batch).
-_CLAMP32 = (1 << 31) - 2     # in-window ceiling for real candidates
-_SENT32 = (1 << 31) - 1      # non-candidate sentinel (sorts last)
-_ORDER32_LIMIT = jnp.int64(1) << 31
+# Selection = ONE full sort on a packed int64 unified key: 2 class
+# bits | 32-bit rebased tag | 28-bit rebased creation order.  A full
+# sort yields the ENTIRE cross-regime service order, letting the batch
+# size k grow to tens of thousands of decisions per O(N) pass.  Tags
+# rebase per CLASS (reservation tags and proportion tags live in
+# unrelated value spaces, so each class subtracts its own origin);
+# rebase-window overflow (entry spread > ~3.2s above its class origin
+# after the _EXIT_BIAS reservation) clamps to _KEY_CLAMP:
+# harmless for candidates strictly beyond the selection boundary
+# (never selectable), and the in-window check fails speculation
+# otherwise, so exactness is never at risk (the serial engine takes
+# the batch).  The creation-order spread guard is 2^28 live creations.
+_KEY_CLAMP = (1 << 32) - 2   # in-window ceiling for real entry keys
+_KEY_HI = (1 << 32) - 1      # above-window exit-key clamp (exact for
+#                              every in-window boundary: see epk notes)
+_EXIT_BIAS = jnp.int64(1) << 30   # window low end reserved for exits
+#                                   below their class origin (~1.07s)
+_ORDER_LIMIT = jnp.int64(1) << 28
+_O_MASK = (jnp.int64(1) << 28) - 1
 
-
-class _Rebase(NamedTuple):
-    """Shared 32-bit rebase of (key, order) + the global exactness
-    guards.  This is the overflow-sensitive core of prefix selection."""
-
-    real: jnp.ndarray      # bool[N] key < KEY_INF
-    kmin: jnp.ndarray      # int64 scalar: min real key (rebase origin)
-    k32: jnp.ndarray       # int32[N] rebased key; _CLAMP32 = real but
-    #                        out of window; _SENT32 = non-candidate
-    o32: jnp.ndarray       # int32[N] rebased creation order
-    guards_ok: jnp.ndarray  # bool: order spread + cost payload fit
-
-
-def _rebase32(key, order, cost) -> _Rebase:
-    real = key < KEY_INF
-    kmin = jnp.min(jnp.where(real, key, KEY_INF))
-    krel = key - kmin
-    fits = real & (krel < _CLAMP32)
-    k32 = jnp.where(fits, krel,
-                    jnp.where(real, _CLAMP32, _SENT32)).astype(jnp.int32)
-    # order rebased like the keys: creation indices grow without bound,
-    # so the int32 cast must be of the spread, not the absolute value
-    omin = jnp.min(jnp.where(real, order, jnp.int64(1) << 62))
-    o32 = (order - omin).astype(jnp.int32)
-    omax = jnp.max(jnp.where(real, order, omin))
-    # the cost guard masks to real candidates: an oversized cost on an
-    # inactive/non-candidate row must not disable the fastpath forever
-    cost_ok = jnp.max(jnp.where(real, cost, 0)) < (jnp.int64(1) << 31)
-    guards_ok = (omax - omin < _ORDER32_LIMIT) & cost_ok
-    return _Rebase(real=real, kmin=kmin, k32=k32, o32=o32,
-                   guards_ok=guards_ok)
+CLS_RESV = 0      # reservation-eligible: constraint phase
+CLS_WEIGHT = 1    # effective-ready: weight phase
+CLS_LB = 2        # AtLimit::Allow limit-break: weight phase + flag
+CLS_NONE = 3      # non-candidate sentinel (sorts after every class)
 
 
 def _ready_now(state: EngineState, now):
@@ -115,15 +118,15 @@ def _ready_now(state: EngineState, now):
 class RingWindow(NamedTuple):
     """Per-epoch prefetch of the tail rings.
 
-    A speculative batch pops at most ONE request per client, so an
-    m-batch epoch only ever reads ring positions ``q_head0 ..
-    q_head0+m-1``.  Prefetching that [m, N] window once per epoch
-    replaces the per-batch ring gather, which XLA lowers to a dense
-    read of the ENTIRE [N, Q] ring pair (~200 MB/batch at bench shapes
-    -- measured as 60x the window's traffic)."""
+    A speculative batch pops at most ``chain_depth`` requests per
+    client, so a window of [w, N] ring positions ``q_head0 ..
+    q_head0+w-1`` covers it.  Prefetching replaces the per-batch ring
+    gather, which XLA lowers to a dense read of the ENTIRE [N, Q] ring
+    pair (~200 MB/batch at bench shapes -- measured as 60x the
+    window's traffic)."""
 
-    arr: jnp.ndarray    # int64[m, N] arrivals at q_head0 + j
-    cost: jnp.ndarray   # int64[m, N]
+    arr: jnp.ndarray    # int64[w, N] arrivals at q_head0 + j
+    cost: jnp.ndarray   # int64[w, N]
     q0: jnp.ndarray     # int32[N] q_head at prefetch time
 
 
@@ -237,149 +240,418 @@ def ring_window(state: EngineState, m: int,
                       q0=q0)
 
 
-def _window_heads(state: EngineState, window: RingWindow):
-    """Every client's next tail element (new head after a pop), read
-    from the prefetched window: rows consumed so far = q_head - q0.
-    Unrolled one-hot selects -- a [w, N] take_along_axis lowers to a
-    serializing gather (measured 20x slower)."""
+def _window_rows(state: EngineState, window: RingWindow, depth: int):
+    """Rows ``off .. off+depth-1`` of the prefetched window for every
+    client, where ``off = q_head - q0`` is how many rows the client
+    consumed since the prefetch.  Unrolled one-hot selects -- a [w, N]
+    take_along_axis lowers to a serializing gather (measured 20x
+    slower)."""
     wsize = window.arr.shape[0]
     off = jnp.remainder(state.q_head - window.q0,
                         state.ring_capacity).astype(jnp.int32)
-    narr = window.arr[0]
-    ncost = window.cost[0]
-    for j in range(1, wsize):
-        pick = off == j
-        narr = jnp.where(pick, window.arr[j], narr)
-        ncost = jnp.where(pick, window.cost[j], ncost)
-    return narr, ncost
+    arr_rows, cost_rows = [], []
+    for d in range(depth):
+        narr = window.arr[min(d, wsize - 1)]
+        ncost = window.cost[min(d, wsize - 1)]
+        for j in range(d + 1, wsize):
+            pick = off == j - d
+            narr = jnp.where(pick, window.arr[j], narr)
+            ncost = jnp.where(pick, window.cost[j], ncost)
+        arr_rows.append(narr)
+        cost_rows.append(ncost)
+    return arr_rows, cost_rows
 
 
-class DenseServe(NamedTuple):
-    """Elementwise ([N]) serve computation: what every client's state
-    would become if its head were popped this batch.  Scatter-free --
-    TPU scatters serialize badly (measured ~6x the whole elementwise
-    serve), so the serve is computed densely for every client (ring
-    heads read with a per-row ``take_along_axis``) and committed with
-    ``jnp.where`` selects; the only index ops per batch are the
-    [k]-sized decision-emit gathers."""
-
-    has_more: jnp.ndarray     # bool[N] client still has queued work
-    new_depth: jnp.ndarray    # int32[N]
-    narr: jnp.ndarray         # int64[N] next head arrival
-    ncost: jnp.ndarray        # int64[N] next head cost
-    head_resv: jnp.ndarray    # int64[N] new tag minus weight-debt offset
-    head_prop: jnp.ndarray    # int64[N]
-    head_limit: jnp.ndarray   # int64[N]
-    prev_resv: jnp.ndarray    # int64[N]
-    prev_prop: jnp.ndarray    # int64[N]
-    prev_limit: jnp.ndarray   # int64[N]
+def _window_heads(state: EngineState, window: RingWindow):
+    """Every client's next tail element (new head after a pop)."""
+    arr_rows, cost_rows = _window_rows(state, window, 1)
+    return arr_rows[0], cost_rows[0]
 
 
-def _dense_serve(state: EngineState, heads,
-                 phase_is_ready,
-                 anticipation_ns: int) -> DenseServe:
+def _heads_rows(heads, depth: int):
+    """Normalize a ``heads`` argument to per-step row lists.
+
+    Accepts the single-pop pair (narr[N], ncost[N]) for depth 1, or
+    stacked [w, N] arrays (a ``ring_window``'s arr/cost with w >=
+    depth) for chained pops."""
+    arr, cost = heads
+    if arr.ndim == 1:
+        assert depth == 1
+        return [arr], [cost]
+    assert arr.shape[0] >= depth, \
+        f"heads window {arr.shape[0]} rows < chain depth {depth}"
+    return [arr[j] for j in range(depth)], [cost[j] for j in range(depth)]
+
+
+# ----------------------------------------------------------------------
+# unified candidate classification
+# ----------------------------------------------------------------------
+
+def _unified_class(now, has, resv, ready, prop, eff, allow: bool):
+    """(class, key) in the unified candidate order the serial engine
+    serves (reference do_next_request :1115-1186): constraint phase
+    first (class 0, by reservation tag), then ready weight (class 1,
+    by effective proportion), then -- Allow only -- limit-break
+    (class 2, by effective proportion; :1157-1165, reachable because
+    the caller guarantees weight > 0 for every active client, see
+    module docstring).  Non-candidates get (CLS_NONE, KEY_INF).
+
+    ONE definition shared by entry classification and the chain's
+    exit classification -- they differ only in the readiness
+    predicate (stored-flag-or-limit for current heads, limit-only for
+    freshly popped ones)."""
+    prop_ok = prop < MAX_TAG
+    c0 = has & (resv <= now)
+    c1 = has & ~c0 & ready & prop_ok
+    cls = jnp.where(c0, CLS_RESV,
+                    jnp.where(c1, CLS_WEIGHT, CLS_NONE))
+    key = jnp.where(c0, resv, jnp.where(c1, eff, KEY_INF))
+    if allow:
+        c2 = has & ~c0 & ~c1 & prop_ok
+        cls = jnp.where(c2, CLS_LB, cls)
+        key = jnp.where(c2, eff, key)
+    return cls.astype(jnp.int32), key
+
+
+def _classify(state: EngineState, now, allow: bool):
+    """Entry (class, key) per client (see ``_unified_class``)."""
+    has_req = state.active & (state.depth > 0)
+    return _unified_class(
+        now, has_req, state.head_resv, _ready_now(state, now),
+        state.head_prop, state.head_prop + state.prop_delta, allow)
+
+
+# ----------------------------------------------------------------------
+# dense serve chains
+# ----------------------------------------------------------------------
+
+class ChainServe(NamedTuple):
+    """Elementwise ([N]) serve-chain computation: what every client's
+    state would become after serving its full chain this batch.
+    Scatter-free -- TPU scatters serialize badly, so the chain is
+    computed densely for every client and committed with ``jnp.where``
+    selects.  Rows outside the committed set are garbage and masked at
+    commit."""
+
+    depth: jnp.ndarray        # int32[N] after the chain
+    qadv: jnp.ndarray         # int32[N] ring pops performed
+    length: jnp.ndarray       # int32[N] serves in the chain (>=1 cand)
+    head_resv: jnp.ndarray    # int64[N] final head tag
+    head_prop: jnp.ndarray
+    head_limit: jnp.ndarray
+    head_arrival: jnp.ndarray
+    head_cost: jnp.ndarray
+    head_rho: jnp.ndarray
+    prev_resv: jnp.ndarray
+    prev_prop: jnp.ndarray
+    prev_limit: jnp.ndarray
+    prev_arrival: jnp.ndarray
+    exit_cls: jnp.ndarray     # int32[N] unified class after the chain
+    exit_key: jnp.ndarray     # int64[N] unified key after the chain
+
+
+def _chain_serve(state: EngineState, now, arr_rows, cost_rows,
+                 cls, allow: bool,
+                 anticipation_ns: int) -> ChainServe:
     """The vectorized pop+retag (pop_process_request / update_next_tag /
-    reduce_reservation_tags, reference :1021-1111) computed for EVERY
-    client; rows outside the served set are garbage and masked out at
-    commit.
+    reduce_reservation_tags, reference :1021-1111) iterated
+    ``len(arr_rows)`` times for EVERY client.
 
-    ``heads`` = (narr, ncost): every client's next tail element (the
-    new head after a pop), precomputed by the caller so the per-epoch
-    ring-window prefetch is shared across batches instead of re-read
-    per batch.  ``phase_is_ready`` is a python bool or traced scalar
-    (the cond-free prefix batch passes the regime flag through)."""
-    # rows with depth <= 1 carry stale ring values -- masked at commit
-    narr, ncost = heads
+    Step 0 serves the entry head in the entry class's phase (weight
+    phase pays the reservation debt, :1077-1111).  Steps >= 1 are the
+    INDUCED constraint serves: they run only for weight/limit-break
+    entries whose just-retagged reservation tag fell to ``now`` or
+    below -- at that serial moment the client is the only class-0
+    candidate, so the serial engine provably serves it next.  The
+    chain stops when the tag climbs past ``now``, the queue drains, or
+    the depth cap is hit; the exit (class, key) is the client's exact
+    re-entry position in the unified order (KEY_INF when it leaves)."""
+    depth_cap = len(arr_rows)
+    is_cand = cls != CLS_NONE
+    chains = (cls == CLS_WEIGHT) | (cls == CLS_LB)
+    phase1 = chains                       # weight-phase entry serve
 
-    nr, np_, nl = _make_tag(
-        state.head_resv, state.head_prop, state.head_limit,
-        state.head_arrival, state.resv_inv, state.weight_inv,
-        state.limit_inv, state.cur_delta, state.cur_rho, narr, ncost,
-        anticipation_ns)
+    h_resv, h_prop, h_limit = (state.head_resv, state.head_prop,
+                               state.head_limit)
+    h_arr, h_cost, h_rho = (state.head_arrival, state.head_cost,
+                            state.head_rho)
+    p_resv, p_prop, p_limit, p_arr = (state.prev_resv, state.prev_prop,
+                                      state.prev_limit,
+                                      state.prev_arrival)
+    depth = state.depth
+    qadv = jnp.zeros_like(state.q_head)
+    length = jnp.zeros_like(state.q_head)
+    cont = is_cand
 
-    # phase_is_ready may be a python bool or a traced scalar (the
-    # cond-free prefix batch passes the regime flag through)
-    offset = jnp.where(
-        phase_is_ready,
-        state.resv_inv * (state.head_cost + state.head_rho),
-        jnp.zeros_like(state.head_resv))
+    for j in range(depth_cap):
+        narr, ncost = arr_rows[j], cost_rows[j]
+        nr, np_, nl = _make_tag(
+            h_resv, h_prop, h_limit, h_arr,
+            state.resv_inv, state.weight_inv, state.limit_inv,
+            state.cur_delta, state.cur_rho, narr, ncost,
+            anticipation_ns)
+        if j == 0:
+            off = jnp.where(phase1,
+                            state.resv_inv * (h_cost + h_rho),
+                            jnp.zeros_like(h_resv))
+        else:
+            off = jnp.zeros_like(h_resv)
 
-    new_depth = state.depth - 1
-    has_more = new_depth > 0
+        new_depth = depth - 1
+        has_more = new_depth > 0
+        upd = cont
+        updh = cont & has_more
 
-    prev_r = jnp.where(has_more, _fold_prev(state.prev_resv, nr),
-                       state.prev_resv) - offset
-    prev_p = jnp.where(has_more, _fold_prev(state.prev_prop, np_),
-                       state.prev_prop)
-    prev_l = jnp.where(has_more, _fold_prev(state.prev_limit, nl),
-                       state.prev_limit)
+        new_h_resv = nr - off
+        pr = jnp.where(has_more, _fold_prev(p_resv, nr), p_resv) - off
+        pp = jnp.where(has_more, _fold_prev(p_prop, np_), p_prop)
+        pl_ = jnp.where(has_more, _fold_prev(p_limit, nl), p_limit)
 
-    return DenseServe(
-        has_more=has_more,
-        new_depth=new_depth.astype(jnp.int32),
-        narr=narr, ncost=ncost,
-        head_resv=nr - offset,
-        head_prop=np_, head_limit=nl,
-        prev_resv=prev_r, prev_prop=prev_p, prev_limit=prev_l,
-    )
+        h_resv = jnp.where(updh, new_h_resv, h_resv)
+        h_prop = jnp.where(updh, np_, h_prop)
+        h_limit = jnp.where(updh, nl, h_limit)
+        h_arr = jnp.where(updh, narr, h_arr)
+        h_cost = jnp.where(updh, ncost, h_cost)
+        h_rho = jnp.where(updh, state.cur_rho, h_rho)
+        p_resv = jnp.where(upd, pr, p_resv)
+        p_prop = jnp.where(upd, pp, p_prop)
+        p_limit = jnp.where(upd, pl_, p_limit)
+        p_arr = jnp.where(updh, narr, p_arr)
+        depth = jnp.where(upd, new_depth, depth).astype(jnp.int32)
+        qadv = (qadv + updh).astype(jnp.int32)
+        length = (length + upd).astype(jnp.int32)
+
+        # continue only for weight/lb entries whose fresh reservation
+        # tag is eligible: the induced-constraint-serve condition
+        cont = cont & chains & has_more & (new_h_resv <= now)
+
+    # exit classification on the final head (shared definition,
+    # ``_unified_class``; a freshly popped head's stored ready flag is
+    # False, so effective readiness is exactly limit <= now).  A chain
+    # that hit the depth cap while still class-0-eligible exits at its
+    # exact (0, resv) key: class 0 sorts before every remaining
+    # class-1/2 entry, so the prefix stops right after the unit --
+    # conservative (the serial engine would keep serving this client),
+    # never inexact.
+    has = state.active & (depth > 0)
+    exit_cls, exit_key = _unified_class(
+        now, has, h_resv, h_limit <= now, h_prop,
+        h_prop + state.prop_delta, allow)
+
+    return ChainServe(
+        depth=depth, qadv=qadv, length=length,
+        head_resv=h_resv, head_prop=h_prop, head_limit=h_limit,
+        head_arrival=h_arr, head_cost=h_cost, head_rho=h_rho,
+        prev_resv=p_resv, prev_prop=p_prop, prev_limit=p_limit,
+        prev_arrival=p_arr,
+        exit_cls=exit_cls.astype(jnp.int32), exit_key=exit_key)
 
 
-def _commit_serves(state: EngineState, mask, serve: DenseServe,
-                   gate) -> EngineState:
-    """Apply the dense serve to the rows in ``mask``, gated on the
-    scalar speculation-validity flag: pure elementwise selects, no
-    scatters."""
-    sel = mask & gate
-    selm = sel & serve.has_more
+def _commit_chains(state: EngineState, sel,
+                   chain: ChainServe) -> EngineState:
+    """Apply the dense chain result to the rows in ``sel``: pure
+    elementwise selects, no scatters."""
 
     def pick(pred, new, old):
         return jnp.where(pred, new, old)
 
+    popped = sel & (chain.qadv > 0)
     return state._replace(
-        depth=pick(sel, serve.new_depth, state.depth),
-        q_head=pick(selm, (state.q_head + 1) % state.ring_capacity,
+        depth=pick(sel, chain.depth, state.depth),
+        q_head=pick(popped,
+                    (state.q_head + chain.qadv) % state.ring_capacity,
                     state.q_head).astype(jnp.int32),
-        head_resv=pick(selm, serve.head_resv, state.head_resv),
-        head_prop=pick(selm, serve.head_prop, state.head_prop),
-        head_limit=pick(selm, serve.head_limit, state.head_limit),
-        head_arrival=pick(selm, serve.narr, state.head_arrival),
-        head_cost=pick(selm, serve.ncost, state.head_cost),
-        head_rho=pick(selm, state.cur_rho, state.head_rho),
+        head_resv=pick(popped, chain.head_resv, state.head_resv),
+        head_prop=pick(popped, chain.head_prop, state.head_prop),
+        head_limit=pick(popped, chain.head_limit, state.head_limit),
+        head_arrival=pick(popped, chain.head_arrival,
+                          state.head_arrival),
+        head_cost=pick(popped, chain.head_cost, state.head_cost),
+        head_rho=pick(popped, chain.head_rho, state.head_rho),
         head_ready=state.head_ready & ~sel,
-        prev_resv=pick(sel, serve.prev_resv, state.prev_resv),
-        prev_prop=pick(sel, serve.prev_prop, state.prev_prop),
-        prev_limit=pick(sel, serve.prev_limit, state.prev_limit),
-        prev_arrival=pick(selm, serve.narr, state.prev_arrival),
+        prev_resv=pick(sel, chain.prev_resv, state.prev_resv),
+        prev_prop=pick(sel, chain.prev_prop, state.prev_prop),
+        prev_limit=pick(sel, chain.prev_limit, state.prev_limit),
+        prev_arrival=pick(popped, chain.prev_arrival,
+                          state.prev_arrival),
     )
 
 
-def _default_heads(state: EngineState):
-    """Single-batch ring-head read (the m=1 window)."""
-    return _window_heads(state, ring_window(state, 1))
+# ----------------------------------------------------------------------
+# unified prefix selection
+# ----------------------------------------------------------------------
+
+def _pack(cls, krel, o):
+    """Lexicographic (class, key, order) as one int64: 2 class bits |
+    32 key bits | 28 order bits.  ``o`` is masked against garbage
+    orders on sentinel rows; all inputs int64."""
+    return ((cls.astype(jnp.int64) << 60) | (krel << 28)
+            | (o & _O_MASK))
 
 
-# state fields the speculative serve path never writes: rings are only
-# popped via q_head, and QoS/identity/ingest-time fields are mutated by
-# ingest alone, which cannot run mid-epoch.  Keeping them OUT of the
-# scan carry stops XLA from shuffling ~100MB of loop-invariant buffers
-# per iteration (the rings dominate).
-_EPOCH_INVARIANT = ("active", "idle", "order", "resv_inv", "weight_inv",
-                    "limit_inv", "prop_delta", "cur_rho", "cur_delta",
-                    "q_arrival", "q_cost")
-_EPOCH_MUTABLE = tuple(f for f in EngineState._fields
-                       if f not in _EPOCH_INVARIANT)
+class _Selection(NamedTuple):
+    """Everything a caller needs to commit + emit a unified prefix."""
+
+    idxs: jnp.ndarray        # int32[k] sorted candidate slots
+    cls_s: jnp.ndarray       # int32[k] sorted entry classes
+    cost_s: jnp.ndarray      # int32[k] sorted entry (head) costs
+    len_s: jnp.ndarray       # int32[k] sorted chain lengths
+    count_units: jnp.ndarray  # int32 committed sort units
+    count: jnp.ndarray       # int32 committed DECISIONS (sum of len)
+    guards_ok: jnp.ndarray   # bool
+    state: EngineState       # after the committed prefix
+    last_client: jnp.ndarray  # int32 slot of the final committed unit
 
 
-_O32_MASK = jnp.int64(0xFFFFFFFF)
+def _unified_prefix(state: EngineState, now, k: int, *,
+                    chain_depth: int, anticipation_ns: int,
+                    allow: bool, heads, max_count) -> _Selection:
+    """Classify, chain, sort, and commit the longest exact prefix."""
+    if heads is None:
+        heads = ring_window(state, chain_depth)
+        heads = (heads.arr, heads.cost)
+    arr_rows, cost_rows = _heads_rows(heads, chain_depth)
+
+    cls, key = _classify(state, now, allow)
+    chain = _chain_serve(state, now, arr_rows, cost_rows, cls, allow,
+                         anticipation_ns)
+
+    is_cand = cls != CLS_NONE
+    # --- packed rebase over two key spaces: reservation tags
+    # (class 0) and effective proportion tags (classes 1/2).
+    # PER-CLASS rebase origins: each class's minimum entry rebases to
+    # the bias, so position 0 of the sort is always in-window and a
+    # nonempty candidate set always commits >= 1 unit (guaranteed
+    # progress), whatever the spread between the classes' key spaces.
+    # _EXIT_BIAS reserves the window's low end for exits that land
+    # BELOW their class origin (e.g. a constraint serve re-entering
+    # weight space under the ready minimum): within the bias they
+    # rebase exactly; further below they clamp to 0, which only
+    # shortens the prefix -- conservative, never inexact.
+    def class_min(m):
+        return jnp.min(jnp.where(m, key, KEY_INF))
+
+    kresv = class_min(cls == CLS_RESV)
+    kprop1 = class_min(cls == CLS_WEIGHT)
+    kprop2 = class_min(cls == CLS_LB)
+
+    def origin_of(c):
+        return jnp.where(c == CLS_RESV, kresv,
+                         jnp.where(c == CLS_WEIGHT, kprop1, kprop2))
+
+    krel = jnp.clip(key - origin_of(cls) + _EXIT_BIAS, 0,
+                    jnp.int64(_KEY_CLAMP))
+
+    # order rebased like the keys: creation indices grow without bound,
+    # so the 28-bit pack must be of the spread, not the absolute value
+    omin = jnp.min(jnp.where(is_cand, state.order, jnp.int64(1) << 62))
+    o64 = state.order - omin
+    omax = jnp.max(jnp.where(is_cand, state.order, omin))
+    # the cost guard masks to real candidates: an oversized cost on an
+    # inactive/non-candidate row must not disable the fastpath forever
+    cost_ok = jnp.max(jnp.where(is_cand, state.head_cost, 0)) \
+        < (jnp.int64(1) << 31)
+    guards_ok = (omax - omin < _ORDER_LIMIT) & cost_ok
+
+    pk_dense = jnp.where(is_cand, _pack(cls, krel, o64),
+                         jnp.int64(KEY_INF))
+
+    # exit keys in the same packed space.  Clamping an exit low (past
+    # the bias below its class origin) only shortens the prefix --
+    # conservative, never inexact; clamping high (_KEY_HI, above the
+    # entry clamp) preserves ``exit > boundary`` for every committable
+    # boundary, which is strictly in-window.
+    ekrel = jnp.clip(chain.exit_key - origin_of(chain.exit_cls)
+                     + _EXIT_BIAS, 0, jnp.int64(_KEY_HI))
+    epk = jnp.where(chain.exit_cls == CLS_NONE, jnp.int64(KEY_INF),
+                    _pack(chain.exit_cls, ekrel, o64))
+
+    iota = jnp.arange(key.shape[0], dtype=jnp.int32)
+    kk = min(k, key.shape[0])
+
+    def trim(a, fill):
+        a = a[:kk]
+        if kk < k:      # k beyond the population: sentinel padding
+            a = jnp.concatenate(
+                [a, jnp.full((k - kk,), fill, dtype=a.dtype)])
+        return a
+
+    if chain_depth == 1:
+        pks, idxs, rpk, costs = lax.sort(
+            (pk_dense, iota, epk,
+             state.head_cost.astype(jnp.int32)), num_keys=1)
+        lens = jnp.ones((k,), dtype=jnp.int32)
+    else:
+        pks, idxs, rpk, costs, lens = lax.sort(
+            (pk_dense, iota, epk,
+             state.head_cost.astype(jnp.int32), chain.length),
+            num_keys=1)
+        lens = trim(lens, 0)
+    pks, idxs = trim(pks, KEY_INF), trim(idxs, -1)
+    rpk, costs = trim(rpk, KEY_INF), trim(costs, 0)
+
+    # exclusive cumulative min of exit keys over the sorted order
+    cm = lax.associative_scan(jnp.minimum, rpk)
+    cm_excl = jnp.concatenate(
+        [jnp.full((1,), jnp.int64(KEY_INF), dtype=jnp.int64), cm[:-1]])
+
+    in_window = ((pks >> 60) < CLS_NONE) & \
+        (((pks >> 28) & _KEY_HI) < _KEY_CLAMP)
+    ok_q = in_window & (cm_excl > pks)
+    count_units = jnp.where(jnp.all(ok_q), jnp.int32(k),
+                            jnp.argmax(~ok_q).astype(jnp.int32))
+    count_units = jnp.where(guards_ok, count_units, jnp.int32(0))
+    if max_count is not None:
+        assert chain_depth == 1, \
+            "max_count caps decisions; only supported at chain_depth=1"
+        count_units = jnp.minimum(count_units, jnp.int32(max_count))
+
+    j = jnp.arange(k, dtype=jnp.int32)
+    served = j < count_units
+    cls_s = (pks >> 60).astype(jnp.int32)   # >= CLS_NONE on sentinels
+    if chain_depth == 1:
+        count = count_units
+    else:
+        count = jnp.sum(jnp.where(served, lens, 0)).astype(jnp.int32)
+
+    # commit: dense membership is ``packed(key) <= packed boundary``
+    # (packed keys are unique).  The boundary pk[count-1] is read as a
+    # masked max over the sorted prefix, not a dynamic gather --
+    # scalar gathers from vectors serialize on this stack (PROFILE.md
+    # findings 4/8).
+    boundary = jnp.max(jnp.where(served, pks, jnp.int64(-1)))
+    sel = pk_dense <= boundary
+    new_state = _commit_chains(state, sel, chain)
+
+    # stored-flag parity (promote loop, reference :1135-1144): every
+    # weight-phase (class >= 1 entry) decision promotes current heads
+    # with limit <= now.  Classes sort ascending, so the LAST committed
+    # unit has the batch's max class: if it is >= 1, its entry decision
+    # ran the batch's final promote pass, and the only head that pass
+    # never saw is the one its own chain popped into place.  With no
+    # class >= 1 unit committed no promote pass ran, so the flags stay
+    # untouched (pops still clear them via _commit_chains).
+    sel_last = j == count_units - 1
+    cls_last = jnp.max(jnp.where(sel_last, cls_s, -1))
+    last_client = jnp.max(jnp.where(sel_last, idxs, -1))
+    do_promote = (count_units > 0) & (cls_last >= CLS_WEIGHT)
+    has_req_after = new_state.active & (new_state.depth > 0)
+    promoted = new_state.head_ready | \
+        (has_req_after & (new_state.head_limit <= now))
+    promoted = promoted & (
+        jnp.arange(state.capacity, dtype=jnp.int32) != last_client)
+    new_state = new_state._replace(head_ready=jnp.where(
+        do_promote, promoted, new_state.head_ready))
+
+    return _Selection(idxs=idxs, cls_s=cls_s, cost_s=costs, len_s=lens,
+                      count_units=count_units, count=count,
+                      guards_ok=guards_ok, state=new_state,
+                      last_client=last_client)
 
 
-def _pack(k32, o32):
-    """Lexicographic (key, order) as one int64: key in the high word,
-    order (nonneg; masked against sign-extension for the garbage orders
-    of sentinel rows) in the low word."""
-    return (k32.astype(jnp.int64) << 32) | (o32.astype(jnp.int64)
-                                            & _O32_MASK)
-
+# ----------------------------------------------------------------------
+# flat (chain_depth=1) batches: one decision per sort unit
+# ----------------------------------------------------------------------
 
 class PrefixBatch(NamedTuple):
     """Result of one prefix-commit attempt."""
@@ -393,182 +665,154 @@ class PrefixBatch(NamedTuple):
     decisions: Decision    # [k]; slots -1 / type NONE past `count`
 
 
-def _prefix_select(key, order, k: int, cost, reentry):
-    """Longest-exact-prefix selection over sorted (key, order).
-
-    ``key``     int64[N], KEY_INF for non-candidates.
-    ``reentry`` int64[N]: the key at which the client re-enters the
-                candidate order after one serve; KEY_INF when it leaves
-                the batch's candidate set; any negative value to force
-                the prefix to stop right after serving this client
-                (regime-exit blocker).
-    ``cost``    int64[N] (>= 0), ridden through the sort as int32.
-
-    Returns (idx, sel_cost, pk, pk_dense, elig_key, count_fn,
-    guards_ok) where ``idx``/``sel_cost``/``pk`` are the [k] sorted
-    candidate slots, costs and packed boundary keys, ``pk_dense`` is
-    the [N] packed key per client (for the dense commit-mask compare),
-    ``elig_key`` is the [k] absolute key per position (for eligibility
-    gates like resv <= now), and ``count_fn(elig_ok)`` finishes the
-    prefix computation given the per-position eligibility mask.
-    """
-    rb = _rebase32(key, order, cost)
-    # re-entry key in the same rebased space: values past the window
-    # clamp high (harmless: every committable boundary is < _CLAMP32,
-    # and packed comparisons stay strict); blockers stay negative.  The
-    # KEY_INF sentinel is mapped before the subtraction (which would
-    # wrap for it); a genuine reentry below kmin cannot occur (tags are
-    # monotone under a serve) but would clamp to 0, which only shortens
-    # the committed prefix -- conservative, never inexact.
-    rrel = jnp.clip(reentry - rb.kmin, 0, jnp.int64(_SENT32))
-    r32 = jnp.where(reentry < 0, jnp.int32(-1),
-                    jnp.where(reentry >= KEY_INF, jnp.int32(_SENT32),
-                              rrel.astype(jnp.int32)))
-    iota = jnp.arange(key.shape[0], dtype=jnp.int32)
-    ks, os_, idxs, cs, rs = lax.sort(
-        (rb.k32, rb.o32, iota, cost.astype(jnp.int32), r32), num_keys=2)
-    ks, os_, idxs, cs, rs = ks[:k], os_[:k], idxs[:k], cs[:k], rs[:k]
-
-    pk_dense = _pack(rb.k32, rb.o32)
-    pk = _pack(ks, os_)
-    rpk = jnp.where(rs < 0, jnp.int64(-1), _pack(rs, os_))
-    # exclusive cumulative min of re-entry keys over the sorted order
-    cm = lax.associative_scan(jnp.minimum, rpk)
-    cm_excl = jnp.concatenate(
-        [jnp.full((1,), (jnp.int64(1) << 62), dtype=jnp.int64), cm[:-1]])
-
-    guards_ok = rb.guards_ok
-    in_window = ks < _CLAMP32
-    elig_key = rb.kmin + ks.astype(jnp.int64)
-
-    def count_fn(elig_ok):
-        ok_q = in_window & elig_ok & (cm_excl > pk)
-        count = jnp.where(jnp.all(ok_q), jnp.int32(k),
-                          jnp.argmax(~ok_q).astype(jnp.int32))
-        return jnp.where(guards_ok, count, jnp.int32(0))
-
-    return (idxs, cs.astype(jnp.int64), pk, pk_dense, elig_key,
-            count_fn, guards_ok)
-
-
-def _commit_prefix(state: EngineState, serve: DenseServe, pk_dense,
-                   count, pk) -> tuple[EngineState, jnp.ndarray]:
-    """Commit the first ``count`` sorted candidates: dense membership is
-    ``packed(key) <= packed boundary`` (packed keys are unique).
-
-    The boundary pk[count-1] is read as a masked max over the sorted
-    prefix, not a dynamic gather -- scalar gathers from vectors
-    serialize on this stack (PROFILE.md findings 4/8)."""
-    j = jnp.arange(pk.shape[0], dtype=jnp.int32)
-    boundary = jnp.max(jnp.where(j < count, pk, jnp.int64(-1)))
-    mask = pk_dense <= boundary
-    return _commit_serves(state, mask, serve, jnp.bool_(True)), mask
-
-
 def speculate_prefix_batch(state: EngineState, now, k: int, *,
                            anticipation_ns: int,
                            heads=None,
-                           max_count=None) -> PrefixBatch:
-    """One prefix-commit batch: regime picked exactly as the serial
-    engine's first decision would (reservation phase iff the lowest
-    reservation tag is eligible, reference :1124-1128), then the
-    longest exact prefix of that regime's sorted candidates commits.
+                           max_count=None,
+                           allow_limit_break: bool = False
+                           ) -> PrefixBatch:
+    """One prefix-commit batch over the unified candidate order: the
+    longest exact prefix of the sorted (class, key, order) triples
+    commits, crossing constraint<->weight regime boundaries inside a
+    single batch (reference do_next_request :1115-1186 makes a fresh
+    phase choice per decision; the class field encodes it per unit).
 
     ``max_count`` (optional int32 scalar, may be traced) caps the
     committed prefix: a shorter prefix of an exact prefix is still
     exact, so callers can budget decisions (e.g. a simulator serving
     at most its remaining slice capacity) without losing parity."""
-    if heads is None:
-        heads = _default_heads(state)
-
-    def capped(count):
-        return count if max_count is None \
-            else jnp.minimum(count, jnp.int32(max_count))
-    has_req = state.active & (state.depth > 0)
-    resv_key = jnp.where(has_req, state.head_resv, KEY_INF)
-    resv_regime = jnp.min(resv_key) <= now      # traced scalar bool
-
-    # COND-FREE regime dispatch: both regimes share one dense serve
-    # and ONE sort; the regime flag where-selects keys, re-entries and
-    # the eligibility gate.  A lax.cond here materialized the selected
-    # branch's operand set per batch and walled off fusion -- removing
-    # it measured 2576 -> 1494 us/batch at k=49152 (PROFILE.md r4
-    # finding 9).
-    ready = has_req & _ready_now(state, now)
-    cand_w = ready & (state.head_prop < MAX_TAG)
-    key_w = jnp.where(cand_w, state.head_prop + state.prop_delta,
-                      KEY_INF)
-    key = jnp.where(resv_regime, resv_key, key_w)
-
-    serve = _dense_serve(state, heads, ~resv_regime, anticipation_ns)
-
-    # re-entry per regime.  Weight regime: a serve whose reservation
-    # tag (post weight-debt reduction) becomes eligible forces the
-    # next serial decision into the constraint phase (blocker = -1).
-    reentry_r = jnp.where(has_req & serve.has_more, serve.head_resv,
-                          KEY_INF)
-    new_eff = serve.head_prop + state.prop_delta
-    new_ready = (serve.head_limit <= now) & (serve.head_prop < MAX_TAG)
-    blocked = cand_w & serve.has_more & (serve.head_resv <= now)
-    reentry_w = jnp.where(
-        blocked, jnp.int64(-1),
-        jnp.where(cand_w & serve.has_more & new_ready, new_eff,
-                  KEY_INF))
-    reentry = jnp.where(resv_regime, reentry_r, reentry_w)
-
-    (idxs, sel_cost, pk, pk_dense, elig_key, count_fn,
-     guards) = _prefix_select(key, state.order, k, state.head_cost,
-                              reentry)
-    # constraint phase serves only tags <= now; the weight phase has
-    # no eligibility gate (readiness is already in the candidate set)
-    elig_ok = jnp.where(resv_regime, elig_key <= now, True)
-    count = capped(count_fn(elig_ok))
-    new_state, _ = _commit_prefix(state, serve, pk_dense, count, pk)
-
-    # stored-flag parity (promote loop, reference :1135-1144), weight
-    # regime only: every weight decision promotes current heads with
-    # limit <= now; the head popped by the LAST committed decision was
-    # never seen by a later promote pass.  With count == 0 no serial
-    # decision ran, so the flags stay untouched.
-    has_req_after = new_state.active & (new_state.depth > 0)
-    promoted = new_state.head_ready | \
-        (has_req_after & (new_state.head_limit <= now))
-    # idxs[count-1] as a masked reduction, not a dynamic scalar gather
+    s = _unified_prefix(state, now, k, chain_depth=1,
+                        anticipation_ns=anticipation_ns,
+                        allow=allow_limit_break, heads=heads,
+                        max_count=max_count)
     j = jnp.arange(k, dtype=jnp.int32)
-    last_client = jnp.max(jnp.where(j == count - 1, idxs, -1))
-    promoted = promoted & (
-        jnp.arange(state.capacity, dtype=jnp.int32) != last_client)
-    new_state = new_state._replace(head_ready=jnp.where(
-        ~resv_regime & (count > 0), promoted, new_state.head_ready))
-
-    phase = jnp.where(resv_regime, jnp.int32(0), jnp.int32(1))
-    served = j < count
+    served = j < s.count_units
+    phase = jnp.where(s.cls_s >= CLS_WEIGHT, 1, 0).astype(jnp.int32)
     decisions = Decision(
         type=jnp.where(served, RETURNING, NONE).astype(jnp.int32),
-        slot=jnp.where(served, idxs, -1).astype(jnp.int32),
-        phase=jnp.broadcast_to(phase, (k,)),
-        cost=jnp.where(served, sel_cost, 0),
+        slot=jnp.where(served, s.idxs, -1).astype(jnp.int32),
+        phase=jnp.where(served, phase, 0),
+        cost=jnp.where(served, s.cost_s.astype(jnp.int64), 0),
         when=jnp.zeros((k,), dtype=jnp.int64),
-        limit_break=jnp.zeros((k,), dtype=bool),
+        limit_break=served & (s.cls_s >= CLS_LB),
     )
-    return PrefixBatch(state=new_state, count=count, guards_ok=guards,
-                       decisions=decisions)
+    return PrefixBatch(state=s.state, count=s.count,
+                       guards_ok=s.guards_ok, decisions=decisions)
+
+
+# ----------------------------------------------------------------------
+# chained batches: one sort unit = up to chain_depth decisions
+# ----------------------------------------------------------------------
+
+class ChainBatch(NamedTuple):
+    """Result of one chained prefix-commit attempt: compact unit form.
+
+    The flat decision stream is ``slot[q]`` repeated ``length[q]``
+    times for each committed unit q in order, phases = the unit's
+    entry phase (class >= 1 -> weight) followed by length-1 constraint
+    serves (see ``expand_units``)."""
+
+    state: EngineState
+    count: jnp.ndarray       # int32 committed DECISIONS
+    unit_count: jnp.ndarray  # int32 committed sort units
+    guards_ok: jnp.ndarray
+    slot: jnp.ndarray        # int32[k] unit client (-1 pad)
+    cls: jnp.ndarray         # int32[k] unit entry class
+    length: jnp.ndarray      # int32[k] unit decisions
+
+
+def speculate_chain_batch(state: EngineState, now, k: int, *,
+                          chain_depth: int, anticipation_ns: int,
+                          heads=None,
+                          allow_limit_break: bool = False
+                          ) -> ChainBatch:
+    """One prefix-commit batch with serve chains (see module
+    docstring): each sort unit serves a client up to ``chain_depth``
+    times -- a weight serve plus the constraint serves its
+    reservation-debt reduction induces -- so interleaved-regime
+    streams commit in long prefixes."""
+    s = _unified_prefix(state, now, k, chain_depth=chain_depth,
+                        anticipation_ns=anticipation_ns,
+                        allow=allow_limit_break, heads=heads,
+                        max_count=None)
+    j = jnp.arange(k, dtype=jnp.int32)
+    served = j < s.count_units
+    return ChainBatch(
+        state=s.state, count=s.count, unit_count=s.count_units,
+        guards_ok=s.guards_ok,
+        slot=jnp.where(served, s.idxs, -1).astype(jnp.int32),
+        cls=jnp.where(served, s.cls_s, CLS_NONE).astype(jnp.int32),
+        length=jnp.where(served, s.len_s, 0).astype(jnp.int32))
+
+
+def expand_units(slot, cls, length, pre_state, *,
+                 limit_break: bool = False):
+    """Host-side expansion of committed units into the flat serial
+    decision stream (slots, phases, costs, limit_breaks) -- numpy, for
+    differential tests and parity harnesses.  ``pre_state`` is the
+    EngineState BEFORE the batch (its rings supply the induced serves'
+    costs)."""
+    import numpy as np
+
+    slot = np.asarray(slot)
+    cls = np.asarray(cls)
+    length = np.asarray(length)
+    head_cost = np.asarray(pre_state.head_cost)
+    q_head = np.asarray(pre_state.q_head)
+    q_cost = np.asarray(pre_state.q_cost)
+    ring = q_cost.shape[1]
+    slots, phases, costs, lbs = [], [], [], []
+    for u in range(slot.shape[0]):
+        c = int(slot[u])
+        if c < 0 or length[u] == 0:
+            continue
+        for step in range(int(length[u])):
+            slots.append(c)
+            phases.append(1 if (step == 0 and cls[u] >= CLS_WEIGHT)
+                          else 0)
+            lbs.append(bool(limit_break and step == 0
+                            and cls[u] >= CLS_LB))
+            if step == 0:
+                costs.append(int(head_cost[c]))
+            else:
+                costs.append(int(q_cost[c, (q_head[c] + step - 1)
+                                        % ring]))
+    return (np.asarray(slots, np.int32), np.asarray(phases, np.int32),
+            np.asarray(costs, np.int64), np.asarray(lbs, bool))
+
+
+# ----------------------------------------------------------------------
+# epoch scans
+# ----------------------------------------------------------------------
+
+# state fields the speculative serve path never writes: rings are only
+# popped via q_head, and QoS/identity/ingest-time fields are mutated by
+# ingest alone, which cannot run mid-epoch.  Keeping them OUT of the
+# scan carry stops XLA from shuffling ~100MB of loop-invariant buffers
+# per iteration (the rings dominate).
+_EPOCH_INVARIANT = ("active", "idle", "order", "resv_inv", "weight_inv",
+                    "limit_inv", "prop_delta", "cur_rho", "cur_delta",
+                    "q_arrival", "q_cost")
+_EPOCH_MUTABLE = tuple(f for f in EngineState._fields
+                       if f not in _EPOCH_INVARIANT)
 
 
 class PrefixEpoch(NamedTuple):
-    """M prefix-commit batches' output, compact for one readback."""
+    """M flat prefix batches' output, compact for one readback."""
 
     state: EngineState     # after ALL committed prefixes
     count: jnp.ndarray     # int32[M] decisions committed per batch
     guards_ok: jnp.ndarray  # bool[M]
     slot: jnp.ndarray      # int32[M, k] serial-order winners (-1 pad)
-    phase: jnp.ndarray     # int8[M]    regime of batch i
+    phase: jnp.ndarray     # int8[M, k]  0 reservation / 1 weight
     cost: jnp.ndarray      # int32[M, k]
+    lb: jnp.ndarray        # bool[M, k]  limit-break serves (Allow)
 
 
 def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
-                      anticipation_ns: int) -> PrefixEpoch:
-    """Run m prefix-commit batches of up to k decisions on device.
+                      anticipation_ns: int,
+                      allow_limit_break: bool = False) -> PrefixEpoch:
+    """Run m flat prefix-commit batches of up to k decisions on device.
 
     EVERY batch commits its own exact prefix, so the concatenated
     per-batch prefixes are always the serial decision stream at
@@ -587,22 +831,72 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
         st = EngineState(**invariant, **mut)
         batch = speculate_prefix_batch(
             st, now, k, anticipation_ns=anticipation_ns,
-            heads=_window_heads(st, window))
+            heads=_window_heads(st, window),
+            allow_limit_break=allow_limit_break)
         out = (batch.count, batch.guards_ok,
                batch.decisions.slot,
-               batch.decisions.phase[0].astype(jnp.int8),
-               batch.decisions.cost.astype(jnp.int32))
+               batch.decisions.phase.astype(jnp.int8),
+               batch.decisions.cost.astype(jnp.int32),
+               batch.decisions.limit_break)
         new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
         return new_mut, out
 
-    mutable, (count, guards, slot, phase, cost) = lax.scan(
+    mutable, (count, guards, slot, phase, cost, lb) = lax.scan(
         body, mutable0, None, length=m)
     state = EngineState(**invariant, **mutable)
     return PrefixEpoch(state=state, count=count, guards_ok=guards,
-                       slot=slot, phase=phase, cost=cost)
+                       slot=slot, phase=phase, cost=cost, lb=lb)
 
 
-def make_prefix_runner(k: int, *, anticipation_ns: int = 0):
+class ChainEpoch(NamedTuple):
+    """M chained prefix batches' output, compact for one readback."""
+
+    state: EngineState
+    count: jnp.ndarray       # int32[M] decisions committed per batch
+    unit_count: jnp.ndarray  # int32[M]
+    guards_ok: jnp.ndarray   # bool[M]
+    slot: jnp.ndarray        # int32[M, k] unit clients (-1 pad)
+    cls: jnp.ndarray         # int8[M, k]  unit entry class
+    length: jnp.ndarray      # int8[M, k]  unit decisions
+
+
+def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
+                     chain_depth: int, anticipation_ns: int,
+                     allow_limit_break: bool = False,
+                     use_pallas: bool | None = None) -> ChainEpoch:
+    """Run m chained prefix batches on device.  Each batch prefetches
+    its own ``chain_depth``-row ring window (one barrel-shift ring
+    pass per batch; a shared per-epoch window would need m *
+    chain_depth rows of unrolled selects, which costs more than the
+    rotate at chain depths > 1)."""
+    assert chain_depth <= state.ring_capacity
+    invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
+    mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+
+    def body(mut, _):
+        st = EngineState(**invariant, **mut)
+        win = ring_window(st, chain_depth, use_pallas=use_pallas)
+        batch = speculate_chain_batch(
+            st, now, k, chain_depth=chain_depth,
+            anticipation_ns=anticipation_ns,
+            heads=(win.arr, win.cost),
+            allow_limit_break=allow_limit_break)
+        out = (batch.count, batch.unit_count, batch.guards_ok,
+               batch.slot, batch.cls.astype(jnp.int8),
+               batch.length.astype(jnp.int8))
+        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
+        return new_mut, out
+
+    mutable, (count, units, guards, slot, cls, length) = lax.scan(
+        body, mutable0, None, length=m)
+    state = EngineState(**invariant, **mutable)
+    return ChainEpoch(state=state, count=count, unit_count=units,
+                      guards_ok=guards, slot=slot, cls=cls,
+                      length=length)
+
+
+def make_prefix_runner(k: int, *, anticipation_ns: int = 0,
+                       allow_limit_break: bool = False):
     """Host-orchestrated prefix runner: (state, now) -> (state,
     decisions, n_committed).  The serial engine is needed only when the
     global rebase guards fail (creation-order spread or a served cost
@@ -610,9 +904,10 @@ def make_prefix_runner(k: int, *, anticipation_ns: int = 0):
     intact means nothing is eligible at ``now`` (serial FUTURE/NONE).
     """
     attempt = jax.jit(functools.partial(
-        speculate_prefix_batch, k=k, anticipation_ns=anticipation_ns))
+        speculate_prefix_batch, k=k, anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break))
     exact = jax.jit(lambda s, t: kernels.engine_run(
-        s, t, k, allow_limit_break=False,
+        s, t, k, allow_limit_break=allow_limit_break,
         anticipation_ns=anticipation_ns, advance_now=False))
 
     def run(state: EngineState, now):

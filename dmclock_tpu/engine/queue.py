@@ -204,6 +204,7 @@ class TpuPullPriorityQueue:
         self.spec_hits = 0        # pulls served launch-free
         self.spec_refills = 0
         self.spec_settles = 0     # invalidations with unconsumed tail
+        self.spec_replays = 0     # settle replays (incl. mixed-drain)
         self._buf: Deque[Tuple] = deque()
         self._buf_slots: Dict[int, int] = {}
         self._buf_horizon = 0
@@ -498,6 +499,11 @@ class TpuPullPriorityQueue:
                 self.spec_settles += 1
                 self._spec_size = 1
             if self._buf or not self._spec_exact:
+                # counted separately from spec_settles: a mixed batch
+                # that drained fully (empty buffer, inexact) replays
+                # too, and the adaptive-size telemetry needs to see
+                # that cost (round-4 advisor finding)
+                self.spec_replays += 1
                 st = self._spec_pre
                 n = self._spec_consumed
                 while n:

@@ -87,6 +87,10 @@ class DeviceSim(NamedTuple):
     served_prop: jnp.ndarray   # int64[S, C]
     last_served: jnp.ndarray   # int64[S, C] slice-end of last completion
     t: jnp.ndarray             # int64 slice-aligned clock (scalar)
+    guard_trips: jnp.ndarray   # int32 scalar: prefix rebase-guard trips
+    #                            (must stay 0 -- init_device_sim
+    #                            validates the only dynamic inputs;
+    #                            run_device_sim raises otherwise)
 
 
 @dataclass
@@ -200,7 +204,8 @@ def init_device_sim(cfg: SimConfig, ring_capacity: int = 256
                     served_resv=jnp.zeros((s, c), jnp.int64),
                     served_prop=jnp.zeros((s, c), jnp.int64),
                     last_served=jnp.zeros((s, c), jnp.int64),
-                    t=jnp.int64(0))
+                    t=jnp.int64(0),
+                    guard_trips=jnp.int32(0))
     return sim, spec
 
 
@@ -216,6 +221,7 @@ def shard_device_sim(sim: DeviceSim, mesh: Mesh) -> DeviceSim:
         served_prop=jax.device_put(sim.served_prop, srv),
         last_served=jax.device_put(sim.last_served, srv),
         t=jax.device_put(sim.t, rep),
+        guard_trips=jax.device_put(sim.guard_trips, rep),
     )
 
 
@@ -277,9 +283,9 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
     s_total = spec.n_servers
 
     def shard_fn(engine, tracker, load, served_resv, served_prop,
-                 last_served, t, server_ids):
+                 last_served, t, trips, server_ids):
         def one_slice(carry, _):
-            engine, tracker, load, sresv, sprop, slast, t = carry
+            engine, tracker, load, sresv, sprop, slast, t, trips = carry
             # tracker is [S_local, C] inside the shard: the client-global
             # counters reduce over BOTH the local server slice and the
             # mesh axis
@@ -349,15 +355,20 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                         limit_break=jnp.zeros((q,), bool))
 
                     def cond(carry):
-                        _eng, total, last, _d = carry
+                        _eng, total, last, _d, _gt = carry
                         return (total < q) & (last > 0)
 
                     def body(carry):
-                        eng, total, _last, dbuf = carry
-                        # guards_ok is unchecked by design: its only
-                        # dynamic inputs (cost, creation-order spread)
-                        # are static in this sim and validated at
-                        # init_device_sim, so it cannot fail here.
+                        eng, total, _last, dbuf, gt = carry
+                        # guards_ok cannot legitimately fail here: its
+                        # only dynamic inputs (cost, creation-order
+                        # spread) are static in this sim and validated
+                        # at init_device_sim.  The trip counter makes
+                        # that invariant CHECKED rather than assumed:
+                        # run_device_sim raises if it ever goes
+                        # nonzero (a future init_device_sim edit that
+                        # weakens the validation would surface, not
+                        # silently under-serve).
                         # The ring-head read forces the XLA rotate:
                         # this whole body runs under vmap (servers),
                         # which would grid the gridless Pallas kernel
@@ -368,6 +379,8 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                         batch = speculate_prefix_batch(
                             eng, t_end, kb, anticipation_ns=0,
                             max_count=q - total, heads=heads)
+                        gt = gt + jnp.where(batch.guards_ok, 0,
+                                            1).astype(jnp.int32)
                         # pack the committed prefix at the buffer
                         # offset (invalid rows scatter out of range
                         # and drop)
@@ -378,14 +391,17 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                             buf.at[pos].set(vals, mode="drop"),
                             dbuf, batch.decisions)
                         return (batch.state, total + batch.count,
-                                batch.count, dbuf)
+                                batch.count, dbuf, gt)
 
-                    eng, _total, _last, dbuf = lax.while_loop(
+                    eng, _total, _last, dbuf, gt = lax.while_loop(
                         cond, body,
-                        (eng, jnp.int32(0), jnp.int32(1), d0))
-                    return eng, dbuf
+                        (eng, jnp.int32(0), jnp.int32(1), d0,
+                         jnp.int32(0)))
+                    return eng, dbuf, gt
 
-                engine, decs = jax.vmap(per_server_run)(engine)
+                engine, decs, gts = jax.vmap(per_server_run)(engine)
+                trips = (trips + lax.psum(gts.sum(), SERVER_AXIS)
+                         ).astype(jnp.int32)
             else:
                 def per_server_run(eng):
                     eng, _, d = kernels.engine_run(
@@ -429,37 +445,59 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                 + sends.astype(jnp.int64) * load.gap_ns,
             )
             return (engine, tracker, load, sresv, sprop, slast,
-                    t_end), None
+                    t_end, trips), None
 
         (engine, tracker, load, served_resv, served_prop, last_served,
-         t), _ = lax.scan(
+         t, trips), _ = lax.scan(
             one_slice,
             (engine, tracker, load, served_resv, served_prop,
-             last_served, t), None, length=slices)
+             last_served, t, trips), None, length=slices)
         return (engine, tracker, load, served_resv, served_prop,
-                last_served, t)
+                last_served, t, trips)
 
     srv = P(SERVER_AXIS)
     rep = P()
     server_ids = jnp.arange(s_total, dtype=jnp.int32)
     fn = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(srv, srv, rep, srv, srv, srv, rep, srv),
-        out_specs=(srv, srv, rep, srv, srv, srv, rep),
+        in_specs=(srv, srv, rep, srv, srv, srv, rep, rep, srv),
+        out_specs=(srv, srv, rep, srv, srv, srv, rep, rep),
         check_vma=False)
-    engine, tracker, load, served_resv, served_prop, last_served, t = \
-        fn(sim.engine, sim.tracker, sim.load, sim.served_resv,
-           sim.served_prop, sim.last_served, sim.t, server_ids)
+    (engine, tracker, load, served_resv, served_prop, last_served, t,
+     trips) = fn(sim.engine, sim.tracker, sim.load, sim.served_resv,
+                 sim.served_prop, sim.last_served, sim.t,
+                 sim.guard_trips, server_ids)
     return DeviceSim(engine=engine, tracker=tracker, load=load,
                      served_resv=served_resv, served_prop=served_prop,
-                     last_served=last_served, t=t)
+                     last_served=last_served, t=t, guard_trips=trips)
+
+
+def check_guard_trips(sim: DeviceSim) -> None:
+    """Raise if any prefix batch tripped a rebase guard.  The guards'
+    only dynamic inputs (request cost, creation-order spread) are
+    validated statically by init_device_sim, so a trip means that
+    validation no longer covers the workload and committed counts are
+    untrustworthy."""
+    trips = int(np.asarray(sim.guard_trips))
+    if trips:
+        raise RuntimeError(
+            f"device_sim: {trips} prefix rebase-guard trip(s) -- "
+            "init_device_sim's static validation no longer covers the "
+            "workload (cost or creation-order spread past the int32 "
+            "sort payload); committed counts are untrustworthy")
 
 
 def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
                    ring_capacity: int = 256,
                    slices_per_launch: int = 64,
-                   max_launches: int = 200):
+                   max_launches: int = 200,
+                   check_guards: bool = True):
     """Run to completion (all clients' ops served) or the launch cap.
+
+    ``check_guards`` (default on) raises after any launch whose prefix
+    batches tripped a rebase guard -- the invariant init_device_sim
+    validates statically, made CHECKED so future edits that weaken the
+    validation surface instead of silently under-serving.
 
     Returns (sim, spec, report_str)."""
     if mesh is None:
@@ -480,6 +518,8 @@ def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
     completed = 0
     for launches in range(1, max_launches + 1):
         sim = step(sim)
+        if check_guards:
+            check_guard_trips(sim)
         completed = int(np.asarray(sim.served_resv).sum()
                         + np.asarray(sim.served_prop).sum())
         if completed >= total_ops:

@@ -98,6 +98,7 @@ class PriorityQueueBase {
     size_t resv_pos = HEAP_NOT_IN;
     size_t limit_pos = HEAP_NOT_IN;
     size_t ready_pos = HEAP_NOT_IN;
+    size_t prop_pos = HEAP_NOT_IN;  // optional prop heap (use_prop_heap)
 
     ClientRec(const C& c, const ClientInfo& i, uint64_t tick, uint64_t ord)
         : client(c), order(ord), info(i), last_tick(tick) {}
@@ -157,6 +158,27 @@ class PriorityQueueBase {
     }
   };
 
+  // Optional 4th heap order (the reference's USE_PROP_HEAP,
+  // dmclock_server.h:18-25, :369-371, :775-783): lowest effective
+  // proportion among NON-IDLE clients, for O(1) idle-reactivation
+  // lookup instead of the O(n) client scan -- the scan is the CPU
+  // scaling ceiling at 10k+ clients (BASELINE.md: 62us of the 68us
+  // add_request mean).  Idle clients sort last so top() is the query
+  // answer whenever it is non-idle.
+  struct PropCompare {
+    bool operator()(const ClientRec& a, const ClientRec& b) const {
+      if (a.idle != b.idle) return b.idle;
+      int64_t ta = (a.has_request() ? a.next_request().tag.proportion
+                                    : a.prev_tag.proportion) +
+                   a.prop_delta;
+      int64_t tb = (b.has_request() ? b.next_request().tag.proportion
+                                    : b.prev_tag.proportion) +
+                   b.prop_delta;
+      if (ta != tb) return ta < tb;
+      return a.order < b.order;
+    }
+  };
+
   struct Options {
     bool delayed_tag_calc = false;
     bool dynamic_cli_info = false;
@@ -164,6 +186,7 @@ class PriorityQueueBase {
     TimeNs reject_threshold_ns = 0;  // >0 implies AtLimit::Reject
     TimeNs anticipation_timeout_ns = 0;
     unsigned heap_branching = 2;  // the K_WAY_HEAP analog
+    bool use_prop_heap = false;   // O(1) idle-reactivation lookup
     double idle_age_s = STANDARD_IDLE_AGE_S;
     double erase_age_s = STANDARD_ERASE_AGE_S;
     double check_time_s = STANDARD_CHECK_TIME_S;
@@ -176,7 +199,8 @@ class PriorityQueueBase {
         opt_(opt),
         resv_heap_(opt.heap_branching),
         limit_heap_(opt.heap_branching),
-        ready_heap_(opt.heap_branching) {
+        ready_heap_(opt.heap_branching),
+        prop_heap_(opt.heap_branching) {
     if (opt_.reject_threshold_ns > 0) opt_.at_limit = AtLimit::Reject;
     // Reject needs accurate tags at add time (reference :856-857);
     // always-on like the reference's death-tested assert
@@ -348,7 +372,10 @@ class PriorityQueueBase {
           it = client_map_.erase(it);
           ++erased_num;
         } else {
-          if (idle_point && rec.last_tick <= idle_point) rec.idle = true;
+          if (idle_point && rec.last_tick <= idle_point) {
+            rec.idle = true;
+            if (opt_.use_prop_heap) prop_heap_.adjust(rec);
+          }
           ++it;
         }
       }
@@ -371,16 +398,20 @@ class PriorityQueueBase {
       IndirectHeap<ClientRec, LimitCompare, &ClientRec::limit_pos>;
   using ReadyHeap =
       IndirectHeap<ClientRec, ReadyCompare, &ClientRec::ready_pos>;
+  using PropHeap =
+      IndirectHeap<ClientRec, PropCompare, &ClientRec::prop_pos>;
 
   void adjust_all_heaps(ClientRec& rec) {
     resv_heap_.adjust(rec);
     limit_heap_.adjust(rec);
     ready_heap_.adjust(rec);
+    if (opt_.use_prop_heap) prop_heap_.adjust(rec);
   }
   void remove_from_heaps(ClientRec& rec) {
     resv_heap_.remove(rec);
     limit_heap_.remove(rec);
     ready_heap_.remove(rec);
+    if (opt_.use_prop_heap) prop_heap_.remove(rec);
   }
 
   const ClientInfo& get_cli_info(ClientRec& rec) {
@@ -419,26 +450,41 @@ class PriorityQueueBase {
       resv_heap_.push(rec);
       limit_heap_.push(rec);
       ready_heap_.push(rec);
+      if (opt_.use_prop_heap) prop_heap_.push(rec);
     } else {
       rec = it->second.get();
     }
 
     if (rec->idle) {
       // idle reactivation (reference :937-985): shift the returning
-      // client's effective proportion next to the lowest active tag
+      // client's effective proportion next to the lowest active tag.
+      // With the prop heap the lookup is O(1) (the reference's
+      // USE_PROP_HEAP, :775-783): idle clients -- including this one
+      // -- sort last, so a non-idle top IS the scan's minimum.
       bool found = false;
       int64_t lowest = 0;
-      for (auto& kv : client_map_) {
-        ClientRec& other = *kv.second;
-        if (other.idle) continue;
-        int64_t p = (other.has_request()
-                         ? other.next_request().tag.proportion
-                         : other.prev_tag.proportion) + other.prop_delta;
-        if (!found || p < lowest) { lowest = p; found = true; }
+      if (opt_.use_prop_heap) {
+        if (!prop_heap_.empty() && !prop_heap_.top().idle) {
+          ClientRec& low = prop_heap_.top();
+          lowest = (low.has_request()
+                        ? low.next_request().tag.proportion
+                        : low.prev_tag.proportion) + low.prop_delta;
+          found = true;
+        }
+      } else {
+        for (auto& kv : client_map_) {
+          ClientRec& other = *kv.second;
+          if (other.idle) continue;
+          int64_t p = (other.has_request()
+                           ? other.next_request().tag.proportion
+                           : other.prev_tag.proportion) + other.prop_delta;
+          if (!found || p < lowest) { lowest = p; found = true; }
+        }
       }
       if (found && lowest < LOWEST_PROP_TAG_TRIGGER)
         rec->prop_delta = lowest - time_ns;
       rec->idle = false;
+      if (opt_.use_prop_heap) prop_heap_.adjust(*rec);
     }
 
     RequestTag tag = initial_tag(*rec, req_params, time_ns, cost);
@@ -557,6 +603,7 @@ class PriorityQueueBase {
   Heap resv_heap_;
   LimitHeap limit_heap_;
   ReadyHeap ready_heap_;
+  PropHeap prop_heap_;
 
   uint64_t last_erase_point_ = 0;
   std::deque<std::pair<double, uint64_t>> clean_mark_points_;

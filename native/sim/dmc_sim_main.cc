@@ -39,6 +39,7 @@ struct Args {
   uint64_t seed = 12345;
   unsigned k_way = 2;  // heap branching (reference K_WAY_HEAP,
                        // sim/CMakeLists.txt:1-10 -- runtime here)
+  bool use_prop_heap = false;  // reference USE_PROP_HEAP analog
   bool intervals = false;
   bool trace = false;
 };
@@ -47,7 +48,7 @@ int usage(const char* prog) {
   fprintf(stderr,
           "usage: %s -c CONF [--model dmclock|dmclock-delayed|ssched] "
           "[--server-mode pull|push] [--seed N] [--k-way K] "
-          "[--intervals] [--trace]\n",
+          "[--use-prop-heap] [--intervals] [--trace]\n",
           prog);
   return 2;
 }
@@ -67,10 +68,12 @@ int finish(Sim& sim, const Args& args) {
 
 static DmcQueue::Options make_opts(bool delayed, unsigned k_way,
                                    int64_t anticipation_ns,
-                                   bool soft_limit) {
+                                   bool soft_limit,
+                                   bool use_prop_heap) {
   DmcQueue::Options opt;
   opt.delayed_tag_calc = delayed;
   opt.heap_branching = k_way;
+  opt.use_prop_heap = use_prop_heap;
   // soft limit -> Allow, hard -> Wait (reference
   // test_dmclock_main.cc:190-198 create_queue_f)
   opt.at_limit = soft_limit ? dmclock::AtLimit::Allow
@@ -82,11 +85,12 @@ static DmcQueue::Options make_opts(bool delayed, unsigned k_way,
 
 int run_dmclock(const SimConfig& cfg, const Args& args, bool delayed) {
   unsigned k_way = args.k_way;
+  bool prop_heap = args.use_prop_heap;
   if (args.server_mode == "push") {
     qos_sim::Simulation<DmcPushQueue, DmcTracker> sim(
         cfg, nullptr, [] { return std::make_unique<DmcTracker>(); },
         args.seed, args.trace,
-        [delayed, k_way](
+        [delayed, k_way, prop_heap](
             ServerId,
             std::function<dmclock::ClientInfo(const ClientId&)> info_f,
             int64_t anticipation_ns, bool soft_limit,
@@ -99,18 +103,21 @@ int run_dmclock(const SimConfig& cfg, const Args& args, bool delayed) {
           return std::make_unique<DmcPushQueue>(
               std::move(info_f), std::move(can_handle),
               std::move(handle), std::move(now_f), std::move(sched_at),
-              make_opts(delayed, k_way, anticipation_ns, soft_limit));
+              make_opts(delayed, k_way, anticipation_ns, soft_limit,
+                        prop_heap));
         });
     return finish(sim, args);
   }
   qos_sim::Simulation<DmcQueue, DmcTracker> sim(
       cfg,
-      [delayed, k_way](ServerId, std::function<dmclock::ClientInfo(
-                                     const ClientId&)> info_f,
-                       int64_t anticipation_ns, bool soft_limit) {
+      [delayed, k_way, prop_heap](
+          ServerId,
+          std::function<dmclock::ClientInfo(const ClientId&)> info_f,
+          int64_t anticipation_ns, bool soft_limit) {
         return std::make_unique<DmcQueue>(
             std::move(info_f),
-            make_opts(delayed, k_way, anticipation_ns, soft_limit));
+            make_opts(delayed, k_way, anticipation_ns, soft_limit,
+                      prop_heap));
       },
       [] { return std::make_unique<DmcTracker>(); }, args.seed,
       args.trace);
@@ -168,6 +175,8 @@ int main(int argc, char** argv) {
     } else if (!strcmp(argv[i], "--k-way")) {
       if (++i >= argc) return usage(argv[0]);
       args.k_way = (unsigned)strtoul(argv[i], nullptr, 10);
+    } else if (!strcmp(argv[i], "--use-prop-heap")) {
+      args.use_prop_heap = true;
     } else if (!strcmp(argv[i], "--intervals")) {
       args.intervals = true;
     } else if (!strcmp(argv[i], "--trace")) {

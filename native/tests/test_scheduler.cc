@@ -203,6 +203,61 @@ MT_TEST(remove_by_client_and_filter) {
   MT_CHECK_EQ(q.request_count(), uint64_t{2});
 }
 
+MT_TEST(prop_heap_matches_scan) {
+  // The optional prop heap (reference USE_PROP_HEAP,
+  // dmclock_server.h:18-25, :775-783) must be behaviorally invisible:
+  // an identical op sequence -- including idle-reactivations, GC idle
+  // marking, and erases -- produces the identical decision stream
+  // with the O(1) lookup and the O(n) scan.
+  g_infos.clear();
+  const int N = 12;
+  for (uint64_t c = 1; c <= N; ++c)
+    g_infos[c] = ClientInfo(0.5 * (c % 3), 1.0 + c % 4,
+                            c % 2 ? 0 : 8.0);
+  for (bool gc_pass : {false, true}) {
+    Q::Options oa = opts(true), ob = opts(true);
+    ob.use_prop_heap = true;
+    oa.idle_age_s = ob.idle_age_s = 10.0;
+    oa.erase_age_s = ob.erase_age_s = 20.0;
+    oa.check_time_s = ob.check_time_s = 1.0;
+    Q qa(info_of, oa), qb(info_of, ob);
+    double fake = 0.0;
+    qa.set_monotonic_clock([&] { return fake; });
+    qb.set_monotonic_clock([&] { return fake; });
+    uint64_t seed = 12345, req = 0;
+    auto rnd = [&] { seed = seed * 6364136223846793005ULL + 1442695040888963407ULL;
+                     return seed >> 33; };
+    int64_t t = 1 * S;
+    for (int round = 0; round < 40; ++round) {
+      // a random burst of adds (some clients go idle across rounds
+      // and reactivate here, exercising the lookup under test)
+      for (int i = 0; i < 6; ++i) {
+        uint64_t c = 1 + rnd() % N;
+        if (round > 10 && c <= 3) continue;  // 1-3 idle out
+        ++req;
+        MT_CHECK_EQ(qa.add_request(req, c, ReqParams(1, 1), t),
+                    qb.add_request(req, c, ReqParams(1, 1), t));
+      }
+      for (int i = 0; i < 5; ++i) {
+        auto pa = qa.pull_request(t + S);
+        auto pb = qb.pull_request(t + S);
+        MT_CHECK_EQ((int)pa.type, (int)pb.type);
+        if (pa.is_retn()) {
+          MT_CHECK_EQ(pa.client, pb.client);
+          MT_CHECK_EQ((int)pa.phase, (int)pb.phase);
+        }
+      }
+      t += S / 2;
+      if (gc_pass) {
+        fake += 1.0;
+        qa.do_clean();
+        qb.do_clean();
+      }
+    }
+    MT_CHECK_EQ(qa.client_count(), qb.client_count());
+  }
+}
+
 MT_TEST(gc_idle_then_erase) {
   // injected monotonic clock; timeline mirrors the reference's
   // client_idle_erase test (:100-185)

@@ -109,11 +109,15 @@ def main():
 
     # -- serve: dense elementwise retag (no ring access)
     def serve(state):
+        n = state.capacity
+        cls = jnp.full((n,), fastpath.CLS_WEIGHT, jnp.int32)
+
         def body(c, _):
             t, _x = c
             st = state._replace(prev_prop=state.prev_prop + t)
-            heads = (st.head_arrival, st.head_cost)
-            sv = fastpath._dense_serve(st, heads, True, 0)
+            sv = fastpath._chain_serve(
+                st, jnp.int64(1 << 60), [st.head_arrival],
+                [st.head_cost], cls, False, 0)
             return (t + sv.head_prop[0] + 1, _x), sv.head_resv[0]
         return body
     measure_scan("serve: dense elementwise retag", serve, state,

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Drift-aware benchmark regression guard.
+
+The shared-tunnel TPU runtime drifts by the hour (RESULTS.md quotes
+31-49M for one shape across sessions, ~±30%), so a naive
+newest-vs-previous comparison would flap.  Instead every `bench.py`
+run appends its per-workload rates to `benchmark/history/` (one JSON
+per session), and this guard compares the NEWEST record of each
+workload against the MEDIAN of the prior records: a drop past the
+tolerance factor (default 2×, chosen to clear the observed ±30%
+session noise with margin while still catching the order-of-magnitude
+regressions that matter, e.g. a fastpath falling back to the serial
+scan) fails CI.
+
+Usage:
+    python scripts/bench_guard.py [--tolerance 2.0] [--min-records 2]
+
+Exit 0 when there is not enough history yet (the guard cannot judge a
+first session), when every workload's newest rate clears
+median/tolerance, or when run on a box with no history at all; exit 1
+on a regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+HISTORY = REPO / "benchmark" / "history"
+
+
+def load_records():
+    """History records sorted oldest -> newest (filename carries the
+    timestamp; bench.py writes bench_<unix_ts>.json)."""
+    if not HISTORY.is_dir():
+        return []
+    recs = []
+    for p in sorted(HISTORY.glob("bench_*.json")):
+        try:
+            recs.append((p.name, json.loads(p.read_text())))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_guard: unreadable {p.name}: {e}",
+                  file=sys.stderr)
+    return recs
+
+
+def median(xs):
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tolerance", type=float, default=2.0,
+                    help="fail when newest < median(prior)/tolerance")
+    ap.add_argument("--min-records", type=int, default=2,
+                    help="prior records needed before judging")
+    args = ap.parse_args()
+
+    recs = load_records()
+    if not recs:
+        print("bench_guard: no history yet -- pass (bench.py appends "
+              "benchmark/history/ records on real hardware)")
+        return 0
+
+    newest_name, newest = recs[-1]
+    # only same-device sessions are comparable: the tunnel serves
+    # whatever chip generation is attached that day, and a device swap
+    # would read as a phantom regression (or hide a real one)
+    dev = newest.get("device")
+    prior = [(n, r) for n, r in recs[:-1] if r.get("device") == dev]
+    status = 0
+    for wl, row in sorted(newest.get("workloads", {}).items()):
+        dps = row.get("dps")
+        if dps is None:
+            continue
+        hist = [r["workloads"][wl]["dps"] for _, r in prior
+                if wl in r.get("workloads", {})
+                and "dps" in r["workloads"][wl]]
+        if len(hist) < args.min_records:
+            print(f"bench_guard: {wl}: {dps/1e6:.1f}M "
+                  f"({len(hist)} prior record(s) -- not judged)")
+            continue
+        med = median(hist)
+        floor = med / args.tolerance
+        verdict = "OK" if dps >= floor else "REGRESSION"
+        print(f"bench_guard: {wl}: newest {dps/1e6:.1f}M vs median "
+              f"{med/1e6:.1f}M over {len(hist)} sessions "
+              f"(floor {floor/1e6:.1f}M at tolerance "
+              f"{args.tolerance:g}x) -- {verdict}")
+        if dps < floor:
+            status = 1
+    if status:
+        print(f"bench_guard: FAILED on {newest_name} -- a >"
+              f"{args.tolerance:g}x drop survived the drift margin; "
+              "investigate before shipping", file=sys.stderr)
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

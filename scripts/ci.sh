@@ -22,6 +22,9 @@ python scripts/run_fullscale.py
 echo "== on-silicon parity gate (skips on cpu-only boxes) =="
 python scripts/silicon_parity.py
 
+echo "== bench history regression guard (drift-aware) =="
+python scripts/bench_guard.py
+
 echo "== graft entry compile check =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

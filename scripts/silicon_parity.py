@@ -110,6 +110,12 @@ def main() -> int:
               "device": str(jax.devices()[0]),
               "shapes": [], "total_decisions": 0, "match": True}
     t0 = time.perf_counter()
+    # a stale artifact claiming success must be impossible whatever
+    # happens below: mark it in-progress BEFORE the first shape runs,
+    # and the except arm below catches every failure mode (run_sim
+    # crashes and JAX runtime errors included, not just asserts)
+    ARTIFACT.write_text(json.dumps({**report, "match": False,
+                                    "running": True}, indent=1))
     try:
         for name, cfg in make_shapes():
             oracle = run_sim(cfg, model="dmclock-delayed", seed=7,
@@ -131,11 +137,12 @@ def main() -> int:
             report["shapes"].append({"name": name, "decisions": n})
             report["total_decisions"] += n
             print(f"silicon parity: {name}: {n} decisions bit-exact")
-    except AssertionError as e:
-        # the artifact must never keep claiming success after a
-        # mismatch: record the failure evidence, then fail the gate
+    except BaseException as e:
+        # the artifact must never keep claiming success after ANY
+        # failure -- assertion, run_sim crash, JAX runtime error, or
+        # interrupt: record the evidence, then fail the gate
         report["match"] = False
-        report["error"] = str(e)
+        report["error"] = f"{type(e).__name__}: {e}"
         report["wall_s"] = round(time.perf_counter() - t0, 1)
         ARTIFACT.write_text(json.dumps(report, indent=1))
         raise

@@ -257,3 +257,40 @@ def test_prefix_serve_skewed_population_matches_scan(mesh8):
                     client_weight=1.0, client_server_select_range=1),
     ]
     _prefix_vs_scan(make_cfg(groups, iops=200000.0), mesh8, 256)
+
+
+def test_guard_trips_checked(mesh8):
+    """The prefix rebase guards are a CHECKED invariant, not an
+    assumption: corrupting the state init_device_sim validated (a
+    served cost past the int32 sort payload) must trip the counter,
+    and run_device_sim's check must raise on it."""
+    groups = [
+        ClientGroup(client_count=64, client_total_ops=10**9,
+                    client_iops_goal=20000, client_outstanding_ops=200,
+                    client_reservation=0.0, client_limit=0.0,
+                    client_weight=1.0, client_server_select_range=8),
+    ]
+    import dataclasses
+    cfg = make_cfg(groups, iops=200000.0)
+    sim, spec = DS.init_device_sim(cfg)
+    spec = dataclasses.replace(spec, q_per_slice=256,
+                               slice_ns=spec.op_time_ns * 256)
+    sim = DS.shard_device_sim(sim, mesh8)
+    step = jax.jit(functools.partial(DS.device_sim_step, spec=spec,
+                                     mesh=mesh8, slices=4))
+    sim = step(sim)
+    assert int(np.asarray(sim.guard_trips)) == 0
+
+    # break the init-time validation after the fact: request costs
+    # past 2^31 (what the init assert statically excludes) -- fresh
+    # ingests install them on candidate heads, so the very next serve
+    # batch sees the oversized sort payload
+    bad_cost = jnp.full_like(sim.load.cost, jnp.int64(1) << 32)
+    sim = sim._replace(load=sim.load._replace(cost=bad_cost))
+    sim = step(sim)
+    assert int(np.asarray(sim.guard_trips)) > 0, \
+        "corrupted cost payload never tripped the guard counter"
+
+    # and the driver-level check raises on a tripped counter
+    with pytest.raises(RuntimeError, match="rebase-guard"):
+        DS.check_guard_trips(sim)

@@ -214,15 +214,16 @@ def test_prefix_epoch_concatenation_is_serial_stream():
         ser_state, ser_decs = serial_run(st, 30 * S, c)
         assert np.array_equal(slots[i][:c], ser_decs.slot)
         assert np.array_equal(costs[i][:c], ser_decs.cost)
-        assert (ser_decs.phase == int(phases[i])).all()
+        assert np.array_equal(phases[i][:c], ser_decs.phase)
         assert (slots[i][c:] == -1).all()
         st = ser_state
     assert_states_equal(ep.state, st)
 
 
 def test_prefix_epoch_regime_transition():
-    """An epoch spanning a resv->weight transition: batches before the
-    flip are reservation-phase, after are weight-phase, stream exact."""
+    """An epoch spanning a resv->weight transition: the unified order
+    commits across the boundary and the per-position phases match the
+    serial engine's per-decision phase choices exactly."""
     infos = {c: ClientInfo(2, 1, 0) for c in range(6)}
     state = deep_state(infos, depth=12)
     m, k = 12, 8
@@ -232,6 +233,7 @@ def test_prefix_epoch_regime_transition():
     counts = jax.device_get(ep.count)
     phases = jax.device_get(ep.phase)
     st = state
+    served_phases = set()
     for i in range(m):
         c = int(counts[i])
         if c == 0:
@@ -239,10 +241,10 @@ def test_prefix_epoch_regime_transition():
         ser_state, ser_decs = serial_run(st, now, c)
         assert np.array_equal(jax.device_get(ep.slot)[i][:c],
                               ser_decs.slot)
-        assert (ser_decs.phase == int(phases[i])).all()
+        assert np.array_equal(phases[i][:c], ser_decs.phase)
+        served_phases |= set(int(p) for p in phases[i][:c])
         st = ser_state
     assert_states_equal(ep.state, st)
-    served_phases = {int(phases[i]) for i in range(m) if counts[i]}
     assert served_phases == {0, 1}, \
         f"epoch never crossed the transition: {served_phases}"
 
@@ -355,6 +357,341 @@ def test_pallas_rotate_matches_xla():
         b = _rotate_rows_pallas(ring, q0, w, interpret=True)
         assert a.shape == b.shape == (w, n)
         assert (np.asarray(a) == np.asarray(b)).all(), (n, q, w)
+
+
+# ----------------------------------------------------------------------
+# serve chains (chain_depth > 1) + mixed-regime batches
+# ----------------------------------------------------------------------
+
+def expand_batch(batch, pre_state):
+    """Flat (slots, phases, costs, lbs) stream of a ChainBatch."""
+    from dmclock_tpu.engine.fastpath import expand_units
+
+    return expand_units(jax.device_get(batch.slot),
+                        jax.device_get(batch.cls),
+                        jax.device_get(batch.length), pre_state,
+                        limit_break=True)
+
+
+def check_chain_vs_serial(state, now, k, chain_depth, *,
+                          anticipation_ns=0, allow=False,
+                          return_batch=False):
+    """One chained batch vs the serial engine run for `count` steps."""
+    from dmclock_tpu.engine.fastpath import speculate_chain_batch
+
+    batch = speculate_chain_batch(state, jnp.int64(now), k,
+                                  chain_depth=chain_depth,
+                                  anticipation_ns=anticipation_ns,
+                                  allow_limit_break=allow)
+    assert bool(batch.guards_ok)
+    c = int(batch.count)
+    if c == 0:
+        assert_states_equal(batch.state, state)
+        _, ser_decs = serial_run_lb(state, now, 1, allow)
+        assert ser_decs.type[0] != kernels.RETURNING
+        return (batch.state, 0, batch) if return_batch \
+            else (batch.state, 0)
+    slots, phases, costs, lbs = expand_batch(batch, state)
+    assert slots.shape[0] == c
+    ser_state, ser_decs = serial_run_lb(state, now, c, allow)
+    assert (ser_decs.type == kernels.RETURNING).all()
+    assert np.array_equal(slots, ser_decs.slot)
+    assert np.array_equal(phases, ser_decs.phase)
+    assert np.array_equal(costs, ser_decs.cost)
+    assert np.array_equal(lbs, ser_decs.limit_break)
+    assert_states_equal(batch.state, ser_state)
+    return (batch.state, c, batch) if return_batch \
+        else (batch.state, c)
+
+
+def serial_run_lb(state, now, k, allow):
+    st, _, decs = kernels.engine_run(
+        state, jnp.int64(now), k, allow_limit_break=allow,
+        anticipation_ns=0, advance_now=False)
+    return st, jax.device_get(decs)
+
+
+def mixed_qos_state(n=8, depth=12, resv=2.0, seed=3):
+    """Mixed-QoS population whose stream interleaves phases per
+    decision -- the reference's balanced cfg4 shape and the chain
+    engine's target.  The mechanism needs arrival-DOMINATED retagging:
+    a weight serve advances the popped client's reservation tag by
+    inv*(rho+cost) and the debt reduction subtracts exactly
+    inv*(cost+rho), so prev-dominated tags are invariant under weight
+    serves; only heads retagged to a recent arrival (~now) get dragged
+    below now and force the constraint phase.  Arrivals therefore
+    stream right up to the returned ``now``."""
+    rng = random.Random(seed)
+    infos = {c: ClientInfo(resv, 0.5 + (c % 4), 0) for c in range(n)}
+    adds = []
+    for j in range(depth):
+        for c in infos:
+            t = S + j * (S // 3) + rng.randint(0, S // 10)
+            adds.append((c, t, 1, 1, 1))
+    now = S + depth * (S // 3)
+    return build_state(infos, adds, capacity=max(8, n)), now
+
+
+@pytest.mark.parametrize("chain_depth", [1, 2, 4])
+def test_chain_balanced_mix_exact(chain_depth):
+    """Balanced mixed-QoS stream (phase flips every few decisions):
+    chained batches must stay bit-exact vs the serial engine, and at
+    chain_depth >= 2 must commit multi-decision batches through the
+    flips."""
+    state, now = mixed_qos_state(n=8, depth=12)
+    st = state
+    total, sizes = 0, []
+    for _ in range(120):
+        st, c = check_chain_vs_serial(st, now, 16, chain_depth)
+        sizes.append(c)
+        total += c
+        if c == 0:
+            break
+    assert total == 8 * 12
+    if chain_depth >= 2:
+        assert max(sizes) >= 4, \
+            f"chains never amortized the phase flips: {sizes}"
+
+
+def test_unified_batch_crosses_regimes():
+    """ONE batch must serve both phases when reservation-eligible and
+    ready-weight candidates coexist: the constraint drain and the
+    weight tail commit together (the round-4 engine dispatched one
+    regime per batch, so this shape always took two)."""
+    infos = {}
+    for c in range(3):
+        # reservation-only; one eligible serve each, then the fresh
+        # tag (+2s at rate 1, rho=cost=1) leaves the candidate set
+        infos[c] = ClientInfo(1, 0, 0)
+    for c in range(3, 6):
+        infos[c] = ClientInfo(0, 2, 0)       # weight-only, ready
+    state = deep_state(infos, depth=4)
+    now = 2 * S
+    batch = speculate_prefix_batch(state, jnp.int64(now), 32,
+                                   anticipation_ns=0)
+    assert bool(batch.guards_ok)
+    c = int(batch.count)
+    fd = jax.device_get(batch.decisions)
+    phases = set(fd.phase[:c].tolist())
+    assert phases == {0, 1}, \
+        f"single batch served one regime only: {phases} (count {c})"
+    ser_state, ser_decs = serial_run(state, now, c)
+    assert np.array_equal(fd.slot[:c], ser_decs.slot)
+    assert_states_equal(batch.state, ser_state)
+
+
+def test_fuzz_chains_actually_fire():
+    """Variable-cost workloads (offset != advance) must produce
+    multi-serve chain units somewhere -- guard against the chain path
+    silently never engaging."""
+    from dmclock_tpu.engine.fastpath import speculate_chain_batch
+
+    rng = random.Random(99)
+    infos = {c: ClientInfo(1.0 + (c % 3), 1.0 + (c % 4), 0)
+             for c in range(10)}
+    adds = []
+    t = 1 * S
+    for _ in range(150):
+        c = rng.randrange(10)
+        t += rng.randint(0, S // 5)
+        delta = rng.randint(1, 4)
+        adds.append((c, t, rng.randint(1, 4), delta,
+                     rng.randint(1, delta)))
+    st = build_state(infos, adds, capacity=16, ring=64)
+    now = t
+    max_len = 1
+    for _ in range(100):
+        batch = speculate_chain_batch(st, jnp.int64(now), 10,
+                                      chain_depth=4,
+                                      anticipation_ns=0)
+        if int(batch.count) == 0:
+            now += S // 2
+            continue
+        max_len = max(max_len,
+                      int(jax.device_get(batch.length).max()))
+        st = batch.state
+        if max_len > 1:
+            break
+    assert max_len > 1, "chains never fired on a variable-cost stream"
+
+
+@pytest.mark.parametrize("seed", [41, 42, 43, 44])
+def test_fuzz_chain_matches_serial(seed):
+    """Random QoS mixes and chain depths: every chained batch's
+    expanded stream must replay serially, bit-exact."""
+    rng = random.Random(seed)
+    n = rng.randint(2, 16)
+    infos = {}
+    for c in range(n):
+        kind = rng.randrange(4)
+        if kind == 0:
+            infos[c] = ClientInfo(rng.uniform(0.5, 3), 0, 0)
+        elif kind == 1:
+            infos[c] = ClientInfo(0, rng.uniform(0.5, 4), 0)
+        elif kind == 2:
+            infos[c] = ClientInfo(rng.uniform(0.5, 2),
+                                  rng.uniform(0.5, 4),
+                                  rng.uniform(4, 9))
+        else:
+            infos[c] = ClientInfo(rng.uniform(0.5, 3),
+                                  rng.uniform(0.5, 3), 0)
+    adds = []
+    t = 1 * S
+    for _ in range(rng.randint(20, 120)):
+        c = rng.randrange(n)
+        t += rng.randint(0, S // 4)
+        delta = rng.randint(1, 5)
+        adds.append((c, t, rng.randint(1, 3), delta,
+                     rng.randint(1, delta)))
+    state = build_state(infos, adds, capacity=32)
+    cd = rng.choice([2, 3, 4])
+    k = rng.choice([4, 8, 16])
+    now = t + rng.randint(0, 6) * S
+    st = state
+    for _ in range(20):
+        st, c = check_chain_vs_serial(st, now, k, cd)
+        if c == 0:
+            now += rng.randint(1, 5) * S
+
+
+def test_chain_epoch_matches_batches():
+    """scan_chain_epoch must produce exactly the same unit stream as
+    repeated speculate_chain_batch calls."""
+    from dmclock_tpu.engine.fastpath import (scan_chain_epoch,
+                                             speculate_chain_batch)
+
+    state, now = mixed_qos_state(n=8, depth=8)
+    m, k, cd = 6, 10, 3
+    ep = scan_chain_epoch(state, jnp.int64(now), m, k, chain_depth=cd,
+                          anticipation_ns=0)
+    st = state
+    for i in range(m):
+        batch = speculate_chain_batch(st, jnp.int64(now), k,
+                                      chain_depth=cd,
+                                      anticipation_ns=0)
+        assert int(batch.count) == int(jax.device_get(ep.count)[i])
+        assert int(batch.unit_count) == \
+            int(jax.device_get(ep.unit_count)[i])
+        assert np.array_equal(jax.device_get(batch.slot),
+                              jax.device_get(ep.slot)[i])
+        assert np.array_equal(jax.device_get(batch.length),
+                              jax.device_get(ep.length)[i])
+        st = batch.state
+    assert_states_equal(ep.state, st)
+
+
+# ----------------------------------------------------------------------
+# max_count capping (flat batches)
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("cap", [0, 1, 3, 7, 20])
+def test_max_count_prefix_of_prefix(cap):
+    """max_count=c yields exactly the first c decisions and the same
+    state as a serial run of c steps -- a shorter prefix of an exact
+    prefix is still exact, including the capped promote-parity
+    exclusion of the last popped head."""
+    infos = {c: ClientInfo(1, 1 + c % 3, 3.0 + (c % 2)) for c in
+             range(6)}
+    state = deep_state(infos, depth=5)
+    now = 6 * S
+    full = speculate_prefix_batch(state, jnp.int64(now), 16,
+                                  anticipation_ns=0)
+    capped = speculate_prefix_batch(state, jnp.int64(now), 16,
+                                    anticipation_ns=0, max_count=cap)
+    expect = min(cap, int(full.count))
+    assert int(capped.count) == expect
+    fd = jax.device_get(capped.decisions)
+    if expect:
+        ser_state, ser_decs = serial_run(state, now, expect)
+        assert np.array_equal(fd.slot[:expect], ser_decs.slot)
+        assert_states_equal(capped.state, ser_state)
+    else:
+        assert_states_equal(capped.state, state)
+    assert (fd.slot[expect:] == -1).all()
+
+
+# ----------------------------------------------------------------------
+# AtLimit::Allow (limit-break) on the fast path
+# ----------------------------------------------------------------------
+
+def limited_state(depth=6, n=8):
+    """Everyone weight>0 with tight limits: the Allow fallback fires
+    once limits are exhausted at ``now``."""
+    infos = {c: ClientInfo(0.5 if c % 2 else 0, 1 + c % 3,
+                           2.0 + (c % 2)) for c in range(n)}
+    return deep_state(infos, depth=depth)
+
+
+@pytest.mark.parametrize("chain_depth", [1, 3])
+def test_allow_limit_break_exact(chain_depth):
+    """Allow mode: the committed stream (including limit_break flags
+    and the induced constraint serves) must replay the serial engine
+    under allow_limit_break=True, bit-exact, to exhaustion."""
+    from dmclock_tpu.engine.fastpath import CLS_LB
+
+    state = limited_state()
+    now = 2 * S
+    st = state
+    total, any_lb = 0, False
+    for _ in range(120):
+        st, c, batch = check_chain_vs_serial(st, now, 16, chain_depth,
+                                             allow=True,
+                                             return_batch=True)
+        if c == 0:
+            break
+        any_lb |= bool((jax.device_get(batch.cls)[:int(
+            batch.unit_count)] >= CLS_LB).any())
+        total += c
+    assert total == 8 * 6, f"Allow run served {total}"
+    assert int(jnp.max(st.depth)) == 0
+    assert any_lb, "Allow drive never produced a limit-break unit"
+
+
+def test_allow_flat_batch_flags_match_serial():
+    """Flat Allow batches: limit_break flags per decision equal the
+    serial engine's, and the drive reaches actual limit-breaks."""
+    st = limited_state(depth=4)
+    now = 3 * S
+    any_lb = False
+    for _ in range(40):
+        batch = speculate_prefix_batch(st, jnp.int64(now), 32,
+                                       anticipation_ns=0,
+                                       allow_limit_break=True)
+        assert bool(batch.guards_ok)
+        c = int(batch.count)
+        if c == 0:
+            break
+        ser_state, ser_decs = serial_run_lb(st, now, c, True)
+        fd = jax.device_get(batch.decisions)
+        assert np.array_equal(fd.slot[:c], ser_decs.slot)
+        assert np.array_equal(fd.limit_break[:c], ser_decs.limit_break)
+        assert np.array_equal(fd.phase[:c], ser_decs.phase)
+        assert_states_equal(batch.state, ser_state)
+        any_lb |= bool(fd.limit_break[:c].any())
+        st = batch.state
+    assert int(jnp.max(st.depth)) == 0, "Allow drive never drained"
+    assert any_lb, "Allow drive never limit-broke"
+
+
+@pytest.mark.parametrize("seed", [51, 52, 53])
+def test_fuzz_allow_matches_serial(seed):
+    """Random limited populations (weight > 0 everywhere, the Allow
+    fastpath restriction): chained Allow batches replay serially."""
+    rng = random.Random(seed)
+    n = rng.randint(3, 12)
+    infos = {c: ClientInfo(rng.choice([0, 0.5, 1.0]),
+                           rng.uniform(0.5, 3),
+                           rng.choice([0, 2.0, 4.0]))
+             for c in range(n)}
+    state = deep_state(infos, depth=rng.randint(2, 8), capacity=16)
+    now = rng.randint(1, 8) * S
+    st = state
+    for _ in range(15):
+        st, c = check_chain_vs_serial(st, now, 8,
+                                      rng.choice([1, 2, 4]),
+                                      allow=True)
+        if c == 0:
+            now += rng.randint(1, 4) * S
 
 
 def test_anticipation_prefix_differential():
