@@ -919,3 +919,341 @@ def make_prefix_runner(k: int, *, anticipation_ns: int = 0,
         return batch.state, batch.decisions, int(batch.count)
 
     return run
+
+
+# ----------------------------------------------------------------------
+# calendar commit: sortless window batches
+# ----------------------------------------------------------------------
+#
+# The sort-based prefix batch tops out when re-entries undercut the
+# sorted tail: a Zipf weight-64 client re-enters every 2*winv_64 ns of
+# proportion-tag space, so a single sort commits only the entries
+# inside that window (~2.5k of 100k at the cfg4 steady state).  The
+# calendar batch removes the sort entirely, from two structural facts:
+#
+#  1. The serial engine's SERVED unified keys are nondecreasing: it
+#     always serves the global minimum, and a serve's re-entry key is
+#     above its entry key (per-client tags are monotone under serves;
+#     the one exception -- a weight serve's reservation-debt reduction
+#     dropping the client into class 0 -- is absorbed into the serving
+#     UNIT exactly as in the chained batches, and unit ENTRY keys are
+#     nondecreasing per client, enforced below).
+#  2. Therefore, for ANY boundary B, the set {serves whose unit entry
+#     key < B} is exactly a prefix of the serial order -- computable
+#     PER CLIENT by iterating its own tag recurrence, independent of
+#     every other client.
+#
+# A client that cannot be followed past some point (serve-step budget
+# exhausted, a unit's induced-serve chain cut mid-way, a non-monotone
+# next entry) contributes its first unfollowable entry key as a STOP;
+# B_eff = min over stops, and two dense passes (measure stops, then
+# commit gated on B_eff) yield up to `steps` decisions per client per
+# batch with no [k] cap and no 32-bit rebase guards (keys pack into a
+# 58-bit per-class window that never clamps in practice; clamping is
+# monotone and therefore only conservative).  The batch emits
+# per-client counts, not an ordered stream: the committed SET plus the
+# final state is exact (differentially pinned vs the serial engine);
+# callers needing the ordered stream use the sort-based batches.
+
+_CAL_BIAS = jnp.int64(1) << 57
+_CAL_MASK = (jnp.int64(1) << 58) - 1
+
+
+class CalendarBatch(NamedTuple):
+    """Result of one calendar-commit batch."""
+
+    state: EngineState
+    count: jnp.ndarray        # int32 committed decisions
+    resv_count: jnp.ndarray   # int32 constraint-phase decisions
+    units: jnp.ndarray        # int32[N] committed units per client
+    served: jnp.ndarray       # int32[N] committed decisions per client
+    served_resv: jnp.ndarray  # int32[N] constraint decisions
+    lb: jnp.ndarray           # int32[N] limit-break entries (Allow)
+    progress_ok: jnp.ndarray  # bool: count>0 or no candidate existed
+
+
+def _cal_pack(cls, key, kresv, kprop1, kprop2):
+    origin = jnp.where(cls == CLS_RESV, kresv,
+                       jnp.where(cls == CLS_WEIGHT, kprop1, kprop2))
+    rel = jnp.clip(key - origin + _CAL_BIAS, 0, _CAL_MASK)
+    return jnp.where(cls == CLS_NONE, jnp.int64(KEY_INF),
+                     (cls.astype(jnp.int64) << 58) | rel)
+
+
+def _calendar_pass(state: EngineState, now, arr_rows, cost_rows,
+                   allow: bool, anticipation_ns: int,
+                   kresv, kprop1, kprop2, b_eff):
+    """One dense pass of per-client serve iteration, as a lax.scan
+    over the step axis (an unrolled step loop at steps=32 exploded
+    compile time through the remote compiler).
+
+    With ``b_eff`` None: measure mode -- serve everything followable
+    and return the per-client STOP pack (KEY_INF when the client ran
+    out of work).  With ``b_eff`` a scalar: commit mode -- serves gate
+    on the unit entry pack being strictly below it; returns the final
+    dense state fields and the per-client counters.
+
+    Readiness is classified as ``limit <= now`` at every step: a
+    stored ready flag implies it under the monotonic-now restriction
+    (promotion happened at some now' <= now with limit <= now', and
+    pops clear the flag), so the stored bit adds nothing here."""
+    n = state.capacity
+
+    carry0 = dict(
+        h_resv=state.head_resv, h_prop=state.head_prop,
+        h_limit=state.head_limit, h_arr=state.head_arrival,
+        h_cost=state.head_cost, h_rho=state.head_rho,
+        p_resv=state.prev_resv, p_prop=state.prev_prop,
+        p_limit=state.prev_limit, p_arr=state.prev_arrival,
+        depth=state.depth,
+        qadv=jnp.zeros_like(state.q_head),
+        alive=jnp.ones((n,), dtype=bool),
+        in_unit=jnp.zeros((n,), dtype=bool),
+        stop_pk=jnp.full((n,), jnp.int64(KEY_INF)),
+        prev_pk=jnp.full((n,), jnp.int64(-1)),
+        unit_cls=jnp.zeros((n,), dtype=jnp.int32),
+        units=jnp.zeros((n,), dtype=jnp.int32),
+        served=jnp.zeros((n,), dtype=jnp.int32),
+        served_resv=jnp.zeros((n,), dtype=jnp.int32),
+        lb=jnp.zeros((n,), dtype=jnp.int32),
+    )
+
+    def step(c, row):
+        narr, ncost = row
+        has = state.active & (c["depth"] > 0)
+        cls, key = _unified_class(
+            now, has, c["h_resv"], c["h_limit"] <= now, c["h_prop"],
+            c["h_prop"] + state.prop_delta, allow)
+        pk = _cal_pack(cls, key, kresv, kprop1, kprop2)
+
+        at_boundary = ~c["in_unit"]
+        cand = cls != CLS_NONE
+        alive = c["alive"]
+        nonmono = alive & at_boundary & cand & (pk < c["prev_pk"])
+        stop_pk = c["stop_pk"]
+        if b_eff is None:
+            stop_pk = jnp.where(
+                nonmono, jnp.minimum(stop_pk, c["prev_pk"]), stop_pk)
+        alive = alive & ~(at_boundary & (~cand | nonmono))
+        start = alive & at_boundary & cand
+        if b_eff is not None:
+            start = start & (pk < b_eff)
+            alive = alive & ~(at_boundary & ~start)
+
+        serve = start | (c["in_unit"] & alive)
+        phase1 = start & (cls >= CLS_WEIGHT)
+
+        nr, np_, nl = _make_tag(
+            c["h_resv"], c["h_prop"], c["h_limit"], c["h_arr"],
+            state.resv_inv, state.weight_inv, state.limit_inv,
+            state.cur_delta, state.cur_rho, narr, ncost,
+            anticipation_ns)
+        off = jnp.where(phase1,
+                        state.resv_inv * (c["h_cost"] + c["h_rho"]),
+                        jnp.zeros_like(c["h_resv"]))
+        new_depth = c["depth"] - 1
+        has_more = new_depth > 0
+        upd = serve
+        updh = serve & has_more
+        new_h_resv = nr - off
+        pr = jnp.where(has_more, _fold_prev(c["p_resv"], nr),
+                       c["p_resv"]) - off
+        pp = jnp.where(has_more, _fold_prev(c["p_prop"], np_),
+                       c["p_prop"])
+        pl_ = jnp.where(has_more, _fold_prev(c["p_limit"], nl),
+                        c["p_limit"])
+
+        chains_cls = (cls == CLS_WEIGHT) | (cls == CLS_LB)
+        unit_cls = jnp.where(start, cls, c["unit_cls"])
+        cont_cls = (unit_cls == CLS_WEIGHT) | (unit_cls == CLS_LB)
+
+        new = dict(
+            h_resv=jnp.where(updh, new_h_resv, c["h_resv"]),
+            h_prop=jnp.where(updh, np_, c["h_prop"]),
+            h_limit=jnp.where(updh, nl, c["h_limit"]),
+            h_arr=jnp.where(updh, narr, c["h_arr"]),
+            h_cost=jnp.where(updh, ncost, c["h_cost"]),
+            h_rho=jnp.where(updh, state.cur_rho, c["h_rho"]),
+            p_resv=jnp.where(upd, pr, c["p_resv"]),
+            p_prop=jnp.where(upd, pp, c["p_prop"]),
+            p_limit=jnp.where(upd, pl_, c["p_limit"]),
+            p_arr=jnp.where(updh, narr, c["p_arr"]),
+            depth=jnp.where(upd, new_depth,
+                            c["depth"]).astype(jnp.int32),
+            qadv=(c["qadv"] + updh).astype(jnp.int32),
+            alive=alive,
+            in_unit=serve & cont_cls & has_more & (new_h_resv <= now),
+            stop_pk=stop_pk,
+            prev_pk=jnp.where(start, pk, c["prev_pk"]),
+            unit_cls=unit_cls,
+            units=c["units"] + start,
+            served=c["served"] + serve,
+            served_resv=c["served_resv"]
+            + ((start & (cls == CLS_RESV)) | (serve & c["in_unit"])),
+            lb=c["lb"] + (start & (cls >= CLS_LB)),
+        )
+        return new, None
+
+    rows = (jnp.stack(arr_rows), jnp.stack(cost_rows))
+    c, _ = lax.scan(step, carry0, rows)
+
+    if b_eff is None:
+        # post-loop stops: a chain still mid-unit cannot be followed
+        # (exclude its whole unit); an alive client at a unit boundary
+        # stops at its NEXT entry key.
+        stop_pk = jnp.where(c["in_unit"],
+                            jnp.minimum(c["stop_pk"], c["prev_pk"]),
+                            c["stop_pk"])
+        has = state.active & (c["depth"] > 0)
+        cls, key = _unified_class(
+            now, has, c["h_resv"], c["h_limit"] <= now, c["h_prop"],
+            c["h_prop"] + state.prop_delta, allow)
+        pk = _cal_pack(cls, key, kresv, kprop1, kprop2)
+        boundary_stop = c["alive"] & ~c["in_unit"] & (cls != CLS_NONE)
+        nonmono_next = boundary_stop & (pk < c["prev_pk"])
+        stop_pk = jnp.where(
+            boundary_stop,
+            jnp.minimum(stop_pk,
+                        jnp.where(nonmono_next, c["prev_pk"], pk)),
+            stop_pk)
+        return stop_pk
+
+    fields = dict(head_resv=c["h_resv"], head_prop=c["h_prop"],
+                  head_limit=c["h_limit"], head_arrival=c["h_arr"],
+                  head_cost=c["h_cost"], head_rho=c["h_rho"],
+                  prev_resv=c["p_resv"], prev_prop=c["p_prop"],
+                  prev_limit=c["p_limit"], prev_arrival=c["p_arr"],
+                  depth=c["depth"])
+    return (fields, c["qadv"], c["units"], c["served"],
+            c["served_resv"], c["lb"], c["prev_pk"], c["unit_cls"])
+
+
+def calendar_batch(state: EngineState, now, *, steps: int,
+                   anticipation_ns: int = 0,
+                   allow_limit_break: bool = False,
+                   heads=None) -> CalendarBatch:
+    """One calendar-commit batch: up to ``steps`` decisions PER CLIENT
+    in two dense elementwise passes, no sort (see section comment).
+
+    The committed set is exactly the serial engine's next ``count``
+    decisions (differentially pinned by tests/test_prefix.py's
+    calendar suite); the emission is per-client counts + final state.
+    ``progress_ok`` False (count 0 with candidates present) happens
+    only when the very first serial unit is unfollowable (its induced
+    chain exceeds ``steps``): fall back to the serial engine."""
+    assert steps <= state.ring_capacity, \
+        "calendar steps exceed the ring window"
+    if heads is None:
+        win = ring_window(state, steps)
+        heads = (win.arr, win.cost)
+    arr_rows, cost_rows = _heads_rows(heads, steps)
+
+    cls0, key0 = _classify(state, now, allow_limit_break)
+    kresv = jnp.min(jnp.where(cls0 == CLS_RESV, key0, KEY_INF))
+    kprop1 = jnp.min(jnp.where(cls0 == CLS_WEIGHT, key0, KEY_INF))
+    kprop2 = jnp.min(jnp.where(cls0 == CLS_LB, key0, KEY_INF))
+    any_cand = jnp.any(cls0 != CLS_NONE)
+
+    stop_pk = _calendar_pass(state, now, arr_rows, cost_rows,
+                             allow_limit_break, anticipation_ns,
+                             kresv, kprop1, kprop2, None)
+    b_eff = jnp.min(stop_pk)
+    (fields, qadv, units, served, served_resv, lb, last_pk,
+     last_cls) = _calendar_pass(state, now, arr_rows, cost_rows,
+                                allow_limit_break, anticipation_ns,
+                                kresv, kprop1, kprop2, b_eff)
+
+    did = served > 0
+    popped = did & (qadv > 0)
+
+    def pick(pred, new, old):
+        return jnp.where(pred, new, old)
+
+    new_state = state._replace(
+        depth=pick(did, fields["depth"], state.depth),
+        q_head=pick(popped,
+                    (state.q_head + qadv) % state.ring_capacity,
+                    state.q_head).astype(jnp.int32),
+        head_resv=pick(popped, fields["head_resv"], state.head_resv),
+        head_prop=pick(popped, fields["head_prop"], state.head_prop),
+        head_limit=pick(popped, fields["head_limit"],
+                        state.head_limit),
+        head_arrival=pick(popped, fields["head_arrival"],
+                          state.head_arrival),
+        head_cost=pick(popped, fields["head_cost"], state.head_cost),
+        head_rho=pick(popped, fields["head_rho"], state.head_rho),
+        head_ready=state.head_ready & ~did,
+        prev_resv=pick(did, fields["prev_resv"], state.prev_resv),
+        prev_prop=pick(did, fields["prev_prop"], state.prev_prop),
+        prev_limit=pick(did, fields["prev_limit"], state.prev_limit),
+        prev_arrival=pick(popped, fields["prev_arrival"],
+                          state.prev_arrival),
+    )
+
+    # stored-flag parity (promote loop): the batch's LAST serial
+    # decision is the unit with the max entry pack (ties by creation
+    # order); if its class is >= 1, its entry ran the final promote
+    # pass, whose only unseen head is the one that unit's own chain
+    # popped into place.
+    lp = jnp.where(did, last_pk, jnp.int64(-1))
+    maxpk = jnp.max(lp)
+    tied = did & (lp == maxpk)
+    excl = jnp.argmax(jnp.where(tied, state.order,
+                                jnp.int64(-1))).astype(jnp.int32)
+    cls_last = jnp.max(jnp.where(tied, last_cls, -1))
+    do_promote = jnp.any(did) & (cls_last >= CLS_WEIGHT)
+    has_req_after = new_state.active & (new_state.depth > 0)
+    promoted = new_state.head_ready | \
+        (has_req_after & (new_state.head_limit <= now))
+    promoted = promoted & (
+        jnp.arange(state.capacity, dtype=jnp.int32) != excl)
+    new_state = new_state._replace(head_ready=jnp.where(
+        do_promote, promoted, new_state.head_ready))
+
+    count = jnp.sum(served).astype(jnp.int32)
+    return CalendarBatch(
+        state=new_state, count=count,
+        resv_count=jnp.sum(served_resv).astype(jnp.int32),
+        units=units, served=served, served_resv=served_resv, lb=lb,
+        progress_ok=(count > 0) | ~any_cand)
+
+
+class CalendarEpoch(NamedTuple):
+    """M calendar batches' output, compact for one readback."""
+
+    state: EngineState
+    count: jnp.ndarray        # int32[M] decisions per batch
+    resv_count: jnp.ndarray   # int32[M]
+    progress_ok: jnp.ndarray  # bool[M]
+    served: jnp.ndarray       # int32[N] per-client decisions (whole
+    #                           epoch; calibration feed)
+
+
+def scan_calendar_epoch(state: EngineState, now, m: int, *,
+                        steps: int, anticipation_ns: int = 0,
+                        allow_limit_break: bool = False,
+                        use_pallas: bool | None = None
+                        ) -> CalendarEpoch:
+    """Run m calendar batches on device (each prefetches its own
+    ``steps``-row ring window)."""
+    invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
+    mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+    served0 = jnp.zeros((state.capacity,), dtype=jnp.int32)
+
+    def body(carry, _):
+        mut, acc = carry
+        st = EngineState(**invariant, **mut)
+        win = ring_window(st, steps, use_pallas=use_pallas)
+        batch = calendar_batch(st, now, steps=steps,
+                               anticipation_ns=anticipation_ns,
+                               allow_limit_break=allow_limit_break,
+                               heads=(win.arr, win.cost))
+        out = (batch.count, batch.resv_count, batch.progress_ok)
+        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
+        return (new_mut, acc + batch.served), out
+
+    (mutable, served), (count, resv, ok) = lax.scan(
+        body, (mutable0, served0), None, length=m)
+    state = EngineState(**invariant, **mutable)
+    return CalendarEpoch(state=state, count=count, resv_count=resv,
+                         progress_ok=ok, served=served)

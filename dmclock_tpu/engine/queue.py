@@ -10,13 +10,23 @@ bookkeeping.  Everything per-request-hot runs on device.
 
 Restrictions vs the oracle (by design, documented):
 - DelayedTagCalc only -- the head-only device representation *is* the
-  delayed optimization (reference :277-280).  Consequently
-  AtLimit::Reject (which the reference asserts incompatible with
-  delayed calc, :856-857) is not offered here; use the oracle queue.
+  delayed optimization (reference :277-280).
+- AtLimit::Reject IS offered, as a hybrid the reference cannot express
+  (it asserts Reject incompatible with delayed calc, :856-857, because
+  a delayed queue has no limit tag at add time): the host keeps an
+  IMMEDIATE-mode mirror of the limit axis -- prev_limit/prev_arrival
+  evolve only on adds (accepted or rejected both advance them, the
+  reference's pinned behavior, :989-993), never on serves, so the
+  per-client scalar recurrence is exactly computable host-side with
+  ``core.tags.tag_calc`` and EAGAIN returns synchronously with no
+  device round-trip.  Admission decisions are bit-identical to the
+  oracle's immediate-mode Reject queue; scheduling of admitted
+  requests stays delayed-tagged on device.
 """
 
 from __future__ import annotations
 
+import errno
 import functools
 import threading
 import time as _walltime
@@ -30,7 +40,8 @@ import numpy as np
 from ..core.qos import ClientInfo
 from ..core.recs import Phase, ReqParams
 from ..core.scheduler import AtLimit, NextReqType, PullReq
-from ..core.timebase import sec_to_ns
+from ..core.tags import tag_calc
+from ..core.timebase import MAX_TAG, MIN_TAG, sec_to_ns
 from . import kernels
 from .kernels import (OP_ADD, OP_CREATE, OP_NOP, FUTURE, NONE, RETURNING,
                       IngestOps)
@@ -136,7 +147,7 @@ class TpuPullPriorityQueue:
     def __init__(self,
                  client_info_f: ClientInfoFunc,
                  *,
-                 at_limit: AtLimit = AtLimit.WAIT,
+                 at_limit=AtLimit.WAIT,
                  anticipation_timeout_ns: int = 0,
                  # initial sizes only -- both grow by doubling on
                  # demand.  Small defaults matter: every launch is a
@@ -162,11 +173,23 @@ class TpuPullPriorityQueue:
                  _walltime.monotonic):
         assert delayed_tag_calc, \
             "the TPU engine is DelayedTagCalc by construction"
-        assert at_limit in (AtLimit.WAIT, AtLimit.ALLOW), \
-            "AtLimit.REJECT needs immediate tags; use the oracle queue"
+        # a bare number passed for at_limit is a RejectThreshold and
+        # implies AtLimit.Reject (reference AtLimitParam :89-93,
+        # :829-846); admission runs on the host's immediate-mode limit
+        # mirror (module docstring)
+        if isinstance(at_limit, AtLimit):
+            self.at_limit = at_limit
+            self.reject_threshold_ns = 0
+        else:
+            self.at_limit = AtLimit.REJECT
+            self.reject_threshold_ns = int(at_limit)
         self.client_info_f = client_info_f
-        self.at_limit = at_limit
         self.anticipation_timeout_ns = int(anticipation_timeout_ns)
+        # host immediate-mode limit mirror (REJECT admission):
+        # slot -> (prev_limit, prev_arrival, limit_inv, info cache)
+        self._lim_prev: Dict[int, int] = {}
+        self._lim_prev_arr: Dict[int, int] = {}
+        self._lim_inv: Dict[int, int] = {}
 
         self.data_mtx = threading.Lock()
         self.state: EngineState = init_state(capacity, ring_capacity)
@@ -337,6 +360,33 @@ class TpuPullPriorityQueue:
                      info.reservation_inv_ns, info.weight_inv_ns,
                      info.limit_inv_ns, self._next_order))
                 self._next_order += 1
+                self._lim_inv[slot] = info.limit_inv_ns
+                self._lim_prev[slot] = 0
+                self._lim_prev_arr[slot] = 0
+            if self.at_limit is AtLimit.REJECT:
+                # host immediate-mode limit mirror (module docstring):
+                # the axis recurrence depends only on add-time inputs,
+                # and a rejected add still advances it (the reference
+                # computes the tag -- mutating prev -- before the
+                # reject check, pinned by test_reject_at_limit).
+                # Known divergence: the reference un-idles a client on
+                # a REJECTED add (its reactivation runs before the
+                # check, :937-985 vs :989-993); here the device sees
+                # no op, so reactivation waits for the next accepted
+                # add.
+                ant = self.anticipation_timeout_ns
+                pa = self._lim_prev_arr[slot]
+                t_eff = time_ns - ant if ant and (time_ns - ant) < pa \
+                    else time_ns
+                lim = tag_calc(t_eff, self._lim_prev[slot],
+                               self._lim_inv[slot], req_params.delta,
+                               False, cost)
+                if lim != MAX_TAG and lim != MIN_TAG:
+                    self._lim_prev[slot] = lim
+                self._lim_prev_arr[slot] = time_ns
+                self._last_tick[slot] = self.tick
+                if lim > time_ns + self.reject_threshold_ns:
+                    return errno.EAGAIN
             if len(self._payloads[slot]) >= self.state.ring_capacity:
                 self._grow_ring()
             self._payloads[slot].append((request, time_ns, cost))

@@ -491,6 +491,10 @@ class PriorityQueueBase {
 
     if (opt_.at_limit == AtLimit::Reject &&
         tag.limit > time_ns + opt_.reject_threshold_ns) {
+      // the rejected add still advanced prev_tag (initial_tag ->
+      // update_req_tag, the reference's pinned behavior), which is a
+      // prop-heap key for clients with no queued request
+      if (opt_.use_prop_heap) prop_heap_.adjust(*rec);
       return EAGAIN;  // without taking ownership (reference :989-993)
     }
 

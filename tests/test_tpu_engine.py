@@ -446,3 +446,87 @@ def test_ingest_wave_matches_sequential_scan():
                                       anticipation_ns=0)
         seq_state = wave_state = st
         t += 10**9
+
+
+# ----------------------------------------------------------------------
+# AtLimit::Reject -- host immediate-mode limit mirror
+# ----------------------------------------------------------------------
+
+class TestTpuReject:
+    """The TPU queue's Reject admission must be bit-identical to the
+    oracle's immediate-mode Reject queue (the reference cannot even
+    express Reject+delayed; here admission runs on a host mirror of
+    the immediate limit recurrence, queue.py module docstring)."""
+
+    def test_reject_at_limit(self):
+        import errno
+        q = TpuPullPriorityQueue(lambda c: ClientInfo(0, 1, 1),
+                                 at_limit=AtLimit.REJECT)
+        assert q.add_request("a", 52, ReqParams(), time_ns=1 * S) == 0
+        assert q.add_request("b", 52, ReqParams(), time_ns=2 * S) == 0
+        assert q.add_request("c", 52, ReqParams(), time_ns=3 * S) == 0
+        assert q.add_request("d", 52, ReqParams(),
+                             time_ns=int(3.9 * S)) == errno.EAGAIN
+        # the rejected request still advanced the limit mirror
+        assert q.add_request("e", 52, ReqParams(),
+                             time_ns=4 * S) == errno.EAGAIN
+        assert q.add_request("f", 52, ReqParams(), time_ns=6 * S) == 0
+        # admitted requests actually get served
+        served = 0
+        for _ in range(8):
+            pr = q.pull_request(now_ns=100 * S)
+            if pr.type is not NextReqType.RETURNING:
+                break
+            served += 1
+        assert served == 4
+
+    def test_reject_threshold_number_implies_reject(self):
+        import errno
+        q = TpuPullPriorityQueue(lambda c: ClientInfo(0, 1, 1),
+                                 at_limit=3 * S)
+        assert q.at_limit is AtLimit.REJECT
+        assert q.reject_threshold_ns == 3 * S
+        for _ in range(4):
+            assert q.add_request("x", 52, ReqParams(),
+                                 time_ns=1 * S) == 0
+        assert q.add_request("x", 52, ReqParams(),
+                             time_ns=1 * S) == errno.EAGAIN
+        assert q.add_request("x", 52, ReqParams(), time_ns=3 * S) == 0
+
+    @pytest.mark.parametrize("seed", [5, 6, 7])
+    @pytest.mark.parametrize("threshold_s", [0, 2])
+    def test_reject_admission_matches_oracle(self, seed, threshold_s):
+        """Random add sequences: the EAGAIN pattern must equal the
+        oracle immediate-mode queue's, add for add."""
+        rng = random.Random(seed)
+        infos = {c: ClientInfo(0, 1.0 + c % 2,
+                               rng.choice([0.5, 1.0, 2.0]))
+                 for c in range(6)}
+        at = AtLimit.REJECT if threshold_s == 0 else threshold_s * S
+
+        oracle = PullPriorityQueue(lambda c: infos[c],
+                                   delayed_tag_calc=False,
+                                   at_limit=at, run_gc_thread=False)
+        tpu = TpuPullPriorityQueue(lambda c: infos[c], at_limit=at)
+        t = 1 * S
+        outcomes = []
+        for i in range(200):
+            c = rng.randrange(6)
+            t += rng.randint(0, S // 3)
+            delta = rng.randint(1, 3)
+            rho = rng.randint(1, delta)
+            cost = rng.randint(1, 2)
+            ro = oracle.add_request(("r", i), c, ReqParams(delta, rho),
+                                    time_ns=t, cost=cost)
+            rt = tpu.add_request(("r", i), c, ReqParams(delta, rho),
+                                 time_ns=t, cost=cost)
+            assert ro == rt, \
+                f"add {i} (t={t}): oracle {ro} vs tpu {rt}"
+            outcomes.append(ro)
+            # occasional pulls: serves must not perturb admission
+            # (the immediate limit recurrence is add-only)
+            if rng.random() < 0.2:
+                oracle.pull_request(now_ns=t)
+                tpu.pull_request(now_ns=t)
+        assert any(o != 0 for o in outcomes), "no rejects exercised"
+        assert any(o == 0 for o in outcomes)
