@@ -518,15 +518,102 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     return out
 
 
+def bench_frontier(points=((2, 64), (3, 64), (6, 64), (12, 64)), *,
+                   n: int = 100_000, dt_round_ns: int = 50_000_000,
+                   target_latency_ms: float = 0.0):
+    """Throughput/latency frontier for the cfg4 calendar workload.
+
+    A decision's latency is bounded by the round it rides in, and the
+    round's device time scales with its batch count m -- so sweeping m
+    at fixed per-batch depth traces the frontier.  Each point reports
+    the differenced-chain dec/s, the device-side mean round time, and
+    windowed per-round completion-interval percentiles (device-bound
+    once W rounds in flight amortize the ~110ms tunnel round-trip; the
+    floor of the method is RTT/W per interval).
+
+    With ``target_latency_ms`` the sweep instead returns the
+    highest-throughput point whose device-side mean round time fits
+    the budget (the --target-latency mode).
+    """
+    rows = []
+    for m, steps in points:
+        r = bench_sustained(
+            n, 0, m, 24, zipf=True, resv_rate=1200.0,
+            dt_round_ns=dt_round_ns, waves=64, rounds_lo=8,
+            latency_rounds=60, calendar_steps=steps,
+            target_resv_share=0.5, reps=2)
+        rows.append({"m": m, "steps": steps,
+                     "dps": r["dps"],
+                     "round_ms_mean": r.get("round_ms_mean", 0.0),
+                     "round_ms_p50": r.get("round_ms_p50", 0.0),
+                     "round_ms_p99": r.get("round_ms_p99", 0.0),
+                     "resv_phase_frac": r["resv_phase_frac"],
+                     "decisions": r["decisions"]})
+        import sys
+        print(f"# frontier m={m} steps={steps}: "
+              f"{r['dps']/1e6:.1f}M dec/s, round mean "
+              f"{r.get('round_ms_mean', 0):.1f}ms, interval p99 "
+              f"{r.get('round_ms_p99', 0):.1f}ms", file=sys.stderr)
+    if target_latency_ms:
+        # an operating point only counts if it holds the workload's
+        # defining 0.50 constraint share (+-0.1): a resv-saturated or
+        # off-mix point's throughput is a different workload's number
+        fits = [x for x in rows
+                if x["round_ms_mean"] <= target_latency_ms
+                and abs(x["resv_phase_frac"] - 0.5) <= 0.1]
+        pick = max(fits, key=lambda x: x["dps"]) if fits else \
+            min((x for x in rows
+                 if abs(x["resv_phase_frac"] - 0.5) <= 0.1),
+                key=lambda x: x["round_ms_mean"], default=rows[0])
+        pick = dict(pick)
+        pick["met_budget"] = bool(fits)
+        return pick, rows
+    return None, rows
+
+
 def main() -> None:
     import argparse
     import contextlib
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--profile", metavar="DIR", default=None)
-    ap.add_argument("--mode", choices=["all", "serve", "cfg3", "cfg4"],
+    ap.add_argument("--mode",
+                    choices=["all", "serve", "cfg3", "cfg4",
+                             "frontier"],
                     default="all")
+    ap.add_argument("--target-latency", type=float, default=0.0,
+                    metavar="MS",
+                    help="pick the fastest cfg4 operating point whose "
+                         "device-side mean round time fits this "
+                         "budget; implies --mode frontier")
     args = ap.parse_args()
+    if args.target_latency:
+        args.mode = "frontier"
+
+    if args.mode == "frontier":
+        import sys
+        pick, rows = bench_frontier(
+            target_latency_ms=args.target_latency)
+        out = {"metric": "cfg4 throughput/latency frontier "
+                         "(calendar engine; device-side round mean + "
+                         "windowed completion-interval percentiles)",
+               "rows": rows}
+        if pick is not None:
+            out["picked"] = pick
+            out["metric"] += (f"; --target-latency "
+                              f"{args.target_latency}ms pick: "
+                              f"m={pick['m']} "
+                              f"{pick['dps']/1e6:.1f}M dec/s at "
+                              f"{pick['round_ms_mean']:.1f}ms rounds"
+                              + ("" if pick["met_budget"] else
+                                 " (budget NOT met; closest point)"))
+        print(json.dumps(out))
+        try:
+            _record_history({"frontier_" + str(r["m"]): r
+                             for r in rows})
+        except OSError:
+            pass
+        return
     trace_ctx = (jax.profiler.trace(args.profile) if args.profile
                  else contextlib.nullcontext())
 
@@ -548,14 +635,17 @@ def main() -> None:
             # (constraint share auto-calibrated to 0.50 -- a faster
             # engine needs a proportionally larger floor for the same
             # phase mix; round-5 equilibrium lands near 1200/s/client).
-            # Calendar engine: m=12 batches x 64 serve-steps/client
-            # covers the Zipf heavy tail's per-round demand; waves=64
-            # lets the load generator offer ~60 arrivals/client/round.
+            # Calendar engine, m=3 batches x 64 serve-steps/client:
+            # the frontier sweep showed decisions/round are capped by
+            # the load generator (waves=64 ~ 5.8M arrivals/round), so
+            # the smallest m whose per-client budget covers the
+            # per-round arrival cap (192 >= 63) is strictly fastest
+            # (m=12 commits the same decisions in 4x the passes).
             results["cfg4"] = bench_sustained(
-                100_000, 0, 12, 24, zipf=True,
+                100_000, 0, 3, 40, zipf=True,
                 resv_rate=1200.0, dt_round_ns=50_000_000,
-                waves=64, rounds_lo=8, latency_rounds=100,
-                calendar_steps=64, target_resv_share=0.5)
+                waves=64, rounds_lo=12, latency_rounds=100,
+                calendar_steps=64, target_resv_share=0.5, reps=4)
 
     c4 = results.get("cfg4")
     primary = c4 or results.get("cfg3") or results["serve"]
@@ -587,9 +677,10 @@ def main() -> None:
     print(json.dumps({
         "metric": "dmclock sustained scheduling decisions/sec, "
                   "ARRIVALS INCLUDED (Poisson superwave ingest on "
-                  "device each round; prefix-commit epochs, bit-exact "
-                  "vs serial engine; decision stream in HBM, counts "
-                  "read back untimed) -- " + "; ".join(parts),
+                  "device each round; cfg4 on the sortless calendar "
+                  "engine, serve/cfg3 on the sorted prefix engine, "
+                  "both bit-exact vs the serial engine; counts read "
+                  "back untimed) -- " + "; ".join(parts),
         "value": round(primary["dps"], 1),
         "unit": "decisions/sec/chip",
         "vs_baseline": round(primary["dps"] / 10_000_000, 4),

@@ -443,6 +443,133 @@ def device_sim_headline():
     return row
 
 
+def tpu_calendar_sweep():
+    """Round-5 calendar engine rows: serve-only drain throughput by
+    (m, steps) over the 100k-client weight steady state (single-chain,
+    latency-corrected; chains sized to consume well under the 32M
+    backlog so per-epoch commits stay representative).  The calendar
+    batch has no [k] sort cap: per-batch commits are bounded by the
+    per-client step budget x the population (~500k at steps=8 on
+    weights 1..4) instead of the sorted engine's ~62k."""
+    import functools
+    import sys
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    sys.path.insert(0, str(REPO))
+    from __graft_entry__ import _preloaded_state
+    from dmclock_tpu.engine.fastpath import scan_calendar_epoch
+    from profile_util import scalar_latency, state_digest
+
+    lat = scalar_latency()
+    rows = []
+    for m, steps, epochs in ((4, 8, 10), (8, 8, 5), (8, 16, 4)):
+        run = jax.jit(functools.partial(
+            scan_calendar_epoch, m=m, steps=steps, anticipation_ns=0),
+            donate_argnums=(0,))
+        st = _preloaded_state(100_000, 320, ring=320)
+        ep = run(st, jnp.int64(0))
+        jax.device_get(state_digest(ep.state))        # warm
+        st = _preloaded_state(100_000, 320, ring=320)
+        t0 = time.perf_counter()
+        counts = []
+        for _ in range(epochs):
+            ep = run(st, jnp.int64(0))
+            st = ep.state
+            counts.append(ep.count)
+        jax.device_get(state_digest(st))
+        wall = time.perf_counter() - t0 - lat
+        total = sum(int(jax.device_get(c).sum()) for c in counts)
+        rows.append((m, steps, total / wall, total))
+        print(f"calendar m={m} steps={steps}: {total/wall/1e6:.1f} "
+              f"M dec/s ({total} decisions, {wall:.2f}s)")
+    return rows
+
+
+def tpu_allow_regime_row():
+    """AtLimit::Allow on the fast paths (VERDICT r4 weak #3: the Allow
+    regime ran at 0.01M on the serial scan).  A limited population
+    (weights > 0, tight limits, now past every limit) serves purely
+    via limit-break: measured on the flat sorted batch and the
+    calendar batch."""
+    import functools
+    import sys
+    import time
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    sys.path.insert(0, str(REPO))
+    from __graft_entry__ import _preloaded_state
+    from dmclock_tpu.engine.fastpath import (scan_calendar_epoch,
+                                             scan_prefix_epoch)
+    from profile_util import scalar_latency, state_digest
+
+    lat = scalar_latency()
+
+    def limited_state():
+        st = _preloaded_state(100_000, 256, ring=256)
+        n = 100_000
+        # tight limits: limit tags already past `now`, so Wait would
+        # park everyone and Allow limit-breaks every serve
+        return st._replace(
+            limit_inv=jnp.full((n,), 10**6, dtype=jnp.int64),
+            head_limit=jnp.full((n,), 10**12, dtype=jnp.int64),
+            head_ready=jnp.zeros((n,), dtype=bool))
+
+    rows = []
+    # sorted flat epochs, Allow
+    run = jax.jit(functools.partial(
+        scan_prefix_epoch, m=21, k=49152, anticipation_ns=0,
+        allow_limit_break=True), donate_argnums=(0,))
+    st = limited_state()
+    ep = run(st, jnp.int64(0))
+    jax.device_get(state_digest(ep.state))
+    lb = bool(jax.device_get(ep.lb).any())
+    st = limited_state()
+    t0 = time.perf_counter()
+    total = 0
+    for _ in range(3):
+        ep = run(st, jnp.int64(0))
+        st = ep.state
+        total += int(jax.device_get(ep.count).sum())
+    jax.device_get(state_digest(st))
+    wall = time.perf_counter() - t0 - lat
+    rows.append(("Allow limit-break (sorted flat epochs)",
+                 total / wall, lb))
+    print(f"allow sorted: {total/wall/1e6:.1f} M dec/s (lb={lb})")
+
+    # calendar epochs, Allow.  The epoch output has no lb aggregate,
+    # so verify limit-breaks actually fire via one calendar_batch on
+    # the same state (a classification regression must not let this
+    # row silently measure something else).
+    from dmclock_tpu.engine.fastpath import calendar_batch
+    b = calendar_batch(limited_state(), jnp.int64(0), steps=8,
+                       anticipation_ns=0, allow_limit_break=True)
+    lb_cal = bool(jax.device_get(b.lb).sum() > 0)
+    assert lb_cal, "calendar Allow row: no limit-breaks fired"
+    run = jax.jit(functools.partial(
+        scan_calendar_epoch, m=8, steps=8, anticipation_ns=0,
+        allow_limit_break=True), donate_argnums=(0,))
+    st = limited_state()
+    ep = run(st, jnp.int64(0))
+    jax.device_get(state_digest(ep.state))
+    st = limited_state()
+    t0 = time.perf_counter()
+    total = 0
+    for _ in range(6):
+        ep = run(st, jnp.int64(0))
+        st = ep.state
+        total += int(jax.device_get(ep.count).sum())
+    jax.device_get(state_digest(st))
+    wall = time.perf_counter() - t0 - lat
+    rows.append(("Allow limit-break (calendar epochs)",
+                 total / wall, lb_cal))
+    print(f"allow calendar: {total/wall/1e6:.1f} M dec/s")
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-native", action="store_true")
@@ -455,7 +582,15 @@ def main():
                     help="also run the cfg4 reservation calibration "
                     "study (slow: ~9 sustained runs)")
     ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--calendar", action="store_true",
+                    help="round-5 calendar-engine + Allow-regime rows "
+                         "(prints; paste into RESULTS.md)")
     args = ap.parse_args()
+
+    if args.calendar:
+        tpu_calendar_sweep()
+        tpu_allow_regime_row()
+        return
 
     here = Path(__file__).resolve().parent
     native_part = here / ".native_section.md"

@@ -104,6 +104,7 @@ class DeviceSimSpec:
     max_sends: int             # per client per slice (static bound)
     slice_ns: int
     allow_limit_break: bool
+    all_weights_positive: bool = True  # Allow-fastpath restriction
     random_select: bool = False
     force_scan: bool = False   # test hook: disable the prefix serve
 
@@ -136,6 +137,8 @@ def _make_spec(cfg: SimConfig, q_per_slice: int = 4) -> DeviceSimSpec:
         op_time_ns=op_time_ns, q_per_slice=q_per_slice,
         max_sends=max_sends, slice_ns=slice_ns,
         allow_limit_break=cfg.server_soft_limit,
+        all_weights_positive=all(g.client_weight > 0
+                                 for g in cfg.cli_group),
         random_select=cfg.server_random_selection)
 
 
@@ -332,11 +335,16 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
             # each capped at the remaining slice budget, which keeps
             # the concatenated stream the exact serial prefix -- until
             # the budget is met or a batch commits nothing.
-            # AtLimit::Allow needs the serial engine's limit-break
-            # path, so it keeps the scan.
+            # AtLimit::Allow rides the prefix path too (limit-break
+            # candidates are a third unified class), PROVIDED every
+            # client has weight > 0: a ready weight-0 client switches
+            # the reference's Allow fallback to reservation order
+            # globally, which per-client classification cannot express
+            # (fastpath module docstring) -- that shape keeps the scan.
             t_end = t + spec.slice_ns
             use_prefix = (spec.q_per_slice >= 256
-                          and not spec.allow_limit_break
+                          and (not spec.allow_limit_break
+                               or spec.all_weights_positive)
                           and not spec.force_scan)
 
             if use_prefix:
@@ -378,7 +386,8 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                             eng, 1, use_pallas=False))
                         batch = speculate_prefix_batch(
                             eng, t_end, kb, anticipation_ns=0,
-                            max_count=q - total, heads=heads)
+                            max_count=q - total, heads=heads,
+                            allow_limit_break=spec.allow_limit_break)
                         gt = gt + jnp.where(batch.guards_ok, 0,
                                             1).astype(jnp.int32)
                         # pack the committed prefix at the buffer

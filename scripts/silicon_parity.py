@@ -91,6 +91,95 @@ def make_shapes():
     return [("example", example), ("100th", hundredth), ("wide", wide)]
 
 
+def _calendar_silicon_check() -> int:
+    """The round-5 headline path on real silicon: calendar batches on
+    a mixed-QoS deep state must commit exactly the serial engine's
+    next `count` decisions -- per-client decision/phase counts AND the
+    full final state, both computed on the accelerator."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dmclock_tpu.core import ClientInfo, ReqParams
+    from dmclock_tpu.core.timebase import NS_PER_SEC as S
+    from dmclock_tpu.engine import TpuPullPriorityQueue, kernels
+    from dmclock_tpu.engine.fastpath import calendar_batch
+
+    rng = __import__("random").Random(17)
+    infos = {}
+    for c in range(48):
+        kind = c % 4
+        if kind == 0:
+            infos[c] = ClientInfo(1.5, 0, 0)
+        elif kind == 1:
+            infos[c] = ClientInfo(0, 1.0 + c % 3, 0)
+        elif kind == 2:
+            infos[c] = ClientInfo(1.0, 2.0, 6.0)
+        else:
+            infos[c] = ClientInfo(0.5, 1.0, 0)
+    q = TpuPullPriorityQueue(lambda c: infos[c], capacity=64,
+                             ring_capacity=64)
+    t = 1 * S
+    for i in range(900):
+        c = rng.randrange(48)
+        t += rng.randint(0, S // 8)
+        delta = rng.randint(1, 4)
+        q.add_request(("r", i), c, ReqParams(delta,
+                                             rng.randint(1, delta)),
+                      time_ns=t, cost=rng.randint(1, 3))
+    with q.data_mtx:
+        q._flush()
+    state = q.state
+    total = 0
+    now = t + 2 * S
+    import functools
+    cal = jax.jit(functools.partial(calendar_batch, steps=8,
+                                    anticipation_ns=0))
+    runs = {p: jax.jit(functools.partial(
+        kernels.engine_run, steps=p, allow_limit_break=False,
+        anticipation_ns=0, advance_now=False))
+        for p in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)}
+    for _ in range(30):
+        b = cal(state, jnp.int64(now))
+        assert bool(b.progress_ok), "calendar stalled on silicon"
+        cnt = int(b.count)
+        if cnt == 0:
+            now += 2 * S
+            continue
+        # serial replay in power-of-two chunks (engine_run at fixed
+        # now composes exactly; one compiled program per chunk size
+        # instead of one per distinct count)
+        ser_state = state
+        ds = []
+        n = cnt
+        while n:
+            p = 1 << (n.bit_length() - 1)
+            ser_state, _, decs = runs[p](ser_state, jnp.int64(now))
+            ds.append(jax.device_get(decs))
+            n -= p
+        d_slot = np.concatenate([x.slot for x in ds])
+        d_phase = np.concatenate([x.phase for x in ds])
+        d_type = np.concatenate([x.type for x in ds])
+        assert (d_type == kernels.RETURNING).all()
+        served = np.zeros(64, np.int32)
+        np.add.at(served, d_slot, 1)
+        assert np.array_equal(served, jax.device_get(b.served)), \
+            "calendar per-client counts diverge from serial on device"
+        resv = np.zeros(64, np.int32)
+        np.add.at(resv, d_slot[d_phase == 0], 1)
+        assert np.array_equal(resv, jax.device_get(b.served_resv)), \
+            "calendar phase counts diverge from serial on device"
+        for name, a, bb in zip(state._fields,
+                               jax.device_get(b.state),
+                               jax.device_get(ser_state)):
+            assert np.array_equal(a, bb), \
+                f"calendar state field {name} diverges on device"
+        state = b.state
+        total += cnt
+    assert total > 500, f"calendar silicon check too shallow: {total}"
+    return total
+
+
 def main() -> int:
     import jax
 
@@ -137,6 +226,12 @@ def main() -> int:
             report["shapes"].append({"name": name, "decisions": n})
             report["total_decisions"] += n
             print(f"silicon parity: {name}: {n} decisions bit-exact")
+        n = _calendar_silicon_check()
+        report["shapes"].append({"name": "calendar-vs-serial",
+                                 "decisions": n})
+        report["total_decisions"] += n
+        print(f"silicon parity: calendar-vs-serial: {n} decisions "
+              "set+state exact on device")
     except BaseException as e:
         # the artifact must never keep claiming success after ANY
         # failure -- assertion, run_sim crash, JAX runtime error, or

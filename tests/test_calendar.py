@@ -1,0 +1,235 @@
+"""Differential tests for the calendar-commit (sortless) engine.
+
+``calendar_batch`` promises: the committed SET -- per-client decision
+/ constraint-phase / limit-break counts -- and the final state are
+EXACTLY the serial engine's after ``count`` decisions, for the batch's
+computed boundary B_eff.  Split from test_prefix.py: one pytest
+process holding both suites' compiled programs exceeds this box's
+XLA-CPU memory tolerance (see conftest).
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmclock_tpu.core import ClientInfo
+from dmclock_tpu.core.timebase import NS_PER_SEC
+from dmclock_tpu.engine import kernels
+
+from engine_helpers import (assert_states_equal, build_state,
+                            deep_state)
+from test_prefix import mixed_qos_state, serial_run_lb
+
+S = NS_PER_SEC
+
+
+def check_calendar_vs_serial(state, now, steps, *, allow=False,
+                             anticipation_ns=0):
+    """One calendar batch vs the serial engine run for `count` steps:
+    the committed SET (per-client decision/phase/limit-break counts)
+    and the final state must match exactly."""
+    from dmclock_tpu.engine.fastpath import calendar_batch
+
+    b = calendar_batch(state, jnp.int64(now), steps=steps,
+                       anticipation_ns=anticipation_ns,
+                       allow_limit_break=allow)
+    assert bool(b.progress_ok)
+    c = int(b.count)
+    if c == 0:
+        assert_states_equal(b.state, state)
+        _, ser = serial_run_lb(state, now, 1, allow)
+        assert ser.type[0] != kernels.RETURNING, \
+            "calendar committed 0 but serial engine would serve"
+        return b.state, 0
+    ser_state, ser = serial_run_lb(state, now, c, allow)
+    assert (ser.type == kernels.RETURNING).all()
+    n = state.capacity
+    served = np.zeros(n, np.int32)
+    np.add.at(served, ser.slot, 1)
+    assert np.array_equal(served, jax.device_get(b.served)), \
+        "per-client decision counts diverge"
+    resv = np.zeros(n, np.int32)
+    np.add.at(resv, ser.slot[ser.phase == 0], 1)
+    assert np.array_equal(resv, jax.device_get(b.served_resv)), \
+        "per-client constraint-phase counts diverge"
+    lbc = np.zeros(n, np.int32)
+    np.add.at(lbc, ser.slot[ser.limit_break], 1)
+    assert np.array_equal(lbc, jax.device_get(b.lb)), \
+        "per-client limit-break counts diverge"
+    assert_states_equal(b.state, ser_state)
+    return b.state, c
+
+
+def drive_calendar(state, now, steps, *, allow=False,
+                   anticipation_ns=0, max_batches=300):
+    counts = []
+    st = state
+    for _ in range(max_batches):
+        st, c = check_calendar_vs_serial(
+            st, now, steps, allow=allow,
+            anticipation_ns=anticipation_ns)
+        counts.append(c)
+        if c == 0:
+            break
+    return st, counts
+
+
+def test_calendar_weight_steady_state():
+    """Pure weight workload: every client commits up to `steps`
+    decisions per batch (the sort-based batch is capped at one serve
+    per client per sorted window)."""
+    infos = {c: ClientInfo(0, 1 + (c % 4), 0) for c in range(10)}
+    state = deep_state(infos, depth=24)
+    st, counts = drive_calendar(state, 60 * S, 8)
+    assert sum(counts) == 10 * 24
+    assert max(counts) > 20, f"calendar never batched deep: {counts}"
+
+
+def test_calendar_heavy_weight_skew():
+    """The cfg4 cutter shape: one weight-64 client among weight-1
+    clients.  A sort batch commits only the entries inside the heavy
+    client's 2*winv re-entry window; the calendar batch must follow
+    the heavy client many serves deep in ONE pass."""
+    infos = {0: ClientInfo(0, 64, 0)}
+    for c in range(1, 9):
+        infos[c] = ClientInfo(0, 1, 0)
+    state = deep_state(infos, depth=32)
+    from dmclock_tpu.engine.fastpath import calendar_batch
+    b = calendar_batch(state, jnp.int64(500 * S), steps=16,
+                       anticipation_ns=0)
+    assert int(jax.device_get(b.served)[0]) > 8, \
+        "heavy client not followed deep"
+    check_calendar_vs_serial(state, 500 * S, 16)
+
+
+def test_calendar_mixed_regimes():
+    state, now = mixed_qos_state(n=8, depth=12)
+    st, counts = drive_calendar(state, now, 8)
+    assert sum(counts) == 8 * 12
+
+
+def test_calendar_resv_arrears():
+    """Deep reservation arrears (the cfg4 round-start segment) commit
+    across many serves per client in one batch."""
+    infos = {c: ClientInfo(2, 1, 0) for c in range(8)}
+    state = deep_state(infos, depth=16)
+    st, counts = drive_calendar(state, 9 * S, 16)
+    assert sum(counts) == 8 * 16
+    assert max(counts) > 30
+
+
+def test_calendar_single_client():
+    infos = {0: ClientInfo(0, 1, 0)}
+    adds = [(0, 1 * S, 1, 1, 1) for _ in range(20)]
+    state = build_state(infos, adds, capacity=8, ring=32)
+    st, counts = drive_calendar(state, 100 * S, 16)
+    assert sum(counts) == 20
+    assert counts[0] >= 15, f"single client not followed: {counts}"
+
+
+def test_calendar_nothing_eligible():
+    infos = {c: ClientInfo(5, 0, 0) for c in range(4)}
+    adds = [(c, 100 * S, 1, 1, 1) for c in range(4)]
+    state = build_state(infos, adds, capacity=8)
+    check_calendar_vs_serial(state, 1, 4)
+
+
+@pytest.mark.parametrize("seed", [61, 62, 63, 64, 65])
+def test_fuzz_calendar_matches_serial(seed):
+    """Random QoS mixes / costs / arrivals: calendar batches replay
+    the serial engine exactly (set + state), Wait mode."""
+    rng = random.Random(seed)
+    n = rng.randint(2, 16)
+    infos = {}
+    for c in range(n):
+        kind = rng.randrange(4)
+        if kind == 0:
+            infos[c] = ClientInfo(rng.uniform(0.5, 3), 0, 0)
+        elif kind == 1:
+            infos[c] = ClientInfo(0, rng.uniform(0.5, 4), 0)
+        elif kind == 2:
+            infos[c] = ClientInfo(rng.uniform(0.5, 2),
+                                  rng.uniform(0.5, 4),
+                                  rng.uniform(4, 9))
+        else:
+            infos[c] = ClientInfo(rng.uniform(0.5, 3),
+                                  rng.uniform(0.5, 3), 0)
+    adds = []
+    t = 1 * S
+    for _ in range(rng.randint(20, 150)):
+        c = rng.randrange(n)
+        t += rng.randint(0, S // 4)
+        delta = rng.randint(1, 5)
+        adds.append((c, t, rng.randint(1, 3), delta,
+                     rng.randint(1, delta)))
+    state = build_state(infos, adds, capacity=32)
+    steps = rng.choice([4, 8])
+    now = t + rng.randint(0, 6) * S
+    st = state
+    for _ in range(14):
+        st, c = check_calendar_vs_serial(st, now, steps)
+        if c == 0:
+            now += rng.randint(1, 5) * S
+
+
+@pytest.mark.parametrize("seed", [71, 72, 73])
+def test_fuzz_calendar_allow(seed):
+    """Allow mode (weights > 0 everywhere): calendar batches replay
+    the serial limit-break engine exactly."""
+    rng = random.Random(seed)
+    n = rng.randint(3, 12)
+    infos = {c: ClientInfo(rng.choice([0, 0.5, 1.0]),
+                           rng.uniform(0.5, 3),
+                           rng.choice([0, 2.0, 4.0]))
+             for c in range(n)}
+    state = deep_state(infos, depth=rng.randint(2, 8), capacity=16)
+    now = rng.randint(1, 8) * S
+    st = state
+    for _ in range(12):
+        st, c = check_calendar_vs_serial(st, now, rng.choice([4, 8]),
+                                         allow=True)
+        if c == 0:
+            now += rng.randint(1, 4) * S
+
+
+def test_calendar_anticipation():
+    rng = random.Random(23)
+    ant = S // 2
+    infos = {c: ClientInfo(0, 1.0 + c % 3, 0) for c in range(8)}
+    adds = []
+    t = S
+    for i in range(80):
+        c = rng.randrange(8)
+        t += rng.choice([ant // 4, ant // 3, 2 * ant])
+        adds.append((c, t, rng.randint(1, 3), rng.randint(1, 4), 1))
+    state = build_state(infos, adds, capacity=16, ring=32,
+                        anticipation_ns=ant)
+    st, counts = drive_calendar(state, t + 1000 * S, 8,
+                                anticipation_ns=ant)
+    assert sum(counts) == 80
+
+
+def test_calendar_epoch_matches_batches():
+    from dmclock_tpu.engine.fastpath import (calendar_batch,
+                                             scan_calendar_epoch)
+
+    state, now = mixed_qos_state(n=8, depth=10)
+    m, steps = 5, 6
+    ep = scan_calendar_epoch(state, jnp.int64(now), m, steps=steps,
+                             anticipation_ns=0)
+    assert bool(jax.device_get(ep.progress_ok).all())
+    st = state
+    total_served = np.zeros(state.capacity, np.int32)
+    for i in range(m):
+        b = calendar_batch(st, jnp.int64(now), steps=steps,
+                           anticipation_ns=0)
+        assert int(b.count) == int(jax.device_get(ep.count)[i])
+        assert int(b.resv_count) == \
+            int(jax.device_get(ep.resv_count)[i])
+        total_served += jax.device_get(b.served)
+        st = b.state
+    assert np.array_equal(total_served, jax.device_get(ep.served))
+    assert_states_equal(ep.state, st)

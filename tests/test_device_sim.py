@@ -294,3 +294,37 @@ def test_guard_trips_checked(mesh8):
     # and the driver-level check raises on a tripped counter
     with pytest.raises(RuntimeError, match="rebase-guard"):
         DS.check_guard_trips(sim)
+
+
+def test_prefix_serve_allow_soft_limit_matches_scan(mesh8):
+    """AtLimit::Allow (soft limit) on the prefix path: the reference's
+    own stress shape (dmc_sim_100th.conf sets server_soft_limit=true,
+    all weights positive) must serve identically to the q-step serial
+    scan -- the round-4 engine excluded Allow from the fastpath
+    entirely."""
+    groups = [
+        ClientGroup(client_count=256, client_total_ops=10**9,
+                    client_iops_goal=20000, client_outstanding_ops=200,
+                    client_reservation=20.0, client_limit=60.0,
+                    client_weight=1.0 + (1 % 3),
+                    client_server_select_range=8),
+    ]
+    cfg = make_cfg(groups, iops=200000.0, soft_limit=True)
+    spec = DS._make_spec(cfg)
+    assert spec.allow_limit_break and spec.all_weights_positive
+    _prefix_vs_scan(cfg, mesh8, 256)
+
+
+def test_allow_weight_zero_keeps_scan(mesh8):
+    """The Allow-fastpath restriction: a weight-0 client group forces
+    the serial scan (per-client classification cannot express the
+    reference's ready-weight-0 reservation-order fallback)."""
+    groups = [
+        ClientGroup(client_count=32, client_total_ops=1000,
+                    client_iops_goal=2000, client_outstanding_ops=20,
+                    client_reservation=10.0, client_limit=30.0,
+                    client_weight=0.0, client_server_select_range=8),
+    ]
+    cfg = make_cfg(groups, iops=200000.0, soft_limit=True)
+    spec = DS._make_spec(cfg)
+    assert spec.allow_limit_break and not spec.all_weights_positive
