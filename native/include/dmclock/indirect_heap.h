@@ -104,6 +104,52 @@ class IndirectHeap {
   // iteration over raw storage (heap order, not sorted)
   typename std::vector<T*>::iterator begin() { return data_.begin(); }
   typename std::vector<T*>::iterator end() { return data_.end(); }
+  typename std::vector<T*>::const_iterator begin() const {
+    return data_.begin();
+  }
+  typename std::vector<T*>::const_iterator end() const {
+    return data_.end();
+  }
+
+  // search surface (reference indirect_intrusive_heap.h:68-203
+  // iterators/find/rfind): O(1) via the intrusive index when the
+  // element is known, predicate scans otherwise.  `find(elem)`
+  // returns end() for elements not in this heap.
+  typename std::vector<T*>::iterator find(const T& elem) {
+    size_t i = elem.*Index;
+    if (i == HEAP_NOT_IN || i >= data_.size() || data_[i] != &elem)
+      return data_.end();
+    return data_.begin() + i;
+  }
+
+  typename std::vector<T*>::const_iterator find(const T& elem) const {
+    size_t i = elem.*Index;
+    if (i == HEAP_NOT_IN || i >= data_.size() || data_[i] != &elem)
+      return data_.end();
+    return data_.begin() + i;
+  }
+
+  template <typename Pred>
+  typename std::vector<T*>::iterator find_if(Pred&& pred) {
+    return std::find_if(data_.begin(), data_.end(),
+                        [&](T* e) { return pred(*e); });
+  }
+
+  template <typename Pred>
+  typename std::vector<T*>::const_iterator find_if(Pred&& pred) const {
+    return std::find_if(data_.begin(), data_.end(),
+                        [&](T* e) { return pred(*e); });
+  }
+
+  // reverse-order predicate search (the reference's rfind: useful
+  // when the target is likely near the heap's bottom, e.g. a
+  // just-pushed element)
+  template <typename Pred>
+  typename std::vector<T*>::iterator rfind_if(Pred&& pred) {
+    auto rit = std::find_if(data_.rbegin(), data_.rend(),
+                            [&](T* e) { return pred(*e); });
+    return rit == data_.rend() ? data_.end() : std::prev(rit.base());
+  }
 
   template <typename Fn>
   void display_sorted(std::ostream& os, Fn&& fmt) const {

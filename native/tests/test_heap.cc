@@ -242,3 +242,60 @@ MT_TEST(iteration_and_display_sorted) {
   MT_CHECK_EQ(h.size(), size_t{40});
   MT_CHECK_EQ(h.top().key, expect.front());
 }
+
+MT_TEST(search_surface_find_and_rfind) {
+  // find (O(1) via the intrusive slot), find_if / rfind_if predicate
+  // scans (reference indirect_intrusive_heap.h:68-203)
+  HeapA h(2);
+  std::vector<std::unique_ptr<Elem>> owner;
+  for (int i = 0; i < 25; ++i) {
+    owner.push_back(std::make_unique<Elem>(i * 3));
+    h.push(owner.back().get());
+  }
+  // exact-element find returns the element's own storage slot
+  for (auto& e : owner) {
+    auto it = h.find(*e);
+    MT_CHECK(it != h.end());
+    MT_CHECK(*it == e.get());
+  }
+  Elem outside(999);
+  MT_CHECK(h.find(outside) == h.end());
+  // predicate find locates by key
+  auto it = h.find_if([](const Elem& e) { return e.key == 36; });
+  MT_CHECK(it != h.end());
+  MT_CHECK_EQ((*it)->key, 36);
+  // rfind_if agrees with find_if when the match is unique
+  auto rit = h.rfind_if([](const Elem& e) { return e.key == 36; });
+  MT_CHECK(rit != h.end());
+  MT_CHECK(*rit == *it);
+  // no match: both return end()
+  MT_CHECK(h.find_if([](const Elem& e) { return e.key == 1; })
+           == h.end());
+  MT_CHECK(h.rfind_if([](const Elem& e) { return e.key == 1; })
+           == h.end());
+  // removal clears the slot, so find no longer returns it
+  Elem* victim = owner[7].get();
+  h.remove(*victim);
+  MT_CHECK(h.find(*victim) == h.end());
+  // rfind_if under DUPLICATES returns the LAST storage match (its
+  // distinguishing behavior vs find_if)
+  owner.push_back(std::make_unique<Elem>(36));   // second key==36
+  h.push(owner.back().get());
+  auto f1 = h.find_if([](const Elem& e) { return e.key == 36; });
+  auto r1 = h.rfind_if([](const Elem& e) { return e.key == 36; });
+  MT_CHECK(f1 != h.end());
+  MT_CHECK(r1 != h.end());
+  MT_CHECK(f1 <= r1);
+  MT_CHECK((*r1)->key == 36 && (*f1)->key == 36);
+  // they bracket the duplicate pair: no matching element lies after
+  // r1 or before f1
+  for (auto it2 = std::next(r1); it2 != h.end(); ++it2)
+    MT_CHECK((*it2)->key != 36);
+  for (auto it2 = h.begin(); it2 != f1; ++it2)
+    MT_CHECK((*it2)->key != 36);
+  // const searches compile and agree
+  const HeapA& ch = h;
+  MT_CHECK(ch.find(*owner.back()) != ch.end());
+  MT_CHECK(ch.find_if([](const Elem& e) { return e.key == 36; })
+           != ch.end());
+}
