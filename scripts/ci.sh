@@ -13,23 +13,28 @@ cmake --build native/build -j >/dev/null
 ctest --test-dir native/build --output-on-failure
 
 echo "== simulator smoke =="
-python -m dmclock_tpu.sim.dmc_sim -c configs/dmc_sim_example.conf | tail -3
+# the python sim boots jax (axon platform on this image), so it gets
+# a timeout too -- see the tunnel-wedge note below
+timeout -k 30 900 python -m dmclock_tpu.sim.dmc_sim -c configs/dmc_sim_example.conf | tail -3
 native/build/dmc_sim_native -c configs/dmc_sim_example.conf | tail -3
 
 echo "== full-scale TPU parity (100x100 acceptance config) =="
-python scripts/run_fullscale.py
+timeout -k 30 1800 python scripts/run_fullscale.py
 
+# TPU legs get hard timeouts: the shared axon tunnel can WEDGE (a
+# trivial device op hangs indefinitely -- observed round 5); a hung
+# gate is worse than a failed one
 echo "== on-silicon parity gate (skips on cpu-only boxes) =="
-python scripts/silicon_parity.py
+timeout -k 30 1800 python scripts/silicon_parity.py
 
 echo "== bench history regression guard (drift-aware) =="
 python scripts/bench_guard.py
 
 echo "== graft entry compile check =="
-python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+timeout -k 30 1200 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 echo "== bench smoke (one small epoch) =="
-python - <<'EOF'
+timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp
 from __graft_entry__ import _preloaded_state
 from dmclock_tpu.engine.fastpath import scan_prefix_epoch

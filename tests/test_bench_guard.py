@@ -1,0 +1,70 @@
+"""Unit tests for the drift-aware benchmark regression guard
+(scripts/bench_guard.py): history medians, same-device filtering, the
+tolerance floor, and the not-enough-history pass."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+spec = importlib.util.spec_from_file_location(
+    "bench_guard", REPO / "scripts" / "bench_guard.py")
+bg = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bg)
+
+
+def write_history(tmp_path, rows):
+    h = tmp_path / "history"
+    h.mkdir()
+    for i, (device, dps) in enumerate(rows):
+        (h / f"bench_{1000 + i}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": device,
+             "workloads": {"serve": {"dps": dps}}}))
+    return h
+
+
+def run_guard(monkeypatch, capsys, hist, argv=()):
+    monkeypatch.setattr(bg, "HISTORY", hist)
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py", *argv])
+    rc = bg.main()
+    return rc, capsys.readouterr().out
+
+
+def test_no_history_passes(monkeypatch, capsys, tmp_path):
+    rc, out = run_guard(monkeypatch, capsys, tmp_path / "none")
+    assert rc == 0
+    assert "no history" in out
+
+
+def test_within_drift_passes(monkeypatch, capsys, tmp_path):
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 35e6),
+                                    ("tpu0", 45e6), ("tpu0", 25e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0 and "OK" in out
+
+
+def test_big_drop_fails(monkeypatch, capsys, tmp_path):
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 35e6),
+                                    ("tpu0", 45e6), ("tpu0", 10e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 1 and "REGRESSION" in out
+
+
+def test_device_change_not_compared(monkeypatch, capsys, tmp_path):
+    # a 4x drop on a DIFFERENT device must not read as a regression
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 45e6),
+                                    ("tpu1", 10e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "not judged" in out
+
+
+def test_tolerance_flag(monkeypatch, capsys, tmp_path):
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 40e6),
+                                    ("tpu0", 15e6)])
+    rc, _ = run_guard(monkeypatch, capsys, hist)
+    assert rc == 1               # 15M < 40M/2 at the default 2x
+    rc2, _ = run_guard(monkeypatch, capsys, hist,
+                       argv=("--tolerance", "3.0"))
+    assert rc2 == 0              # 15M >= 40M/3
