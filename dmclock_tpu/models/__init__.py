@@ -98,7 +98,15 @@ def _dmclock_native_queue(server_id, client_info_f, anticipation_ns,
         client_info_f,
         delayed_tag_calc=True,
         at_limit=AtLimit.ALLOW if soft_limit else AtLimit.WAIT,
-        anticipation_timeout_ns=anticipation_ns)
+        anticipation_timeout_ns=anticipation_ns,
+        use_prop_heap=USE_PROP_HEAP)
+
+
+# module-level switch for the native model's optional prop heap (the
+# reference USE_PROP_HEAP build flag made runtime; behaviorally
+# invisible -- pinned by tests/test_native_parity.py -- so sims only
+# flip it for performance studies, via dmc_sim --use-prop-heap)
+USE_PROP_HEAP = False
 
 
 def _dmclock_push_queue(delayed: bool):

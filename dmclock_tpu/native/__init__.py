@@ -43,6 +43,26 @@ def _so_path() -> Path:
     return _BUILD_DIR / "libdmclock_c.so"
 
 
+_CAPI_VERSION = 2
+
+
+def _rebuild() -> Optional[Path]:
+    """Force a cmake rebuild of the C library (stale-ABI path)."""
+    if not shutil.which("cmake"):
+        return None
+    try:
+        subprocess.run(["cmake", "-S", str(_NATIVE_DIR), "-B",
+                        str(_BUILD_DIR)], check=True,
+                       capture_output=True, timeout=300)
+        subprocess.run(["cmake", "--build", str(_BUILD_DIR), "-j",
+                        "--target", "dmclock_c"], check=True,
+                       capture_output=True, timeout=600)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
+        return None
+    so = _so_path()
+    return so if so.exists() else None
+
+
 def ensure_built() -> Optional[Path]:
     """Build libdmclock_c.so with cmake if missing; None on failure."""
     env = os.environ.get("DMCLOCK_NATIVE_LIB")
@@ -82,11 +102,36 @@ def load_library() -> Optional[ctypes.CDLL]:
         return None
     lib = ctypes.CDLL(str(so))
 
+    # ABI version gate: a stale prebuilt .so would silently ignore
+    # newer trailing arguments (C calling convention), turning e.g.
+    # use_prop_heap into a no-op.  Rebuild once on mismatch; refuse to
+    # proceed if that does not converge.
+    if not hasattr(lib, "dmc_capi_version") or \
+            lib.dmc_capi_version() != _CAPI_VERSION:
+        del lib
+        so = _rebuild()
+        if so is None:
+            _lib_err = "stale native ABI and rebuild failed"
+            raise RuntimeError(
+                "libdmclock_c.so has a stale ABI and could not be "
+                "rebuilt; remove native/build and rebuild")
+        lib = ctypes.CDLL(str(so))
+        if not hasattr(lib, "dmc_capi_version") or \
+                lib.dmc_capi_version() != _CAPI_VERSION:
+            _lib_err = "stale native ABI after rebuild"
+            raise RuntimeError(
+                "libdmclock_c.so ABI version mismatch persists after "
+                "rebuild (DMCLOCK_NATIVE_LIB pointing at an old "
+                "library?)")
+
     u64, i64, u32 = ctypes.c_uint64, ctypes.c_int64, ctypes.c_uint32
     p = ctypes.POINTER
     lib.dmc_queue_create.restype = ctypes.c_void_p
     lib.dmc_queue_create.argtypes = [ctypes.c_int, ctypes.c_int, i64,
-                                     i64, ctypes.c_uint, ctypes.c_int]
+                                     i64, ctypes.c_uint, ctypes.c_int,
+                                     ctypes.c_int, ctypes.c_double,
+                                     ctypes.c_double, ctypes.c_double,
+                                     u64]
     lib.dmc_queue_destroy.argtypes = [ctypes.c_void_p]
     lib.dmc_queue_set_client_info.argtypes = [
         ctypes.c_void_p, u64, ctypes.c_double, ctypes.c_double,
@@ -110,6 +155,8 @@ def load_library() -> Optional[ctypes.CDLL]:
     lib.dmc_queue_remove_by_client.argtypes = [
         ctypes.c_void_p, u64, ctypes.c_int, p(u64), u64]
     lib.dmc_queue_do_clean.argtypes = [ctypes.c_void_p]
+    lib.dmc_queue_set_fake_clock.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_double]
     lib.dmc_queue_heap_branching.restype = ctypes.c_uint
     lib.dmc_queue_heap_branching.argtypes = [ctypes.c_void_p]
 
@@ -141,16 +188,24 @@ class NativePullPriorityQueue:
                  reject_threshold_ns: int = 0,
                  anticipation_timeout_ns: int = 0,
                  heap_branching: int = 2,
-                 dynamic_cli_info: bool = False):
+                 dynamic_cli_info: bool = False,
+                 use_prop_heap: bool = False,
+                 idle_age_s: float = 0.0,
+                 erase_age_s: float = 0.0,
+                 check_time_s: float = 0.0,
+                 erase_max: int = 0):
         lib = load_library()
         if lib is None:
             raise RuntimeError("native dmclock library unavailable")
         self._lib = lib
         self.client_info_f = client_info_f
+        # GC ages: 0 keeps the library default (reference constants)
         self._h = lib.dmc_queue_create(
             1 if delayed_tag_calc else 0, at_limit.value,
             int(reject_threshold_ns), int(anticipation_timeout_ns),
-            int(heap_branching), 1 if dynamic_cli_info else 0)
+            int(heap_branching), 1 if dynamic_cli_info else 0,
+            1 if use_prop_heap else 0, float(idle_age_s),
+            float(erase_age_s), float(check_time_s), int(erase_max))
         self._dynamic = dynamic_cli_info
         self._cid: Dict[Any, int] = {}
         self._next_cid = 1
@@ -242,6 +297,11 @@ class NativePullPriorityQueue:
 
     def do_clean(self) -> None:
         self._lib.dmc_queue_do_clean(self._h)
+
+    def set_fake_clock(self, now_s: float) -> None:
+        """Deterministic GC clock (mirrors the oracle's injected
+        monotonic_clock) -- march it forward, then do_clean()."""
+        self._lib.dmc_queue_set_fake_clock(self._h, float(now_s))
 
     def request_count(self) -> int:
         return int(self._lib.dmc_queue_request_count(self._h))

@@ -48,7 +48,17 @@ def main(argv=None) -> int:
                    "mode)")
     p.add_argument("--intervals", action="store_true",
                    help="print per-client per-second op counts")
+    p.add_argument("--use-prop-heap", action="store_true",
+                   help="dmclock-native model: enable the O(1) "
+                   "idle-reactivation prop heap (reference "
+                   "USE_PROP_HEAP equivalent; same behavior, faster "
+                   "adds at scale)")
     args = p.parse_args(argv)
+    if args.use_prop_heap and args.model != "dmclock-native":
+        p.error("--use-prop-heap applies to --model dmclock-native")
+    # unconditional assignment: in-process callers invoking main()
+    # repeatedly must not inherit a previous run's flag
+    models.USE_PROP_HEAP = bool(args.use_prop_heap)
 
     if args.server_mode == "push" and \
             args.model not in models.push_names():

@@ -34,18 +34,28 @@ struct QueueHandle {
   std::unordered_map<uint64_t, ClientInfo> infos;
   std::mutex info_mtx;
   std::unique_ptr<Queue> queue;
+  bool fake_clock_set = false;
+  double fake_now_s = 0.0;
 };
 
 }  // namespace
 
 extern "C" {
 
+// ABI version: bump on ANY signature/semantic change.  The ctypes
+// loader refuses a stale prebuilt .so (a 6-arg dmc_queue_create would
+// silently ignore a 7th argument per the calling convention).
+int dmc_capi_version(void) { return 2; }
+
 // ---- queue ----------------------------------------------------------
 
 void* dmc_queue_create(int delayed_tag_calc, int at_limit,
                        int64_t reject_threshold_ns,
                        int64_t anticipation_timeout_ns,
-                       unsigned heap_branching, int dynamic_cli_info) {
+                       unsigned heap_branching, int dynamic_cli_info,
+                       int use_prop_heap, double idle_age_s,
+                       double erase_age_s, double check_time_s,
+                       uint64_t erase_max) {
   auto* h = new QueueHandle();
   Queue::Options opt;
   opt.delayed_tag_calc = delayed_tag_calc != 0;
@@ -54,6 +64,11 @@ void* dmc_queue_create(int delayed_tag_calc, int at_limit,
   opt.anticipation_timeout_ns = anticipation_timeout_ns;
   opt.heap_branching = heap_branching;
   opt.dynamic_cli_info = dynamic_cli_info != 0;
+  opt.use_prop_heap = use_prop_heap != 0;
+  if (idle_age_s > 0) opt.idle_age_s = idle_age_s;
+  if (erase_age_s > 0) opt.erase_age_s = erase_age_s;
+  if (check_time_s > 0) opt.check_time_s = check_time_s;
+  if (erase_max > 0) opt.erase_max = erase_max;
   opt.run_gc_thread = false;  // GC driven via dmc_queue_do_clean
   h->queue = std::make_unique<Queue>(
       [h](const uint64_t& c) {
@@ -144,6 +159,18 @@ uint64_t dmc_queue_remove_by_client(void* q, uint64_t client,
 
 void dmc_queue_do_clean(void* q) {
   static_cast<QueueHandle*>(q)->queue->do_clean();
+}
+
+// deterministic GC clock injection (the C++ set_monotonic_clock made
+// ABI-visible so differential tests can drive idle-marking exactly
+// like the oracle's injected monotonic_clock)
+void dmc_queue_set_fake_clock(void* q, double now_s) {
+  auto* h = static_cast<QueueHandle*>(q);
+  if (!h->fake_clock_set) {
+    h->fake_clock_set = true;
+    h->queue->set_monotonic_clock([h] { return h->fake_now_s; });
+  }
+  h->fake_now_s = now_s;
 }
 
 unsigned dmc_queue_heap_branching(void* q) {
