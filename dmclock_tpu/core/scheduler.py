@@ -557,6 +557,25 @@ class PriorityQueueBase(Generic[C, R]):
                     if self._cleaning_job is not None:
                         self._cleaning_job.try_update(self.check_time_s)
 
+    # ------------------------------------------------------------------
+    # observability (obs.registry wiring)
+    # ------------------------------------------------------------------
+    def register_metrics(self, registry, labels=None) -> None:
+        """Expose the scheduling counters (reference :810-812) as
+        callback gauges -- read lazily at drain time, so the hot path
+        pays nothing."""
+        for name, attr in (
+                ("dmclock_sched_reservation_total", "reserv_sched_count"),
+                ("dmclock_sched_priority_total", "prop_sched_count"),
+                ("dmclock_sched_limit_break_total",
+                 "limit_break_sched_count")):
+            registry.gauge(name, "scheduling decisions by phase",
+                           labels=labels).set_function(
+                lambda a=attr: getattr(self, a))
+        registry.gauge("dmclock_clients", "tracked client records",
+                       labels=labels).set_function(
+            lambda: len(self.client_map))
+
     # debugging dump (reference display_queues :676-697)
     def display_queues(self) -> str:
         with self.data_mtx:
@@ -573,7 +592,13 @@ class PriorityQueueBase(Generic[C, R]):
 
 @dataclass
 class PullReq(Generic[C, R]):
-    """Result of a pull (reference PullReq, :1286-1306)."""
+    """Result of a pull (reference PullReq, :1286-1306).
+
+    ``tag`` is the served request's tag triple when the backend
+    materializes per-decision tags on the host (the oracle queues do;
+    the TPU batch engine leaves it None) -- consumed by the decision
+    trace (``obs.trace``), never by scheduling.
+    """
 
     type: NextReqType
     client: Any = None
@@ -581,6 +606,7 @@ class PullReq(Generic[C, R]):
     phase: Optional[Phase] = None
     cost: int = 0
     when_ready: Optional[int] = None  # ns
+    tag: Optional[RequestTag] = None
 
     def is_none(self) -> bool:
         return self.type is NextReqType.NONE
@@ -628,12 +654,14 @@ class PullPriorityQueue(PriorityQueueBase[C, R]):
 
             if nxt.heap_id is HeapId.RESERVATION:
                 result.phase = Phase.RESERVATION
-                self._pop_process_request(HeapId.RESERVATION, process)
+                result.tag = self._pop_process_request(
+                    HeapId.RESERVATION, process)
                 self.reserv_sched_count += 1
             else:
                 result.phase = Phase.PRIORITY
                 tag = self._pop_process_request(HeapId.READY, process)
                 self._reduce_reservation_tags(result.client, tag)
+                result.tag = tag
                 self.prop_sched_count += 1
             return result
 

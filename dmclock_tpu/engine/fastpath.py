@@ -76,6 +76,7 @@ from jax import lax
 from jax.experimental.pallas import tpu as pltpu
 
 from ..core.timebase import MAX_TAG
+from ..obs import device as obsdev
 from . import kernels
 from .kernels import (KEY_INF, NONE, RETURNING, Decision, _make_tag,
                       _fold_prev)
@@ -807,11 +808,32 @@ class PrefixEpoch(NamedTuple):
     phase: jnp.ndarray     # int8[M, k]  0 reservation / 1 weight
     cost: jnp.ndarray      # int32[M, k]
     lb: jnp.ndarray        # bool[M, k]  limit-break serves (Allow)
+    metrics: jnp.ndarray   # int64[NUM_METRICS] (zeros unless
+    #                        with_metrics; rides the same readback)
+
+
+def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
+                   guards_ok):
+    """Fold one batch's contribution into the epoch metrics vector --
+    pure reductions over arrays the batch already materialized, so the
+    decision stream cannot be perturbed.  A stall is a batch that
+    committed nothing while work sat queued (every queued head capped
+    by its limit/reservation tag)."""
+    queued = jnp.any(st.active & (st.depth > 0))
+    stall = (count == 0) & queued
+    return obsdev.metrics_combine(met, obsdev.metrics_delta(
+        decisions=count.astype(jnp.int64),
+        resv=resv.astype(jnp.int64), prop=prop.astype(jnp.int64),
+        limit_break=lb.astype(jnp.int64),
+        stalls=stall.astype(jnp.int64),
+        ring_hwm=jnp.max(st.depth).astype(jnp.int64),
+        guard_trips=(~guards_ok).astype(jnp.int64)))
 
 
 def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
                       anticipation_ns: int,
-                      allow_limit_break: bool = False) -> PrefixEpoch:
+                      allow_limit_break: bool = False,
+                      with_metrics: bool = False) -> PrefixEpoch:
     """Run m flat prefix-commit batches of up to k decisions on device.
 
     EVERY batch commits its own exact prefix, so the concatenated
@@ -822,12 +844,17 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
     2^31) zeroes that batch and every later one without committing --
     rerun from the returned state via ``make_prefix_runner``'s serial
     fallback in that case.
+
+    ``with_metrics`` (STATIC) accumulates the ``obs.device`` vector in
+    the same scan carry; the decision stream and final state are
+    bit-identical with it on or off (tests/test_obs.py).
     """
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     window = ring_window(state, m)
 
-    def body(mut, _):
+    def body(carry, _):
+        mut, met = carry
         st = EngineState(**invariant, **mut)
         batch = speculate_prefix_batch(
             st, now, k, anticipation_ns=anticipation_ns,
@@ -838,14 +865,24 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
                batch.decisions.phase.astype(jnp.int8),
                batch.decisions.cost.astype(jnp.int32),
                batch.decisions.limit_break)
+        if with_metrics:
+            served = batch.decisions.slot >= 0
+            resv = jnp.sum(served & (batch.decisions.phase == 0))
+            met = _batch_metrics(
+                met, batch.state, count=batch.count, resv=resv,
+                prop=batch.count - resv,
+                lb=jnp.sum(batch.decisions.limit_break),
+                guards_ok=batch.guards_ok)
         new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
-        return new_mut, out
+        return (new_mut, met), out
 
-    mutable, (count, guards, slot, phase, cost, lb) = lax.scan(
-        body, mutable0, None, length=m)
+    (mutable, metrics), (count, guards, slot, phase, cost, lb) = \
+        lax.scan(body, (mutable0, obsdev.metrics_zero()), None,
+                 length=m)
     state = EngineState(**invariant, **mutable)
     return PrefixEpoch(state=state, count=count, guards_ok=guards,
-                       slot=slot, phase=phase, cost=cost, lb=lb)
+                       slot=slot, phase=phase, cost=cost, lb=lb,
+                       metrics=metrics)
 
 
 class ChainEpoch(NamedTuple):
@@ -858,12 +895,15 @@ class ChainEpoch(NamedTuple):
     slot: jnp.ndarray        # int32[M, k] unit clients (-1 pad)
     cls: jnp.ndarray         # int8[M, k]  unit entry class
     length: jnp.ndarray      # int8[M, k]  unit decisions
+    metrics: jnp.ndarray     # int64[NUM_METRICS] (zeros unless
+    #                          with_metrics)
 
 
 def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
                      chain_depth: int, anticipation_ns: int,
                      allow_limit_break: bool = False,
-                     use_pallas: bool | None = None) -> ChainEpoch:
+                     use_pallas: bool | None = None,
+                     with_metrics: bool = False) -> ChainEpoch:
     """Run m chained prefix batches on device.  Each batch prefetches
     its own ``chain_depth``-row ring window (one barrel-shift ring
     pass per batch; a shared per-epoch window would need m *
@@ -873,7 +913,8 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
 
-    def body(mut, _):
+    def body(carry, _):
+        mut, met = carry
         st = EngineState(**invariant, **mut)
         win = ring_window(st, chain_depth, use_pallas=use_pallas)
         batch = speculate_chain_batch(
@@ -884,15 +925,27 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
         out = (batch.count, batch.unit_count, batch.guards_ok,
                batch.slot, batch.cls.astype(jnp.int8),
                batch.length.astype(jnp.int8))
+        if with_metrics:
+            units = batch.slot >= 0
+            # a unit's entry serve is weight-phase iff class >= 1; its
+            # induced serves are all constraint-phase
+            prop = jnp.sum(jnp.where(units, (batch.cls >= CLS_WEIGHT)
+                                     .astype(jnp.int64), 0))
+            met = _batch_metrics(
+                met, batch.state, count=batch.count,
+                resv=batch.count.astype(jnp.int64) - prop, prop=prop,
+                lb=jnp.sum(units & (batch.cls >= CLS_LB)),
+                guards_ok=batch.guards_ok)
         new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
-        return new_mut, out
+        return (new_mut, met), out
 
-    mutable, (count, units, guards, slot, cls, length) = lax.scan(
-        body, mutable0, None, length=m)
+    (mutable, metrics), (count, units, guards, slot, cls, length) = \
+        lax.scan(body, (mutable0, obsdev.metrics_zero()), None,
+                 length=m)
     state = EngineState(**invariant, **mutable)
     return ChainEpoch(state=state, count=count, unit_count=units,
                       guards_ok=guards, slot=slot, cls=cls,
-                      length=length)
+                      length=length, metrics=metrics)
 
 
 def make_prefix_runner(k: int, *, anticipation_ns: int = 0,
@@ -1227,12 +1280,15 @@ class CalendarEpoch(NamedTuple):
     progress_ok: jnp.ndarray  # bool[M]
     served: jnp.ndarray       # int32[N] per-client decisions (whole
     #                           epoch; calibration feed)
+    metrics: jnp.ndarray      # int64[NUM_METRICS] (zeros unless
+    #                           with_metrics)
 
 
 def scan_calendar_epoch(state: EngineState, now, m: int, *,
                         steps: int, anticipation_ns: int = 0,
                         allow_limit_break: bool = False,
-                        use_pallas: bool | None = None
+                        use_pallas: bool | None = None,
+                        with_metrics: bool = False
                         ) -> CalendarEpoch:
     """Run m calendar batches on device (each prefetches its own
     ``steps``-row ring window)."""
@@ -1241,7 +1297,7 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
     served0 = jnp.zeros((state.capacity,), dtype=jnp.int32)
 
     def body(carry, _):
-        mut, acc = carry
+        mut, acc, met = carry
         st = EngineState(**invariant, **mut)
         win = ring_window(st, steps, use_pallas=use_pallas)
         batch = calendar_batch(st, now, steps=steps,
@@ -1249,11 +1305,22 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                                allow_limit_break=allow_limit_break,
                                heads=(win.arr, win.cost))
         out = (batch.count, batch.resv_count, batch.progress_ok)
+        if with_metrics:
+            met = _batch_metrics(
+                met, batch.state, count=batch.count,
+                resv=batch.resv_count,
+                prop=batch.count - batch.resv_count,
+                lb=jnp.sum(batch.lb).astype(jnp.int64),
+                # a calendar batch with candidates that cannot make
+                # progress is the guard-trip analog (serial fallback)
+                guards_ok=batch.progress_ok)
         new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
-        return (new_mut, acc + batch.served), out
+        return (new_mut, acc + batch.served, met), out
 
-    (mutable, served), (count, resv, ok) = lax.scan(
-        body, (mutable0, served0), None, length=m)
+    (mutable, served, metrics), (count, resv, ok) = lax.scan(
+        body, (mutable0, served0, obsdev.metrics_zero()), None,
+        length=m)
     state = EngineState(**invariant, **mutable)
     return CalendarEpoch(state=state, count=count, resv_count=resv,
-                         progress_ok=ok, served=served)
+                         progress_ok=ok, served=served,
+                         metrics=metrics)

@@ -280,7 +280,8 @@ def engine_step(state: EngineState, now: jnp.ndarray, *,
 
 def engine_run(state: EngineState, now: jnp.ndarray, steps: int, *,
                allow_limit_break: bool, anticipation_ns: int,
-               advance_now: bool = False, with_horizon: bool = False):
+               advance_now: bool = False, with_horizon: bool = False,
+               with_metrics: bool = False):
     """``steps`` scheduling decisions in one launch via lax.scan.
 
     With a fixed ``now`` this equals ``steps`` successive pulls at the
@@ -298,7 +299,16 @@ def engine_run(state: EngineState, now: jnp.ndarray, steps: int, *,
     would also have produced -- the validity window for speculative
     decision buffers.  Conservative: tags replaced mid-run count via
     the initial-state minimum, created tags via per-step minima.
+
+    With ``with_metrics`` an ``obs.device`` metrics vector is appended
+    to the return tuple, accumulated in the same scan (phase counts,
+    limit-capped FUTURE stalls, ring-occupancy high-water mark) and
+    drained with the same fetch as the decisions -- no extra launch.
+    The flag is STATIC and touches only the metrics carry: the decision
+    stream and final state are bit-identical either way (pinned by
+    tests/test_obs.py).
     """
+    from ..obs import device as _obsdev
 
     def tag_horizon(st, t):
         has_req = st.active & (st.depth > 0)
@@ -309,7 +319,7 @@ def engine_run(state: EngineState, now: jnp.ndarray, steps: int, *,
         return jnp.minimum(hr, hl)
 
     def body(carry, _):
-        st, t, h = carry
+        st, t, h, met = carry
         st, dec = engine_step(st, t,
                               allow_limit_break=allow_limit_break,
                               anticipation_ns=anticipation_ns)
@@ -323,17 +333,30 @@ def engine_run(state: EngineState, now: jnp.ndarray, steps: int, *,
             h = jnp.where(served & (nr > t), jnp.minimum(h, nr), h)
             h = jnp.where(served & ~st.head_ready[w] & (nl > t),
                           jnp.minimum(h, nl), h)
+        if with_metrics:
+            served1 = (dec.type == RETURNING).astype(jnp.int64)
+            is_resv = served1 * (dec.phase == 0)
+            met = _obsdev.metrics_combine(met, _obsdev.metrics_delta(
+                decisions=served1, resv=is_resv,
+                prop=served1 - is_resv,
+                limit_break=dec.limit_break.astype(jnp.int64),
+                stalls=(dec.type == FUTURE).astype(jnp.int64),
+                ring_hwm=jnp.max(st.depth).astype(jnp.int64)))
         if advance_now:
             t = jnp.where(dec.type == FUTURE, dec.when, t)
-        return (st, t, h), dec
+        return (st, t, h, met), dec
 
     h0 = tag_horizon(state, now) if with_horizon \
         else jnp.int64(TIME_MAX)
-    (state, now, horizon), decisions = lax.scan(
-        body, (state, now, h0), None, length=steps)
+    (state, now, horizon, metrics), decisions = lax.scan(
+        body, (state, now, h0, _obsdev.metrics_zero()), None,
+        length=steps)
+    out = (state, now, decisions)
     if with_horizon:
-        return state, now, decisions, horizon
-    return state, now, decisions
+        out = out + (horizon,)
+    if with_metrics:
+        out = out + (metrics,)
+    return out
 
 
 # ----------------------------------------------------------------------

@@ -620,6 +620,37 @@ class TpuPullPriorityQueue:
             return out
 
     # ------------------------------------------------------------------
+    # observability (obs.registry wiring)
+    # ------------------------------------------------------------------
+    def register_metrics(self, registry, labels=None) -> None:
+        """Expose the scheduling counters and the speculative-buffer
+        telemetry as callback gauges (zero hot-path cost; same metric
+        names as the oracle queue so dashboards don't care which
+        backend served)."""
+        rows = (
+            ("dmclock_sched_reservation_total", "reserv_sched_count",
+             "scheduling decisions by phase"),
+            ("dmclock_sched_priority_total", "prop_sched_count",
+             "scheduling decisions by phase"),
+            ("dmclock_sched_limit_break_total",
+             "limit_break_sched_count", "scheduling decisions by phase"),
+            ("dmclock_spec_hits_total", "spec_hits",
+             "pulls served launch-free from the speculative buffer"),
+            ("dmclock_spec_refills_total", "spec_refills",
+             "speculative buffer refill launches"),
+            ("dmclock_spec_settles_total", "spec_settles",
+             "speculative invalidations with an unconsumed tail"),
+            ("dmclock_spec_replays_total", "spec_replays",
+             "settle replays (incl. mixed-drain)"),
+        )
+        for name, attr, help_text in rows:
+            registry.gauge(name, help_text, labels=labels).set_function(
+                lambda a=attr: getattr(self, a))
+        registry.gauge("dmclock_clients", "tracked client records",
+                       labels=labels).set_function(
+            lambda: len(self._slot_of))
+
+    # ------------------------------------------------------------------
     # inspection (host mirrors; reference :545-564)
     # ------------------------------------------------------------------
     def empty(self) -> bool:

@@ -1,0 +1,32 @@
+"""Scheduling observability: metrics registry, device counters, traces.
+
+Three tiers, cheapest first:
+
+1. **On-device counters** (``obs.device``): a small int64 metrics
+   vector accumulated inside the kernels that are already running
+   (``engine.fastpath`` epoch scans, ``engine.kernels.engine_run``) and
+   drained with the existing decision fetch -- zero extra device round
+   trips, and gated so the decision stream is bit-identical with
+   metrics on or off (pinned by ``tests/test_obs.py``).
+2. **Host metrics registry** (``obs.registry``): counters / gauges /
+   histograms / timer wrappers with Prometheus text exposition and a
+   JSON snapshot.  The sim harness, the host scheduler queues, and the
+   distributed tracker register their hot-path stats into it.
+3. **Decision trace + QoS conformance** (``obs.trace``,
+   ``sim.harness.SimReport.conformance``): a bounded JSONL trace of
+   scheduling decisions and an end-of-run per-client conformance table
+   (delivered rate vs reservation/weight/limit).
+
+See ``docs/OBSERVABILITY.md`` for metric names and schemas.
+"""
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       TimerMetric, default_registry)
+from .trace import DecisionTrace, validate_trace_file
+from . import device
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "TimerMetric",
+    "default_registry", "DecisionTrace", "validate_trace_file",
+    "device",
+]

@@ -1,0 +1,110 @@
+"""On-device scheduling metrics: one small int64 vector, zero extra
+round trips.
+
+The epoch scans (``engine.fastpath``) and the serial batch runner
+(``engine.kernels.engine_run``) already read back per-batch commit
+counts; the metrics vector rides in the same scan carry and the same
+fetch.  Accumulation is pure reductions over arrays the kernels
+already materialize (decision phases, depths, guard bits), gated on a
+STATIC ``with_metrics`` flag so the decision stream -- and, with the
+flag off, the compiled program -- is bit-identical to the pre-metrics
+kernels (pinned by ``tests/test_obs.py``).
+
+Vector layout (int64[NUM_METRICS]); counters accumulate by addition,
+high-water marks by ``maximum``:
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# -- indices -----------------------------------------------------------
+MET_DECISIONS = 0       # decisions committed (all phases)
+MET_RESV = 1            # constraint-phase (reservation) decisions
+MET_PROP = 2            # weight-phase (priority) decisions
+MET_LIMIT_BREAK = 3     # AtLimit::Allow limit-break serves
+MET_STALLS = 4          # limit-capped stalls: batches/steps that
+#                         committed nothing while work was queued
+MET_RING_HWM = 5        # ring occupancy high-water mark (max depth)
+MET_GUARD_TRIPS = 6     # rebase-guard trips (fastpath fallbacks)
+MET_INGEST_DROPS = 7    # arrivals dropped by the admission clamp
+NUM_METRICS = 8
+
+METRIC_NAMES = (
+    "decisions_total", "decisions_reservation", "decisions_priority",
+    "decisions_limit_break", "limit_stalls", "ring_occupancy_hwm",
+    "rebase_guard_trips", "ingest_drops",
+)
+
+# the max-accumulated rows (everything else adds)
+_HWM_ROWS = (MET_RING_HWM,)
+_HWM_MASK = jnp.zeros((NUM_METRICS,), dtype=bool)
+for _i in _HWM_ROWS:
+    _HWM_MASK = _HWM_MASK.at[_i].set(True)
+
+
+def metrics_zero() -> jnp.ndarray:
+    return jnp.zeros((NUM_METRICS,), dtype=jnp.int64)
+
+
+def metrics_combine(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Merge two metric vectors (counters add, high-water marks max) --
+    the device-side analog of ``ProfileCombiner``.  Associative and
+    commutative, so shards/epochs merge in any order (and through a
+    psum-of-counters + pmax-of-hwm on a mesh)."""
+    return jnp.where(_HWM_MASK, jnp.maximum(a, b), a + b)
+
+
+def metrics_delta(*, decisions=0, resv=0, prop=0, limit_break=0,
+                  stalls=0, ring_hwm=0, guard_trips=0,
+                  ingest_drops=0) -> jnp.ndarray:
+    """Build a one-batch delta vector from scalar contributions."""
+    rows = [decisions, resv, prop, limit_break, stalls, ring_hwm,
+            guard_trips, ingest_drops]
+    return jnp.stack([jnp.asarray(r, dtype=jnp.int64) for r in rows])
+
+
+def admission_clamp(counts: jnp.ndarray, headroom: jnp.ndarray):
+    """Clamp per-client arrival counts to ring headroom (the AtLimit
+    Reject/EAGAIN analog the sustained bench applies before
+    ``ingest_superwave``), returning ``(clamped, dropped_total)`` so
+    the drop count feeds MET_INGEST_DROPS instead of vanishing."""
+    clamped = jnp.minimum(counts, headroom)
+    dropped = jnp.sum((counts - clamped).astype(jnp.int64))
+    return clamped, dropped
+
+
+def metrics_combine_np(acc, *vecs):
+    """Host-side mirror of :func:`metrics_combine` over numpy vectors
+    (bench.py merges fetched per-chain vectors with this).  Derives the
+    max rows from the same ``_HWM_ROWS`` as the device mask, so the two
+    merges cannot silently diverge."""
+    import numpy as np
+
+    acc = np.asarray(acc, dtype=np.int64)
+    hwm = np.isin(np.arange(acc.size), _HWM_ROWS)
+    for v in vecs:
+        v = np.asarray(v)
+        acc = np.where(hwm, np.maximum(acc, v), acc + v)
+    return acc
+
+
+def metrics_dict(vec) -> dict:
+    """Name the rows of a fetched metrics vector (host side)."""
+    import numpy as np
+
+    v = np.asarray(vec).reshape(-1)
+    return {name: int(v[i]) for i, name in enumerate(METRIC_NAMES)}
+
+
+def publish(registry, vec, prefix: str = "dmclock_engine",
+            labels=None) -> None:
+    """Fold a fetched metrics vector into a host ``MetricsRegistry``:
+    counter rows become counters (the vector is itself cumulative per
+    run, so the registry gauge semantics fit better -- publish uses
+    gauges for everything, with the hwm documented as a max)."""
+    for name, value in metrics_dict(vec).items():
+        registry.gauge(f"{prefix}_{name}",
+                       "on-device scheduling metric (see "
+                       "docs/OBSERVABILITY.md)",
+                       labels=labels).set(value)

@@ -1,0 +1,284 @@
+"""Host-side metrics registry.
+
+A minimal, dependency-free Prometheus-style registry: counters, gauges
+(optionally callback-backed so hot paths pay nothing), fixed-bucket
+histograms, and summaries wrapping the repo's ``utils.profile`` timers
+(the reference's ``support/src/profile.h`` accumulators).  Two
+drains: ``prometheus()`` (text exposition format 0.0.4) and
+``snapshot()`` (JSON-able dict, what ``bench.py`` / ``dmc_sim
+--metrics-out`` write).
+
+Durations are exposed in nanoseconds with an explicit ``_ns`` unit in
+the metric name -- the whole repo's tag algebra is int64 ns, and
+converting to float seconds at the edge would be the only lossy step.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.profile import ProfileCombiner, _ProfileBase
+
+_DEFAULT_BUCKETS = (1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, float("inf"))
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and not v.is_integer():
+        return repr(v)
+    return str(int(v))
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Common name/help/labels plumbing."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str,
+                 labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.help = help_text
+        self.labels = dict(labels or {})
+
+    def sample_rows(self) -> List[Tuple[str, Dict[str, str], float]]:
+        """(suffix, extra labels, value) rows for exposition."""
+        raise NotImplementedError
+
+    def value_obj(self):
+        """JSON-able value for ``snapshot()``."""
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonic counter."""
+
+    kind = "counter"
+
+    def __init__(self, name, help_text="", labels=None):
+        super().__init__(name, help_text, labels)
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        assert n >= 0, "counters only go up"
+        self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def sample_rows(self):
+        return [("", {}, self._value)]
+
+    def value_obj(self):
+        return self._value
+
+
+class Gauge(_Metric):
+    """Point-in-time value; ``set_function`` makes it callback-backed
+    (read lazily at drain time -- zero hot-path cost)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help_text="", labels=None):
+        super().__init__(name, help_text, labels)
+        self._value = 0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, v) -> None:
+        self._value = v
+
+    def inc(self, n=1) -> None:
+        self._value += n
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    @property
+    def value(self):
+        return self._fn() if self._fn is not None else self._value
+
+    def sample_rows(self):
+        return [("", {}, self.value)]
+
+    def value_obj(self):
+        return self.value
+
+
+class Histogram(_Metric):
+    """Fixed upper-bound buckets (cumulative, Prometheus-style)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help_text="", labels=None,
+                 buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_text, labels)
+        b = sorted(float(x) for x in buckets)
+        if not b or b[-1] != float("inf"):
+            b.append(float("inf"))
+        self.buckets = tuple(b)
+        self.counts = [0] * len(self.buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v) -> None:
+        self.count += 1
+        self.sum += v
+        for i, ub in enumerate(self.buckets):
+            if v <= ub:
+                self.counts[i] += 1
+                break
+
+    def sample_rows(self):
+        rows = []
+        cum = 0
+        for ub, c in zip(self.buckets, self.counts):
+            cum += c
+            rows.append(("_bucket", {"le": _fmt_value(ub)}, cum))
+        rows.append(("_sum", {}, self.sum))
+        rows.append(("_count", {}, self.count))
+        return rows
+
+    def value_obj(self):
+        return {"buckets": {_fmt_value(ub): c for ub, c
+                            in zip(self.buckets, self.counts)},
+                "sum": self.sum, "count": self.count}
+
+
+class TimerMetric(_Metric):
+    """Summary view over one or more ``utils.profile`` accumulators
+    (``ProfileTimer`` / ``ProfileCombiner``).  Multiple sources are
+    merged at drain time with ``ProfileCombiner`` -- the reference's
+    multi-thread merge semantics (profile.h:100-120) -- so registering
+    each server's timer under one name yields the combined stats."""
+
+    kind = "summary"
+
+    def __init__(self, name, help_text="", labels=None):
+        super().__init__(name, help_text, labels)
+        self._sources: List[_ProfileBase] = []
+
+    def add_source(self, timer: _ProfileBase) -> None:
+        self._sources.append(timer)
+
+    def _combined(self) -> ProfileCombiner:
+        comb = ProfileCombiner()
+        for t in self._sources:
+            comb.combine(t)
+        return comb
+
+    def sample_rows(self):
+        c = self._combined()
+        return [("_count", {}, c.count),
+                ("_sum", {}, c.sum_ns),
+                ("_min", {}, c.low_ns or 0),
+                ("_max", {}, c.high_ns or 0),
+                ("_mean", {}, c.mean_ns()),
+                ("_stddev", {}, c.std_dev_ns())]
+
+    def value_obj(self):
+        c = self._combined()
+        return {"count": c.count, "sum_ns": c.sum_ns,
+                "min_ns": c.low_ns or 0, "max_ns": c.high_ns or 0,
+                "mean_ns": c.mean_ns(), "stddev_ns": c.std_dev_ns()}
+
+
+class MetricsRegistry:
+    """Get-or-create registry keyed by (name, labels).
+
+    All factories are idempotent: asking for an existing
+    (name, labels) pair returns the live instance, so independent
+    modules can share counters without plumbing objects around.
+    """
+
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self._metrics: Dict[Tuple[str, Tuple], _Metric] = {}
+
+    def _get_or_create(self, cls, name, help_text, labels, **kw):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._mtx:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, help_text, labels, **kw)
+                self._metrics[key] = m
+            else:
+                assert isinstance(m, cls), \
+                    f"{name} already registered as {m.kind}"
+            return m
+
+    def counter(self, name, help_text="", labels=None) -> Counter:
+        return self._get_or_create(Counter, name, help_text, labels)
+
+    def gauge(self, name, help_text="", labels=None) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, labels)
+
+    def histogram(self, name, help_text="", labels=None,
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help_text, labels,
+                                   buckets=buckets)
+
+    def timer(self, name, help_text="", labels=None,
+              source: Optional[_ProfileBase] = None) -> TimerMetric:
+        t = self._get_or_create(TimerMetric, name, help_text, labels)
+        if source is not None and source not in t._sources:
+            t.add_source(source)
+        return t
+
+    # -- drains --------------------------------------------------------
+    def metrics(self) -> List[_Metric]:
+        with self._mtx:
+            return list(self._metrics.values())
+
+    def prometheus(self) -> str:
+        """Text exposition format 0.0.4.  Label variants of one metric
+        name register independently (possibly interleaved with other
+        registrations), but a metric family must be one contiguous
+        group in the output -- strict parsers reject interleaving -- so
+        the drain groups by name first."""
+        by_name: Dict[str, List[_Metric]] = {}
+        for m in self.metrics():
+            by_name.setdefault(m.name, []).append(m)
+        lines = []
+        for name, group in by_name.items():
+            help_text = next((m.help for m in group if m.help), "")
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {group[0].kind}")
+            for m in group:
+                for suffix, extra, value in m.sample_rows():
+                    labels = dict(m.labels)
+                    labels.update(extra)
+                    lines.append(f"{name}{suffix}{_label_str(labels)} "
+                                 f"{_fmt_value(float(value))}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-able {name: [{labels, kind, value}, ...]}."""
+        out: Dict[str, list] = {}
+        for m in self.metrics():
+            out.setdefault(m.name, []).append(
+                {"labels": m.labels, "kind": m.kind,
+                 "value": m.value_obj()})
+        return out
+
+    def snapshot_json(self, **json_kw) -> str:
+        return json.dumps(self.snapshot(), **json_kw)
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """Process-wide registry (modules that have no natural owner --
+    e.g. the bench script -- register here)."""
+    return _DEFAULT

@@ -1,0 +1,104 @@
+"""Bounded JSONL decision trace.
+
+One JSON object per scheduling decision, streamed to disk as the sim
+runs (no unbounded in-memory list), capped at ``limit`` rows -- past
+the cap rows are counted but not written, so a runaway sim cannot fill
+the disk.  Schema (``docs/OBSERVABILITY.md``):
+
+    {"t": <virtual ns>, "server": <id>, "client": <id>,
+     "phase": "reservation" | "priority", "cost": <int>,
+     "tag": [resv, prop, limit] | null}
+
+``tag`` is the served request's tag triple when the backend exposes it
+(the host oracle queues do via ``PullReq.tag``); backends that never
+materialize per-decision tags on the host (the TPU batch engine) emit
+``null`` -- the field is optional-by-null, never absent.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import IO, Optional
+
+TRACE_FIELDS = ("t", "server", "client", "phase", "cost", "tag")
+_PHASES = ("reservation", "priority")
+
+
+class DecisionTrace:
+    """Streaming bounded JSONL writer for scheduling decisions."""
+
+    def __init__(self, path: str, limit: int = 1_000_000):
+        self.path = path
+        self.limit = int(limit)
+        self.rows_written = 0
+        self.rows_dropped = 0
+        self._fh: Optional[IO[str]] = open(path, "w")
+
+    def record(self, t_ns: int, server, client, phase: int, cost: int,
+               tag=None) -> None:
+        if self._fh is None:
+            return
+        if self.rows_written >= self.limit:
+            self.rows_dropped += 1
+            return
+        row = {"t": int(t_ns), "server": server, "client": client,
+               "phase": _PHASES[int(phase)], "cost": int(cost),
+               "tag": [int(x) for x in tag] if tag is not None else None}
+        self._fh.write(json.dumps(row, separators=(",", ":")) + "\n")
+        self.rows_written += 1
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def validate_trace_file(path: str) -> dict:
+    """Validate a trace file against the schema; raises ``ValueError``
+    on the first bad row.  Returns summary stats the CI smoke checks
+    against the conformance table:
+
+        {"rows": N, "per_client": {client: count},
+         "per_phase": {"reservation": n, "priority": n}}
+    """
+    per_client: dict = {}
+    per_phase = {"reservation": 0, "priority": 0}
+    rows = 0
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i+1}: not JSON: {e}")
+            if set(row) != set(TRACE_FIELDS):
+                raise ValueError(
+                    f"{path}:{i+1}: fields {sorted(row)} != "
+                    f"{sorted(TRACE_FIELDS)}")
+            if row["phase"] not in _PHASES:
+                raise ValueError(f"{path}:{i+1}: bad phase "
+                                 f"{row['phase']!r}")
+            if not isinstance(row["t"], int) or \
+                    not isinstance(row["cost"], int):
+                raise ValueError(f"{path}:{i+1}: t/cost must be ints")
+            tag = row["tag"]
+            if tag is not None and (
+                    not isinstance(tag, list) or len(tag) != 3 or
+                    not all(isinstance(x, int) for x in tag)):
+                raise ValueError(f"{path}:{i+1}: tag must be null or "
+                                 "[resv, prop, limit] ints")
+            rows += 1
+            key = row["client"]
+            per_client[key] = per_client.get(key, 0) + 1
+            per_phase[row["phase"]] += 1
+    return {"rows": rows, "per_client": per_client,
+            "per_phase": per_phase}

@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine import kernels
+from ..utils.compat import shard_map
 from ..engine.state import EngineState, init_state
 from .tracker import (BorrowTrackerState, TrackerState,
                       borrow_tracker_prepare, borrow_tracker_track,
@@ -211,7 +212,7 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
         return engine, tracker, now, decs
 
     spec = P(SERVER_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(spec, spec, spec, spec),
         out_specs=(spec, spec, spec, spec),
@@ -254,7 +255,7 @@ def create_clients(cluster: ClusterState, new_mask: jnp.ndarray,
             e, ops, anticipation_ns=0))(engine)
 
     spec = P(SERVER_AXIS)
-    engine = jax.shard_map(
+    engine = shard_map(
         shard_fn, mesh=mesh, in_specs=(spec,), out_specs=spec,
         check_vma=False)(cluster.engine)
     return cluster._replace(engine=engine)

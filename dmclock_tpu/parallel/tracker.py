@@ -115,6 +115,45 @@ def tracker_prepare(tracker: TrackerState, requesting: jnp.ndarray,
 
 
 # ----------------------------------------------------------------------
+# observability (obs.registry wiring)
+# ----------------------------------------------------------------------
+
+def tracker_snapshot(tracker) -> dict:
+    """Aggregate hot-path stats of one tracker shard as host scalars
+    (works for both ``TrackerState`` and ``BorrowTrackerState``).  One
+    device fetch per call -- drain-time only, never per request."""
+    import numpy as np
+
+    out = {
+        "completed_delta_total": int(np.asarray(
+            tracker.completed_delta).sum()),
+        "completed_rho_total": int(np.asarray(
+            tracker.completed_rho).sum()),
+        "clients_seen": int(np.asarray(tracker.seen).sum()),
+    }
+    if hasattr(tracker, "borrow_delta"):
+        out["borrow_delta_outstanding"] = int(np.asarray(
+            tracker.borrow_delta).sum())
+        out["borrow_rho_outstanding"] = int(np.asarray(
+            tracker.borrow_rho).sum())
+    return out
+
+
+def register_tracker_metrics(registry, get_tracker, labels=None) -> None:
+    """Register callback gauges over a tracker shard.  ``get_tracker``
+    returns the CURRENT state (tracker states are immutable NamedTuples
+    that callers rebind, so a getter is the only stable handle)."""
+    def gauge_fn(key):
+        return lambda: tracker_snapshot(get_tracker()).get(key, 0)
+
+    for key in ("completed_delta_total", "completed_rho_total",
+                "clients_seen"):
+        registry.gauge(f"dmclock_tracker_{key}",
+                       "distributed ServiceTracker shard stat",
+                       labels=labels).set_function(gauge_fn(key))
+
+
+# ----------------------------------------------------------------------
 # BorrowingTracker variant (reference dmclock_client.h:90-154)
 # ----------------------------------------------------------------------
 

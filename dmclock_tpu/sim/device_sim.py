@@ -58,6 +58,7 @@ from ..engine.fastpath import (_window_heads, ring_window,
                                speculate_prefix_batch)
 from ..engine.state import EngineState, init_state
 from ..parallel.cluster import SERVER_AXIS, make_mesh
+from ..utils.compat import shard_map
 from ..parallel.tracker import (TrackerState, global_counters,
                                 init_tracker, tracker_prepare,
                                 tracker_track)
@@ -467,7 +468,7 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
     srv = P(SERVER_AXIS)
     rep = P()
     server_ids = jnp.arange(s_total, dtype=jnp.int32)
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(srv, srv, rep, srv, srv, srv, rep, rep, srv),
         out_specs=(srv, srv, rep, srv, srv, srv, rep, rep),

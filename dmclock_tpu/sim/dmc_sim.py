@@ -15,20 +15,23 @@ import argparse
 import sys
 
 from .. import models
+from ..obs import DecisionTrace
 from .config import SimConfig, parse_config_file
 from .harness import Simulation
 
 
 def run_sim(cfg: SimConfig, model: str = "dmclock", seed: int = 12345,
             record_trace: bool = False,
-            server_mode: str = "pull") -> Simulation:
+            server_mode: str = "pull",
+            registry=None, decision_trace=None) -> Simulation:
     _pull_factory, tracker_factory = models.get(model)
     if server_mode == "push":
         queue_factory = models.get_push(model)
     else:
         queue_factory = _pull_factory
     sim = Simulation(cfg, queue_factory, tracker_factory, seed=seed,
-                     record_trace=record_trace, server_mode=server_mode)
+                     record_trace=record_trace, server_mode=server_mode,
+                     registry=registry, decision_trace=decision_trace)
     sim.run()
     return sim
 
@@ -48,6 +51,18 @@ def main(argv=None) -> int:
                    "mode)")
     p.add_argument("--intervals", action="store_true",
                    help="print per-client per-second op counts")
+    p.add_argument("--trace", metavar="FILE", default=None,
+                   help="write a bounded JSONL decision trace "
+                   "(schema: docs/OBSERVABILITY.md)")
+    p.add_argument("--trace-limit", type=int, default=1_000_000,
+                   help="max trace rows before dropping (bounded "
+                   "trace; default 1M)")
+    p.add_argument("--conformance", action="store_true",
+                   help="print the per-client QoS conformance table "
+                   "(delivered rate vs reservation/weight/limit)")
+    p.add_argument("--metrics-out", metavar="FILE", default=None,
+                   help="dump the metrics registry at exit: Prometheus "
+                   "text (.prom/.txt) or JSON snapshot (.json)")
     p.add_argument("--use-prop-heap", action="store_true",
                    help="dmclock-native model: enable the O(1) "
                    "idle-reactivation prop heap (reference "
@@ -68,9 +83,30 @@ def main(argv=None) -> int:
         cfg = parse_config_file(args.conf) if args.conf else SimConfig()
     except OSError as e:
         p.error(f"cannot read config file: {e}")
-    sim = run_sim(cfg, model=args.model, seed=args.seed,
-                  server_mode=args.server_mode)
-    print(sim.report().format(show_intervals=args.intervals))
+    trace = DecisionTrace(args.trace, limit=args.trace_limit) \
+        if args.trace else None
+    try:
+        sim = run_sim(cfg, model=args.model, seed=args.seed,
+                      server_mode=args.server_mode,
+                      decision_trace=trace)
+    finally:
+        if trace is not None:
+            trace.close()
+    report = sim.report()
+    print(report.format(show_intervals=args.intervals))
+    if args.conformance:
+        print(report.format_conformance())
+    if trace is not None and trace.rows_dropped:
+        print(f"# trace: {trace.rows_written} rows written, "
+              f"{trace.rows_dropped} dropped past --trace-limit")
+    if args.metrics_out:
+        reg = sim.registry
+        if args.metrics_out.endswith(".json"):
+            text = reg.snapshot_json(indent=1)
+        else:
+            text = reg.prometheus()
+        with open(args.metrics_out, "w") as fh:
+            fh.write(text)
     return 0
 
 

@@ -25,7 +25,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core import NS_PER_SEC, Phase, ReqParams
-from ..utils.profile import ProfileTimer
+from ..obs.registry import MetricsRegistry
+from ..utils.profile import ProfileCombiner, ProfileTimer
 from .config import ClientGroup, ServerGroup, SimConfig
 
 
@@ -82,13 +83,19 @@ def _op_time_ns(threads: int, iops: float) -> int:
     return int(0.5 + threads * 1e6 / iops) * 1000
 
 
-def _record_service(server, client, phase: Phase, cost: int) -> None:
+def _record_service(server, client, phase: Phase, cost: int,
+                    tag=None) -> None:
     """Shared serve bookkeeping (trace row + per-phase stats) for both
     server drive modes -- pull/push trace equality depends on the two
     modes recording identically."""
     if server.trace is not None:
         server.trace.append((server.loop.now_ns, server.id, client,
                              int(phase), cost))
+    if server.decision_trace is not None:
+        server.decision_trace.record(
+            server.loop.now_ns, server.id, client, int(phase), cost,
+            tag=(tag.reservation, tag.proportion, tag.limit)
+            if tag is not None else None)
     phase_idx = server.stats.per_client_phase.setdefault(client, [0, 0])
     phase_idx[int(phase)] += 1
     server.stats.ops_completed += 1
@@ -110,7 +117,8 @@ class SimulatedServer:
     def __init__(self, server_id: Any, iops: float, threads: int,
                  queue, loop: EventLoop,
                  client_resp_f: Callable[[Any, Any, Phase, int, Any], None],
-                 trace: Optional[list] = None):
+                 trace: Optional[list] = None,
+                 decision_trace=None):
         self.id = server_id
         self.queue = queue
         self.loop = loop
@@ -120,6 +128,7 @@ class SimulatedServer:
         self.busy = 0
         self.stats = ServerStats()
         self.trace = trace
+        self.decision_trace = decision_trace
         self._wake_at: Optional[int] = None
 
     # the "network" seam: a client submits a request here
@@ -167,7 +176,8 @@ class SimulatedServer:
         self._dispatch()
 
     def _start_service(self, pr) -> None:
-        _record_service(self, pr.client, pr.phase, pr.cost)
+        _record_service(self, pr.client, pr.phase, pr.cost,
+                        tag=getattr(pr, "tag", None))
 
         def complete(client=pr.client, request=pr.request,
                      phase=pr.phase, cost=pr.cost):
@@ -200,7 +210,8 @@ class PushSimulatedServer:
     def __init__(self, server_id: Any, iops: float, threads: int,
                  make_queue, loop: EventLoop,
                  client_resp_f: Callable[[Any, Any, Phase, int, Any], None],
-                 trace: Optional[list] = None):
+                 trace: Optional[list] = None,
+                 decision_trace=None):
         self.id = server_id
         self.loop = loop
         self.client_resp_f = client_resp_f
@@ -209,6 +220,7 @@ class PushSimulatedServer:
         self.busy = 0
         self.stats = ServerStats()
         self.trace = trace
+        self.decision_trace = decision_trace
         # make_queue(can_handle_f, handle_f, now_ns_f, sched_at_f,
         # capacity_f); capacity_f is the free-slot count (reference
         # has_avail_thread, sim_server.h:179) -- batch-capable queues
@@ -356,12 +368,17 @@ class Simulation:
 
     def __init__(self, cfg: SimConfig, queue_factory, tracker_factory,
                  seed: int = 12345, record_trace: bool = False,
-                 server_mode: str = "pull"):
+                 server_mode: str = "pull",
+                 registry: Optional[MetricsRegistry] = None,
+                 decision_trace=None):
         assert server_mode in ("pull", "push")
         self.server_mode = server_mode
         self.cfg = cfg
         self.loop = EventLoop()
         self.trace: Optional[list] = [] if record_trace else None
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.decision_trace = decision_trace
         self._rng = random.Random(seed)
         self._done_clients = set()
 
@@ -403,13 +420,16 @@ class Simulation:
                                          cfg.server_soft_limit, **cb)
                 self.servers[s] = PushSimulatedServer(
                     s, g.server_iops, g.server_threads, make_queue,
-                    self.loop, self._client_resp, trace=self.trace)
+                    self.loop, self._client_resp, trace=self.trace,
+                    decision_trace=self.decision_trace)
             else:
                 q = queue_factory(s, client_info_f, anticipation_ns,
                                   cfg.server_soft_limit)
                 self.servers[s] = SimulatedServer(
                     s, g.server_iops, g.server_threads, q, self.loop,
-                    self._client_resp, trace=self.trace)
+                    self._client_resp, trace=self.trace,
+                    decision_trace=self.decision_trace)
+            self._register_server_metrics(s)
 
         self.clients: Dict[int, SimulatedClient] = {}
         for c in range(self.n_clients):
@@ -419,8 +439,55 @@ class Simulation:
                 c, g, tracker_factory(), self.loop, select,
                 self._submit, self._client_done)
 
+        # aggregate callback gauges: read lazily at drain time, so the
+        # event loop's hot path never touches the registry
+        reg = self.registry
+        reg.gauge("sim_ops_completed_total",
+                  "client ops completed (all clients)").set_function(
+            lambda: sum(c.stats.ops_completed
+                        for c in self.clients.values()))
+        reg.gauge("sim_reservation_ops_total",
+                  "constraint-phase completions").set_function(
+            lambda: sum(c.stats.reservation_ops
+                        for c in self.clients.values()))
+        reg.gauge("sim_priority_ops_total",
+                  "weight-phase completions").set_function(
+            lambda: sum(c.stats.priority_ops
+                        for c in self.clients.values()))
+        reg.gauge("sim_virtual_time_ns",
+                  "virtual clock").set_function(lambda: self.loop.now_ns)
+        reg.timer("sim_client_get_req_params_ns",
+                  "tracker get_req_params latency (all clients)")
+        reg.timer("sim_client_track_resp_ns",
+                  "tracker track_resp latency (all clients)")
+        for c in self.clients.values():
+            reg.timer("sim_client_get_req_params_ns",
+                      source=c.stats.get_req_params_timer)
+            reg.timer("sim_client_track_resp_ns",
+                      source=c.stats.track_resp_timer)
+
         self._wall_start = None
         self._wall_elapsed_s = None
+
+    def _register_server_metrics(self, s: int) -> None:
+        """Per-server hot-path stats: the host-call timers as merged
+        summaries, the queue's scheduling counters via its own
+        ``register_metrics`` when the backend offers one."""
+        server = self.servers[s]
+        labels = {"server": str(s)}
+        self.registry.timer("sim_server_add_request_ns",
+                            "queue add_request latency", labels=labels,
+                            source=server.stats.add_request_timer)
+        self.registry.timer("sim_server_request_complete_ns",
+                            "completion-path latency", labels=labels,
+                            source=server.stats.request_complete_timer)
+        self.registry.gauge("sim_server_ops_completed",
+                            "decisions served", labels=labels
+                            ).set_function(
+            lambda st=server.stats: st.ops_completed)
+        queue = getattr(server, "queue", None)
+        if queue is not None and hasattr(queue, "register_metrics"):
+            queue.register_metrics(self.registry, labels=labels)
 
     # -- server-selection policies (reference simulate.h:398-444) -----
     def _make_server_select(self, client_idx: int, g: ClientGroup):
@@ -476,6 +543,73 @@ class SimReport:
         self.total_priority_ops = sum(c.stats.priority_ops
                                       for c in sim.clients.values())
 
+    # -- per-client QoS conformance (delivered vs contracted) ----------
+    def conformance(self, tol: float = 0.05) -> List[dict]:
+        """Per-client QoS conformance rows: delivered rate over the
+        client's own active window vs its reservation / weight / limit
+        contract (the reference sim's per-client breakdown,
+        simulate.h:214-270, extended with met/violated verdicts).
+
+        A closed-loop client can deliver under its reservation simply
+        by not asking, so ``resv_met`` judges against
+        ``min(reservation, demand_rate)``; ``limit_ok`` judges the
+        delivered rate against the limit cap.  ``tol`` is the relative
+        slack both verdicts allow.
+        """
+        sim = self.sim
+        rows = []
+        for cid in sorted(sim.clients):
+            c = sim.clients[cid]
+            g = sim.cfg.cli_group[sim.client_group_of[cid]]
+            start_ns = int(g.client_wait_s * NS_PER_SEC)
+            end_ns = c.stats.finish_time_ns or sim.loop.now_ns
+            window_s = max((end_ns - start_ns) / NS_PER_SEC, 1e-9)
+            rate = c.stats.ops_completed / window_s
+            demand = c.stats.ops_requested / window_s
+            resv_floor = min(g.client_reservation, demand)
+            rows.append({
+                "client": cid,
+                "group": sim.client_group_of[cid],
+                "reservation": g.client_reservation,
+                "weight": g.client_weight,
+                "limit": g.client_limit,
+                "ops": c.stats.ops_completed,
+                "reservation_ops": c.stats.reservation_ops,
+                "priority_ops": c.stats.priority_ops,
+                "rate": rate,
+                "demand_rate": demand,
+                "resv_met": (rate >= resv_floor * (1.0 - tol))
+                if g.client_reservation > 0 else True,
+                "limit_ok": (rate <= g.client_limit * (1.0 + tol))
+                if g.client_limit > 0 else True,
+            })
+        return rows
+
+    def format_conformance(self, tol: float = 0.05) -> str:
+        rows = self.conformance(tol=tol)
+        lines = ["-- per-client QoS conformance --",
+                 f"{'client':>6} {'grp':>3} {'resv':>8} {'wght':>6} "
+                 f"{'limit':>8} {'ops':>8} {'res/prop':>13} "
+                 f"{'rate':>9} {'verdict':>10}"]
+        for r in rows:
+            verdict = ("ok" if r["resv_met"] else "RESV-MISS") + \
+                ("" if r["limit_ok"] else "+LIMIT-EXCESS")
+            lines.append(
+                f"{r['client']:>6} {r['group']:>3} "
+                f"{r['reservation']:>8.1f} {r['weight']:>6.1f} "
+                f"{r['limit']:>8.1f} {r['ops']:>8} "
+                f"{r['reservation_ops']:>6}/{r['priority_ops']:<6} "
+                f"{r['rate']:>9.2f} {verdict:>10}")
+        total = sum(r["ops"] for r in rows)
+        misses = sum(1 for r in rows if not r["resv_met"])
+        excess = sum(1 for r in rows if not r["limit_ok"])
+        soft = " (allowed: server_soft_limit serves past the limit " \
+            "when capacity is spare)" \
+            if self.sim.cfg.server_soft_limit and excess else ""
+        lines.append(f"total ops {total} | reservation misses {misses} "
+                     f"| limit excesses {excess}{soft}")
+        return "\n".join(lines)
+
     def client_interval_ops(self, interval_s: float = 1.0) -> Dict[int, List[int]]:
         out = {}
         step = int(interval_s * NS_PER_SEC)
@@ -518,26 +652,25 @@ class SimReport:
                 f" | done @ {finish:.2f}s | average {rate:.2f} ops/s")
 
         # host-call latency averages (the numbers the reference
-        # benchmark greps, simulate.h:306-395)
-        add_t = ProfileTimer()
+        # benchmark greps, simulate.h:306-395), merged with the
+        # reference's ProfileCombiner semantics (profile.h:100-120) so
+        # stddev/min/max survive the multi-server merge
+        add_t = ProfileCombiner()
         for s in sim.servers.values():
-            st = s.stats.add_request_timer
-            if st.count:
-                add_t.count += st.count
-                add_t.sum_ns += st.sum_ns
-        gr_t = ProfileTimer()
-        tr_t = ProfileTimer()
+            add_t.combine(s.stats.add_request_timer)
+        gr_t = ProfileCombiner()
+        tr_t = ProfileCombiner()
         for c in sim.clients.values():
-            for acc, src in ((gr_t, c.stats.get_req_params_timer),
-                             (tr_t, c.stats.track_resp_timer)):
-                if src.count:
-                    acc.count += src.count
-                    acc.sum_ns += src.sum_ns
+            gr_t.combine(c.stats.get_req_params_timer)
+            tr_t.combine(c.stats.track_resp_timer)
         lines.append("-- server internal stats --")
-        lines.append(f"average add_request: {add_t.mean_ns():.0f} ns")
+        lines.append(f"average add_request: {add_t.mean_ns():.0f} ns "
+                     f"(stddev {add_t.std_dev_ns():.0f})")
         lines.append("-- client internal stats --")
-        lines.append(f"average get_req_params: {gr_t.mean_ns():.0f} ns")
-        lines.append(f"average track_resp: {tr_t.mean_ns():.0f} ns")
+        lines.append(f"average get_req_params: {gr_t.mean_ns():.0f} ns "
+                     f"(stddev {gr_t.std_dev_ns():.0f})")
+        lines.append(f"average track_resp: {tr_t.mean_ns():.0f} ns "
+                     f"(stddev {tr_t.std_dev_ns():.0f})")
 
         if show_intervals:
             lines.append("-- per-client interval ops/sec --")

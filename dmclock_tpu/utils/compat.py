@@ -1,0 +1,25 @@
+"""Version-compatibility shims.
+
+The repo targets current jax APIs; this container ships jax 0.4.x,
+where some of them live elsewhere or spell their kwargs differently.
+Import the shimmed name from here instead of feature-testing at every
+call site.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map                    # jax >= 0.6
+except AttributeError:
+    # jax 0.4/0.5: experimental home, and the replication check kwarg
+    # is spelled check_rep instead of check_vma
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map(f, **kw)
+
+__all__ = ["shard_map"]

@@ -53,6 +53,15 @@ def median(xs):
     return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
 
 
+def is_fallback(rec: dict) -> bool:
+    """A backend-fallback session: bench.py could not initialize the
+    accelerator and ran (a reduced shape) on cpu.  Such records keep
+    the trajectory unbroken (BENCH_r05 was a null round) but their
+    rates are not comparable to accelerator sessions -- the guard
+    annotates them and keeps them out of the medians."""
+    return bool(rec.get("fallback")) or rec.get("platform") == "cpu"
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=2.0,
@@ -67,12 +76,25 @@ def main() -> int:
               "benchmark/history/ records on real hardware)")
         return 0
 
+    n_fb = sum(1 for _, r in recs if is_fallback(r))
+    if n_fb:
+        print(f"bench_guard: {n_fb} backend-fallback record(s) in "
+              "history -- annotated, excluded from medians")
+
     newest_name, newest = recs[-1]
+    if is_fallback(newest):
+        err = newest.get("backend_error") or newest.get("error") or ""
+        print(f"bench_guard: newest record {newest_name} is a "
+              f"backend-fallback (cpu) session"
+              + (f" [{err}]" if err else "")
+              + " -- not judged against accelerator history; pass")
+        return 0
     # only same-device sessions are comparable: the tunnel serves
     # whatever chip generation is attached that day, and a device swap
     # would read as a phantom regression (or hide a real one)
     dev = newest.get("device")
-    prior = [(n, r) for n, r in recs[:-1] if r.get("device") == dev]
+    prior = [(n, r) for n, r in recs[:-1]
+             if r.get("device") == dev and not is_fallback(r)]
     status = 0
     for wl, row in sorted(newest.get("workloads", {}).items()):
         dps = row.get("dps")

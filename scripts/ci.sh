@@ -18,6 +18,31 @@ echo "== simulator smoke =="
 timeout -k 30 900 python -m dmclock_tpu.sim.dmc_sim -c configs/dmc_sim_example.conf | tail -3
 native/build/dmc_sim_native -c configs/dmc_sim_example.conf | tail -3
 
+echo "== observability smoke (trace schema + conformance cross-check) =="
+timeout -k 30 900 python - <<'EOF'
+import io, re, sys, tempfile
+from contextlib import redirect_stdout
+from dmclock_tpu.obs import validate_trace_file
+from dmclock_tpu.sim import dmc_sim
+
+trace = tempfile.NamedTemporaryFile(suffix=".jsonl", delete=False).name
+buf = io.StringIO()
+with redirect_stdout(buf):
+    rc = dmc_sim.main(["-c", "configs/dmc_sim_example.conf",
+                       "--trace", trace, "--conformance"])
+assert rc == 0, f"dmc_sim exited {rc}"
+out = buf.getvalue()
+stats = validate_trace_file(trace)        # raises on any bad row
+m = re.search(r"total ops (\d+)", out)
+assert m, "conformance table missing from sim output"
+total = int(m.group(1))
+assert stats["rows"] == total, \
+    f"trace rows {stats['rows']} != conformance total ops {total}"
+assert sum(stats["per_client"].values()) == total
+print(f"observability smoke ok ({total} decisions traced, schema "
+      f"valid, conformance table sums match)")
+EOF
+
 echo "== full-scale TPU parity (100x100 acceptance config) =="
 timeout -k 30 1800 python scripts/run_fullscale.py
 

@@ -9,12 +9,19 @@ int64 nanoseconds.
 """
 
 import gc
+import os
 
 import jax
 import pytest
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the CPU device count is an XLA flag, read when the
+    # backend initializes (no backend exists yet at conftest time)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
 jax.config.update("jax_enable_x64", True)
 
 

@@ -60,6 +60,35 @@ def test_device_change_not_compared(monkeypatch, capsys, tmp_path):
     assert "not judged" in out
 
 
+def test_fallback_newest_annotated_not_judged(monkeypatch, capsys,
+                                              tmp_path):
+    # a backend-fallback (cpu) session must never read as a regression
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 45e6)])
+    (hist / "bench_2000.json").write_text(json.dumps(
+        {"platform": "cpu", "device": "CpuDevice(id=0)",
+         "fallback": True, "backend_error": "RuntimeError: tunnel",
+         "workloads": {"serve": {"dps": 0.2e6}}}))
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "backend-fallback" in out and "not judged" in out
+
+
+def test_fallback_prior_excluded_from_medians(monkeypatch, capsys,
+                                              tmp_path):
+    # fallback records in the prior set must not drag the median down
+    # and mask a real regression
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 44e6)])
+    (hist / "bench_1500.json").write_text(json.dumps(
+        {"platform": "cpu", "device": "tpu0", "fallback": True,
+         "workloads": {"serve": {"dps": 0.2e6}}}))
+    (hist / "bench_2000.json").write_text(json.dumps(
+        {"platform": "tpu", "device": "tpu0",
+         "workloads": {"serve": {"dps": 10e6}}}))
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 1 and "REGRESSION" in out
+    assert "excluded from medians" in out
+
+
 def test_tolerance_flag(monkeypatch, capsys, tmp_path):
     hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 40e6),
                                     ("tpu0", 15e6)])

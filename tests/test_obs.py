@@ -1,0 +1,397 @@
+"""Observability-layer tests.
+
+The load-bearing contract is the guard test: enabling the on-device
+metrics vector must not perturb the decision stream -- `with_metrics`
+is a STATIC flag that only adds reductions over arrays the kernels
+already materialize, so decisions and final state are bit-identical
+with it on or off.  The rest pins the host registry (Prometheus
+exposition + JSON snapshot), the ProfileCombiner merge semantics
+(reference profile.h:100-120), the bounded JSONL decision trace, and
+the sim's per-client QoS conformance table agreeing with the trace.
+"""
+
+import json
+import math
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmclock_tpu.core import ClientInfo, NS_PER_SEC
+from dmclock_tpu.engine import kernels
+from dmclock_tpu.engine.fastpath import (scan_calendar_epoch,
+                                         scan_chain_epoch,
+                                         scan_prefix_epoch)
+from dmclock_tpu.obs import (DecisionTrace, MetricsRegistry,
+                             validate_trace_file)
+from dmclock_tpu.obs import device as obsdev
+from dmclock_tpu.sim import ClientGroup, ServerGroup, SimConfig
+from dmclock_tpu.sim.dmc_sim import run_sim
+from dmclock_tpu.utils.profile import ProfileCombiner, ProfileTimer
+
+from engine_helpers import assert_states_equal, build_state, deep_state
+
+S = NS_PER_SEC
+
+INFOS = {
+    0: ClientInfo(10.0, 2.0, 50.0),
+    1: ClientInfo(5.0, 1.0, 40.0),
+    2: ClientInfo(0.0, 3.0, 0.0),
+}
+
+
+def _mixed_state(depth=6):
+    return deep_state(INFOS, depth)
+
+
+# ----------------------------------------------------------------------
+# guard: metrics on/off bit-identity
+# ----------------------------------------------------------------------
+
+class TestMetricsBitIdentity:
+    def test_engine_run_decisions_identical(self):
+        steps = 24
+        st_off, now_off, dec_off = kernels.engine_run(
+            _mixed_state(), jnp.int64(1 * S), steps,
+            allow_limit_break=False, anticipation_ns=0)
+        st_on, now_on, dec_on, met = kernels.engine_run(
+            _mixed_state(), jnp.int64(1 * S), steps,
+            allow_limit_break=False, anticipation_ns=0,
+            with_metrics=True)
+        for name, a, b in zip(dec_off._fields, dec_off, dec_on):
+            assert bool(jnp.array_equal(a, b)), \
+                f"decision field {name} diverged with metrics on"
+        assert_states_equal(st_off, st_on)
+        assert int(now_off) == int(now_on)
+        # and the vector itself is consistent with the stream
+        d = jax.device_get(dec_on)
+        m = obsdev.metrics_dict(met)
+        served = int((d.type == kernels.RETURNING).sum())
+        assert m["decisions_total"] == served
+        assert m["decisions_reservation"] + m["decisions_priority"] \
+            == served
+        assert m["decisions_reservation"] == \
+            int(((d.type == kernels.RETURNING) & (d.phase == 0)).sum())
+
+    def test_prefix_epoch_identical(self):
+        now = jnp.int64(1 * S)
+        ep_off = scan_prefix_epoch(_mixed_state(), now, 3, 4,
+                                   anticipation_ns=0)
+        ep_on = scan_prefix_epoch(_mixed_state(), now, 3, 4,
+                                  anticipation_ns=0, with_metrics=True)
+        for f in ("count", "guards_ok", "slot", "phase", "cost", "lb"):
+            assert bool(jnp.array_equal(getattr(ep_off, f),
+                                        getattr(ep_on, f))), \
+                f"epoch field {f} diverged with metrics on"
+        assert_states_equal(ep_off.state, ep_on.state)
+        m = obsdev.metrics_dict(ep_on.metrics)
+        total = int(jax.device_get(ep_on.count).sum())
+        assert m["decisions_total"] == total
+        assert m["decisions_reservation"] + m["decisions_priority"] \
+            == total
+        # metrics-off epochs still carry the field, as zeros
+        assert obsdev.metrics_dict(ep_off.metrics) == \
+            {k: 0 for k in obsdev.METRIC_NAMES}
+
+    def test_chain_epoch_identical(self):
+        now = jnp.int64(1 * S)
+        kw = dict(chain_depth=3, anticipation_ns=0, use_pallas=False)
+        ep_off = scan_chain_epoch(_mixed_state(), now, 2, 4, **kw)
+        ep_on = scan_chain_epoch(_mixed_state(), now, 2, 4,
+                                 with_metrics=True, **kw)
+        for f in ("count", "unit_count", "guards_ok", "slot", "cls",
+                  "length"):
+            assert bool(jnp.array_equal(getattr(ep_off, f),
+                                        getattr(ep_on, f))), \
+                f"chain epoch field {f} diverged with metrics on"
+        assert_states_equal(ep_off.state, ep_on.state)
+        m = obsdev.metrics_dict(ep_on.metrics)
+        assert m["decisions_total"] == \
+            int(jax.device_get(ep_on.count).sum())
+
+    def test_calendar_epoch_identical(self):
+        now = jnp.int64(1 * S)
+        kw = dict(steps=4, anticipation_ns=0, use_pallas=False)
+        ep_off = scan_calendar_epoch(_mixed_state(), now, 2, **kw)
+        ep_on = scan_calendar_epoch(_mixed_state(), now, 2,
+                                    with_metrics=True, **kw)
+        for f in ("count", "resv_count", "progress_ok", "served"):
+            assert bool(jnp.array_equal(getattr(ep_off, f),
+                                        getattr(ep_on, f))), \
+                f"calendar epoch field {f} diverged with metrics on"
+        assert_states_equal(ep_off.state, ep_on.state)
+        m = obsdev.metrics_dict(ep_on.metrics)
+        total = int(jax.device_get(ep_on.count).sum())
+        assert m["decisions_total"] == total
+        assert m["decisions_reservation"] == \
+            int(jax.device_get(ep_on.resv_count).sum())
+
+    def test_ring_hwm_bounded_by_depth(self):
+        ep = scan_prefix_epoch(_mixed_state(depth=6), jnp.int64(1 * S),
+                               2, 4, anticipation_ns=0,
+                               with_metrics=True)
+        m = obsdev.metrics_dict(ep.metrics)
+        assert 0 < m["ring_occupancy_hwm"] <= 6
+
+
+# ----------------------------------------------------------------------
+# obs.device vector algebra
+# ----------------------------------------------------------------------
+
+class TestDeviceVector:
+    def test_combine_adds_counters_maxes_hwm(self):
+        a = obsdev.metrics_delta(decisions=5, resv=2, prop=3,
+                                 ring_hwm=7)
+        b = obsdev.metrics_delta(decisions=4, resv=4, ring_hwm=3,
+                                 ingest_drops=11)
+        m = obsdev.metrics_dict(obsdev.metrics_combine(a, b))
+        assert m["decisions_total"] == 9
+        assert m["decisions_reservation"] == 6
+        assert m["decisions_priority"] == 3
+        assert m["ring_occupancy_hwm"] == 7      # max, not 10
+        assert m["ingest_drops"] == 11
+
+    def test_combine_commutative(self):
+        a = obsdev.metrics_delta(decisions=5, ring_hwm=2, stalls=1)
+        b = obsdev.metrics_delta(decisions=1, ring_hwm=9,
+                                 guard_trips=2)
+        ab = obsdev.metrics_combine(a, b)
+        ba = obsdev.metrics_combine(b, a)
+        assert bool(jnp.array_equal(ab, ba))
+
+    def test_admission_clamp_counts_drops(self):
+        counts = jnp.asarray([5, 3, 0, 9], dtype=jnp.int32)
+        headroom = jnp.asarray([2, 3, 4, 0], dtype=jnp.int32)
+        clamped, dropped = obsdev.admission_clamp(counts, headroom)
+        assert jax.device_get(clamped).tolist() == [2, 3, 0, 0]
+        assert int(dropped) == 3 + 9
+
+    def test_np_combine_mirrors_device_combine(self):
+        a = obsdev.metrics_delta(decisions=5, resv=2, prop=3,
+                                 ring_hwm=7, stalls=1)
+        b = obsdev.metrics_delta(decisions=4, resv=4, ring_hwm=3,
+                                 guard_trips=2, ingest_drops=11)
+        dev = np.asarray(jax.device_get(obsdev.metrics_combine(a, b)))
+        host = obsdev.metrics_combine_np(np.asarray(jax.device_get(a)),
+                                         np.asarray(jax.device_get(b)))
+        assert np.array_equal(dev, host)
+
+    def test_publish_into_registry(self):
+        reg = MetricsRegistry()
+        vec = obsdev.metrics_delta(decisions=8, resv=3, prop=5,
+                                   ring_hwm=4)
+        obsdev.publish(reg, vec, prefix="eng")
+        snap = reg.snapshot()
+        assert snap["eng_decisions_total"][0]["value"] == 8
+        assert snap["eng_ring_occupancy_hwm"][0]["value"] == 4
+
+
+# ----------------------------------------------------------------------
+# ProfileCombiner merge semantics (reference profile.h:100-120)
+# ----------------------------------------------------------------------
+
+class TestProfileCombiner:
+    def test_multi_server_merge_matches_single_timer(self):
+        rng = random.Random(7)
+        durations = [[rng.randrange(100, 50_000) for _ in range(40)]
+                     for _ in range(4)]       # 4 simulated servers
+        per_server = []
+        for ds in durations:
+            t = ProfileTimer()
+            for d in ds:
+                t._accumulate(d)
+            per_server.append(t)
+        single = ProfileTimer()
+        for ds in durations:
+            for d in ds:
+                single._accumulate(d)
+        comb = ProfileCombiner()
+        for t in per_server:
+            comb.combine(t)
+        assert comb.count == single.count == 160
+        assert comb.sum_ns == single.sum_ns
+        assert comb.low_ns == single.low_ns == min(map(min, durations))
+        assert comb.high_ns == single.high_ns == max(map(max, durations))
+        assert math.isclose(comb.mean_ns(), single.mean_ns())
+        assert math.isclose(comb.std_dev_ns(), single.std_dev_ns())
+        assert comb.std_dev_ns() > 0
+
+    def test_empty_timer_is_identity(self):
+        t = ProfileTimer()
+        t._accumulate(500)
+        comb = ProfileCombiner()
+        comb.combine(ProfileTimer())      # no-op
+        comb.combine(t)
+        comb.combine(ProfileTimer())      # no-op
+        assert (comb.count, comb.sum_ns, comb.low_ns, comb.high_ns) \
+            == (1, 500, 500, 500)
+
+
+# ----------------------------------------------------------------------
+# host registry
+# ----------------------------------------------------------------------
+
+class TestRegistry:
+    def test_get_or_create_is_idempotent(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("x_total", "help")
+        c1.inc(3)
+        assert reg.counter("x_total").value == 3
+        # distinct labels => distinct instance
+        assert reg.counter("x_total", labels={"s": "1"}).value == 0
+        with pytest.raises(AssertionError):
+            reg.gauge("x_total")      # kind mismatch
+
+    def test_prometheus_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("dec_total", "decisions").inc(5)
+        reg.gauge("depth", "ring depth", labels={"server": "0"}).set(17)
+        h = reg.histogram("lat_ns", buckets=(10, 100))
+        h.observe(5)
+        h.observe(50)
+        h.observe(1e9)
+        text = reg.prometheus()
+        assert "# TYPE dec_total counter" in text
+        assert "dec_total 5" in text
+        assert 'depth{server="0"} 17' in text
+        assert 'lat_ns_bucket{le="10"} 1' in text
+        assert 'lat_ns_bucket{le="100"} 2' in text
+        assert 'lat_ns_bucket{le="+Inf"} 3' in text
+        assert "lat_ns_count 3" in text
+
+    def test_prometheus_families_contiguous(self):
+        # label variants registered interleaved with other metrics
+        # must still drain as one contiguous family (format 0.0.4)
+        reg = MetricsRegistry()
+        reg.gauge("depth", "d", labels={"server": "0"}).set(1)
+        reg.counter("other_total").inc()
+        reg.gauge("depth", "d", labels={"server": "1"}).set(2)
+        lines = reg.prometheus().splitlines()
+        idx = [i for i, l in enumerate(lines)
+               if l.startswith("depth{")]
+        assert idx == [idx[0], idx[0] + 1], f"family split: {lines}"
+        assert lines.count("# TYPE depth gauge") == 1
+
+    def test_timer_metric_merges_sources(self):
+        reg = MetricsRegistry()
+        t1, t2 = ProfileTimer(), ProfileTimer()
+        t1._accumulate(100)
+        t2._accumulate(300)
+        reg.timer("op_ns", source=t1)
+        reg.timer("op_ns", source=t2)
+        v = reg.snapshot()["op_ns"][0]["value"]
+        assert v["count"] == 2
+        assert v["sum_ns"] == 400
+        assert v["min_ns"] == 100 and v["max_ns"] == 300
+        assert v["mean_ns"] == 200.0
+
+    def test_snapshot_json_round_trips(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total").inc()
+        assert json.loads(reg.snapshot_json())["a_total"][0]["value"] \
+            == 1
+
+    def test_callback_gauge_reads_lazily(self):
+        reg = MetricsRegistry()
+        box = {"v": 1}
+        reg.gauge("live").set_function(lambda: box["v"])
+        box["v"] = 42
+        assert reg.snapshot()["live"][0]["value"] == 42
+
+
+# ----------------------------------------------------------------------
+# decision trace + sim conformance
+# ----------------------------------------------------------------------
+
+def _small_cfg(total_ops=60):
+    return SimConfig(
+        client_groups=2, server_groups=1,
+        cli_group=[
+            ClientGroup(client_count=2, client_total_ops=total_ops,
+                        client_iops_goal=80.0, client_reservation=25.0,
+                        client_limit=100.0, client_weight=1.0,
+                        client_outstanding_ops=16,
+                        client_server_select_range=1),
+            ClientGroup(client_count=1, client_total_ops=total_ops,
+                        client_iops_goal=80.0, client_reservation=0.0,
+                        client_limit=0.0, client_weight=2.0,
+                        client_outstanding_ops=16,
+                        client_server_select_range=1),
+        ],
+        srv_group=[ServerGroup(server_count=1, server_iops=200.0,
+                               server_threads=2)])
+
+
+class TestDecisionTrace:
+    def test_bounded_writer_and_validator(self, tmp_path):
+        p = str(tmp_path / "t.jsonl")
+        with DecisionTrace(p, limit=3) as tr:
+            for i in range(5):
+                tr.record(1000 + i, 0, i % 2, i % 2, 1,
+                          tag=(10, 20, 30) if i % 2 else None)
+        assert tr.rows_written == 3 and tr.rows_dropped == 2
+        stats = validate_trace_file(p)
+        assert stats["rows"] == 3
+        assert stats["per_client"] == {0: 2, 1: 1}
+        assert stats["per_phase"]["reservation"] == 2
+
+    def test_validator_rejects_bad_rows(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        p.write_text('{"t": 1, "server": 0, "client": 0, '
+                     '"phase": "warp", "cost": 1, "tag": null}\n')
+        with pytest.raises(ValueError, match="bad phase"):
+            validate_trace_file(str(p))
+        p.write_text('{"t": 1}\n')
+        with pytest.raises(ValueError, match="fields"):
+            validate_trace_file(str(p))
+
+    def test_sim_trace_matches_conformance_table(self, tmp_path):
+        p = str(tmp_path / "sim.jsonl")
+        trace = DecisionTrace(p)
+        sim = run_sim(_small_cfg(), seed=99, decision_trace=trace)
+        trace.close()
+        stats = validate_trace_file(p)
+        rows = sim.report().conformance()
+        # every decision traced exactly once, per client
+        assert stats["per_client"] == \
+            {r["client"]: r["ops"] for r in rows}
+        assert stats["rows"] == sum(r["ops"] for r in rows) == 3 * 60
+        per_phase = {r["client"]: (r["reservation_ops"],
+                                   r["priority_ops"]) for r in rows}
+        assert stats["per_phase"]["reservation"] == \
+            sum(v[0] for v in per_phase.values())
+        assert stats["per_phase"]["priority"] == \
+            sum(v[1] for v in per_phase.values())
+        # the dmclock pull path materializes tags: every row carries one
+        with open(p) as fh:
+            first = json.loads(fh.readline())
+        assert first["tag"] is not None and len(first["tag"]) == 3
+
+    def test_sim_registry_agrees_with_report(self):
+        sim = run_sim(_small_cfg(), seed=5)
+        rep = sim.report()
+        snap = sim.registry.snapshot()
+        assert snap["sim_ops_completed_total"][0]["value"] \
+            == rep.total_ops == 3 * 60
+        assert snap["sim_reservation_ops_total"][0]["value"] \
+            == rep.total_reservation_ops
+        assert snap["sim_priority_ops_total"][0]["value"] \
+            == rep.total_priority_ops
+        # per-server scheduling counters came in via register_metrics
+        assert "dmclock_sched_reservation_total" in snap
+        text = sim.registry.prometheus()
+        assert "sim_ops_completed_total 180" in text
+
+    def test_conformance_verdicts(self):
+        sim = run_sim(_small_cfg(), seed=13)
+        rows = sim.report().conformance()
+        assert len(rows) == 3
+        for r in rows:
+            # closed-loop demand-aware floor: clients that asked got
+            # their reservation within tolerance
+            assert r["resv_met"], f"client {r['client']} missed resv"
+        table = sim.report().format_conformance()
+        assert "per-client QoS conformance" in table
+        assert f"total ops {sum(r['ops'] for r in rows)}" in table
