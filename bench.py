@@ -82,10 +82,46 @@ def _timed_chain(run, state, epochs: int):
     return state, total, wall, g_ok, met
 
 
+def epoch_cost_analysis(compiled) -> dict:
+    """Normalized per-epoch attribution from
+    ``jax.stages.Compiled.cost_analysis()`` (the ROADMAP per-kernel
+    cost item): the stable aggregates only -- flops and bytes accessed
+    -- so PROFILE.md-style breakdowns regenerate from every bench JSON
+    line instead of by hand.  Backends that cannot attribute (or old
+    jax) degrade to an ``error`` note, never a crash."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception as e:      # per-backend support varies
+        return {"error": f"{type(e).__name__}: {e}"}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    out = {}
+    for key in ("flops", "bytes accessed", "transcendentals"):
+        if key in (ca or {}):
+            out[key.replace(" ", "_")] = float(ca[key])
+    return out
+
+
+def _feed_cost_registry(workload: str, cost: dict) -> None:
+    """Mirror the attribution into the process-wide obs registry so
+    embedders that scrape it (docs/OBSERVABILITY.md) see per-epoch
+    cost without parsing the bench JSON line."""
+    from dmclock_tpu.obs import default_registry
+
+    reg = default_registry()
+    for key, v in cost.items():
+        if isinstance(v, (int, float)):
+            reg.gauge(f"dmclock_epoch_cost_{key}",
+                      "XLA cost_analysis attribution of the jitted "
+                      "epoch", labels={"workload": workload}).set(v)
+
+
 def bench_serve_only(k: int = 65536, m: int = 32, *,
                      epochs_lo: int = 3, epochs_hi: int = 6,
                      depth: int = 320, reps: int = 5,
-                     n: int = 100_000, with_metrics: bool = True):
+                     n: int = 100_000, with_metrics: bool = True,
+                     select_impl: str = "sort", tag_width: int = 64,
+                     window_m: int | None = None):
     """Preloaded weight steady state, serving only (no ingest).
 
     DIFFERENCED chains: a short and a long chain each pay one dispatch
@@ -128,10 +164,14 @@ def bench_serve_only(k: int = 65536, m: int = 32, *,
     assert need * 1.5 <= n * depth, \
         f"backlog {n * depth} cannot feed {need} decisions " \
         "with heavy-class margin"
+    # AOT lower+compile: the Compiled handle both runs the chains and
+    # carries the cost_analysis attribution (one compilation, not two)
     run = jax.jit(functools.partial(
         scan_prefix_epoch, m=m, k=k, anticipation_ns=0,
-        with_metrics=with_metrics),
-        donate_argnums=(0,))
+        with_metrics=with_metrics, select_impl=select_impl,
+        tag_width=tag_width, window_m=window_m),
+        donate_argnums=(0,)).lower(state, jnp.int64(0)).compile()
+    cost = epoch_cost_analysis(run)
     # a single differenced pair still carries tunnel jitter of the
     # chains' own order; the MEDIAN over fresh-state reps is stable
     # (measured spread of singles at this shape: 41-71M)
@@ -157,7 +197,9 @@ def bench_serve_only(k: int = 65536, m: int = 32, *,
         "no valid pair: chains too short for the tunnel RTT floor"
     out = {"dps": float(np.median(rates)), "decisions": total_d,
            "reps": [round(r / 1e6, 1) for r in rates],
-           "fill": total_d / total_pot}
+           "fill": total_d / total_pot,
+           "select_impl": select_impl, "tag_width": tag_width,
+           "cost_analysis": cost}
     if with_metrics:
         out["device_metrics"] = obsdev.metrics_dict(met)
     return out
@@ -239,7 +281,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     target_resv_share: float = 0.0,
                     with_metrics: bool = True,
                     conformance_rounds: int = 2,
-                    conformance_out: str = None):
+                    conformance_out: str = None,
+                    select_impl: str = "sort"):
     """Closed loop: Poisson superwave ingest + prefix serve epoch per
     round, chained async on device; ingest IS inside the timed region.
 
@@ -330,7 +373,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
             ep = scan_chain_epoch(st, now, m, k,
                                   chain_depth=chain_depth,
                                   anticipation_ns=0,
-                                  with_metrics=with_metrics)
+                                  with_metrics=with_metrics,
+                                  select_impl=select_impl)
             units = ep.slot >= 0
             lens = ep.length.astype(jnp.int32)
             # a unit's entry serve is weight-phase iff class >= 1;
@@ -340,7 +384,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                            axis=1).astype(jnp.int32)
         else:
             ep = scan_prefix_epoch(st, now, m, k, anticipation_ns=0,
-                                   with_metrics=with_metrics)
+                                   with_metrics=with_metrics,
+                                   select_impl=select_impl)
             srv_pos = ep.slot >= 0
             resv = jnp.sum(srv_pos & (ep.phase == 0),
                            axis=1).astype(jnp.int32)
@@ -348,7 +393,13 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         return (ep.state, ep.count, ep.guards_ok, resv, ep.slot, lens,
                 obsdev.metrics_combine(ep.metrics, drop_met))
 
-    run = jax.jit(round_fn, donate_argnums=(0,))
+    # AOT lower+compile with a zero-arrivals sample (same avals as the
+    # real draws, and the Poisson stream stays byte-identical to prior
+    # sessions): one compilation serves the whole bench and carries the
+    # per-epoch cost_analysis attribution
+    run = jax.jit(round_fn, donate_argnums=(0,)).lower(
+        state, jnp.zeros((n,), jnp.int32), jnp.int64(0)).compile()
+    cost = epoch_cost_analysis(run)
     rng = np.random.default_rng(11)
 
     def draw():
@@ -502,12 +553,35 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                               else k)
 
     resv_frac = float(rs.sum()) / max(cnts.sum(), 1)
+    mean_depth = float(np.asarray(state.depth).mean())
     out = {"dps": dps, "decisions": total,
            "fill": total / denom,
            "resv_phase_frac": resv_frac,
-           "mean_depth": float(np.asarray(state.depth).mean())}
+           "mean_depth": mean_depth,
+           "select_impl": select_impl,
+           "cost_analysis": cost}
     if with_metrics:
-        out["device_metrics"] = obsdev.metrics_dict(met_acc)
+        md = obsdev.metrics_dict(met_acc)
+        out["device_metrics"] = md
+        # what bounded this run (the ROADMAP limit-stall item): the
+        # device counters separate the cases a bare rate cannot --
+        #  - limit_stalls > 0: batches committed NOTHING while work sat
+        #    queued (every head capped by its limit/reservation tag):
+        #    the SCHEDULER stalled;
+        #  - drained queues with zero admission drops: arrivals (the
+        #    waves cap / lambda calibration) bounded the decisions --
+        #    the LOAD GENERATOR capped the run, the engine had slack;
+        #  - otherwise the backlog held and the engine's own
+        #    throughput is the binding constraint (drops > 0 means the
+        #    generator pushed past ring headroom -- engine-bound too).
+        stalls = md.get("limit_stalls", 0)
+        drops = md.get("ingest_drops", 0)
+        if stalls:
+            out["bounded_by"] = "scheduler_stalled"
+        elif mean_depth < 0.75 * depth0 and not drops:
+            out["bounded_by"] = "load_generator_capped"
+        else:
+            out["bounded_by"] = "engine_throughput"
 
     if conformance_rounds:
         # end-of-run per-client QoS conformance: a few extra UNTIMED
@@ -709,6 +783,14 @@ def main() -> None:
                     help="pick the fastest cfg4 operating point whose "
                          "device-side mean round time fits this "
                          "budget; implies --mode frontier")
+    ap.add_argument("--select-impl", choices=["sort", "radix", "both"],
+                    default="sort",
+                    help="prefix-engine selection backend (fastpath "
+                    "select_impl) for the serve/cfg3 workloads; 'both' "
+                    "runs serve under each and reports serve + "
+                    "serve_radix (bit-identical decisions, A/B timing; "
+                    "cfg4's calendar engine is sortless and ignores "
+                    "this)")
     ap.add_argument("--device-metrics", choices=["on", "off"],
                     default="on",
                     help="accumulate the on-device obs vector inside "
@@ -782,8 +864,13 @@ def main() -> None:
             serve_kw = dict(with_metrics=wm)
             if backend == "cpu":
                 serve_kw.update(k=1024, m=4, depth=48, n=4096,
-                                epochs_lo=1, epochs_hi=2, reps=1)
-            results["serve"] = bench_serve_only(**serve_kw)
+                                epochs_lo=1, epochs_hi=2, reps=3)
+            impls = ("sort", "radix") if args.select_impl == "both" \
+                else (args.select_impl,)
+            for impl in impls:
+                key = "serve" if impl == "sort" else "serve_radix"
+                results[key] = bench_serve_only(select_impl=impl,
+                                                **serve_kw)
         if args.mode in ("all", "cfg3") and backend != "cpu":
             # 10k clients, uniform QoS, Poisson arrivals; weight
             # regime.  Rounds are small (~130k decisions, ~7ms), so
@@ -792,7 +879,9 @@ def main() -> None:
             results["cfg3"] = bench_sustained(
                 10_000, 4096, 32, 60, zipf=False, resv_rate=100.0,
                 dt_round_ns=100_000_000, ring=256, depth0=128,
-                rounds_lo=20, with_metrics=wm)
+                rounds_lo=20, with_metrics=wm,
+                select_impl="radix" if args.select_impl == "radix"
+                else "sort")
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster
@@ -820,11 +909,15 @@ def main() -> None:
               "vs_baseline": 0.0})
         return
     c4 = results.get("cfg4")
-    primary = c4 or results.get("cfg3") or results["serve"]
+    primary = c4 or results.get("cfg3") or results.get("serve") \
+        or next(iter(results.values()))
     parts = []
-    if "serve" in results:
-        parts.append(f"serve-only {results['serve']['dps']/1e6:.1f}M "
-                     f"(fill {results['serve']['fill']:.2f})")
+    for key in ("serve", "serve_radix"):
+        if key in results:
+            label = "serve-only" if key == "serve" \
+                else "serve-only[radix]"
+            parts.append(f"{label} {results[key]['dps']/1e6:.1f}M "
+                         f"(fill {results[key]['fill']:.2f})")
     if "cfg3" in results:
         r = results["cfg3"]
         parts.append(f"cfg3 10k-client Poisson sustained "
@@ -861,6 +954,18 @@ def main() -> None:
         final["conformance"] = c4conf
     if wm and "device_metrics" in primary:
         final["device_metrics"] = primary["device_metrics"]
+    # per-epoch XLA attribution + what bounded each sustained run ride
+    # the same JSON line (and the obs registry, for live scrapes)
+    cost_all = {wl: row["cost_analysis"] for wl, row in results.items()
+                if isinstance(row.get("cost_analysis"), dict)}
+    if cost_all:
+        final["cost_analysis"] = cost_all
+        for wl, ca in cost_all.items():
+            _feed_cost_registry(wl, ca)
+    bounded = {wl: row["bounded_by"] for wl, row in results.items()
+               if "bounded_by" in row}
+    if bounded:
+        final["bounded_by"] = bounded
     emit(final)
 
 
@@ -880,9 +985,11 @@ def _record_history(results: dict) -> None:
     rec = {
         "platform": platform,
         "device": str(jax.devices()[0]),
+        # scalars AND tags: select_impl / bounded_by are strings the
+        # guard needs (separate per-impl series; stall attribution)
         "workloads": {
             wl: {k: v for k, v in row.items()
-                 if isinstance(v, (int, float))}
+                 if isinstance(v, (int, float, str, bool))}
             for wl, row in results.items()},
     }
     if platform == "cpu":

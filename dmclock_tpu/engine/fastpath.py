@@ -75,12 +75,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental.pallas import tpu as pltpu
 
-from ..core.timebase import MAX_TAG
+from ..core.timebase import MAX_TAG, MIN_TAG
 from ..obs import device as obsdev
 from . import kernels
 from .kernels import (KEY_INF, NONE, RETURNING, Decision, _make_tag,
                       _fold_prev)
-from .state import EngineState
+from .state import EngineState, TAG_I64_FIELDS
 
 
 # Selection = ONE full sort on a packed int64 unified key: 2 class
@@ -490,6 +490,92 @@ def _pack(cls, krel, o):
             | (o & _O_MASK))
 
 
+# ----------------------------------------------------------------------
+# selection backends: full sort vs histogram (radix) k-selection
+# ----------------------------------------------------------------------
+#
+# The sort backend (the original engine) pays one O(N log N) lax.sort
+# over 4-5 arrays to order ALL clients, then commits the first <= k.
+# But selection only needs the k-th boundary plus membership; the
+# ORDER is needed only for the k-sized decision emit.  The radix
+# backend exploits that: a multi-pass dense histogram finds the exact
+# k-th smallest packed key (no sorts, no gathers -- findings 4/8/10),
+# dense elementwise ops compute membership, a prefix-sum compaction
+# writes the <= k members into [k] arrays, and the expensive sort runs
+# only over those k entries (honoring finding 8: cost/order/exit-key
+# ride the small sort as payloads, never gathered).  Packed keys are
+# unique among candidates (creation order breaks ties), so the small
+# sort reproduces the big sort's first k positions BIT-EXACTLY; the
+# only divergence is in masked padding lanes no caller reads
+# (pinned by tests/test_radix.py).
+#
+# Digit width: dense one-hot histograms cost passes * 2^bits * N
+# comparisons = (64/b) * 2^b * N, minimized at small b; 4-bit digits
+# (16 passes of 16-bucket histograms) cost 8x less than 8-bit ones
+# and keep every pass a pure vectorized compare+reduce.
+
+_RADIX_BITS = 4
+_RADIX_SPAN = 1 << _RADIX_BITS
+
+
+def _radix_kth_key(pk, kk: int):
+    """Exact value of the ``kk``-th smallest element of ``pk``
+    (1-indexed, duplicates counted) via 16 rounds of 4-bit dense
+    histograms over the int64 key space -- O(N) work per round, no
+    sort, no scatter, no scalar gathers (masked reductions only,
+    finding 10).  ``pk`` must be non-negative (packed keys and the
+    KEY_INF sentinel both are)."""
+    buckets = jnp.arange(_RADIX_SPAN, dtype=jnp.int64)
+    lanes = jnp.arange(_RADIX_SPAN, dtype=jnp.int32)
+    prefix = jnp.int64(0)
+    remaining = jnp.int32(kk)
+    active = jnp.ones(pk.shape, dtype=bool)
+    for shift in range(64 - _RADIX_BITS, -1, -_RADIX_BITS):
+        digit = (pk >> shift) & (_RADIX_SPAN - 1)
+        hist = jnp.sum(active[None, :] & (digit[None, :]
+                                          == buckets[:, None]),
+                       axis=1, dtype=jnp.int32)
+        cum = jnp.cumsum(hist)
+        sel = jnp.argmax(cum >= remaining).astype(jnp.int32)
+        below = jnp.sum(jnp.where(lanes < sel, hist, 0))
+        remaining = remaining - below
+        prefix = prefix | (sel.astype(jnp.int64) << shift)
+        active = active & (digit == sel.astype(jnp.int64))
+    return prefix
+
+
+def _select_radix(pk_dense, iota, epk, cost32, lens, k: int, kk: int):
+    """Histogram k-selection + small sort: the sorted first-kk columns
+    of the big sort, built without ordering the other N-kk entries.
+
+    Returns (pks, idxs, rpk, costs, lens_s) shaped [k], with sentinel
+    padding (KEY_INF / -1 / KEY_INF / 0 / 0) past the member count --
+    identical to the sort backend at every position a caller reads
+    (every lane past the committed count is masked downstream).
+    ``lens`` may be None (flat batches)."""
+    t_kth = _radix_kth_key(pk_dense, kk)
+    # membership: at most kk candidates (packed keys are unique among
+    # candidates, so count == kk exactly when enough exist); the
+    # KEY_INF exclusion drops sentinel rows when kk > live count
+    member = (pk_dense <= t_kth) & (pk_dense < jnp.int64(KEY_INF))
+    dest = jnp.cumsum(member.astype(jnp.int32)) - 1
+    dest = jnp.where(member, dest, jnp.int32(k))   # k = dropped
+
+    def compact(src, fill):
+        out = jnp.full((k,), fill, dtype=src.dtype)
+        return out.at[dest].set(src, mode="drop")
+
+    ops = [compact(pk_dense, jnp.int64(KEY_INF)),
+           compact(iota, jnp.int32(-1)),
+           compact(epk, jnp.int64(KEY_INF)),
+           compact(cost32, jnp.int32(0))]
+    if lens is not None:
+        ops.append(compact(lens, jnp.int32(0)))
+        return lax.sort(tuple(ops), num_keys=1)
+    pks, idxs, rpk, costs = lax.sort(tuple(ops), num_keys=1)
+    return pks, idxs, rpk, costs, jnp.ones((k,), dtype=jnp.int32)
+
+
 class _Selection(NamedTuple):
     """Everything a caller needs to commit + emit a unified prefix."""
 
@@ -506,8 +592,11 @@ class _Selection(NamedTuple):
 
 def _unified_prefix(state: EngineState, now, k: int, *,
                     chain_depth: int, anticipation_ns: int,
-                    allow: bool, heads, max_count) -> _Selection:
-    """Classify, chain, sort, and commit the longest exact prefix."""
+                    allow: bool, heads, max_count,
+                    select_impl: str = "sort") -> _Selection:
+    """Classify, chain, select (full sort or histogram k-selection,
+    ``select_impl``), and commit the longest exact prefix."""
+    assert select_impl in ("sort", "radix"), select_impl
     if heads is None:
         heads = ring_window(state, chain_depth)
         heads = (heads.arr, heads.cost)
@@ -577,19 +666,25 @@ def _unified_prefix(state: EngineState, now, k: int, *,
                 [a, jnp.full((k - kk,), fill, dtype=a.dtype)])
         return a
 
-    if chain_depth == 1:
+    if select_impl == "radix":
+        pks, idxs, rpk, costs, lens = _select_radix(
+            pk_dense, iota, epk, state.head_cost.astype(jnp.int32),
+            chain.length if chain_depth > 1 else None, k, kk)
+    elif chain_depth == 1:
         pks, idxs, rpk, costs = lax.sort(
             (pk_dense, iota, epk,
              state.head_cost.astype(jnp.int32)), num_keys=1)
         lens = jnp.ones((k,), dtype=jnp.int32)
+        pks, idxs = trim(pks, KEY_INF), trim(idxs, -1)
+        rpk, costs = trim(rpk, KEY_INF), trim(costs, 0)
     else:
         pks, idxs, rpk, costs, lens = lax.sort(
             (pk_dense, iota, epk,
              state.head_cost.astype(jnp.int32), chain.length),
             num_keys=1)
         lens = trim(lens, 0)
-    pks, idxs = trim(pks, KEY_INF), trim(idxs, -1)
-    rpk, costs = trim(rpk, KEY_INF), trim(costs, 0)
+        pks, idxs = trim(pks, KEY_INF), trim(idxs, -1)
+        rpk, costs = trim(rpk, KEY_INF), trim(costs, 0)
 
     # exclusive cumulative min of exit keys over the sorted order
     cm = lax.associative_scan(jnp.minimum, rpk)
@@ -670,7 +765,8 @@ def speculate_prefix_batch(state: EngineState, now, k: int, *,
                            anticipation_ns: int,
                            heads=None,
                            max_count=None,
-                           allow_limit_break: bool = False
+                           allow_limit_break: bool = False,
+                           select_impl: str = "sort"
                            ) -> PrefixBatch:
     """One prefix-commit batch over the unified candidate order: the
     longest exact prefix of the sorted (class, key, order) triples
@@ -685,7 +781,7 @@ def speculate_prefix_batch(state: EngineState, now, k: int, *,
     s = _unified_prefix(state, now, k, chain_depth=1,
                         anticipation_ns=anticipation_ns,
                         allow=allow_limit_break, heads=heads,
-                        max_count=max_count)
+                        max_count=max_count, select_impl=select_impl)
     j = jnp.arange(k, dtype=jnp.int32)
     served = j < s.count_units
     phase = jnp.where(s.cls_s >= CLS_WEIGHT, 1, 0).astype(jnp.int32)
@@ -725,7 +821,8 @@ class ChainBatch(NamedTuple):
 def speculate_chain_batch(state: EngineState, now, k: int, *,
                           chain_depth: int, anticipation_ns: int,
                           heads=None,
-                          allow_limit_break: bool = False
+                          allow_limit_break: bool = False,
+                          select_impl: str = "sort"
                           ) -> ChainBatch:
     """One prefix-commit batch with serve chains (see module
     docstring): each sort unit serves a client up to ``chain_depth``
@@ -735,7 +832,7 @@ def speculate_chain_batch(state: EngineState, now, k: int, *,
     s = _unified_prefix(state, now, k, chain_depth=chain_depth,
                         anticipation_ns=anticipation_ns,
                         allow=allow_limit_break, heads=heads,
-                        max_count=None)
+                        max_count=None, select_impl=select_impl)
     j = jnp.arange(k, dtype=jnp.int32)
     served = j < s.count_units
     return ChainBatch(
@@ -798,6 +895,109 @@ _EPOCH_MUTABLE = tuple(f for f in EngineState._fields
                        if f not in _EPOCH_INVARIANT)
 
 
+# ----------------------------------------------------------------------
+# int32 epoch tag carry (tag_width=32)
+#
+# The 10 int64 tag/arrival/cost fields in the scan carry
+# (state.TAG_I64_FIELDS) are rebased to int32 offsets from per-field
+# epoch origins (kernels.rebase32), halving the loop-carried HBM
+# traffic of every epoch iteration.  Batches still compute in int64 --
+# the widen/narrow converts fuse into the first/last elementwise pass
+# of each batch -- so decisions are bit-identical to tag_width=64
+# whenever the window holds (pinned by tests/test_radix.py).  A batch
+# whose post-state no longer fits the +-2^31 ns window commits NOTHING
+# (its carry is kept, its guards_ok output is False, and the
+# rebase_fallbacks metric bumps once); the caller reruns the remaining
+# batches on the int64 path from the returned state, exactly like the
+# sort-key rebase-guard fallback.
+# ----------------------------------------------------------------------
+
+class _TagCarry32:
+    """The int32 tag carry shared by the three epoch scans: per-field
+    origins, entry/per-batch narrowing, widening, and the exit restore
+    (one implementation so a fix lands once, not three times).
+
+    Origins are the center of each field's organic (non-sentinel)
+    value span at epoch entry, computed over the epoch's LIVE lanes
+    only -- clients that are active with work queued.  Centering
+    covers entry spreads up to the full 2^32 ns window (~4.3s) with
+    symmetric headroom for in-epoch drift (tag climb above,
+    weight-debt dips below).  Lanes that cannot serve this epoch
+    (inactive or empty at entry; ingest cannot run mid-epoch, so they
+    stay that way) are excluded from the window fit and carried as
+    zero offsets: every read of their tag fields is masked by
+    candidacy (`active & depth > 0`), and the exit restore puts their
+    exact entry values back.  Without the live mask, ONE stale idle
+    lane whose ancient tag sits outside the window would permanently
+    disable the int32 carry on long-running states.
+
+    Epochs whose live entry spread or serve advance exceeds the window
+    trip the fit check and fall back exactly (see the section
+    comment); low-rate workloads whose tags advance ~1e9 ns per serve
+    are expected to live on tag_width=64 (docs/ENGINE.md)."""
+
+    def __init__(self, state: EngineState):
+        self.live0 = state.active & (state.depth > 0)
+
+        def organic_center(v):
+            fin = self.live0 & (v > MIN_TAG) & (v < MAX_TAG)
+            lo = jnp.min(jnp.where(fin, v, MAX_TAG))
+            hi = jnp.max(jnp.where(fin, v, MIN_TAG))
+            return jnp.where(lo > hi, jnp.int64(0),
+                             lo + (hi - lo) // 2)
+
+        self.origins = {f: organic_center(getattr(state, f))
+                        for f in TAG_I64_FIELDS}
+
+    def narrow(self, mut: dict):
+        """Rebase the int64 fields of a mutable-carry dict to int32;
+        returns (narrowed dict, all-windows-held scalar).  Dead lanes
+        rebase as zero offsets and never affect the fit."""
+        ok = jnp.bool_(True)
+        out = dict(mut)
+        for f in TAG_I64_FIELDS:
+            v = jnp.where(self.live0, mut[f], self.origins[f])
+            v32, o = kernels.rebase32(v, self.origins[f])
+            out[f] = v32
+            ok = ok & o
+        return out, ok
+
+    def widen(self, mut32: dict) -> dict:
+        """Inverse of :meth:`narrow` for live lanes; dead lanes widen
+        to their origin -- garbage, but every consumer masks them by
+        candidacy, and :meth:`restore` puts the real values back."""
+        out = dict(mut32)
+        for f in TAG_I64_FIELDS:
+            out[f] = kernels.restore64(mut32[f], self.origins[f])
+        return out
+
+    def gate(self, dead, mut: dict, new_mut: dict, outs):
+        """The per-batch fallback gate every epoch scan shares: narrow
+        the post-batch state, and when it does not fit (or an earlier
+        batch already tripped) zero this batch's outputs and keep the
+        carry at the last good state.
+
+        ``outs`` is a sequence of (value, fallback-fill) pairs in the
+        scan's output order; returns ``(mut, dead, good, trip,
+        gated_values)``."""
+        new32, fit = self.narrow(new_mut)
+        good = ~dead & fit
+        trip = ~dead & ~fit
+        vals = tuple(jnp.where(good, v, f) for v, f in outs)
+        mut = {f: jnp.where(good, new32[f], mut[f]) for f in new32}
+        return mut, dead | ~fit, good, trip, vals
+
+    def restore(self, mut32: dict, mut0_64: dict, ok0) -> dict:
+        """Exit state: widened live lanes, exact entry values for dead
+        lanes (never written mid-epoch), and -- when the ENTRY state
+        already failed to narrow -- the input state untouched."""
+        out = self.widen(mut32)
+        for f in out:
+            keep = (self.live0 & ok0) if f in TAG_I64_FIELDS else ok0
+            out[f] = jnp.where(keep, out[f], mut0_64[f])
+        return out
+
+
 class PrefixEpoch(NamedTuple):
     """M flat prefix batches' output, compact for one readback."""
 
@@ -813,27 +1013,39 @@ class PrefixEpoch(NamedTuple):
 
 
 def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
-                   guards_ok):
+                   guards_ok, rebase_fallback=False, live=True):
     """Fold one batch's contribution into the epoch metrics vector --
     pure reductions over arrays the batch already materialized, so the
     decision stream cannot be perturbed.  A stall is a batch that
     committed nothing while work sat queued (every queued head capped
-    by its limit/reservation tag)."""
+    by its limit/reservation tag).  ``rebase_fallback`` marks an int32
+    tag-carry window trip (tag_width=32 epochs only); ``live`` is
+    False for the DEAD batches after such a trip -- their forced-zero
+    counts are not scheduler stalls, their speculative (discarded)
+    state must not feed the ring high-water mark, and their guard
+    outcomes would re-count one frozen speculation every remaining
+    batch."""
     queued = jnp.any(st.active & (st.depth > 0))
-    stall = (count == 0) & queued
+    stall = (count == 0) & queued & live
+    hwm = jnp.where(live, jnp.max(st.depth), 0)
     return obsdev.metrics_combine(met, obsdev.metrics_delta(
         decisions=count.astype(jnp.int64),
         resv=resv.astype(jnp.int64), prop=prop.astype(jnp.int64),
         limit_break=lb.astype(jnp.int64),
         stalls=stall.astype(jnp.int64),
-        ring_hwm=jnp.max(st.depth).astype(jnp.int64),
-        guard_trips=(~guards_ok).astype(jnp.int64)))
+        ring_hwm=hwm.astype(jnp.int64),
+        guard_trips=(~guards_ok & live).astype(jnp.int64),
+        rebase_fallbacks=jnp.asarray(rebase_fallback,
+                                     jnp.int64)))
 
 
 def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
                       anticipation_ns: int,
                       allow_limit_break: bool = False,
-                      with_metrics: bool = False) -> PrefixEpoch:
+                      with_metrics: bool = False,
+                      select_impl: str = "sort",
+                      tag_width: int = 64,
+                      window_m: int | None = None) -> PrefixEpoch:
     """Run m flat prefix-commit batches of up to k decisions on device.
 
     EVERY batch commits its own exact prefix, so the concatenated
@@ -848,38 +1060,103 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
     ``with_metrics`` (STATIC) accumulates the ``obs.device`` vector in
     the same scan carry; the decision stream and final state are
     bit-identical with it on or off (tests/test_obs.py).
-    """
-    invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
-    mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
-    window = ring_window(state, m)
 
-    def body(carry, _):
-        mut, met = carry
-        st = EngineState(**invariant, **mut)
+    ``select_impl`` (STATIC, "sort"|"radix") picks the selection
+    backend -- both produce bit-identical decision streams
+    (tests/test_radix.py); "radix" replaces the O(N log N) full sort
+    with histogram k-selection + a [k]-sized sort.
+
+    ``tag_width`` (STATIC, 64|32): with 32 the scan carries the int64
+    tag fields as int32 epoch-rebased offsets (half the loop-carried
+    HBM traffic); a window trip makes that batch and every later one
+    commit 0 with guards_ok False (plus one ``rebase_fallbacks``
+    metric bump) -- same caller contract as the sort-key guard.
+
+    ``window_m`` (STATIC) chunks the ring-window prefetch: the epoch
+    runs ``m / window_m`` prefetch chunks of ``window_m`` batches
+    each, so wide epochs (m=64) amortize per-epoch dispatch without
+    growing the unrolled window-select chain past ``window_m`` rows
+    (the chain's cost scales with the window width -- PROFILE.md).
+    Must divide m; None = one m-row window (the original layout).
+    """
+    assert tag_width in (32, 64), tag_width
+    w = m if window_m is None else min(int(window_m), m)
+    assert w > 0 and m % w == 0, "window_m must divide m"
+    narrow32 = tag_width == 32
+    invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
+    mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+    met0 = obsdev.metrics_zero()
+    if narrow32:
+        tc = _TagCarry32(state)
+        mutable0, ok0 = tc.narrow(mutable0_64)
+        if with_metrics:
+            met0 = obsdev.metrics_combine(met0, obsdev.metrics_delta(
+                rebase_fallbacks=(~ok0).astype(jnp.int64)))
+        carry0 = (mutable0, met0, ~ok0)
+    else:
+        carry0 = (mutable0_64, met0)
+
+    def body(window, carry, _):
+        if narrow32:
+            mut, met, dead = carry
+            st = EngineState(**invariant, **tc.widen(mut))
+        else:
+            mut, met = carry
+            st = EngineState(**invariant, **mut)
         batch = speculate_prefix_batch(
             st, now, k, anticipation_ns=anticipation_ns,
             heads=_window_heads(st, window),
-            allow_limit_break=allow_limit_break)
-        out = (batch.count, batch.guards_ok,
-               batch.decisions.slot,
-               batch.decisions.phase.astype(jnp.int8),
-               batch.decisions.cost.astype(jnp.int32),
-               batch.decisions.limit_break)
-        if with_metrics:
-            served = batch.decisions.slot >= 0
-            resv = jnp.sum(served & (batch.decisions.phase == 0))
-            met = _batch_metrics(
-                met, batch.state, count=batch.count, resv=resv,
-                prop=batch.count - resv,
-                lb=jnp.sum(batch.decisions.limit_break),
-                guards_ok=batch.guards_ok)
+            allow_limit_break=allow_limit_break,
+            select_impl=select_impl)
+        count = batch.count
+        guards = batch.guards_ok
+        slot = batch.decisions.slot
+        phase = batch.decisions.phase.astype(jnp.int8)
+        cost = batch.decisions.cost.astype(jnp.int32)
+        lb = batch.decisions.limit_break
         new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
-        return (new_mut, met), out
+        trip = jnp.bool_(False)
+        good = jnp.bool_(True)
+        if narrow32:
+            mut, dead, good, trip, \
+                (count, guards, slot, phase, cost, lb) = tc.gate(
+                    dead, mut, new_mut,
+                    [(count, 0), (guards, False), (slot, -1),
+                     (phase, jnp.int8(0)), (cost, 0), (lb, False)])
+        else:
+            mut = new_mut
+        out = (count, guards, slot, phase, cost, lb)
+        if with_metrics:
+            served = slot >= 0
+            resv = jnp.sum(served & (phase == 0))
+            met = _batch_metrics(
+                met, batch.state, count=count, resv=resv,
+                prop=count - resv, lb=jnp.sum(lb),
+                guards_ok=batch.guards_ok, rebase_fallback=trip,
+                live=good)
+        carry = (mut, met, dead) if narrow32 else (mut, met)
+        return carry, out
 
-    (mutable, metrics), (count, guards, slot, phase, cost, lb) = \
-        lax.scan(body, (mutable0, obsdev.metrics_zero()), None,
-                 length=m)
-    state = EngineState(**invariant, **mutable)
+    def run_chunk(carry, _):
+        mut64 = tc.widen(carry[0]) if narrow32 else carry[0]
+        st_c = EngineState(**invariant, **mut64)
+        window = ring_window(st_c, w)
+        return lax.scan(functools.partial(body, window), carry, None,
+                        length=w)
+
+    if w == m:
+        carry, outs = run_chunk(carry0, None)
+    else:
+        carry, outs = lax.scan(run_chunk, carry0, None, length=m // w)
+        outs = jax.tree_util.tree_map(
+            lambda a: a.reshape((m,) + a.shape[2:]), outs)
+    count, guards, slot, phase, cost, lb = outs
+    mutable, metrics = carry[0], carry[1]
+    if narrow32:
+        state = EngineState(**invariant,
+                            **tc.restore(mutable, mutable0_64, ok0))
+    else:
+        state = EngineState(**invariant, **mutable)
     return PrefixEpoch(state=state, count=count, guards_ok=guards,
                        slot=slot, phase=phase, cost=cost, lb=lb,
                        metrics=metrics)
@@ -903,53 +1180,94 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
                      chain_depth: int, anticipation_ns: int,
                      allow_limit_break: bool = False,
                      use_pallas: bool | None = None,
-                     with_metrics: bool = False) -> ChainEpoch:
+                     with_metrics: bool = False,
+                     select_impl: str = "sort",
+                     tag_width: int = 64) -> ChainEpoch:
     """Run m chained prefix batches on device.  Each batch prefetches
     its own ``chain_depth``-row ring window (one barrel-shift ring
     pass per batch; a shared per-epoch window would need m *
     chain_depth rows of unrolled selects, which costs more than the
-    rotate at chain depths > 1)."""
+    rotate at chain depths > 1).  ``select_impl`` / ``tag_width`` as
+    in :func:`scan_prefix_epoch`."""
     assert chain_depth <= state.ring_capacity
+    assert tag_width in (32, 64), tag_width
+    narrow32 = tag_width == 32
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
-    mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+    mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+    met0 = obsdev.metrics_zero()
+    if narrow32:
+        tc = _TagCarry32(state)
+        mutable0, ok0 = tc.narrow(mutable0_64)
+        if with_metrics:
+            met0 = obsdev.metrics_combine(met0, obsdev.metrics_delta(
+                rebase_fallbacks=(~ok0).astype(jnp.int64)))
+        carry0 = (mutable0, met0, ~ok0)
+    else:
+        carry0 = (mutable0_64, met0)
 
     def body(carry, _):
-        mut, met = carry
-        st = EngineState(**invariant, **mut)
+        if narrow32:
+            mut, met, dead = carry
+            st = EngineState(**invariant, **tc.widen(mut))
+        else:
+            mut, met = carry
+            st = EngineState(**invariant, **mut)
         win = ring_window(st, chain_depth, use_pallas=use_pallas)
         batch = speculate_chain_batch(
             st, now, k, chain_depth=chain_depth,
             anticipation_ns=anticipation_ns,
             heads=(win.arr, win.cost),
-            allow_limit_break=allow_limit_break)
-        out = (batch.count, batch.unit_count, batch.guards_ok,
-               batch.slot, batch.cls.astype(jnp.int8),
-               batch.length.astype(jnp.int8))
+            allow_limit_break=allow_limit_break,
+            select_impl=select_impl)
+        count, ucount = batch.count, batch.unit_count
+        guards = batch.guards_ok
+        slot = batch.slot
+        cls = batch.cls.astype(jnp.int8)
+        length = batch.length.astype(jnp.int8)
+        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
+        trip = jnp.bool_(False)
+        good = jnp.bool_(True)
+        if narrow32:
+            mut, dead, good, trip, \
+                (count, ucount, guards, slot, cls, length) = tc.gate(
+                    dead, mut, new_mut,
+                    [(count, 0), (ucount, 0), (guards, False),
+                     (slot, -1), (cls, jnp.int8(CLS_NONE)),
+                     (length, jnp.int8(0))])
+        else:
+            mut = new_mut
+        out = (count, ucount, guards, slot, cls, length)
         if with_metrics:
-            units = batch.slot >= 0
+            units = slot >= 0
             # a unit's entry serve is weight-phase iff class >= 1; its
             # induced serves are all constraint-phase
-            prop = jnp.sum(jnp.where(units, (batch.cls >= CLS_WEIGHT)
+            prop = jnp.sum(jnp.where(units, (cls >= CLS_WEIGHT)
                                      .astype(jnp.int64), 0))
             met = _batch_metrics(
-                met, batch.state, count=batch.count,
-                resv=batch.count.astype(jnp.int64) - prop, prop=prop,
-                lb=jnp.sum(units & (batch.cls >= CLS_LB)),
-                guards_ok=batch.guards_ok)
-        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
-        return (new_mut, met), out
+                met, batch.state, count=count,
+                resv=count.astype(jnp.int64) - prop, prop=prop,
+                lb=jnp.sum(units & (cls >= CLS_LB)),
+                guards_ok=batch.guards_ok, rebase_fallback=trip,
+                live=good)
+        carry = (mut, met, dead) if narrow32 else (mut, met)
+        return carry, out
 
-    (mutable, metrics), (count, units, guards, slot, cls, length) = \
-        lax.scan(body, (mutable0, obsdev.metrics_zero()), None,
-                 length=m)
-    state = EngineState(**invariant, **mutable)
+    carry, (count, units, guards, slot, cls, length) = \
+        lax.scan(body, carry0, None, length=m)
+    mutable, metrics = carry[0], carry[1]
+    if narrow32:
+        state = EngineState(**invariant,
+                            **tc.restore(mutable, mutable0_64, ok0))
+    else:
+        state = EngineState(**invariant, **mutable)
     return ChainEpoch(state=state, count=count, unit_count=units,
                       guards_ok=guards, slot=slot, cls=cls,
                       length=length, metrics=metrics)
 
 
 def make_prefix_runner(k: int, *, anticipation_ns: int = 0,
-                       allow_limit_break: bool = False):
+                       allow_limit_break: bool = False,
+                       select_impl: str = "sort"):
     """Host-orchestrated prefix runner: (state, now) -> (state,
     decisions, n_committed).  The serial engine is needed only when the
     global rebase guards fail (creation-order spread or a served cost
@@ -958,7 +1276,8 @@ def make_prefix_runner(k: int, *, anticipation_ns: int = 0,
     """
     attempt = jax.jit(functools.partial(
         speculate_prefix_batch, k=k, anticipation_ns=anticipation_ns,
-        allow_limit_break=allow_limit_break))
+        allow_limit_break=allow_limit_break,
+        select_impl=select_impl))
     exact = jax.jit(lambda s, t: kernels.engine_run(
         s, t, k, allow_limit_break=allow_limit_break,
         anticipation_ns=anticipation_ns, advance_now=False))
@@ -1288,39 +1607,78 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                         steps: int, anticipation_ns: int = 0,
                         allow_limit_break: bool = False,
                         use_pallas: bool | None = None,
-                        with_metrics: bool = False
-                        ) -> CalendarEpoch:
+                        with_metrics: bool = False,
+                        tag_width: int = 64) -> CalendarEpoch:
     """Run m calendar batches on device (each prefetches its own
-    ``steps``-row ring window)."""
+    ``steps``-row ring window).  ``tag_width`` as in
+    :func:`scan_prefix_epoch` (a window trip reports
+    ``progress_ok=False`` for that batch and every later one)."""
+    assert tag_width in (32, 64), tag_width
+    narrow32 = tag_width == 32
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
-    mutable0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+    mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     served0 = jnp.zeros((state.capacity,), dtype=jnp.int32)
+    met0 = obsdev.metrics_zero()
+    if narrow32:
+        tc = _TagCarry32(state)
+        mutable0, ok0 = tc.narrow(mutable0_64)
+        if with_metrics:
+            met0 = obsdev.metrics_combine(met0, obsdev.metrics_delta(
+                rebase_fallbacks=(~ok0).astype(jnp.int64)))
+        carry0 = (mutable0, served0, met0, ~ok0)
+    else:
+        carry0 = (mutable0_64, served0, met0)
 
     def body(carry, _):
-        mut, acc, met = carry
-        st = EngineState(**invariant, **mut)
+        if narrow32:
+            mut, acc, met, dead = carry
+            st = EngineState(**invariant, **tc.widen(mut))
+        else:
+            mut, acc, met = carry
+            st = EngineState(**invariant, **mut)
         win = ring_window(st, steps, use_pallas=use_pallas)
         batch = calendar_batch(st, now, steps=steps,
                                anticipation_ns=anticipation_ns,
                                allow_limit_break=allow_limit_break,
                                heads=(win.arr, win.cost))
-        out = (batch.count, batch.resv_count, batch.progress_ok)
+        count, resv_count = batch.count, batch.resv_count
+        progress = batch.progress_ok
+        served = batch.served
+        lb_total = jnp.sum(batch.lb).astype(jnp.int64)
+        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
+        trip = jnp.bool_(False)
+        good = jnp.bool_(True)
+        if narrow32:
+            mut, dead, good, trip, \
+                (count, resv_count, progress, served,
+                 lb_total) = tc.gate(
+                    dead, mut, new_mut,
+                    [(count, 0), (resv_count, 0), (progress, False),
+                     (served, 0), (lb_total, 0)])
+        else:
+            mut = new_mut
+        out = (count, resv_count, progress)
         if with_metrics:
             met = _batch_metrics(
-                met, batch.state, count=batch.count,
-                resv=batch.resv_count,
-                prop=batch.count - batch.resv_count,
-                lb=jnp.sum(batch.lb).astype(jnp.int64),
+                met, batch.state, count=count,
+                resv=resv_count,
+                prop=count - resv_count,
+                lb=lb_total,
                 # a calendar batch with candidates that cannot make
                 # progress is the guard-trip analog (serial fallback)
-                guards_ok=batch.progress_ok)
-        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
-        return (new_mut, acc + batch.served, met), out
+                guards_ok=batch.progress_ok, rebase_fallback=trip,
+                live=good)
+        carry = (mut, acc + served, met, dead) if narrow32 \
+            else (mut, acc + served, met)
+        return carry, out
 
-    (mutable, served, metrics), (count, resv, ok) = lax.scan(
-        body, (mutable0, served0, obsdev.metrics_zero()), None,
-        length=m)
-    state = EngineState(**invariant, **mutable)
+    carry, (count, resv, ok) = lax.scan(body, carry0, None, length=m)
+    mutable, served, metrics = carry[0], carry[1], carry[2]
+    if narrow32:
+        state = EngineState(**invariant,
+                            **tc.restore(mutable, mutable0_64, ok0))
+    else:
+        state = EngineState(**invariant, **mutable)
     return CalendarEpoch(state=state, count=count, resv_count=resv,
                          progress_ok=ok, served=served,
                          metrics=metrics)

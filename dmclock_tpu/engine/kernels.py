@@ -101,6 +101,56 @@ def _min_not_0(current, possible):
 
 
 # ----------------------------------------------------------------------
+# int32 epoch tag rebase
+# ----------------------------------------------------------------------
+#
+# The epoch scans carry ~10 int64 [N] tag/arrival/cost arrays through
+# every iteration; at bench shapes that loop-carried traffic is the
+# bulk of the remaining elementwise cost (PROFILE.md headroom item).
+# Within one epoch the organic values of each field move only a few ms
+# of virtual time, so they fit an int32 offset from a per-field origin
+# with ~2.1s (+-2^31 ns) to spare.  ``rebase32``/``restore64`` are the
+# exact (window-checked) conversion pair: sentinels (MAX_TAG/MIN_TAG,
+# which pin tags of disabled QoS axes) map to reserved int32 codes, and
+# any organic value outside the window fails the check -- the caller
+# must then stay on (fall back to) the int64 path.  Round-trip
+# bit-exactness inside the window is pinned by tests/test_radix.py.
+
+I32_MAX_TAG = (1 << 31) - 1     # reserved code for MAX_TAG
+I32_MIN_TAG = -(1 << 31)        # reserved code for MIN_TAG
+# organic window: strictly inside the reserved codes, with a small
+# margin so clamped garbage can never alias a sentinel
+_I32_WINDOW = (1 << 31) - 8
+
+
+def rebase32(vals, origin):
+    """Rebase int64 tags to int32 around ``origin``.
+
+    Returns ``(vals32, ok)``: exact sentinel mapping for MAX_TAG /
+    MIN_TAG, exact offset for organic values within +-(2^31 - 8) of
+    ``origin``; ``ok`` is False when any organic value falls outside
+    the window (the conversion result must then be discarded)."""
+    is_max = vals == MAX_TAG
+    is_min = vals == MIN_TAG
+    rel = vals - origin
+    in_win = (rel > -_I32_WINDOW) & (rel < _I32_WINDOW)
+    ok = jnp.all(is_max | is_min | in_win)
+    v32 = jnp.where(
+        is_max, jnp.int64(I32_MAX_TAG),
+        jnp.where(is_min, jnp.int64(I32_MIN_TAG),
+                  jnp.clip(rel, -_I32_WINDOW, _I32_WINDOW)))
+    return v32.astype(jnp.int32), ok
+
+
+def restore64(vals32, origin):
+    """Exact inverse of :func:`rebase32` for in-window conversions."""
+    v = vals32.astype(jnp.int64)
+    return jnp.where(vals32 == I32_MAX_TAG, jnp.int64(MAX_TAG),
+                     jnp.where(vals32 == I32_MIN_TAG, jnp.int64(MIN_TAG),
+                               v + origin))
+
+
+# ----------------------------------------------------------------------
 # selection: masked lexicographic argmin = a heap top
 # ----------------------------------------------------------------------
 

@@ -71,6 +71,21 @@ class EngineState(NamedTuple):
         return self.q_arrival.shape[-1]
 
 
+# The int64 per-client fields the epoch scans mutate batch to batch:
+# tag triples, arrival timestamps, and the served-cost bookkeeping.
+# Within one epoch each field's organic values drift only a few ms of
+# virtual time, so the scans can carry them as int32 offsets from a
+# per-field epoch origin (``kernels.rebase32``/``restore64``) at half
+# the loop-carried HBM traffic -- the ``tag_width=32`` knob of
+# ``fastpath.scan_prefix_epoch`` and friends.  Everything else in the
+# scan carry (depth, q_head, head_ready) is already narrow.
+TAG_I64_FIELDS = (
+    "head_resv", "head_prop", "head_limit", "head_arrival",
+    "head_cost", "head_rho",
+    "prev_resv", "prev_prop", "prev_limit", "prev_arrival",
+)
+
+
 def init_state(capacity: int, ring_capacity: int = 64) -> EngineState:
     """Fresh state: every slot free."""
     n = capacity

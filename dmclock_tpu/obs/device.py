@@ -28,12 +28,16 @@ MET_STALLS = 4          # limit-capped stalls: batches/steps that
 MET_RING_HWM = 5        # ring occupancy high-water mark (max depth)
 MET_GUARD_TRIPS = 6     # rebase-guard trips (fastpath fallbacks)
 MET_INGEST_DROPS = 7    # arrivals dropped by the admission clamp
-NUM_METRICS = 8
+MET_REBASE_FALLBACKS = 8  # int32 tag-rebase window trips (epoch ran
+#                           out of the +-2^31 ns window; the batch
+#                           committed nothing and the caller must rerun
+#                           it on the int64 tag path)
+NUM_METRICS = 9
 
 METRIC_NAMES = (
     "decisions_total", "decisions_reservation", "decisions_priority",
     "decisions_limit_break", "limit_stalls", "ring_occupancy_hwm",
-    "rebase_guard_trips", "ingest_drops",
+    "rebase_guard_trips", "ingest_drops", "rebase_fallbacks",
 )
 
 # the max-accumulated rows (everything else adds)
@@ -57,10 +61,10 @@ def metrics_combine(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def metrics_delta(*, decisions=0, resv=0, prop=0, limit_break=0,
                   stalls=0, ring_hwm=0, guard_trips=0,
-                  ingest_drops=0) -> jnp.ndarray:
+                  ingest_drops=0, rebase_fallbacks=0) -> jnp.ndarray:
     """Build a one-batch delta vector from scalar contributions."""
     rows = [decisions, resv, prop, limit_break, stalls, ring_hwm,
-            guard_trips, ingest_drops]
+            guard_trips, ingest_drops, rebase_fallbacks]
     return jnp.stack([jnp.asarray(r, dtype=jnp.int64) for r in rows])
 
 

@@ -108,6 +108,9 @@ class DeviceSimSpec:
     all_weights_positive: bool = True  # Allow-fastpath restriction
     random_select: bool = False
     force_scan: bool = False   # test hook: disable the prefix serve
+    select_impl: str = "sort"  # prefix selection backend
+    #                            ("sort"|"radix"; bit-identical
+    #                            decisions -- fastpath select_impl)
 
 
 def _make_spec(cfg: SimConfig, q_per_slice: int = 4) -> DeviceSimSpec:
@@ -143,9 +146,11 @@ def _make_spec(cfg: SimConfig, q_per_slice: int = 4) -> DeviceSimSpec:
         random_select=cfg.server_random_selection)
 
 
-def init_device_sim(cfg: SimConfig, ring_capacity: int = 256
+def init_device_sim(cfg: SimConfig, ring_capacity: int = 256,
+                    select_impl: str = "sort"
                     ) -> tuple[DeviceSim, DeviceSimSpec]:
     spec = _make_spec(cfg)
+    spec.select_impl = select_impl
     s, c = spec.n_servers, spec.n_clients
     max_window = max(g.client_outstanding_ops for g in cfg.cli_group)
     assert max_window <= ring_capacity, (
@@ -388,7 +393,8 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                         batch = speculate_prefix_batch(
                             eng, t_end, kb, anticipation_ns=0,
                             max_count=q - total, heads=heads,
-                            allow_limit_break=spec.allow_limit_break)
+                            allow_limit_break=spec.allow_limit_break,
+                            select_impl=spec.select_impl)
                         gt = gt + jnp.where(batch.guards_ok, 0,
                                             1).astype(jnp.int32)
                         # pack the committed prefix at the buffer
@@ -501,7 +507,8 @@ def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
                    ring_capacity: int = 256,
                    slices_per_launch: int = 64,
                    max_launches: int = 200,
-                   check_guards: bool = True):
+                   check_guards: bool = True,
+                   select_impl: str = "sort"):
     """Run to completion (all clients' ops served) or the launch cap.
 
     ``check_guards`` (default on) raises after any launch whose prefix
@@ -518,7 +525,8 @@ def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
         total = sum(g.server_count for g in cfg.srv_group)
         if total % n_dev != 0:
             mesh = make_mesh(1)
-    sim, spec = init_device_sim(cfg, ring_capacity=ring_capacity)
+    sim, spec = init_device_sim(cfg, ring_capacity=ring_capacity,
+                                select_impl=select_impl)
     sim = shard_device_sim(sim, mesh)
     step = jax.jit(functools.partial(
         device_sim_step, spec=spec, mesh=mesh,

@@ -100,20 +100,33 @@ def main() -> int:
         dps = row.get("dps")
         if dps is None:
             continue
+        # the selection backend is part of the series identity: sort
+        # and radix epochs are bit-identical in DECISIONS but not in
+        # cost, so their rates form separate histories (a radix session
+        # judged against sort medians would flap in both directions).
+        # Rows without the tag predate the knob == "sort".
+        impl = row.get("select_impl", "sort")
+        tag = f"{wl}[{impl}]" if impl != "sort" else wl
         hist = [r["workloads"][wl]["dps"] for _, r in prior
                 if wl in r.get("workloads", {})
-                and "dps" in r["workloads"][wl]]
+                and "dps" in r["workloads"][wl]
+                and r["workloads"][wl].get("select_impl",
+                                           "sort") == impl]
         if len(hist) < args.min_records:
-            print(f"bench_guard: {wl}: {dps/1e6:.1f}M "
+            print(f"bench_guard: {tag}: {dps/1e6:.1f}M "
                   f"({len(hist)} prior record(s) -- not judged)")
             continue
         med = median(hist)
         floor = med / args.tolerance
         verdict = "OK" if dps >= floor else "REGRESSION"
-        print(f"bench_guard: {wl}: newest {dps/1e6:.1f}M vs median "
+        # a load-generator-capped run under-reports the engine: worth
+        # seeing next to any REGRESSION verdict before panicking
+        bb = row.get("bounded_by")
+        print(f"bench_guard: {tag}: newest {dps/1e6:.1f}M vs median "
               f"{med/1e6:.1f}M over {len(hist)} sessions "
               f"(floor {floor/1e6:.1f}M at tolerance "
-              f"{args.tolerance:g}x) -- {verdict}")
+              f"{args.tolerance:g}x) -- {verdict}"
+              + (f" [bounded by {bb}]" if bb else ""))
         if dps < floor:
             status = 1
     if status:

@@ -58,6 +58,41 @@ python scripts/bench_guard.py
 echo "== graft entry compile check =="
 timeout -k 30 1200 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+echo "== radix/sort selection parity smoke (cpu backend) =="
+# one epoch under BOTH select_impl values must produce identical
+# decision digests -- the bit-exactness contract the radix fast path
+# ships under (tests/test_radix.py pins the full matrix; this is the
+# cheap always-on gate).  Forced to cpu the same way conftest.py does:
+# the image's boot shim pre-selects its platform via jax.config, so
+# env vars alone don't stick.
+timeout -k 30 900 python - <<'EOF'
+import functools, hashlib
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+from __graft_entry__ import _preloaded_state
+from dmclock_tpu.engine.fastpath import scan_prefix_epoch
+
+digests = {}
+for impl in ("sort", "radix"):
+    state = _preloaded_state(2048, 16, ring=16)
+    ep = jax.jit(functools.partial(
+        scan_prefix_epoch, m=4, k=256, anticipation_ns=0,
+        select_impl=impl))(state, jnp.int64(0))
+    assert bool(jax.device_get(ep.guards_ok).all()), \
+        f"{impl}: rebase guards failed"
+    h = hashlib.sha256()
+    for arr in (ep.count, ep.slot, ep.phase, ep.cost, ep.lb):
+        h.update(jax.device_get(arr).tobytes())
+    digests[impl] = h.hexdigest()
+    print(f"{impl}: digest {digests[impl][:16]} "
+          f"({int(jax.device_get(ep.count).sum())} decisions)")
+assert digests["sort"] == digests["radix"], \
+    f"decision digests diverged: {digests}"
+print("radix/sort parity smoke ok")
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

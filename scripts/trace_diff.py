@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Diff two decision-trace JSONL streams; report the FIRST divergence.
+
+The tool behind radix-vs-sort and silicon-parity triage (ROADMAP
+trace-diff item): run the same workload twice with ``--trace`` (dmc_sim
+/ ssched_sim, any backend pair -- oracle vs TPU engine, python vs
+native, sort vs radix), then
+
+    python scripts/trace_diff.py a.jsonl b.jsonl
+
+prints either ``identical`` or ONE line per differing field of the
+first divergent decision, with both rows' tag triples when present --
+the full context a parity bug needs, without staring at two
+million-line traces.
+
+Comparison semantics (schema: ``docs/OBSERVABILITY.md``):
+
+- decisions are compared in stream order, field by field over
+  ``t, server, client, phase, cost``;
+- ``tag`` participates only when BOTH rows carry one (backends that
+  never materialize per-decision tags host-side emit ``null`` -- a
+  null-vs-triple pair is not a divergence, but both values are shown
+  at any reported divergence);
+- a stream ending early is itself a divergence (reported with the
+  surviving row).
+
+Exit status: 0 identical, 1 divergent, 2 bad input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Iterator, Optional, Tuple
+
+COMPARE_FIELDS = ("t", "server", "client", "phase", "cost")
+
+
+def rows(path: str) -> Iterator[Tuple[int, dict]]:
+    """(line_number, row) pairs; raises ValueError on malformed rows."""
+    with open(path) as fh:
+        for i, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not JSON: {e}")
+            if not isinstance(row, dict) or "client" not in row:
+                raise ValueError(f"{path}:{i}: not a decision row")
+            yield i, row
+
+
+def _fmt_tag(tag) -> str:
+    if tag is None:
+        return "tag=null"
+    return f"tag=[resv={tag[0]}, prop={tag[1]}, limit={tag[2]}]"
+
+
+def _fmt_row(name: str, lineno: Optional[int], row: Optional[dict]) -> str:
+    if row is None:
+        return f"  {name}: <stream ended>"
+    fields = " ".join(f"{k}={row.get(k)!r}" for k in COMPARE_FIELDS)
+    return f"  {name}:{lineno}: {fields} {_fmt_tag(row.get('tag'))}"
+
+
+def diff_row(a: dict, b: dict, ignore=()) -> list:
+    """Names of fields that diverge between two decision rows."""
+    bad = [f for f in COMPARE_FIELDS
+           if f not in ignore and a.get(f) != b.get(f)]
+    if "tag" not in ignore and \
+            a.get("tag") is not None and b.get("tag") is not None \
+            and a["tag"] != b["tag"]:
+        bad.append("tag")
+    return bad
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="report the first divergent decision between two "
+                    "--trace JSONL streams")
+    ap.add_argument("trace_a")
+    ap.add_argument("trace_b")
+    ap.add_argument("--ignore", default="server",
+                    help="comma-separated fields excluded from the "
+                    "comparison (default: server -- cross-backend "
+                    "traces rarely share server ids; pass '' to "
+                    "compare everything)")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="stop after N decisions (0 = whole streams)")
+    args = ap.parse_args(argv)
+    ignore = tuple(f for f in args.ignore.split(",") if f)
+
+    try:
+        it_a, it_b = rows(args.trace_a), rows(args.trace_b)
+        n = 0
+        while True:
+            ra = next(it_a, None)
+            rb = next(it_b, None)
+            if ra is None and rb is None:
+                print(f"identical ({n} decisions)")
+                return 0
+            if ra is None or rb is None:
+                short = args.trace_a if ra is None else args.trace_b
+                print(f"divergence at decision {n}: {short} ended "
+                      f"after {n} decisions")
+                print(_fmt_row(args.trace_a,
+                               ra[0] if ra else None,
+                               ra[1] if ra else None))
+                print(_fmt_row(args.trace_b,
+                               rb[0] if rb else None,
+                               rb[1] if rb else None))
+                return 1
+            (la, a), (lb, b) = ra, rb
+            bad = diff_row(a, b, ignore)
+            if bad:
+                print(f"divergence at decision {n}: "
+                      f"fields {', '.join(bad)} differ")
+                print(_fmt_row(args.trace_a, la, a))
+                print(_fmt_row(args.trace_b, lb, b))
+                return 1
+            n += 1
+            if args.limit and n >= args.limit:
+                print(f"identical ({n} decisions, --limit reached)")
+                return 0
+    except (OSError, ValueError) as e:
+        print(f"trace_diff: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -214,9 +214,12 @@ def _prefix_vs_scan(cfg, mesh8, q):
     spec_big = dataclasses.replace(
         spec, q_per_slice=q, slice_ns=spec.op_time_ns * q)
     spec_scan = dataclasses.replace(spec_big, force_scan=True)
+    # the radix selection backend must be indistinguishable here too
+    # (same loop, vmapped over servers under shard_map)
+    spec_radix = dataclasses.replace(spec_big, select_impl="radix")
 
     outs = []
-    for spc in (spec_big, spec_scan):
+    for spc in (spec_big, spec_scan, spec_radix):
         sm = DS.shard_device_sim(sim, mesh8)
         step = jax.jit(functools.partial(DS.device_sim_step, spec=spc,
                                          mesh=mesh8, slices=8))
@@ -224,12 +227,14 @@ def _prefix_vs_scan(cfg, mesh8, q):
             sm = step(sm)
         outs.append((np.asarray(sm.served_resv),
                      np.asarray(sm.served_prop)))
-    (ar, ap), (br, bp) = outs
+    (ar, ap), (br, bp), (rr, rp) = outs
     assert ar.sum() + ap.sum() > 0
     assert np.array_equal(ar, br), \
         f"resv-phase service diverges: {ar.sum()} vs {br.sum()}"
     assert np.array_equal(ap, bp), \
         f"prop-phase service diverges: {ap.sum()} vs {bp.sum()}"
+    assert np.array_equal(ar, rr) and np.array_equal(ap, rp), \
+        "radix selection diverges from sort in the device sim"
 
 
 def test_prefix_serve_mode_matches_scan(mesh8):
