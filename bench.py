@@ -799,9 +799,36 @@ def main() -> None:
     ap.add_argument("--conformance-out", metavar="FILE", default=None,
                     help="write the cfg4 per-client conformance table "
                     "as JSONL")
+    ap.add_argument("--metrics-port", type=int, metavar="PORT",
+                    default=None,
+                    help="serve the live default metrics registry over "
+                    "HTTP (GET /metrics, Prometheus text) for the "
+                    "duration of the bench; 0 picks an ephemeral port "
+                    "(printed to stderr)")
+    ap.add_argument("--fault-plan", default="none", metavar="TAG",
+                    help="label this session's fault-injection plan "
+                    "(robust.faults.describe() tag) in the JSON line "
+                    "and the benchmark history record; bench_guard "
+                    "keeps non-'none' (chaos) sessions out of the "
+                    "clean-run regression medians")
     args = ap.parse_args()
     if args.target_latency:
         args.mode = "frontier"
+    if args.metrics_port is not None:
+        # best-effort: a failed bind (port taken, privileged) must not
+        # kill the session before the JSON line can be emitted
+        try:
+            import atexit
+
+            from dmclock_tpu.obs import start_http_server
+            http_srv = start_http_server(port=args.metrics_port)
+            print(f"# metrics: serving {http_srv.url}",
+                  file=sys.stderr)
+            atexit.register(http_srv.close)
+        except (OSError, OverflowError) as e:
+            # OverflowError: out-of-range port from CPython's bind()
+            print(f"# metrics: endpoint disabled ({e})",
+                  file=sys.stderr)
 
     backend, fallback, backend_err = _resolve_backend()
     wm = args.device_metrics == "on"
@@ -810,6 +837,9 @@ def main() -> None:
         """THE json line: every exit path goes through here so the
         bench trajectory never has a null round again (BENCH_r05)."""
         out["backend"] = backend
+        # chaos sessions self-identify so the regression series stays
+        # clean (scripts/bench_guard.py; docs/ROBUSTNESS.md)
+        out["fault_plan"] = args.fault_plan
         if fallback:
             out["fallback"] = True
         if backend_err:
@@ -849,7 +879,8 @@ def main() -> None:
         emit(out)
         try:
             _record_history({"frontier_" + str(r["m"]): r
-                             for r in rows})
+                             for r in rows},
+                            fault_plan=args.fault_plan)
         except OSError:
             pass
         return
@@ -935,7 +966,7 @@ def main() -> None:
             f"upper bounds)")
 
     try:
-        _record_history(results)
+        _record_history(results, fault_plan=args.fault_plan)
     except OSError as e:      # telemetry must never eat the results
         print(f"# history record failed: {e}", file=sys.stderr)
     final = {
@@ -969,12 +1000,14 @@ def main() -> None:
     emit(final)
 
 
-def _record_history(results: dict) -> None:
+def _record_history(results: dict, fault_plan: str = "none") -> None:
     """Append this session's rates to benchmark/history/ for the
     drift-aware regression guard (scripts/bench_guard.py).  CPU
     (backend-fallback) sessions are recorded too, tagged
     ``"fallback": true`` so the trajectory stays unbroken -- the guard
-    annotates them and keeps them out of the accelerator medians."""
+    annotates them and keeps them out of the accelerator medians.
+    ``fault_plan`` != "none" marks a chaos session: recorded for the
+    trajectory, excluded from the clean-run medians."""
     from pathlib import Path
 
     if not results:
@@ -985,6 +1018,7 @@ def _record_history(results: dict) -> None:
     rec = {
         "platform": platform,
         "device": str(jax.devices()[0]),
+        "fault_plan": fault_plan,
         # scalars AND tags: select_impl / bounded_by are strings the
         # guard needs (separate per-impl series; stall attribution)
         "workloads": {

@@ -19,7 +19,12 @@ Layers:
   models    -- registered scheduler "models" (dmclock oracle, dmclock
                native C++, dmclock TPU engine, ssched FIFO)
   native    -- ctypes bindings to the C++ host runtime
-  utils     -- periodic tasks, profiling timers, orbax checkpointing
+  obs       -- metrics registry + scrape endpoint, on-device counters,
+               decision traces
+  robust    -- fault injection, degraded-mode cluster stepping,
+               guarded commits (docs/ROBUSTNESS.md)
+  utils     -- periodic tasks, profiling timers, crash-safe atomic
+               checkpointing with digest sidecars + rotation
 """
 
 __version__ = "0.2.0"

@@ -5,11 +5,42 @@ Equivalent of the reference's ``ClientInfo`` (``src/dmclock_server.h:95-132``):
 increments.  The reference caches multiplicative inverses as doubles; we
 cache integer nanosecond increments (see ``timebase.rate_to_inv_ns``)
 with the same 0 -> 0 "axis disabled" sentinel.
+
+Construction VALIDATES its inputs (docs/ROBUSTNESS.md): a NaN,
+infinite, or negative rate -- or a nonzero limit below the reservation
+-- would silently produce garbage tags (``rate_to_inv_ns`` of NaN/inf
+degenerates to the axis-disabled sentinel, and an impossible
+limit-below-reservation contract stalls the client forever), so each is
+rejected with a ``ValueError`` naming the client when the caller
+provides one.
 """
 
 from __future__ import annotations
 
+import math
+from typing import Any, Optional
+
 from .timebase import rate_to_inv_ns
+
+
+def _validate_qos(reservation: float, weight: float, limit: float,
+                  client: Optional[Any]) -> None:
+    who = f" for client {client!r}" if client is not None else ""
+    for label, v in (("reservation", reservation), ("weight", weight),
+                     ("limit", limit)):
+        if math.isnan(v):
+            raise ValueError(f"QoS {label} is NaN{who}")
+        if math.isinf(v):
+            raise ValueError(f"QoS {label} is infinite{who} "
+                             "(use 0 to disable the axis)")
+        if v < 0:
+            raise ValueError(f"QoS {label} must be >= 0{who}, "
+                             f"got {v}")
+    if limit > 0 and limit < reservation:
+        raise ValueError(
+            f"QoS limit {limit} < reservation {reservation}{who}: "
+            "the cap would sit below the guaranteed floor, so the "
+            "contract is unsatisfiable")
 
 
 class ClientInfo:
@@ -17,19 +48,27 @@ class ClientInfo:
     (limit) -- with cached ns-per-unit-cost increments.
 
     Mutable via :meth:`update` to support ``update_client_info``
-    (reference dmclock_server.h:633-648).
+    (reference dmclock_server.h:633-648).  ``client`` (optional) names
+    the owner in validation errors.
     """
 
     __slots__ = ("reservation", "weight", "limit",
-                 "reservation_inv_ns", "weight_inv_ns", "limit_inv_ns")
+                 "reservation_inv_ns", "weight_inv_ns", "limit_inv_ns",
+                 "client")
 
-    def __init__(self, reservation: float, weight: float, limit: float):
+    def __init__(self, reservation: float, weight: float, limit: float,
+                 client: Optional[Any] = None):
+        self.client = client
         self.update(reservation, weight, limit)
 
     def update(self, reservation: float, weight: float, limit: float) -> None:
-        self.reservation = float(reservation)
-        self.weight = float(weight)
-        self.limit = float(limit)
+        reservation = float(reservation)
+        weight = float(weight)
+        limit = float(limit)
+        _validate_qos(reservation, weight, limit, self.client)
+        self.reservation = reservation
+        self.weight = weight
+        self.limit = limit
         self.reservation_inv_ns = rate_to_inv_ns(self.reservation)
         self.weight_inv_ns = rate_to_inv_ns(self.weight)
         self.limit_inv_ns = rate_to_inv_ns(self.limit)

@@ -42,6 +42,7 @@ from ..core.recs import Phase, ReqParams
 from ..core.scheduler import AtLimit, NextReqType, PullReq
 from ..core.tags import tag_calc
 from ..core.timebase import MAX_TAG, MIN_TAG, sec_to_ns
+from ..robust.guarded import retry_with_backoff
 from . import kernels
 from .kernels import (OP_ADD, OP_CREATE, OP_NOP, FUTURE, NONE, RETURNING,
                       IngestOps)
@@ -169,6 +170,13 @@ class TpuPullPriorityQueue:
                  # so the shared jit cache grows O(log2(batch)), not
                  # O(batch)
                  speculative_batch: int = 0,
+                 # guarded-commit contract (docs/ROBUSTNESS.md):
+                 # transient device failures are retried this many
+                 # times with exponential backoff from retry_base_s
+                 # before raising; state only rebinds on success
+                 device_retries: int = 3,
+                 retry_base_s: float = 0.05,
+                 retry_sleep: Callable[[float], None] = None,
                  monotonic_clock: Callable[[], float] =
                  _walltime.monotonic):
         assert delayed_tag_calc, \
@@ -221,6 +229,15 @@ class TpuPullPriorityQueue:
         self.prop_sched_count = 0
         self.limit_break_sched_count = 0
 
+        # guarded-commit telemetry (docs/ROBUSTNESS.md): launches
+        # retried after a transient device error, and adds rejected
+        # for an invalid cost (nothing committed either way)
+        self.device_retries = int(device_retries)
+        self.retry_base_s = float(retry_base_s)
+        self._retry_sleep = retry_sleep or _walltime.sleep
+        self.guard_retries = 0
+        self.invalid_cost_rejects = 0
+
         # speculative decision buffer (see _pull_spec)
         self._spec = int(speculative_batch)
         self._spec_size = 1 if self._spec else 0  # adaptive, <= _spec
@@ -255,6 +272,40 @@ class TpuPullPriorityQueue:
         return _shared_jit_ingest_run(steps, advance_now,
                                       self.at_limit is AtLimit.ALLOW,
                                       self.anticipation_timeout_ns)
+
+    def _launch(self, fn, *args):
+        """Run one device launch under the guarded-commit contract:
+        transient failures (a wedged tunnel, a runtime hiccup) retry
+        with bounded exponential backoff instead of raising out of the
+        serving layer.  Launches are pure jit calls, so a failed
+        attempt commits nothing -- callers rebind state only from the
+        returned value."""
+        def on_retry(_attempt, _exc):
+            self.guard_retries += 1
+
+        return retry_with_backoff(
+            lambda: fn(*args), retries=self.device_retries,
+            base_s=self.retry_base_s, on_retry=on_retry,
+            sleep=self._retry_sleep)
+
+    def _drain_and_launch(self, fused_fn, plain_fn, *args):
+        """The guarded commit-nothing form of every op-consuming
+        launch: drain the pending op rows, run ``fused_fn(state, ops,
+        *args)`` (or ``plain_fn(state, *args)`` when nothing is
+        pending; None = skip the launch entirely), and restore the
+        drained rows if the launch ultimately fails so a later attempt
+        (or a recovered device) still applies them."""
+        rows = self._pending
+        ops = self._build_ops()
+        if ops is None:
+            if plain_fn is None:
+                return None
+            return self._launch(plain_fn, self.state, *args)
+        try:
+            return self._launch(fused_fn, self.state, ops, *args)
+        except Exception:
+            self._pending = rows + self._pending
+            raise
 
     # ------------------------------------------------------------------
     # capacity management
@@ -330,9 +381,9 @@ class TpuPullPriorityQueue:
         return jnp.asarray(packed)
 
     def _flush(self) -> None:
-        ops = self._build_ops()
-        if ops is not None:
-            self.state = self._jit_ingest()(self.state, ops)
+        res = self._drain_and_launch(self._jit_ingest(), None)
+        if res is not None:
+            self.state = res
 
     # ------------------------------------------------------------------
     # public API (mirrors core.scheduler.PullPriorityQueue)
@@ -340,6 +391,18 @@ class TpuPullPriorityQueue:
     def add_request(self, request: Any, client_id: Any,
                     req_params: ReqParams = ReqParams(),
                     time_ns: Optional[int] = None, cost: int = 1) -> int:
+        # guarded commit: an invalid cost would poison the tag algebra
+        # (a non-positive charge breaks monotonicity device-side), so
+        # the trip commits NOTHING -- no tick, no create, no limit
+        # mirror advance -- and reports EINVAL instead of raising
+        try:
+            cost = int(cost)
+        except (TypeError, ValueError):
+            cost = 0
+        if cost < 1:
+            with self.data_mtx:
+                self.invalid_cost_rejects += 1
+            return errno.EINVAL
         if time_ns is None:
             time_ns = sec_to_ns(_walltime.time())
         with self.data_mtx:
@@ -432,13 +495,9 @@ class TpuPullPriorityQueue:
         with self.data_mtx:
             if self._spec:
                 return self._pull_spec(now_ns)
-            ops = self._build_ops()
-            if ops is None:
-                self.state, dec = self._jit_run(1, False)(
-                    self.state, now_ns)
-            else:
-                self.state, dec = self._jit_ingest_run(1, False)(
-                    self.state, ops, now_ns)
+            self.state, dec = self._drain_and_launch(
+                self._jit_ingest_run(1, False),
+                self._jit_run(1, False), now_ns)
             d = jax.device_get(dec)
             return self._decision_to_pullreq(
                 int(d[0, 0]), int(d[1, 0]), int(d[2, 0]),
@@ -500,9 +559,9 @@ class TpuPullPriorityQueue:
         self._settle_spec()
         self._flush()
         pre = self.state
-        st, dec, hz = _shared_jit_run_horizon(
+        st, dec, hz = self._launch(_shared_jit_run_horizon(
             self._spec_size, self.at_limit is AtLimit.ALLOW,
-            self.anticipation_timeout_ns)(pre, now_ns)
+            self.anticipation_timeout_ns), pre, now_ns)
         self.state = st
         d, horizon = jax.device_get((dec, hz))
         first = (int(d[0, 0]), int(d[1, 0]), int(d[2, 0]),
@@ -558,7 +617,8 @@ class TpuPullPriorityQueue:
                 n = self._spec_consumed
                 while n:
                     p = 1 << (n.bit_length() - 1)
-                    st, _ = self._jit_run(p, False)(st, self._spec_t0)
+                    st, _ = self._launch(self._jit_run(p, False), st,
+                                         self._spec_t0)
                     n -= p
                 self.state = st
         self._spec_pre = None
@@ -597,14 +657,9 @@ class TpuPullPriorityQueue:
                     return out
             max_decisions -= len(out)
             self._settle_spec()
-            ops = self._build_ops()
-            if ops is None:
-                self.state, dec = self._jit_run(
-                    max_decisions, advance_now)(self.state, now_ns)
-            else:
-                self.state, dec = self._jit_ingest_run(
-                    max_decisions, advance_now)(self.state, ops,
-                                                now_ns)
+            self.state, dec = self._drain_and_launch(
+                self._jit_ingest_run(max_decisions, advance_now),
+                self._jit_run(max_decisions, advance_now), now_ns)
             d = jax.device_get(dec)
             for i in range(d.shape[1]):
                 pr = self._decision_to_pullreq(
@@ -642,6 +697,13 @@ class TpuPullPriorityQueue:
              "speculative invalidations with an unconsumed tail"),
             ("dmclock_spec_replays_total", "spec_replays",
              "settle replays (incl. mixed-drain)"),
+            ("dmclock_guard_retries_total", "guard_retries",
+             "device launches retried after a transient failure "
+             "(guarded-commit contract, docs/ROBUSTNESS.md)"),
+            ("dmclock_invalid_cost_rejects_total",
+             "invalid_cost_rejects",
+             "adds rejected for a non-positive cost (EINVAL, "
+             "nothing committed)"),
         )
         for name, attr, help_text in rows:
             registry.gauge(name, help_text, labels=labels).set_function(

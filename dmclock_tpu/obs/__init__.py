@@ -20,13 +20,15 @@ Three tiers, cheapest first:
 See ``docs/OBSERVABILITY.md`` for metric names and schemas.
 """
 
-from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       TimerMetric, default_registry)
+from .registry import (Counter, Gauge, Histogram, MetricsHTTPServer,
+                       MetricsRegistry, TimerMetric, default_registry,
+                       start_http_server)
 from .trace import DecisionTrace, validate_trace_file
 from . import device
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "TimerMetric",
-    "default_registry", "DecisionTrace", "validate_trace_file",
+    "default_registry", "MetricsHTTPServer", "start_http_server",
+    "DecisionTrace", "validate_trace_file",
     "device",
 ]

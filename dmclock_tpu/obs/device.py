@@ -32,12 +32,22 @@ MET_REBASE_FALLBACKS = 8  # int32 tag-rebase window trips (epoch ran
 #                           out of the +-2^31 ns window; the batch
 #                           committed nothing and the caller must rerun
 #                           it on the int64 tag path)
-NUM_METRICS = 9
+MET_SERVER_DROPOUTS = 9   # cluster fault layer: up -> down transitions
+#                           (robust.cluster; docs/ROBUSTNESS.md)
+MET_TRACKER_RESYNCS = 10  # cluster fault layer: down -> up restarts
+#                           that re-synced TrackerState marks from the
+#                           monotone global counters
+MET_FAULTS_INJECTED = 11  # total injected fault events (dropouts,
+#                           restarts, delayed counters, duplicated
+#                           completions, nonzero clock skew) -- every
+#                           FaultPlan perturbation is visible here
+NUM_METRICS = 12
 
 METRIC_NAMES = (
     "decisions_total", "decisions_reservation", "decisions_priority",
     "decisions_limit_break", "limit_stalls", "ring_occupancy_hwm",
     "rebase_guard_trips", "ingest_drops", "rebase_fallbacks",
+    "server_dropouts", "tracker_resyncs", "faults_injected",
 )
 
 # the max-accumulated rows (everything else adds)
@@ -61,10 +71,13 @@ def metrics_combine(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def metrics_delta(*, decisions=0, resv=0, prop=0, limit_break=0,
                   stalls=0, ring_hwm=0, guard_trips=0,
-                  ingest_drops=0, rebase_fallbacks=0) -> jnp.ndarray:
+                  ingest_drops=0, rebase_fallbacks=0,
+                  server_dropouts=0, tracker_resyncs=0,
+                  faults_injected=0) -> jnp.ndarray:
     """Build a one-batch delta vector from scalar contributions."""
     rows = [decisions, resv, prop, limit_break, stalls, ring_hwm,
-            guard_trips, ingest_drops, rebase_fallbacks]
+            guard_trips, ingest_drops, rebase_fallbacks,
+            server_dropouts, tracker_resyncs, faults_injected]
     return jnp.stack([jnp.asarray(r, dtype=jnp.int64) for r in rows])
 
 

@@ -282,3 +282,82 @@ def default_registry() -> MetricsRegistry:
     """Process-wide registry (modules that have no natural owner --
     e.g. the bench script -- register here)."""
     return _DEFAULT
+
+
+# ----------------------------------------------------------------------
+# scrape endpoint (stdlib http.server; ROADMAP "registry scrape" item)
+# ----------------------------------------------------------------------
+
+class MetricsHTTPServer:
+    """Tiny background HTTP server exposing a registry's drains so a
+    long-running sim/bench can be scraped LIVE instead of dumped at
+    exit:
+
+    - ``GET /metrics`` (or ``/``) -> Prometheus text exposition 0.0.4
+    - ``GET /metrics.json``       -> the JSON ``snapshot()``
+
+    Drains are read lazily per request (callback gauges, timer merges),
+    so serving a scrape costs the hot path nothing.  ``port=0`` binds
+    an ephemeral port (read it back from ``.port``); ``close()`` shuts
+    the daemon thread down.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        reg = registry if registry is not None else default_registry()
+        self.registry = reg
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path in ("/", "/metrics"):
+                    body = reg.prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/metrics.json":
+                    body = reg.snapshot_json().encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *_args):  # scrapes are not news
+                pass
+
+        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="metrics-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_http_server(registry: Optional[MetricsRegistry] = None,
+                      port: int = 0,
+                      host: str = "127.0.0.1") -> MetricsHTTPServer:
+    """Start a background scrape endpoint over ``registry`` (default:
+    the process-wide registry)."""
+    return MetricsHTTPServer(registry, port=port, host=host)

@@ -106,28 +106,31 @@ def install_clients(cluster: ClusterState, resv_inv, weight_inv,
     return cluster._replace(engine=eng)
 
 
-def _one_server_step(engine: EngineState, tracker: TrackerState,
-                     now: jnp.ndarray, arrivals_per_client: jnp.ndarray,
-                     cost: jnp.ndarray, decisions_per_step: int,
-                     anticipation_ns: int, allow_limit_break: bool,
-                     max_arrivals: int):
-    """One server's slice of a cluster step (runs inside shard_map with
-    a [1, ...]-shaped shard; vmapped over that unit axis).
+def server_round(engine: EngineState, tracker: TrackerState,
+                 now: jnp.ndarray, arrivals_per_client: jnp.ndarray,
+                 cost: jnp.ndarray, g_delta: jnp.ndarray,
+                 g_rho: jnp.ndarray, decisions_per_step: int,
+                 anticipation_ns: int, allow_limit_break: bool,
+                 max_arrivals: int):
+    """One server's round against a CALLER-SUPPLIED view of the global
+    counters (``g_delta``/``g_rho``, [C] int64).  The healthy cluster
+    passes the fresh psum (``_one_server_step``); the fault-injection
+    layer (``robust.cluster``) passes a possibly stale held view -- the
+    dmClock protocol tolerates stale counters by construction, which is
+    exactly what makes delayed/lost piggyback updates injectable here
+    without touching the tag algebra.
 
     Phase A: client c sends ``min(arrivals_per_client[c],
-    max_arrivals)`` requests, each carrying psum-derived ReqParams;
+    max_arrivals)`` requests, each carrying view-derived ReqParams;
     arrivals interleave wave-major (every client's j-th request before
     any client's j+1-th, clients in slot order within a wave) -- the
     order the host-sim parity test replicates.
     Phase B: the engine makes ``decisions_per_step`` decisions.
     Phase C: completions fold into the tracker counters.
     """
-    # --- distributed ReqParams via the psum'd global counters; the
-    # tracker STATE type picks the accounting policy
+    # the tracker STATE type picks the accounting policy
     borrowing = isinstance(tracker, BorrowTrackerState)
     prepare = borrow_tracker_prepare if borrowing else tracker_prepare
-    g_delta, g_rho = global_counters(
-        tracker, lambda x: lax.psum(x, SERVER_AXIS))
 
     c = arrivals_per_client.shape[0]
     slots = jnp.arange(c, dtype=jnp.int32)
@@ -171,6 +174,24 @@ def _one_server_step(engine: EngineState, tracker: TrackerState,
     track = borrow_tracker_track if borrowing else tracker_track
     tracker = track(tracker, decs.slot, decs.cost, decs.phase, served)
     return engine, tracker, now, decs
+
+
+def _one_server_step(engine: EngineState, tracker: TrackerState,
+                     now: jnp.ndarray, arrivals_per_client: jnp.ndarray,
+                     cost: jnp.ndarray, decisions_per_step: int,
+                     anticipation_ns: int, allow_limit_break: bool,
+                     max_arrivals: int):
+    """One server's slice of a healthy cluster step (runs inside
+    shard_map with a [1, ...]-shaped shard; vmapped over that unit
+    axis): the distributed ReqParams come from the FRESH psum'd global
+    counters, then the round runs via :func:`server_round`."""
+    g_delta, g_rho = global_counters(
+        tracker, lambda x: lax.psum(x, SERVER_AXIS))
+    return server_round(
+        engine, tracker, now, arrivals_per_client, cost, g_delta,
+        g_rho, decisions_per_step=decisions_per_step,
+        anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break, max_arrivals=max_arrivals)
 
 
 def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,

@@ -1,0 +1,40 @@
+"""Fault injection and graceful degradation (docs/ROBUSTNESS.md).
+
+- ``robust.faults``  -- deterministic seeded :class:`FaultPlan` pytrees
+  (server dropout/restart, delayed piggyback counters, clock skew,
+  duplicated completions) and their host-side event oracles.
+- ``robust.cluster`` -- degraded-mode cluster stepping: live-server
+  masks gate the tracker psum and per-shard commits, restarted shards
+  re-sync from the monotone global counters, every fault lands in the
+  device metrics vector.  Imported lazily (it pulls in the engine).
+- ``robust.guarded`` -- the guarded-commit contract: device guard
+  trips commit nothing and the host retries with bounded exponential
+  backoff (``retry_with_backoff``; used by the TPU queue around every
+  device launch).
+
+This ``__init__`` stays light (``engine.queue`` imports
+``robust.guarded`` at module load): ``robust.cluster`` resolves on
+first attribute access.
+"""
+
+from . import faults, guarded
+from .faults import (FaultPlan, FaultStep, describe, plan_events,
+                     plan_step, sample_plan, single_outage_plan,
+                     zero_plan)
+from .guarded import (RECOVERABLE_ERRORS, GuardedEpoch,
+                      retry_with_backoff, run_epoch_guarded)
+
+__all__ = [
+    "faults", "guarded", "cluster",
+    "FaultPlan", "FaultStep", "zero_plan", "sample_plan",
+    "single_outage_plan", "plan_step", "plan_events", "describe",
+    "retry_with_backoff", "run_epoch_guarded", "GuardedEpoch",
+    "RECOVERABLE_ERRORS",
+]
+
+
+def __getattr__(name):
+    if name == "cluster":
+        import importlib
+        return importlib.import_module(".cluster", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,246 @@
+"""The guarded-commit contract: trip -> commit nothing -> retry.
+
+Generalizes the tag32 ``rebase_fallbacks`` pattern (docs/ENGINE.md)
+into one repo-wide contract, documented in docs/ROBUSTNESS.md:
+
+1. **Device side** -- an engine step that trips a guard (int32
+   tag-window overflow, creation-order/cost rebase guard, calendar
+   no-progress) commits *nothing* from that trip: the scan carry keeps
+   the last good state, ``guards_ok``/``progress_ok`` reads False, and
+   a fault counter bumps.  This is already built into the epoch scans;
+   :func:`run_epoch_guarded` is the host half that resumes the
+   remaining batches on the always-exact path.
+2. **Host side** -- transient device failures (the shared tunnel
+   wedging, a runtime OOM-and-recover) are retried with **bounded
+   exponential backoff** instead of raising out of the serving layer:
+   :func:`retry_with_backoff`, used by ``engine.queue
+   .TpuPullPriorityQueue`` around every device launch.  State is only
+   rebound on success (jax programs are pure), so a failed launch
+   never half-commits.
+
+This module must stay import-light: ``engine.queue`` imports it, so
+anything from ``engine`` is imported lazily inside functions.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, NamedTuple, Optional
+
+# Exception classes worth retrying: jax DEVICE errors (XlaRuntimeError
+# -- the wedged-tunnel failure mode) and tunnel/transport failures
+# (OSError covers ConnectionError; TimeoutError).  Plain RuntimeError
+# is deliberately NOT in the set: a generic host-side RuntimeError is
+# a caller bug, and retrying it would just re-raise the same error
+# after three backoff sleeps under the queue lock.
+
+
+def _recoverable_classes():
+    classes = [OSError, TimeoutError]
+    try:
+        from jax.errors import JaxRuntimeError
+        classes.append(JaxRuntimeError)
+    except ImportError:     # pragma: no cover - older jax
+        try:
+            from jaxlib.xla_extension import XlaRuntimeError
+            classes.append(XlaRuntimeError)
+        except ImportError:
+            # no importable device-error class: transport errors only
+            # -- adding bare RuntimeError would break the
+            # never-retry-caller-bugs contract above
+            pass
+    return tuple(classes)
+
+
+RECOVERABLE_ERRORS = _recoverable_classes()
+
+
+def retry_with_backoff(fn: Callable, *, retries: int = 3,
+                       base_s: float = 0.05, factor: float = 2.0,
+                       max_s: float = 2.0,
+                       recoverable=RECOVERABLE_ERRORS,
+                       on_retry: Optional[Callable[[int, BaseException],
+                                                   None]] = None,
+                       sleep: Callable[[float], None] = _time.sleep):
+    """Call ``fn()``; on a recoverable error sleep
+    ``min(base_s * factor**i, max_s)`` and retry, at most ``retries``
+    times, then re-raise the last error.  ``on_retry(attempt, exc)``
+    observes each retry (the queue counts them into its metrics).
+    ``fn`` must be pure/idempotent -- jitted device launches are."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except recoverable as e:  # noqa: PERF203 -- the whole point
+            if attempt >= retries:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(min(base_s * (factor ** attempt), max_s))
+            attempt += 1
+
+
+class GuardedEpoch(NamedTuple):
+    """Result of :func:`run_epoch_guarded`."""
+
+    state: object            # EngineState after every committed batch
+    count: int               # decisions committed (incl. the resume)
+    results: tuple           # the raw epoch result(s), in run order
+    rebase_fallbacks: int    # tag32 window trips resumed on int64
+    serial_fallbacks: int    # order/cost guard trips resumed serially
+    retries: int             # transient device errors retried
+
+
+_EPOCHS = {"prefix": "scan_prefix_epoch", "chain": "scan_chain_epoch",
+           "calendar": "scan_calendar_epoch"}
+
+# Module-level jit cache keyed by the static epoch configuration (the
+# engine/queue.py _JIT_CACHE convention): a fresh jax.jit(partial(...))
+# per call would retrace + recompile the whole epoch program on EVERY
+# guarded run, and the compile dwarfs the epoch at bench shapes.
+_EPOCH_JIT_CACHE: dict = {}
+
+
+def _jit_epoch(engine: str, m_run: int, kw: dict):
+    key = (engine, m_run, tuple(sorted(kw.items())))
+    if key not in _EPOCH_JIT_CACHE:
+        import functools
+
+        import jax
+
+        from ..engine import fastpath
+        fn = getattr(fastpath, _EPOCHS[engine])
+        _EPOCH_JIT_CACHE[key] = jax.jit(
+            functools.partial(fn, m=m_run, **kw))
+    return _EPOCH_JIT_CACHE[key]
+
+
+def _jit_serial(steps: int, allow_limit_break: bool,
+                anticipation_ns: int):
+    key = ("serial", steps, allow_limit_break, anticipation_ns)
+    if key not in _EPOCH_JIT_CACHE:
+        import functools
+
+        import jax
+
+        from ..engine import kernels
+        _EPOCH_JIT_CACHE[key] = jax.jit(functools.partial(
+            kernels.engine_run, steps=steps,
+            allow_limit_break=allow_limit_break,
+            anticipation_ns=anticipation_ns, advance_now=False))
+    return _EPOCH_JIT_CACHE[key]
+
+
+def _epoch_count(engine: str, result) -> int:
+    import numpy as np
+    return int(np.asarray(result.count).sum())
+
+
+def _guard_vec(engine: str, result):
+    import numpy as np
+    ok = result.progress_ok if engine == "calendar" \
+        else result.guards_ok
+    return np.asarray(ok)
+
+
+def run_epoch_guarded(state, now, *, engine: str = "prefix",
+                      m: int, k: int = 0, chain_depth: int = 4,
+                      anticipation_ns: int = 0,
+                      allow_limit_break: bool = False,
+                      with_metrics: bool = False,
+                      select_impl: str = "sort",
+                      tag_width: int = 64,
+                      window_m: Optional[int] = None,
+                      skew_ns: int = 0,
+                      retries: int = 3, base_s: float = 0.05,
+                      sleep: Callable[[float], None] = _time.sleep,
+                      on_retry=None) -> GuardedEpoch:
+    """Run one epoch of any of the three epoch engines under the
+    guarded-commit contract, host side included.
+
+    The epoch itself enforces commit-nothing-on-trip; this wrapper (a)
+    retries transient device failures with bounded backoff, (b) on a
+    tag32 window trip resumes the REMAINING batches from the returned
+    last-good state on the int64 path, and (c) on an order/cost guard
+    trip (64-bit; never observed in practice) resumes on the serial
+    engine -- the ``make_prefix_runner`` fallback generalized to all
+    three engines.  ``skew_ns`` is the fault-injection hook: the epoch
+    sees ``now + skew_ns``.  With ``skew_ns=0`` the first attempt is
+    the untouched epoch call -- bit-identical to no wrapper at all
+    (chaos differential gate).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import kernels
+
+    assert engine in _EPOCHS, engine
+    kw = dict(anticipation_ns=anticipation_ns,
+              allow_limit_break=allow_limit_break,
+              with_metrics=with_metrics, tag_width=tag_width)
+    if engine == "prefix":
+        kw.update(k=k, select_impl=select_impl, window_m=window_m)
+    elif engine == "chain":
+        kw.update(k=k, select_impl=select_impl,
+                  chain_depth=chain_depth)
+    else:
+        # the calendar batch has no [k] cap; k doubles as its
+        # per-client serve-step budget
+        kw.update(steps=max(k, 1))
+    retry_count = [0]
+
+    def count_retry(attempt, exc):
+        retry_count[0] += 1
+        if on_retry is not None:
+            on_retry(attempt, exc)
+
+    def attempt(st, t, m_run, width):
+        fn = _jit_epoch(engine, m_run, {**kw, "tag_width": width})
+        return retry_with_backoff(
+            lambda: jax.block_until_ready(fn(st, t)),
+            retries=retries, base_s=base_s, sleep=sleep,
+            on_retry=count_retry)
+
+    t = jnp.asarray(now, dtype=jnp.int64) + jnp.int64(skew_ns)
+    results = []
+    rebase_fb = serial_fb = 0
+    ep = attempt(state, t, m, tag_width)
+    results.append(ep)
+    total = _epoch_count(engine, ep)
+    state = ep.state
+    guards = _guard_vec(engine, ep)
+    if not guards.all():
+        remaining = int(m - guards.sum())
+        if tag_width == 32:
+            # tag32 window trip: the batch committed nothing; resume
+            # the remaining batches on the int64 path (exactness pinned
+            # by tests/test_radix.py)
+            rebase_fb = 1
+            ep2 = attempt(state, t, remaining, 64)
+            results.append(ep2)
+            g2 = _guard_vec(engine, ep2)
+            total += _epoch_count(engine, ep2)
+            state = ep2.state
+            guards = g2
+            remaining = int(remaining - g2.sum())
+        if not guards.all():
+            # order/cost guard (or calendar no-progress) on the exact
+            # path: fall back to the serial engine for the rest
+            serial_fb = 1
+            steps = max(remaining, 1) * max(k, 1)
+            run = _jit_serial(steps, allow_limit_break,
+                              anticipation_ns)
+            st2, _, decs = retry_with_backoff(
+                lambda: jax.block_until_ready(run(state, t)),
+                retries=retries, base_s=base_s, sleep=sleep,
+                on_retry=count_retry)
+            import numpy as np
+            total += int((np.asarray(decs.type)
+                          == kernels.RETURNING).sum())
+            state = st2
+            results.append(decs)
+    return GuardedEpoch(state=state, count=total,
+                        results=tuple(results),
+                        rebase_fallbacks=rebase_fb,
+                        serial_fallbacks=serial_fb,
+                        retries=retry_count[0])

@@ -63,6 +63,12 @@ def main(argv=None) -> int:
     p.add_argument("--metrics-out", metavar="FILE", default=None,
                    help="dump the metrics registry at exit: Prometheus "
                    "text (.prom/.txt) or JSON snapshot (.json)")
+    p.add_argument("--metrics-port", type=int, metavar="PORT",
+                   default=None,
+                   help="serve the live metrics registry over HTTP "
+                   "(GET /metrics, Prometheus text; /metrics.json) "
+                   "for the duration of the run; 0 picks an "
+                   "ephemeral port (printed)")
     p.add_argument("--use-prop-heap", action="store_true",
                    help="dmclock-native model: enable the O(1) "
                    "idle-reactivation prop heap (reference "
@@ -85,13 +91,22 @@ def main(argv=None) -> int:
         p.error(f"cannot read config file: {e}")
     trace = DecisionTrace(args.trace, limit=args.trace_limit) \
         if args.trace else None
+    registry = None
+    http_srv = None
+    if args.metrics_port is not None:
+        from ..obs import MetricsRegistry, start_http_server
+        registry = MetricsRegistry()
+        http_srv = start_http_server(registry, port=args.metrics_port)
+        print(f"# metrics: serving {http_srv.url}")
     try:
         sim = run_sim(cfg, model=args.model, seed=args.seed,
                       server_mode=args.server_mode,
-                      decision_trace=trace)
+                      registry=registry, decision_trace=trace)
     finally:
         if trace is not None:
             trace.close()
+        if http_srv is not None:
+            http_srv.close()
     report = sim.report()
     print(report.format(show_intervals=args.intervals))
     if args.conformance:

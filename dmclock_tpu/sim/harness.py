@@ -401,7 +401,9 @@ class Simulation:
 
         from ..core import ClientInfo
         self._infos = [ClientInfo(g.client_reservation, g.client_weight,
-                                  g.client_limit) for g in cfg.cli_group]
+                                  g.client_limit,
+                                  client=f"client-group-{gi}")
+                       for gi, g in enumerate(cfg.cli_group)]
 
         def client_info_f(c):
             return self._infos[self.client_group_of[c]]

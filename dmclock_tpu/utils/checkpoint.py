@@ -1,11 +1,27 @@
-"""Checkpoint / resume for device-resident scheduler and sim state.
+"""Crash-safe checkpoint / resume for device-resident state.
 
 The reference has no checkpointing (all state is in-memory and sims run
 to completion; SURVEY.md section 5).  Here every piece of device state
 -- ``EngineState``, the cluster's tracker shards, a whole ``DeviceSim``
--- is a pytree of arrays, so orbax makes save/restore nearly free, and
-long simulations (or an embedding storage service) can snapshot the
-scheduler mid-flight and resume bit-exactly.
+-- is a pytree of arrays; a snapshot is one ``.npz`` of the flattened
+leaves plus a sha256 **digest sidecar** (``<path>.sha256``).
+
+Crash safety (docs/ROBUSTNESS.md):
+
+- ``save_pytree`` is **atomic**: data and sidecar are written to temp
+  files, fsynced, and ``os.replace``d into place (data first, then
+  sidecar; the parent directory is fsynced after each rename).  A
+  crash at ANY point leaves either the previous snapshot pair intact
+  or a data/sidecar pair that fails verification -- never a
+  restorable-but-torn state (pinned by the kill-during-save matrix in
+  ``tests/test_checkpoint.py``; the ``_crash_hook`` module attribute
+  is the test's injection seam).
+- ``restore_pytree`` verifies the sidecar digest against the loaded
+  leaves and raises :class:`CheckpointCorruptError` on a truncated
+  file, a flipped byte, or a missing/mismatched sidecar.
+- ``save_pytree_rotating`` / ``restore_pytree_rotating`` keep a
+  rotation directory of ``ckpt-<seq>`` snapshots; restore walks newest
+  to oldest and lands on the first intact entry.
 
 Host-side bookkeeping (client-id maps, payload FIFOs) lives outside the
 pytree; ``TpuPullPriorityQueue`` snapshots it alongside via
@@ -14,31 +30,248 @@ pytree; ``TpuPullPriorityQueue`` snapshots it alongside via
 
 from __future__ import annotations
 
+import hashlib
 import os
-from typing import Any
+import re
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 
-def save_pytree(path: str, tree: Any) -> None:
-    """Write any pytree-of-arrays checkpoint (orbax)."""
-    import orbax.checkpoint as ocp
-
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.abspath(path), tree, force=True)
+class CheckpointCorruptError(RuntimeError):
+    """The snapshot at a path is unreadable, torn, or fails its
+    digest -- restore must not hand it out."""
 
 
-def restore_pytree(path: str, like: Any) -> Any:
+# test seam: called with a stage label at every point a crash could
+# interrupt a save ("data_written", "data_synced", "data_renamed",
+# "sidecar_written", "done"); tests raise from it to simulate a kill
+_crash_hook: Optional[Callable[[str], None]] = None
+
+
+def _crash(stage: str) -> None:
+    if _crash_hook is not None:
+        _crash_hook(stage)
+
+
+def _leaf_digest(arrays) -> str:
+    """sha256 over every leaf's dtype, shape, and bytes (order
+    matters; the treedef comes from ``like`` at restore time)."""
+    h = hashlib.sha256()
+    for a in arrays:
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                 os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _sidecar(path: str) -> str:
+    return path + ".sha256"
+
+
+def _prev(path: str) -> str:
+    return path + ".prev"
+
+
+def _pair_verifies(path: str) -> bool:
+    """True when the (data, sidecar) pair at ``path`` is internally
+    consistent (loads cleanly, digest matches).  Structure is not
+    checked -- this is the is-it-torn probe the save path uses before
+    deciding which pair to preserve."""
+    side = _sidecar(path)
+    if not (os.path.exists(path) and os.path.exists(side)):
+        return False
+    try:
+        with open(side) as fh:
+            want = fh.read().strip()
+        with np.load(path) as z:
+            arrays = [z[n] for n in sorted(z.files)]
+        return _leaf_digest(arrays) == want
+    except Exception:
+        return False
+
+
+def save_pytree(path, tree: Any) -> None:
+    """Atomically write a pytree-of-arrays checkpoint (tmp + fsync +
+    rename, digest sidecar).
+
+    Overwriting an existing snapshot in place cannot swap a (data,
+    sidecar) PAIR in one rename, so before the destructive renames the
+    old pair is hard-linked to ``<path>.prev`` / ``<path>.prev.sha256``
+    -- at every crash point the previous snapshot survives intact
+    under one name or the other, and ``restore_pytree`` falls back to
+    the ``.prev`` pair when the primary fails verification.  The links
+    are removed once the new pair is fully committed."""
+    path = os.fspath(path)
+    arrays = [np.asarray(leaf) for leaf in jax.tree.leaves(tree)]
+    digest = _leaf_digest(arrays)
+    tmp_data = f"{path}.tmp.{os.getpid()}"
+    tmp_side = f"{_sidecar(path)}.tmp.{os.getpid()}"
+    # Preserve the newest INTACT snapshot as .prev before the
+    # destructive renames.  In the healthy case .prev is absent (it is
+    # pruned on every successful commit) and the primary pair is
+    # linked without a verify read.  A leftover .prev means the last
+    # save crashed somewhere mid-commit: the primary may be torn (keep
+    # the old .prev) or fully committed with only the .prev prune
+    # missing (refresh .prev from it -- otherwise a crash in the NEXT
+    # save could fall back past the newest committed state), so the
+    # rare post-crash path pays one digest read to decide.
+    if os.path.exists(path) and os.path.exists(_sidecar(path)):
+        have_prev = os.path.exists(_prev(path)) and \
+            os.path.exists(_sidecar(_prev(path)))
+        if not have_prev or _pair_verifies(path):
+            for src, dst in ((path, _prev(path)),
+                             (_sidecar(path), _sidecar(_prev(path)))):
+                if os.path.exists(dst):
+                    os.unlink(dst)
+                os.link(src, dst)
+            _fsync_dir(path)
+    try:
+        with open(tmp_data, "wb") as fh:
+            np.savez(fh, **{f"leaf_{i:05d}": a
+                            for i, a in enumerate(arrays)})
+            _crash("data_written")
+            fh.flush()
+            os.fsync(fh.fileno())
+        _crash("data_synced")
+        os.replace(tmp_data, path)
+        _fsync_dir(path)
+        _crash("data_renamed")
+        with open(tmp_side, "w") as fh:
+            fh.write(digest + "\n")
+            _crash("sidecar_written")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp_side, _sidecar(path))
+        _fsync_dir(path)
+        _crash("done")
+        for old in (_prev(path), _sidecar(_prev(path))):
+            if os.path.exists(old):
+                os.unlink(old)
+    finally:
+        for tmp in (tmp_data, tmp_side):
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+
+def restore_pytree(path, like: Any) -> Any:
     """Restore a checkpoint saved by ``save_pytree``; ``like`` provides
     the tree structure and array shapes/dtypes (e.g. a freshly built
-    state)."""
-    import orbax.checkpoint as ocp
+    state).  Raises :class:`CheckpointCorruptError` unless the data
+    loads cleanly AND matches its sidecar digest AND fits ``like``.
+    When the primary pair fails verification but an intact ``.prev``
+    pair exists (an in-place overwrite was interrupted mid-commit),
+    the previous snapshot is returned instead."""
+    path = os.fspath(path)
+    try:
+        return _restore_exact(path, like)
+    except CheckpointCorruptError:
+        prev = _prev(path)
+        if os.path.exists(prev) and os.path.exists(_sidecar(prev)):
+            return _restore_exact(prev, like)
+        raise
 
-    with ocp.StandardCheckpointer() as ckptr:
-        abstract = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), like)
-        return ckptr.restore(os.path.abspath(path), abstract)
+
+def _restore_exact(path: str, like: Any) -> Any:
+    side = _sidecar(path)
+    if not os.path.exists(path):
+        raise CheckpointCorruptError(f"no checkpoint at {path}")
+    if not os.path.exists(side):
+        raise CheckpointCorruptError(
+            f"{path}: missing digest sidecar {side} -- save was "
+            "interrupted or the sidecar was lost; refusing to restore")
+    with open(side) as fh:
+        want = fh.read().strip()
+    like_leaves, treedef = jax.tree.flatten(like)
+    try:
+        with np.load(path) as z:
+            names = sorted(z.files)
+            arrays = [z[n] for n in names]
+    except Exception as e:
+        raise CheckpointCorruptError(f"{path}: unreadable ({e})")
+    if len(arrays) != len(like_leaves):
+        raise CheckpointCorruptError(
+            f"{path}: {len(arrays)} leaves saved, structure needs "
+            f"{len(like_leaves)}")
+    got = _leaf_digest(arrays)
+    if got != want:
+        raise CheckpointCorruptError(
+            f"{path}: digest mismatch (sidecar {want[:16]}..., "
+            f"content {got[:16]}...) -- torn or corrupted snapshot")
+    out = []
+    for arr, ref in zip(arrays, like_leaves):
+        ref = np.asarray(ref)
+        if arr.shape != ref.shape or arr.dtype != ref.dtype:
+            raise CheckpointCorruptError(
+                f"{path}: leaf shape/dtype {arr.shape}/{arr.dtype} != "
+                f"expected {ref.shape}/{ref.dtype}")
+        out.append(jax.numpy.asarray(arr))
+    return jax.tree.unflatten(treedef, out)
+
+
+# ----------------------------------------------------------------------
+# rotation directory
+# ----------------------------------------------------------------------
+
+_ROT_RE = re.compile(r"^ckpt-(\d{8})$")
+
+
+def _rotation_entries(dirpath: str) -> List[Tuple[int, str]]:
+    out = []
+    if not os.path.isdir(dirpath):
+        return out
+    for name in os.listdir(dirpath):
+        m = _ROT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(dirpath, name)))
+    return sorted(out)
+
+
+def save_pytree_rotating(dirpath, tree: Any, keep: int = 4) -> str:
+    """Write the next ``ckpt-<seq>`` snapshot into a rotation
+    directory (created on demand), then prune to the newest ``keep``
+    entries.  Returns the written path.  Each entry is an independent
+    atomic ``save_pytree``, so a crash mid-save never harms the older
+    entries restore falls back to."""
+    dirpath = os.fspath(dirpath)
+    os.makedirs(dirpath, exist_ok=True)
+    entries = _rotation_entries(dirpath)
+    seq = entries[-1][0] + 1 if entries else 1
+    path = os.path.join(dirpath, f"ckpt-{seq:08d}")
+    save_pytree(path, tree)
+    for _, old in _rotation_entries(dirpath)[:-keep]:
+        for p in (old, _sidecar(old)):
+            if os.path.exists(p):
+                os.unlink(p)
+    return path
+
+
+def restore_pytree_rotating(dirpath, like: Any) -> Tuple[Any, str]:
+    """Restore the newest INTACT snapshot from a rotation directory,
+    walking newest to oldest past torn/corrupt entries.  Returns
+    ``(tree, path)``; raises :class:`CheckpointCorruptError` when no
+    entry verifies."""
+    dirpath = os.fspath(dirpath)
+    entries = _rotation_entries(dirpath)
+    errors = []
+    for _, path in reversed(entries):
+        try:
+            return restore_pytree(path, like), path
+        except CheckpointCorruptError as e:
+            errors.append(str(e))
+    raise CheckpointCorruptError(
+        f"{dirpath}: no intact snapshot in rotation"
+        + (f" ({'; '.join(errors)})" if errors else " (empty)"))
 
 
 def queue_state_dict(q) -> dict:

@@ -62,6 +62,15 @@ def is_fallback(rec: dict) -> bool:
     return bool(rec.get("fallback")) or rec.get("platform") == "cpu"
 
 
+def is_chaos(rec: dict) -> bool:
+    """A fault-injection session (bench.py --fault-plan != "none"):
+    its rates reflect injected dropouts/skew, not the engine, so it
+    never enters the clean-run medians and is never judged against
+    them (docs/ROBUSTNESS.md).  Records predating the field are
+    clean runs."""
+    return rec.get("fault_plan", "none") != "none"
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=2.0,
@@ -80,8 +89,19 @@ def main() -> int:
     if n_fb:
         print(f"bench_guard: {n_fb} backend-fallback record(s) in "
               "history -- annotated, excluded from medians")
+    n_chaos = sum(1 for _, r in recs if is_chaos(r))
+    if n_chaos:
+        print(f"bench_guard: {n_chaos} chaos (fault-injection) "
+              "record(s) in history -- excluded from clean-run "
+              "medians")
 
     newest_name, newest = recs[-1]
+    if is_chaos(newest):
+        print(f"bench_guard: newest record {newest_name} is a chaos "
+              f"session (fault_plan "
+              f"{newest.get('fault_plan')!r}) -- recorded for the "
+              "trajectory, not judged against clean-run history; pass")
+        return 0
     if is_fallback(newest):
         err = newest.get("backend_error") or newest.get("error") or ""
         print(f"bench_guard: newest record {newest_name} is a "
@@ -94,7 +114,8 @@ def main() -> int:
     # would read as a phantom regression (or hide a real one)
     dev = newest.get("device")
     prior = [(n, r) for n, r in recs[:-1]
-             if r.get("device") == dev and not is_fallback(r)]
+             if r.get("device") == dev and not is_fallback(r)
+             and not is_chaos(r)]
     status = 0
     for wl, row in sorted(newest.get("workloads", {}).items()):
         dps = row.get("dps")

@@ -93,6 +93,70 @@ assert digests["sort"] == digests["radix"], \
 print("radix/sort parity smoke ok")
 EOF
 
+echo "== chaos smoke (seeded dropout+restart; zero-fault digest gate) =="
+# the robustness spine (docs/ROBUSTNESS.md): (1) an all-benign
+# FaultPlan must be BIT-IDENTICAL to running with no fault plumbing at
+# all; (2) a seeded one-dropout-one-restart plan must complete, keep
+# surviving servers' reservation conformance within contract, and
+# surface the injected events EXACTLY in the fault metric rows.
+timeout -k 30 900 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    import os
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+jax.config.update("jax_enable_x64", True)
+import numpy as np, jax.numpy as jnp
+from dmclock_tpu.core.timebase import rate_to_inv_ns
+from dmclock_tpu.parallel import cluster as CL
+from dmclock_tpu.robust import cluster as RC, faults as F
+
+S, C, T, K = 4, 8, 6, 16
+ADV = 10 ** 8
+QOS = [(10.0, 1.0 + (i % 3), 0.0) for i in range(C)]
+mesh = CL.make_mesh(4)
+
+def fresh():
+    cl = CL.init_cluster(S, C)
+    cl = CL.install_clients(
+        cl, jnp.asarray([rate_to_inv_ns(r) for r, _, _ in QOS], jnp.int64),
+        jnp.asarray([rate_to_inv_ns(w) for _, w, _ in QOS], jnp.int64),
+        jnp.asarray([rate_to_inv_ns(l) for _, _, l in QOS], jnp.int64))
+    return RC.shard_robust(RC.init_robust(CL.shard_cluster(cl, mesh)), mesh)
+
+arrivals = np.ones((T, S, C), dtype=np.int32)
+
+# (1) zero-fault bit-identity digest gate
+_, seq_none = RC.run_with_plan(fresh(), arrivals, 1, mesh, None,
+                               decisions_per_step=K, advance_ns=ADV)
+_, seq_zero = RC.run_with_plan(fresh(), arrivals, 1, mesh,
+                               F.zero_plan(T, S),
+                               decisions_per_step=K, advance_ns=ADV)
+d0, d1 = RC.decision_digest(seq_none), RC.decision_digest(seq_zero)
+assert d0 == d1, f"zero-fault digest diverged: {d0[:16]} vs {d1[:16]}"
+print(f"zero-fault digest gate ok ({d0[:16]})")
+
+# (2) seeded chaos run: one dropout + one restart
+plan = F.single_outage_plan(T, S, server=2, down_from=2, down_until=4)
+rc, seq = RC.run_with_plan(fresh(), arrivals, 1, mesh, plan,
+                           decisions_per_step=K, advance_ns=ADV)
+totals = RC.metrics_totals(rc)
+ev = F.plan_events(plan)
+assert totals["server_dropouts"] == ev["server_dropouts"] == 1, totals
+assert totals["tracker_resyncs"] == ev["tracker_resyncs"] == 1, totals
+assert totals["faults_injected"] == ev["faults_injected"], totals
+rows = RC.cluster_conformance(seq, arrivals, plan, QOS, ADV)
+survivors = [r for r in rows if r["live_steps"] == T]
+assert survivors and all(r["resv_met"] for r in survivors), \
+    "surviving servers missed reservation conformance"
+print(RC.format_cluster_conformance(rows).splitlines()[-1])
+print(f"chaos smoke ok (plan {F.describe(plan)}; fault counters match "
+      "the injected plan exactly; surviving servers within contract)")
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

@@ -1,21 +1,26 @@
 """Checkpoint/resume: a snapshotted queue or device sim must continue
-bit-exactly from where it left off."""
+bit-exactly from where it left off -- and a TORN snapshot (truncated,
+bit-flipped, sidecar-less, killed mid-save) must never be restorable
+(docs/ROBUSTNESS.md)."""
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-pytest.importorskip("orbax.checkpoint")
-
 from dmclock_tpu.core import ClientInfo, ReqParams
 from dmclock_tpu.engine import TpuPullPriorityQueue, init_state
-from dmclock_tpu.utils.checkpoint import (queue_state_dict,
+from dmclock_tpu.utils import checkpoint as ckpt_mod
+from dmclock_tpu.utils.checkpoint import (CheckpointCorruptError,
+                                          queue_state_dict,
                                           restore_pytree,
+                                          restore_pytree_rotating,
                                           restore_queue_state,
-                                          save_pytree)
+                                          save_pytree,
+                                          save_pytree_rotating)
 
 S = 10**9
 
@@ -88,3 +93,182 @@ def test_device_sim_checkpoint_resume(tmp_path):
     for f in ("served_resv", "served_prop", "t"):
         assert (np.asarray(getattr(cont, f))
                 == np.asarray(getattr(resumed, f))).all(), f
+
+
+# ----------------------------------------------------------------------
+# corruption: a damaged snapshot must never restore
+# ----------------------------------------------------------------------
+
+def _state(mark: int):
+    st = init_state(16, 8)
+    return st._replace(head_resv=st.head_resv.at[3].set(mark))
+
+
+def _like():
+    return init_state(16, 8)
+
+
+def test_restore_truncated_file(tmp_path):
+    p = tmp_path / "snap"
+    save_pytree(p, _state(111))
+    raw = p.read_bytes()
+    p.write_bytes(raw[:len(raw) // 2])
+    with pytest.raises(CheckpointCorruptError):
+        restore_pytree(p, _like())
+
+
+def _flip_payload_byte(path, mark: int) -> None:
+    """Flip one byte INSIDE stored leaf data (found via the int64
+    marker's byte pattern) -- a flip in zip header padding would be
+    semantically dead and rightly restorable."""
+    raw = bytearray(open(path, "rb").read())
+    pat = int(mark).to_bytes(8, "little")
+    idx = bytes(raw).find(pat)
+    assert idx > 0, "marker bytes not found in snapshot"
+    raw[idx] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+
+
+def test_restore_flipped_byte(tmp_path):
+    p = tmp_path / "snap"
+    mark = 0x0123456789AB
+    save_pytree(p, _state(mark))
+    _flip_payload_byte(p, mark)
+    with pytest.raises(CheckpointCorruptError):
+        restore_pytree(p, _like())
+
+
+def test_restore_missing_sidecar(tmp_path):
+    p = tmp_path / "snap"
+    save_pytree(p, _state(111))
+    os.unlink(str(p) + ".sha256")
+    with pytest.raises(CheckpointCorruptError, match="sidecar"):
+        restore_pytree(p, _like())
+
+
+def test_restore_shape_mismatch(tmp_path):
+    p = tmp_path / "snap"
+    save_pytree(p, _state(111))
+    with pytest.raises(CheckpointCorruptError):
+        restore_pytree(p, init_state(32, 8))
+
+
+def test_restore_from_rotation_skips_corrupt_newest(tmp_path):
+    rot = tmp_path / "rot"
+    mark = 0x0123456789AB
+    save_pytree_rotating(rot, _state(1))
+    newest = save_pytree_rotating(rot, _state(mark))
+    # corrupt the newest entry; restore must fall back to entry 1
+    _flip_payload_byte(newest, mark)
+    tree, path = restore_pytree_rotating(rot, _like())
+    assert int(tree.head_resv[3]) == 1
+    assert path.endswith("ckpt-00000001")
+
+
+def test_rotation_prunes_to_keep(tmp_path):
+    rot = tmp_path / "rot"
+    for i in range(6):
+        save_pytree_rotating(rot, _state(i), keep=3)
+    names = sorted(n for n in os.listdir(rot)
+                   if not n.endswith(".sha256"))
+    assert names == [f"ckpt-{i:08d}" for i in (4, 5, 6)]
+    tree, _ = restore_pytree_rotating(rot, _like())
+    assert int(tree.head_resv[3]) == 5
+
+
+def test_rotation_empty_raises(tmp_path):
+    with pytest.raises(CheckpointCorruptError, match="no intact"):
+        restore_pytree_rotating(tmp_path / "nothing", _like())
+
+
+# ----------------------------------------------------------------------
+# kill-during-save: no crash point leaves a restorable-but-torn state
+# ----------------------------------------------------------------------
+
+class _SimulatedKill(BaseException):
+    """BaseException so nothing in the save path can swallow it --
+    the closest in-process stand-in for SIGKILL."""
+
+
+@pytest.mark.parametrize("stage", ["data_written", "data_synced",
+                                   "data_renamed", "sidecar_written"])
+def test_kill_during_save_restores_previous_intact(tmp_path, stage):
+    rot = tmp_path / "rot"
+    save_pytree_rotating(rot, _state(7))       # the intact predecessor
+
+    def kill_at(s, stage=stage):
+        if s == stage:
+            raise _SimulatedKill(stage)
+
+    ckpt_mod._crash_hook = kill_at
+    try:
+        with pytest.raises(_SimulatedKill):
+            save_pytree_rotating(rot, _state(8))
+    finally:
+        ckpt_mod._crash_hook = None
+    # restore never sees the torn entry: it lands on the predecessor
+    tree, path = restore_pytree_rotating(rot, _like())
+    assert int(tree.head_resv[3]) == 7, \
+        f"kill at {stage} left a restorable torn snapshot"
+    assert path.endswith("ckpt-00000001")
+    # and a clean retry of the same save then wins
+    save_pytree_rotating(rot, _state(8))
+    tree, _ = restore_pytree_rotating(rot, _like())
+    assert int(tree.head_resv[3]) == 8
+
+
+@pytest.mark.parametrize("stage", ["data_written", "data_synced",
+                                   "data_renamed", "sidecar_written"])
+def test_kill_during_inplace_overwrite_keeps_old_snapshot(tmp_path,
+                                                          stage):
+    """Non-rotating save over an EXISTING path: a kill at any commit
+    stage (including between the data and sidecar renames) must leave
+    the previous snapshot restorable via the hard-linked .prev pair."""
+    p = tmp_path / "snap"
+    save_pytree(p, _state(7))
+
+    def kill_at(s, stage=stage):
+        if s == stage:
+            raise _SimulatedKill(stage)
+
+    ckpt_mod._crash_hook = kill_at
+    try:
+        with pytest.raises(_SimulatedKill):
+            save_pytree(p, _state(8))
+    finally:
+        ckpt_mod._crash_hook = None
+    tree = restore_pytree(p, _like())
+    assert int(tree.head_resv[3]) == 7, \
+        f"kill at {stage} lost the previous in-place snapshot"
+    # a clean retry commits the new state and prunes the .prev pair
+    save_pytree(p, _state(8))
+    assert int(restore_pytree(p, _like()).head_resv[3]) == 8
+    assert not os.path.exists(str(p) + ".prev")
+
+
+def test_double_crash_keeps_newest_committed_snapshot(tmp_path):
+    """Crash AFTER full commit but before the .prev prune, then crash
+    the next save mid-commit: fallback must land on the newest fully
+    committed snapshot, not the stale .prev from two saves ago."""
+    p = tmp_path / "snap"
+
+    def kill_at(stage):
+        def hook(s):
+            if s == stage:
+                raise _SimulatedKill(s)
+        return hook
+
+    save_pytree(p, _state(1))
+    ckpt_mod._crash_hook = kill_at("done")     # state 2 fully commits,
+    try:                                       # .prev (state 1) stays
+        with pytest.raises(_SimulatedKill):
+            save_pytree(p, _state(2))
+    finally:
+        ckpt_mod._crash_hook = None
+    ckpt_mod._crash_hook = kill_at("data_renamed")   # state 3 tears
+    try:
+        with pytest.raises(_SimulatedKill):
+            save_pytree(p, _state(3))
+    finally:
+        ckpt_mod._crash_hook = None
+    assert int(restore_pytree(p, _like()).head_resv[3]) == 2
