@@ -213,6 +213,20 @@ def obsdev_np_combine(acc, *vecs):
     return obsdev.metrics_combine_np(acc, *vecs)
 
 
+def _per_pass_cap(n: int, k: int, calendar_steps: int,
+                  calendar_impl: str, ladder_levels: int) -> int:
+    """Max decisions one batch/pass can commit -- the fill metric's
+    denominator.  A bucketed calendar batch refreshes the per-client
+    ``steps`` budget at every ladder level, so its cap scales with
+    ``ladder_levels``; without the factor a bucketed run's fill would
+    inflate past 1.0 and stop being comparable to the minstop series
+    it is A/B'd against."""
+    if not calendar_steps:
+        return k
+    levels = ladder_levels if calendar_impl == "bucketed" else 1
+    return n * calendar_steps * levels
+
+
 def _zipf_weights(n: int, s: float = 1.1, lo: float = 0.5,
                   hi: float = 64.0) -> np.ndarray:
     """Zipf-by-rank weights, clipped to a sane QoS range and shuffled
@@ -282,7 +296,9 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     with_metrics: bool = True,
                     conformance_rounds: int = 2,
                     conformance_out: str = None,
-                    select_impl: str = "sort"):
+                    select_impl: str = "sort",
+                    calendar_impl: str = "minstop",
+                    ladder_levels: int = 8):
     """Closed loop: Poisson superwave ingest + prefix serve epoch per
     round, chained async on device; ingest IS inside the timed region.
 
@@ -361,10 +377,14 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         if calendar_steps:
             # sortless calendar batches: per-client counts come back
             # directly ([N] served vector doubles as the calibration
-            # feed; lens column unused)
+            # feed; lens column unused).  calendar_impl="bucketed"
+            # fuses ladder_levels refreshed-boundary commits per batch
+            # (one launch = what took L minstop batches).
             ep = scan_calendar_epoch(st, now, m, steps=calendar_steps,
                                      anticipation_ns=0,
-                                     with_metrics=with_metrics)
+                                     with_metrics=with_metrics,
+                                     calendar_impl=calendar_impl,
+                                     ladder_levels=ladder_levels)
             return (ep.state, ep.count, ep.progress_ok,
                     ep.resv_count, ep.served,
                     jnp.ones_like(ep.served),
@@ -542,15 +562,15 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         dps = float(np.median(rates))
         cnts = np.concatenate(all_cnts)
         rs = np.concatenate(all_rs)
-        denom = n_pre * m * (n * calendar_steps if calendar_steps
-                             else k)
+        denom = n_pre * m * _per_pass_cap(n, k, calendar_steps,
+                                          calendar_impl, ladder_levels)
     else:
         lat = scalar_latency()
         d_hi, t_hi, cnts, rs = chain(range(rounds))
         dps = d_hi / (t_hi - lat)
         total = d_hi
-        denom = rounds * m * (n * calendar_steps if calendar_steps
-                              else k)
+        denom = rounds * m * _per_pass_cap(n, k, calendar_steps,
+                                           calendar_impl, ladder_levels)
 
     resv_frac = float(rs.sum()) / max(cnts.sum(), 1)
     mean_depth = float(np.asarray(state.depth).mean())
@@ -560,6 +580,15 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
            "mean_depth": mean_depth,
            "select_impl": select_impl,
            "cost_analysis": cost}
+    if calendar_steps:
+        # decisions per device launch (pass = one calendar batch):
+        # the bucketed-vs-minstop acceptance currency -- the ladder's
+        # whole point is committing more per pass on skewed stops
+        n_passes = n_pre * m
+        out["calendar_impl"] = calendar_impl
+        out["decisions_per_pass"] = total / max(n_passes, 1)
+        if calendar_impl == "bucketed":
+            out["ladder_levels"] = ladder_levels
     if with_metrics:
         md = obsdev.metrics_dict(met_acc)
         out["device_metrics"] = md
@@ -751,6 +780,41 @@ def bench_frontier(points=((2, 64), (3, 64), (6, 64), (12, 64)), *,
     return None, rows
 
 
+def _is_backend_error(e: BaseException) -> bool:
+    """A device-launch failure that means the BACKEND is unusable, not
+    that the bench is buggy: the tunneled runtime can pass the
+    init-time probe and then raise at the first real dispatch
+    (BENCH_r05: ``RuntimeError: Unable to initialize backend 'axon'``
+    surfaced at the first device launch after ``jax.devices()``
+    succeeded).  XlaRuntimeError subclasses RuntimeError."""
+    if not isinstance(e, RuntimeError):
+        return False
+    msg = str(e).lower()
+    return (type(e).__name__ == "XlaRuntimeError"
+            or "backend" in msg or "unable to initialize" in msg
+            or "failed to connect" in msg)
+
+
+def _switch_to_cpu_backend() -> None:
+    """Best-effort mid-process backend switch after a dispatch-time
+    failure: point jax at cpu and drop every cached backend/program so
+    the re-entered run initializes fresh."""
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+    try:
+        import jax.extend.backend as _jeb
+        _jeb.clear_backends()
+    except Exception:
+        try:    # older jax spells it on the bridge module
+            from jax._src import xla_bridge as _xb
+            _xb._clear_backends()
+        except Exception:
+            pass
+
+
 def _resolve_backend():
     """Probe the accelerator backend, falling back to CPU when setup
     fails (BENCH_r05: the tunneled TPU runtime raised RuntimeError in
@@ -791,6 +855,19 @@ def main() -> None:
                     "serve_radix (bit-identical decisions, A/B timing; "
                     "cfg4's calendar engine is sortless and ignores "
                     "this)")
+    ap.add_argument("--calendar-impl",
+                    choices=["minstop", "bucketed", "both"],
+                    default="minstop",
+                    help="calendar-engine commit-boundary scheme for "
+                    "the cfg4 workload (fastpath calendar_impl): "
+                    "'bucketed' fuses a stop-key ladder of "
+                    "--ladder-levels refreshed boundaries per batch "
+                    "(more decisions per pass on skewed populations); "
+                    "'both' runs cfg4 under each and reports cfg4 + "
+                    "cfg4_bucketed (separate bench_guard series)")
+    ap.add_argument("--ladder-levels", type=int, default=8,
+                    metavar="L",
+                    help="ladder levels per bucketed calendar batch")
     ap.add_argument("--device-metrics", choices=["on", "off"],
                     default="on",
                     help="accumulate the on-device obs vector inside "
@@ -831,6 +908,7 @@ def main() -> None:
                   file=sys.stderr)
 
     backend, fallback, backend_err = _resolve_backend()
+    backend_fallback = None   # "dispatch" after a launch-time switch
     wm = args.device_metrics == "on"
 
     def emit(out: dict) -> None:
@@ -844,6 +922,8 @@ def main() -> None:
             out["fallback"] = True
         if backend_err:
             out["backend_error"] = backend_err
+        if backend_fallback:
+            out["backend_fallback"] = backend_fallback
         print(json.dumps(out))
 
     if backend == "none":
@@ -887,8 +967,8 @@ def main() -> None:
     trace_ctx = (jax.profiler.trace(args.profile) if args.profile
                  else contextlib.nullcontext())
 
-    results = {}
-    with trace_ctx:
+    def run_workloads(backend: str) -> dict:
+        results = {}
         if args.mode in ("all", "serve"):
             # the cpu fallback cannot hold a 100k x 320 backlog in
             # tolerable time; a scaled-down shape keeps the smoke alive
@@ -924,13 +1004,40 @@ def main() -> None:
             # the smallest m whose per-client budget covers the
             # per-round arrival cap (192 >= 63) is strictly fastest
             # (m=12 commits the same decisions in 4x the passes).
-            results["cfg4"] = bench_sustained(
-                100_000, 0, 3, 40, zipf=True,
-                resv_rate=1200.0, dt_round_ns=50_000_000,
-                waves=64, rounds_lo=12, latency_rounds=100,
-                calendar_steps=64, target_resv_share=0.5, reps=4,
-                with_metrics=wm,
-                conformance_out=args.conformance_out)
+            # --calendar-impl A/Bs the bucketed stop-key ladder
+            # against minstop (separate bench_guard series; the JSON
+            # line records decisions_per_pass for each).
+            cals = ("minstop", "bucketed") \
+                if args.calendar_impl == "both" \
+                else (args.calendar_impl,)
+            for cal in cals:
+                key = "cfg4" if cal == "minstop" else "cfg4_bucketed"
+                results[key] = bench_sustained(
+                    100_000, 0, 3, 40, zipf=True,
+                    resv_rate=1200.0, dt_round_ns=50_000_000,
+                    waves=64, rounds_lo=12, latency_rounds=100,
+                    calendar_steps=64, target_resv_share=0.5, reps=4,
+                    with_metrics=wm, calendar_impl=cal,
+                    ladder_levels=args.ladder_levels,
+                    conformance_out=args.conformance_out)
+        return results
+
+    with trace_ctx:
+        try:
+            results = run_workloads(backend)
+        except RuntimeError as e:
+            if not _is_backend_error(e):
+                raise
+            # the init-time probe passed but the FIRST dispatch
+            # raised (BENCH_r05): switch to cpu and re-enter, keeping
+            # the guaranteed JSON line
+            print(f"# backend failed at dispatch ({e}); "
+                  "re-entering on cpu", file=sys.stderr)
+            backend_err = f"{type(e).__name__}: {e}"
+            _switch_to_cpu_backend()
+            backend, fallback = "cpu", True
+            backend_fallback = "dispatch"
+            results = run_workloads("cpu")
 
     if not results:
         emit({"metric": "sustained workloads skipped on cpu fallback "
@@ -939,7 +1046,7 @@ def main() -> None:
               "value": 0.0, "unit": "decisions/sec/chip",
               "vs_baseline": 0.0})
         return
-    c4 = results.get("cfg4")
+    c4 = results.get("cfg4") or results.get("cfg4_bucketed")
     primary = c4 or results.get("cfg3") or results.get("serve") \
         or next(iter(results.values()))
     parts = []
@@ -954,15 +1061,21 @@ def main() -> None:
         parts.append(f"cfg3 10k-client Poisson sustained "
                      f"{r['dps']/1e6:.1f}M (fill {r['fill']:.2f}, "
                      f"depth {r['mean_depth']:.0f})")
-    if c4:
+    for key, label in (("cfg4", "cfg4"),
+                       ("cfg4_bucketed", "cfg4[bucketed]")):
+        r4 = results.get(key)
+        if not r4:
+            continue
         parts.append(
-            f"cfg4 100k-client Zipf resv-constrained "
-            f"{c4['dps']/1e6:.1f}M (resv phase "
-            f"{c4['resv_phase_frac']:.2f}; round mean "
-            f"{c4.get('round_ms_mean', 0):.0f}ms device-side, "
+            f"{label} 100k-client Zipf resv-constrained "
+            f"{r4['dps']/1e6:.1f}M (resv phase "
+            f"{r4['resv_phase_frac']:.2f}; "
+            f"{r4.get('decisions_per_pass', 0):.0f} dec/pass; "
+            f"round mean "
+            f"{r4.get('round_ms_mean', 0):.0f}ms device-side, "
             f"measured-interval p50 "
-            f"{c4.get('round_ms_p50', 0):.0f}ms p99 "
-            f"{c4.get('round_ms_p99', 0):.0f}ms tunnel-inclusive "
+            f"{r4.get('round_ms_p50', 0):.0f}ms p99 "
+            f"{r4.get('round_ms_p99', 0):.0f}ms tunnel-inclusive "
             f"upper bounds)")
 
     try:

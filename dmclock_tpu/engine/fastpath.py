@@ -513,35 +513,12 @@ def _pack(cls, krel, o):
 # comparisons = (64/b) * 2^b * N, minimized at small b; 4-bit digits
 # (16 passes of 16-bucket histograms) cost 8x less than 8-bit ones
 # and keep every pass a pure vectorized compare+reduce.
+#
+# The histogram walk itself lives in ``kernels`` now (radix_kth_key):
+# the calendar engine's bucketed stop-key ladder reuses it, so the
+# machinery is shared instead of prefix-path-private.
 
-_RADIX_BITS = 4
-_RADIX_SPAN = 1 << _RADIX_BITS
-
-
-def _radix_kth_key(pk, kk: int):
-    """Exact value of the ``kk``-th smallest element of ``pk``
-    (1-indexed, duplicates counted) via 16 rounds of 4-bit dense
-    histograms over the int64 key space -- O(N) work per round, no
-    sort, no scatter, no scalar gathers (masked reductions only,
-    finding 10).  ``pk`` must be non-negative (packed keys and the
-    KEY_INF sentinel both are)."""
-    buckets = jnp.arange(_RADIX_SPAN, dtype=jnp.int64)
-    lanes = jnp.arange(_RADIX_SPAN, dtype=jnp.int32)
-    prefix = jnp.int64(0)
-    remaining = jnp.int32(kk)
-    active = jnp.ones(pk.shape, dtype=bool)
-    for shift in range(64 - _RADIX_BITS, -1, -_RADIX_BITS):
-        digit = (pk >> shift) & (_RADIX_SPAN - 1)
-        hist = jnp.sum(active[None, :] & (digit[None, :]
-                                          == buckets[:, None]),
-                       axis=1, dtype=jnp.int32)
-        cum = jnp.cumsum(hist)
-        sel = jnp.argmax(cum >= remaining).astype(jnp.int32)
-        below = jnp.sum(jnp.where(lanes < sel, hist, 0))
-        remaining = remaining - below
-        prefix = prefix | (sel.astype(jnp.int64) << shift)
-        active = active & (digit == sel.astype(jnp.int64))
-    return prefix
+_radix_kth_key = kernels.radix_kth_key
 
 
 def _select_radix(pk_dense, iota, epk, cost32, lens, k: int, kk: int):
@@ -1013,7 +990,9 @@ class PrefixEpoch(NamedTuple):
 
 
 def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
-                   guards_ok, rebase_fallback=False, live=True):
+                   guards_ok, rebase_fallback=False, live=True,
+                   ladder_levels_used=0, ladder_base_decisions=0,
+                   ladder_fallbacks=0):
     """Fold one batch's contribution into the epoch metrics vector --
     pure reductions over arrays the batch already materialized, so the
     decision stream cannot be perturbed.  A stall is a batch that
@@ -1036,7 +1015,10 @@ def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
         ring_hwm=hwm.astype(jnp.int64),
         guard_trips=(~guards_ok & live).astype(jnp.int64),
         rebase_fallbacks=jnp.asarray(rebase_fallback,
-                                     jnp.int64)))
+                                     jnp.int64),
+        cal_ladder_levels_used=ladder_levels_used,
+        cal_ladder_base_decisions=ladder_base_decisions,
+        cal_ladder_fallbacks=ladder_fallbacks))
 
 
 def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
@@ -1500,26 +1482,24 @@ def _calendar_pass(state: EngineState, now, arr_rows, cost_rows,
             c["served_resv"], c["lb"], c["prev_pk"], c["unit_cls"])
 
 
-def calendar_batch(state: EngineState, now, *, steps: int,
-                   anticipation_ns: int = 0,
-                   allow_limit_break: bool = False,
-                   heads=None) -> CalendarBatch:
-    """One calendar-commit batch: up to ``steps`` decisions PER CLIENT
-    in two dense elementwise passes, no sort (see section comment).
+def _calendar_batch_core(state: EngineState, now, arr_rows, cost_rows,
+                         *, anticipation_ns: int,
+                         allow_limit_break: bool):
+    """The measure + boundary + commit + promote pipeline of one
+    calendar batch, given the prefetched window rows.  Shared by
+    :func:`calendar_batch` (one boundary per launch) and the bucketed
+    ladder (L fused boundaries per launch).
 
-    The committed set is exactly the serial engine's next ``count``
-    decisions (differentially pinned by tests/test_prefix.py's
-    calendar suite); the emission is per-client counts + final state.
-    ``progress_ok`` False (count 0 with candidates present) happens
-    only when the very first serial unit is unfollowable (its induced
-    chain exceeds ``steps``): fall back to the serial engine."""
-    assert steps <= state.ring_capacity, \
-        "calendar steps exceed the ring window"
-    if heads is None:
-        win = ring_window(state, steps)
-        heads = (win.arr, win.cost)
-    arr_rows, cost_rows = _heads_rows(heads, steps)
+    The boundary is the stop-key distribution's FIRST order statistic
+    -- what ``kernels.radix_kth_key(stop_pk, 1)`` computes -- read as
+    a plain ``jnp.min``: the same value for 16x fewer dense passes,
+    and this stack's CPU backend miscompiles the histogram walk inside
+    the sharded device sim (deterministic compiler SIGFPE, see
+    tests/test_calendar_bucketed.py's device-sim note).  The histogram
+    rounds proper serve where ranks beyond 1 are genuinely needed: the
+    quantile planner (:func:`calendar_stop_ladder`).
 
+    Returns ``(CalendarBatch, b_eff, stop_pk)``."""
     cls0, key0 = _classify(state, now, allow_limit_break)
     kresv = jnp.min(jnp.where(cls0 == CLS_RESV, key0, KEY_INF))
     kprop1 = jnp.min(jnp.where(cls0 == CLS_WEIGHT, key0, KEY_INF))
@@ -1583,11 +1563,204 @@ def calendar_batch(state: EngineState, now, *, steps: int,
         do_promote, promoted, new_state.head_ready))
 
     count = jnp.sum(served).astype(jnp.int32)
-    return CalendarBatch(
+    batch = CalendarBatch(
         state=new_state, count=count,
         resv_count=jnp.sum(served_resv).astype(jnp.int32),
         units=units, served=served, served_resv=served_resv, lb=lb,
         progress_ok=(count > 0) | ~any_cand)
+    return batch, b_eff, stop_pk
+
+
+def calendar_batch(state: EngineState, now, *, steps: int,
+                   anticipation_ns: int = 0,
+                   allow_limit_break: bool = False,
+                   heads=None) -> CalendarBatch:
+    """One calendar-commit batch: up to ``steps`` decisions PER CLIENT
+    in two dense elementwise passes, no sort (see section comment).
+
+    The committed set is exactly the serial engine's next ``count``
+    decisions (differentially pinned by tests/test_prefix.py's
+    calendar suite); the emission is per-client counts + final state.
+    ``progress_ok`` False (count 0 with candidates present) happens
+    only when the very first serial unit is unfollowable (its induced
+    chain exceeds ``steps``): fall back to the serial engine."""
+    assert steps <= state.ring_capacity, \
+        "calendar steps exceed the ring window"
+    if heads is None:
+        win = ring_window(state, steps)
+        heads = (win.arr, win.cost)
+    arr_rows, cost_rows = _heads_rows(heads, steps)
+    batch, _, _ = _calendar_batch_core(
+        state, now, arr_rows, cost_rows,
+        anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break)
+    return batch
+
+
+# ----------------------------------------------------------------------
+# bucketed calendar commits: the histogram stop-key ladder
+# ----------------------------------------------------------------------
+#
+# The minstop boundary B_eff = min over per-client stop keys lets the
+# single most conservative client truncate the whole batch: on a Zipf
+# population the heavy client exhausts its `steps` budget at a low key
+# while most clients could be followed far past it, so each launch
+# commits one thin slab of the key space and pays a fresh dispatch for
+# the next.  The bucketed ladder fuses L successive boundaries into
+# ONE launch: a lax.scan over ladder levels where every level
+# re-prefetches the ring window from the committed state (REFRESHED
+# per-client step budgets -- the budget-stopped blocker continues from
+# where it stood), measures fresh stop keys, takes the level boundary
+# B_i = the stop distribution's first order statistic, and commits the
+# exact serial prefix < B_i.  Level i therefore starts from exactly the
+# serial state at B_{i-1}, so the concatenated committed sets are one
+# serial prefix and the classical minstop exactness argument applies
+# per level -- one device launch commits what previously took L full
+# measure+commit batches.
+#
+# Why each level's boundary is its own refreshed min-stop and not a
+# raw CDF quantile of the FIRST measure's stops: a stop key is a hard
+# followability limit -- committing past a budget-stopped client's
+# stop would emit other clients' serves the serial engine orders
+# AFTER the blocker's unmeasured ones (not a prefix, not exact).
+# Refreshing the budget is what discharges a stop, and only the
+# level's own measure can prove it discharged.  The stop-key CDF
+# ladder (``calendar_stop_ladder``, kernels.radix_quantile_ladder) is
+# the PLANNER view of the same histogram: it predicts where the
+# refreshed levels will land (on a skewed population the achieved
+# boundaries track the stop quantiles) and prices a ladder depth L
+# before running it; the commit path keeps the provable boundary.
+
+_CAL_IMPLS = ("minstop", "bucketed")
+
+
+class CalendarLadderBatch(NamedTuple):
+    """Result of one bucketed calendar batch (L fused ladder levels).
+
+    Totals aggregate over every level; the committed set is one serial
+    prefix of ``count`` decisions (level i starts from the committed
+    state of level i-1), so the differential contract is exactly
+    :class:`CalendarBatch`'s with more committed per launch."""
+
+    state: EngineState
+    count: jnp.ndarray        # int32 committed decisions (all levels)
+    resv_count: jnp.ndarray   # int32 constraint-phase decisions
+    units: jnp.ndarray        # int32[N] committed units per client
+    served: jnp.ndarray       # int32[N] committed decisions per client
+    served_resv: jnp.ndarray  # int32[N] constraint decisions
+    lb: jnp.ndarray           # int32[N] limit-break entries (Allow)
+    progress_ok: jnp.ndarray  # bool: level 0 committed or had no
+    #                           candidate (same fallback contract as
+    #                           CalendarBatch.progress_ok)
+    level_count: jnp.ndarray  # int32[L] decisions per ladder level
+    level_bound: jnp.ndarray  # int64[L] committed boundary per level
+    level_stall: jnp.ndarray  # bool[L] committed 0 with candidates
+    #                           present (a mid-ladder stall wastes the
+    #                           remaining levels; metric row
+    #                           calendar_ladder_fallbacks)
+
+
+def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
+                          steps: int, levels: int,
+                          anticipation_ns: int, allow: bool,
+                          use_pallas):
+    """The fused ladder: a lax.scan over L levels, each a full
+    window-prefetch + measure + histogram boundary + commit from the
+    previous level's committed state.  Carries only the mutable epoch
+    fields (the ring pair and QoS identity stay loop-invariant,
+    exactly like the epoch scans).  Returns ``(mut', acc, outs)`` with
+    ``acc`` the [N] per-client counters summed over levels and
+    ``outs`` the per-level (count, resv_count, bound, stall) stacks."""
+    n = invariant["active"].shape[-1]
+    acc0 = dict(units=jnp.zeros((n,), jnp.int32),
+                served=jnp.zeros((n,), jnp.int32),
+                served_resv=jnp.zeros((n,), jnp.int32),
+                lb=jnp.zeros((n,), jnp.int32))
+
+    def level(carry, _):
+        mut, acc = carry
+        st = EngineState(**invariant, **mut)
+        win = ring_window(st, steps, use_pallas=use_pallas)
+        arr_rows, cost_rows = _heads_rows((win.arr, win.cost), steps)
+        batch, b_eff, _ = _calendar_batch_core(
+            st, now, arr_rows, cost_rows,
+            anticipation_ns=anticipation_ns, allow_limit_break=allow)
+        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
+        acc = dict(units=acc["units"] + batch.units,
+                   served=acc["served"] + batch.served,
+                   served_resv=acc["served_resv"] + batch.served_resv,
+                   lb=acc["lb"] + batch.lb)
+        # a level that commits nothing WITH candidates present is a
+        # ladder stall: progress_ok's per-level analog (later levels
+        # deterministically repeat it -- same state, same boundary)
+        stall = ~batch.progress_ok
+        return (new_mut, acc), (batch.count, batch.resv_count, b_eff,
+                                stall)
+
+    (mut, acc), outs = lax.scan(level, (mut, acc0), None,
+                                length=levels)
+    return mut, acc, outs
+
+
+def calendar_batch_bucketed(state: EngineState, now, *, steps: int,
+                            levels: int,
+                            anticipation_ns: int = 0,
+                            allow_limit_break: bool = False,
+                            use_pallas: bool | None = None
+                            ) -> CalendarLadderBatch:
+    """One bucketed calendar batch: L fused ladder levels (see section
+    comment), each committing the exact serial prefix below its own
+    refreshed stop-key boundary with a fresh per-client ``steps``
+    budget.  With ``levels=1`` the committed set, the final state, and
+    every counter are bit-identical to :func:`calendar_batch` (the
+    ci.sh digest gate)."""
+    assert steps <= state.ring_capacity, \
+        "calendar steps exceed the ring window"
+    assert levels >= 1, "the ladder needs at least one level"
+    invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
+    mut0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+    mut, acc, (count, resv, bound, stall) = _calendar_ladder_scan(
+        invariant, mut0, now, steps=steps, levels=levels,
+        anticipation_ns=anticipation_ns, allow=allow_limit_break,
+        use_pallas=use_pallas)
+    total = jnp.sum(count).astype(jnp.int32)
+    return CalendarLadderBatch(
+        state=EngineState(**invariant, **mut),
+        count=total,
+        resv_count=jnp.sum(resv).astype(jnp.int32),
+        units=acc["units"], served=acc["served"],
+        served_resv=acc["served_resv"], lb=acc["lb"],
+        progress_ok=~stall[0],
+        level_count=count, level_bound=bound, level_stall=stall)
+
+
+def calendar_stop_ladder(state: EngineState, now, *, steps: int,
+                         levels: int, anticipation_ns: int = 0,
+                         allow_limit_break: bool = False,
+                         heads=None):
+    """The histogram PLANNER view of the ladder: one measure pass,
+    then the stop-key CDF quantiles B_1 <= ... <= B_levels via the
+    shared dense-histogram rounds (kernels.radix_quantile_ladder).
+    B_1 is exactly the minstop boundary; the higher quantiles predict
+    where successive refreshed-budget commit levels land on a skewed
+    stop distribution (diagnostic/sizing -- the commit path itself
+    re-measures per level; see section comment).
+
+    Returns ``(ladder int64[levels], stop_pk int64[N])``."""
+    assert steps <= state.ring_capacity, \
+        "calendar steps exceed the ring window"
+    if heads is None:
+        win = ring_window(state, steps)
+        heads = (win.arr, win.cost)
+    arr_rows, cost_rows = _heads_rows(heads, steps)
+    cls0, key0 = _classify(state, now, allow_limit_break)
+    kresv = jnp.min(jnp.where(cls0 == CLS_RESV, key0, KEY_INF))
+    kprop1 = jnp.min(jnp.where(cls0 == CLS_WEIGHT, key0, KEY_INF))
+    kprop2 = jnp.min(jnp.where(cls0 == CLS_LB, key0, KEY_INF))
+    stop_pk = _calendar_pass(state, now, arr_rows, cost_rows,
+                             allow_limit_break, anticipation_ns,
+                             kresv, kprop1, kprop2, None)
+    return kernels.radix_quantile_ladder(stop_pk, levels), stop_pk
 
 
 class CalendarEpoch(NamedTuple):
@@ -1601,6 +1774,10 @@ class CalendarEpoch(NamedTuple):
     #                           epoch; calibration feed)
     metrics: jnp.ndarray      # int64[NUM_METRICS] (zeros unless
     #                           with_metrics)
+    level_count: jnp.ndarray  # int32[M, L] decisions per ladder level
+    #                           (L = ladder_levels for "bucketed", 1
+    #                           for "minstop"; bench decisions-per-
+    #                           level attribution)
 
 
 def scan_calendar_epoch(state: EngineState, now, m: int, *,
@@ -1608,12 +1785,27 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                         allow_limit_break: bool = False,
                         use_pallas: bool | None = None,
                         with_metrics: bool = False,
-                        tag_width: int = 64) -> CalendarEpoch:
+                        tag_width: int = 64,
+                        calendar_impl: str = "minstop",
+                        ladder_levels: int = 8) -> CalendarEpoch:
     """Run m calendar batches on device (each prefetches its own
     ``steps``-row ring window).  ``tag_width`` as in
     :func:`scan_prefix_epoch` (a window trip reports
-    ``progress_ok=False`` for that batch and every later one)."""
+    ``progress_ok=False`` for that batch and every later one).
+
+    ``calendar_impl`` (STATIC, "minstop"|"bucketed") picks the commit
+    boundary scheme, mirroring the prefix engine's ``select_impl``
+    switch: "minstop" is one global min-stop boundary per batch;
+    "bucketed" fuses ``ladder_levels`` refreshed-budget boundaries per
+    batch (see the bucketed section comment), so one launch commits
+    what took ``ladder_levels`` minstop batches.  Both produce exact
+    serial prefixes; ``ladder_levels=1`` is bit-identical to
+    "minstop" (ci.sh digest gate)."""
     assert tag_width in (32, 64), tag_width
+    assert calendar_impl in _CAL_IMPLS, calendar_impl
+    bucketed = calendar_impl == "bucketed"
+    levels = int(ladder_levels) if bucketed else 1
+    assert levels >= 1, "the ladder needs at least one level"
     narrow32 = tag_width == 32
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
@@ -1636,43 +1828,76 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
         else:
             mut, acc, met = carry
             st = EngineState(**invariant, **mut)
-        win = ring_window(st, steps, use_pallas=use_pallas)
-        batch = calendar_batch(st, now, steps=steps,
-                               anticipation_ns=anticipation_ns,
-                               allow_limit_break=allow_limit_break,
-                               heads=(win.arr, win.cost))
-        count, resv_count = batch.count, batch.resv_count
-        progress = batch.progress_ok
-        served = batch.served
-        lb_total = jnp.sum(batch.lb).astype(jnp.int64)
-        new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
+        if bucketed:
+            mut_in = {f: getattr(st, f) for f in _EPOCH_MUTABLE}
+            new_mut, lacc, (lvl_count, lvl_resv, _bound, lvl_stall) = \
+                _calendar_ladder_scan(
+                    invariant, mut_in, now, steps=steps,
+                    levels=levels, anticipation_ns=anticipation_ns,
+                    allow=allow_limit_break, use_pallas=use_pallas)
+            batch_state = EngineState(**invariant, **new_mut)
+            count = jnp.sum(lvl_count).astype(jnp.int32)
+            resv_count = jnp.sum(lvl_resv).astype(jnp.int32)
+            progress = ~lvl_stall[0]
+            served = lacc["served"]
+            lb_total = jnp.sum(lacc["lb"]).astype(jnp.int64)
+            levels_used = jnp.sum((lvl_count > 0)
+                                  .astype(jnp.int64))
+            ladder_fb = jnp.any(lvl_stall).astype(jnp.int64)
+            base_decs = lvl_count[0].astype(jnp.int64)
+        else:
+            win = ring_window(st, steps, use_pallas=use_pallas)
+            batch = calendar_batch(
+                st, now, steps=steps,
+                anticipation_ns=anticipation_ns,
+                allow_limit_break=allow_limit_break,
+                heads=(win.arr, win.cost))
+            batch_state = batch.state
+            count, resv_count = batch.count, batch.resv_count
+            progress = batch.progress_ok
+            served = batch.served
+            lb_total = jnp.sum(batch.lb).astype(jnp.int64)
+            lvl_count = count[None]
+            levels_used = (count > 0).astype(jnp.int64)
+            ladder_fb = jnp.int64(0)
+            base_decs = count.astype(jnp.int64)
+            new_mut = {f: getattr(batch.state, f)
+                       for f in _EPOCH_MUTABLE}
         trip = jnp.bool_(False)
         good = jnp.bool_(True)
         if narrow32:
             mut, dead, good, trip, \
-                (count, resv_count, progress, served,
-                 lb_total) = tc.gate(
+                (count, resv_count, progress, served, lb_total,
+                 lvl_count, levels_used, ladder_fb,
+                 base_decs) = tc.gate(
                     dead, mut, new_mut,
                     [(count, 0), (resv_count, 0), (progress, False),
-                     (served, 0), (lb_total, 0)])
+                     (served, 0), (lb_total, 0),
+                     (lvl_count, jnp.zeros((levels,), jnp.int32)),
+                     (levels_used, 0), (ladder_fb, 0),
+                     (base_decs, 0)])
         else:
             mut = new_mut
-        out = (count, resv_count, progress)
+        out = (count, resv_count, progress, lvl_count)
         if with_metrics:
             met = _batch_metrics(
-                met, batch.state, count=count,
+                met, batch_state, count=count,
                 resv=resv_count,
                 prop=count - resv_count,
                 lb=lb_total,
                 # a calendar batch with candidates that cannot make
                 # progress is the guard-trip analog (serial fallback)
-                guards_ok=batch.progress_ok, rebase_fallback=trip,
-                live=good)
+                guards_ok=progress | ~good, rebase_fallback=trip,
+                live=good,
+                ladder_levels_used=levels_used,
+                ladder_base_decisions=base_decs,
+                ladder_fallbacks=ladder_fb)
         carry = (mut, acc + served, met, dead) if narrow32 \
             else (mut, acc + served, met)
         return carry, out
 
-    carry, (count, resv, ok) = lax.scan(body, carry0, None, length=m)
+    carry, (count, resv, ok, lvls) = lax.scan(body, carry0, None,
+                                              length=m)
     mutable, served, metrics = carry[0], carry[1], carry[2]
     if narrow32:
         state = EngineState(**invariant,
@@ -1681,4 +1906,4 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
         state = EngineState(**invariant, **mutable)
     return CalendarEpoch(state=state, count=count, resv_count=resv,
                          progress_ok=ok, served=served,
-                         metrics=metrics)
+                         metrics=metrics, level_count=lvls)

@@ -151,6 +151,70 @@ def restore64(vals32, origin):
 
 
 # ----------------------------------------------------------------------
+# dense-histogram (radix) key selection
+# ----------------------------------------------------------------------
+#
+# Shared machinery: the prefix engine's k-selection
+# (``fastpath._select_radix``) and the calendar engine's bucketed
+# stop-key ladder both need order statistics of an int64 key vector
+# without sorting it.  Multi-pass dense histograms walk the key space
+# top-down to the exact kk-th smallest element -- O(N) work per round,
+# no sort, no scatter, no scalar gathers (masked reductions only,
+# PROFILE.md finding 10).  Digit width: dense one-hot histograms cost
+# rounds * 2^bits * N compares = (64/b) * 2^b * N, minimized at small
+# b; 4-bit digits (16 rounds of 16-bucket histograms) cost 8x less
+# than 8-bit ones and keep every round a pure vectorized
+# compare+reduce.
+
+RADIX_BITS = 4
+RADIX_SPAN = 1 << RADIX_BITS
+
+
+def radix_kth_key(pk, kk):
+    """Exact value of the ``kk``-th smallest element of ``pk``
+    (1-indexed, duplicates counted) via 16 rounds of 4-bit dense
+    histograms over the int64 key space.  ``kk`` may be a static int
+    or a traced int32 scalar (the calendar quantile ladder passes
+    traced CDF ranks).  ``pk`` must be non-negative (packed keys and
+    the KEY_INF sentinel both are)."""
+    buckets = jnp.arange(RADIX_SPAN, dtype=jnp.int64)
+    lanes = jnp.arange(RADIX_SPAN, dtype=jnp.int32)
+    prefix = jnp.int64(0)
+    remaining = jnp.asarray(kk, dtype=jnp.int32)
+    active = jnp.ones(pk.shape, dtype=bool)
+    for shift in range(64 - RADIX_BITS, -1, -RADIX_BITS):
+        digit = (pk >> shift) & (RADIX_SPAN - 1)
+        hist = jnp.sum(active[None, :] & (digit[None, :]
+                                          == buckets[:, None]),
+                       axis=1, dtype=jnp.int32)
+        cum = jnp.cumsum(hist)
+        sel = jnp.argmax(cum >= remaining).astype(jnp.int32)
+        below = jnp.sum(jnp.where(lanes < sel, hist, 0))
+        remaining = remaining - below
+        prefix = prefix | (sel.astype(jnp.int64) << shift)
+        active = active & (digit == sel.astype(jnp.int64))
+    return prefix
+
+
+def radix_quantile_ladder(pk, levels: int):
+    """CDF quantile ladder of the FINITE entries of ``pk``: boundary i
+    (1-indexed) is the ``ceil(i * C / levels)``-th smallest key, where
+    C counts entries strictly below KEY_INF.  Returns nondecreasing
+    int64[levels] (all KEY_INF when nothing is finite).
+
+    This is the calendar engine's bucketed-commit planner
+    (docs/ENGINE.md): the per-client stop keys of a measure pass
+    histogram into the ladder B_1 <= ... <= B_levels that predicts
+    where successive refreshed-budget commit levels will land on a
+    skewed stop distribution."""
+    fin = jnp.sum((pk < jnp.int64(KEY_INF)).astype(jnp.int32))
+    lv = jnp.arange(1, levels + 1, dtype=jnp.int32)
+    ranks = jnp.maximum((lv * fin + levels - 1) // levels,
+                        jnp.int32(1))
+    return jax.vmap(lambda r: radix_kth_key(pk, r))(ranks)
+
+
+# ----------------------------------------------------------------------
 # selection: masked lexicographic argmin = a heap top
 # ----------------------------------------------------------------------
 

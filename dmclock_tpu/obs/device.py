@@ -41,13 +41,28 @@ MET_FAULTS_INJECTED = 11  # total injected fault events (dropouts,
 #                           restarts, delayed counters, duplicated
 #                           completions, nonzero clock skew) -- every
 #                           FaultPlan perturbation is visible here
-NUM_METRICS = 12
+MET_CAL_LADDER_LEVELS = 12  # bucketed calendar: ladder levels that
+#                             committed > 0 decisions (summed over
+#                             batches; minstop batches count as one
+#                             level when they commit)
+MET_CAL_LADDER_BASE = 13  # bucketed calendar: decisions the FIRST
+#                           ladder level committed -- the minstop-
+#                           equivalent share, so (decisions_total -
+#                           this) is what the ladder bought per launch
+MET_CAL_LADDER_FALLBACKS = 14  # bucketed calendar: batches whose
+#                                ladder stalled (a level committed 0
+#                                with candidates present -- the
+#                                serial-fallback analog; remaining
+#                                levels of that batch are wasted)
+NUM_METRICS = 15
 
 METRIC_NAMES = (
     "decisions_total", "decisions_reservation", "decisions_priority",
     "decisions_limit_break", "limit_stalls", "ring_occupancy_hwm",
     "rebase_guard_trips", "ingest_drops", "rebase_fallbacks",
     "server_dropouts", "tracker_resyncs", "faults_injected",
+    "calendar_ladder_levels_used", "calendar_ladder_base_decisions",
+    "calendar_ladder_fallbacks",
 )
 
 # the max-accumulated rows (everything else adds)
@@ -73,12 +88,38 @@ def metrics_delta(*, decisions=0, resv=0, prop=0, limit_break=0,
                   stalls=0, ring_hwm=0, guard_trips=0,
                   ingest_drops=0, rebase_fallbacks=0,
                   server_dropouts=0, tracker_resyncs=0,
-                  faults_injected=0) -> jnp.ndarray:
+                  faults_injected=0, cal_ladder_levels_used=0,
+                  cal_ladder_base_decisions=0,
+                  cal_ladder_fallbacks=0) -> jnp.ndarray:
     """Build a one-batch delta vector from scalar contributions."""
     rows = [decisions, resv, prop, limit_break, stalls, ring_hwm,
             guard_trips, ingest_drops, rebase_fallbacks,
-            server_dropouts, tracker_resyncs, faults_injected]
+            server_dropouts, tracker_resyncs, faults_injected,
+            cal_ladder_levels_used, cal_ladder_base_decisions,
+            cal_ladder_fallbacks]
     return jnp.stack([jnp.asarray(r, dtype=jnp.int64) for r in rows])
+
+
+def metrics_mesh_reduce(vec: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """In-graph mesh merge of per-shard metric vectors: counter rows
+    ``psum``, high-water-mark rows ``pmax`` -- the collective form of
+    :func:`metrics_combine` (associative + commutative, so the mesh
+    order cannot matter).  Call inside ``shard_map`` on the per-shard
+    vector; the result is replicated across the axis, so cluster
+    totals need no host-side gather (the ROADMAP healthy-path item)."""
+    from jax import lax
+
+    return jnp.where(_HWM_MASK, lax.pmax(vec, axis_name),
+                     lax.psum(vec, axis_name))
+
+
+def metrics_combine_axis(mat: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a stacked [S, NUM_METRICS] matrix along its leading axis
+    with the vector's merge semantics (counters add, hwm max) -- the
+    local-shard half of a mesh merge (vmapped servers within a shard
+    reduce here, then :func:`metrics_mesh_reduce` crosses the mesh)."""
+    return jnp.where(_HWM_MASK, jnp.max(mat, axis=0),
+                     jnp.sum(mat, axis=0))
 
 
 def admission_clamp(counts: jnp.ndarray, headroom: jnp.ndarray):

@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine import kernels
+from ..obs import device as obsdev
 from ..utils.compat import shard_map
 from ..engine.state import EngineState, init_state
 from .tracker import (BorrowTrackerState, TrackerState,
@@ -111,7 +112,7 @@ def server_round(engine: EngineState, tracker: TrackerState,
                  cost: jnp.ndarray, g_delta: jnp.ndarray,
                  g_rho: jnp.ndarray, decisions_per_step: int,
                  anticipation_ns: int, allow_limit_break: bool,
-                 max_arrivals: int):
+                 max_arrivals: int, with_metrics: bool = False):
     """One server's round against a CALLER-SUPPLIED view of the global
     counters (``g_delta``/``g_rho``, [C] int64).  The healthy cluster
     passes the fresh psum (``_one_server_step``); the fault-injection
@@ -162,17 +163,30 @@ def server_round(engine: EngineState, tracker: TrackerState,
         engine = kernels.ingest(engine, ops,
                                 anticipation_ns=anticipation_ns)
 
-    # --- scheduling decisions
-    engine, now, decs = kernels.engine_run(
-        engine, now, decisions_per_step,
-        allow_limit_break=allow_limit_break,
-        anticipation_ns=anticipation_ns, advance_now=True)
+    # --- scheduling decisions.  ``with_metrics`` (STATIC) rides the
+    # obs vector in the same scan carry -- decisions bit-identical
+    # either way (tests/test_obs.py) -- so the healthy path can merge
+    # cluster totals in-graph (metrics_mesh_reduce) with no host-side
+    # gather.
+    if with_metrics:
+        engine, now, decs, met = kernels.engine_run(
+            engine, now, decisions_per_step,
+            allow_limit_break=allow_limit_break,
+            anticipation_ns=anticipation_ns, advance_now=True,
+            with_metrics=True)
+    else:
+        engine, now, decs = kernels.engine_run(
+            engine, now, decisions_per_step,
+            allow_limit_break=allow_limit_break,
+            anticipation_ns=anticipation_ns, advance_now=True)
 
     # --- completions -> counters (the response half of the protocol;
     # both policies fold completions identically)
     served = decs.type == kernels.RETURNING
     track = borrow_tracker_track if borrowing else tracker_track
     tracker = track(tracker, decs.slot, decs.cost, decs.phase, served)
+    if with_metrics:
+        return engine, tracker, now, decs, met
     return engine, tracker, now, decs
 
 
@@ -180,7 +194,7 @@ def _one_server_step(engine: EngineState, tracker: TrackerState,
                      now: jnp.ndarray, arrivals_per_client: jnp.ndarray,
                      cost: jnp.ndarray, decisions_per_step: int,
                      anticipation_ns: int, allow_limit_break: bool,
-                     max_arrivals: int):
+                     max_arrivals: int, with_metrics: bool = False):
     """One server's slice of a healthy cluster step (runs inside
     shard_map with a [1, ...]-shaped shard; vmapped over that unit
     axis): the distributed ReqParams come from the FRESH psum'd global
@@ -191,7 +205,8 @@ def _one_server_step(engine: EngineState, tracker: TrackerState,
         engine, tracker, now, arrivals_per_client, cost, g_delta,
         g_rho, decisions_per_step=decisions_per_step,
         anticipation_ns=anticipation_ns,
-        allow_limit_break=allow_limit_break, max_arrivals=max_arrivals)
+        allow_limit_break=allow_limit_break, max_arrivals=max_arrivals,
+        with_metrics=with_metrics)
 
 
 def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
@@ -200,7 +215,8 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
                  max_arrivals: int = 1,
                  anticipation_ns: int = 0,
                  allow_limit_break: bool = False,
-                 advance_ns: int = 0):
+                 advance_ns: int = 0,
+                 with_metrics: bool = False):
     """Advance the whole cluster: ``arrivals`` is int32[S, C] request
     counts (honored up to the static ``max_arrivals`` per client per
     round, wave-major order -- see _one_server_step), sharded over
@@ -216,7 +232,15 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
     start (the real time elapsing between arrival waves; without it a
     weight-dominated cluster never advances past its reservation tags
     and the constraint phase never engages).
-    """
+
+    ``with_metrics`` (STATIC) additionally returns ``(per_shard
+    int64[S, NUM_METRICS], merged int64[NUM_METRICS])``: each server's
+    obs vector from the same scan carry as its decisions, and the
+    cluster total merged IN-GRAPH across the mesh (counter rows psum,
+    hwm rows pmax -- ``obs.device.metrics_mesh_reduce``), so cluster
+    totals need no host-side gather.  Decisions are bit-identical with
+    the flag on or off (tests/test_obs.py pins the engine; the merged
+    == host-summed pin lives in tests/test_cluster_realism.py)."""
     cost = jnp.asarray(cost, dtype=jnp.int64)
 
     def shard_fn(engine, tracker, now, arr):
@@ -225,22 +249,37 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
             decisions_per_step=decisions_per_step,
             anticipation_ns=anticipation_ns,
             allow_limit_break=allow_limit_break,
-            max_arrivals=max_arrivals)
+            max_arrivals=max_arrivals, with_metrics=with_metrics)
         # shards carry a leading [1] server axis; vmap it away
-        engine, tracker, now, decs = jax.vmap(
+        out = jax.vmap(
             lambda e, t, n, a: step(e, t, n, a, cost=cost),
         )(engine, tracker, now, arr)
+        if with_metrics:
+            engine, tracker, now, decs, met = out
+            # local servers reduce with the vector's own merge
+            # semantics, then one collective crosses the mesh; the
+            # merged vector is replicated (P() out-spec)
+            merged = obsdev.metrics_mesh_reduce(
+                obsdev.metrics_combine_axis(met), SERVER_AXIS)
+            return engine, tracker, now, decs, met, merged
+        engine, tracker, now, decs = out
         return engine, tracker, now, decs
 
     spec = P(SERVER_AXIS)
+    n_out = 6 if with_metrics else 4
     fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(spec, spec, spec, spec),
-        out_specs=(spec, spec, spec, spec),
+        out_specs=(spec,) * (n_out - 1) + (P(),) if with_metrics
+        else (spec,) * n_out,
         check_vma=False)
     now0 = cluster.now + jnp.int64(advance_ns)
-    engine, tracker, now, decs = fn(cluster.engine, cluster.tracker,
-                                    now0, arrivals)
+    out = fn(cluster.engine, cluster.tracker, now0, arrivals)
+    if with_metrics:
+        engine, tracker, now, decs, shard_met, merged = out
+        return (ClusterState(engine=engine, tracker=tracker, now=now),
+                decs, shard_met, merged)
+    engine, tracker, now, decs = out
     return ClusterState(engine=engine, tracker=tracker, now=now), decs
 
 

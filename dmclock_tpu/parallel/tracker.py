@@ -76,6 +76,24 @@ def tracker_track(tracker: TrackerState, slots: jnp.ndarray,
     )
 
 
+def tracker_track_counts(tracker: TrackerState, served: jnp.ndarray,
+                         served_resv: jnp.ndarray,
+                         cost: jnp.ndarray) -> TrackerState:
+    """Counts form of :func:`tracker_track` for engines that emit
+    per-client completion totals instead of an ordered decision stream
+    (the calendar engine's ``served``/``served_resv`` vectors):
+    ``delta += served * cost``, ``rho += served_resv * cost`` -- the
+    exact sums the per-decision fold computes when every request of a
+    client carries the same cost (the device sim's model; ``cost`` is
+    the per-client [C] request cost).  Dense adds, no scatter."""
+    return tracker._replace(
+        completed_delta=tracker.completed_delta
+        + served.astype(jnp.int64) * cost,
+        completed_rho=tracker.completed_rho
+        + served_resv.astype(jnp.int64) * cost,
+    )
+
+
 def tracker_prepare(tracker: TrackerState, requesting: jnp.ndarray,
                     global_delta: jnp.ndarray, global_rho: jnp.ndarray):
     """ReqParams for every client in ``requesting`` (bool[C]) sending its

@@ -151,6 +151,8 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                       select_impl: str = "sort",
                       tag_width: int = 64,
                       window_m: Optional[int] = None,
+                      calendar_impl: str = "minstop",
+                      ladder_levels: int = 8,
                       skew_ns: int = 0,
                       retries: int = 3, base_s: float = 0.05,
                       sleep: Callable[[float], None] = _time.sleep,
@@ -186,7 +188,8 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
     else:
         # the calendar batch has no [k] cap; k doubles as its
         # per-client serve-step budget
-        kw.update(steps=max(k, 1))
+        kw.update(steps=max(k, 1), calendar_impl=calendar_impl,
+                  ladder_levels=ladder_levels)
     retry_count = [0]
 
     def count_retry(attempt, exc):

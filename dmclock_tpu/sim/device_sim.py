@@ -54,14 +54,15 @@ from ..engine import kernels
 # module-level on purpose: importing fastpath inside a traced function
 # would stage its module-level jnp constants into the caller's trace
 # (cached in module globals -> UnexpectedTracerError on reuse)
-from ..engine.fastpath import (_window_heads, ring_window,
+from ..engine.fastpath import (_window_heads, calendar_batch,
+                               calendar_batch_bucketed, ring_window,
                                speculate_prefix_batch)
 from ..engine.state import EngineState, init_state
 from ..parallel.cluster import SERVER_AXIS, make_mesh
 from ..utils.compat import shard_map
 from ..parallel.tracker import (TrackerState, global_counters,
                                 init_tracker, tracker_prepare,
-                                tracker_track)
+                                tracker_track, tracker_track_counts)
 from .config import SimConfig
 
 
@@ -111,6 +112,18 @@ class DeviceSimSpec:
     select_impl: str = "sort"  # prefix selection backend
     #                            ("sort"|"radix"; bit-identical
     #                            decisions -- fastpath select_impl)
+    calendar_impl: Optional[str] = None  # None = prefix/scan serving
+    #                            only; "minstop"|"bucketed" front-loads
+    #                            each slice with sortless calendar
+    #                            batches (whole batches only, budget-
+    #                            gated; the capped prefix loop finishes
+    #                            the slice), so skewed populations
+    #                            serve without the per-batch sort --
+    #                            service is EXACTLY the q-step serial
+    #                            stream either way
+    calendar_steps: int = 8    # per-client serve budget per calendar
+    #                            batch (<= ring_capacity)
+    ladder_levels: int = 4     # fused ladder levels ("bucketed")
 
 
 def _make_spec(cfg: SimConfig, q_per_slice: int = 4) -> DeviceSimSpec:
@@ -147,10 +160,20 @@ def _make_spec(cfg: SimConfig, q_per_slice: int = 4) -> DeviceSimSpec:
 
 
 def init_device_sim(cfg: SimConfig, ring_capacity: int = 256,
-                    select_impl: str = "sort"
+                    select_impl: str = "sort",
+                    calendar_impl: Optional[str] = None,
+                    calendar_steps: int = 8,
+                    ladder_levels: int = 4
                     ) -> tuple[DeviceSim, DeviceSimSpec]:
+    assert calendar_impl in (None, "minstop", "bucketed"), calendar_impl
+    assert 1 <= calendar_steps <= ring_capacity, \
+        "calendar_steps must fit the ring window"
+    assert ladder_levels >= 1
     spec = _make_spec(cfg)
     spec.select_impl = select_impl
+    spec.calendar_impl = calendar_impl
+    spec.calendar_steps = calendar_steps
+    spec.ladder_levels = ladder_levels
     s, c = spec.n_servers, spec.n_clients
     max_window = max(g.client_outstanding_ops for g in cfg.cli_group)
     assert max_window <= ring_capacity, (
@@ -348,10 +371,25 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
             # globally, which per-client classification cannot express
             # (fastpath module docstring) -- that shape keeps the scan.
             t_end = t + spec.slice_ns
-            use_prefix = (spec.q_per_slice >= 256
+            # opting into the calendar serve path implies the budgeted
+            # batch loop (it is exact at any q; the q >= 256 heuristic
+            # only picks the default)
+            use_prefix = ((spec.q_per_slice >= 256
+                           or spec.calendar_impl is not None)
                           and (not spec.allow_limit_break
                                or spec.all_weights_positive)
                           and not spec.force_scan)
+            use_cal = use_prefix and spec.calendar_impl is not None
+            if spec.calendar_impl is not None and not use_cal:
+                # refuse rather than silently A/B two identical
+                # scan-path runs: the Allow-with-weight-0 shape (and
+                # the force_scan test hook) cannot serve through the
+                # batch loop at all (fastpath module docstring)
+                raise ValueError(
+                    "calendar_impl requires the batch serve loop: "
+                    "incompatible with force_scan, and with "
+                    "allow_limit_break unless every client weight "
+                    "is positive")
 
             if use_prefix:
                 q = spec.q_per_slice
@@ -367,6 +405,63 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                         cost=jnp.zeros((q,), jnp.int64),
                         when=jnp.zeros((q,), jnp.int64),
                         limit_break=jnp.zeros((q,), bool))
+
+                    # --- calendar front-load (spec.calendar_impl):
+                    # commit WHOLE sortless calendar batches while they
+                    # fit the remaining slice budget -- each batch is an
+                    # exact serial prefix, and a batch that would
+                    # overshoot q is discarded untaken, so the capped
+                    # prefix loop below finishes the slice exactly.
+                    # Counts-only emission: the tracker and the stats
+                    # fold per-client totals (tracker_track_counts).
+                    cal_total = jnp.int32(0)
+                    cal_srv = cal_rsv = None
+                    if use_cal:
+                        steps = min(spec.calendar_steps,
+                                    eng.ring_capacity)
+                        zc = jnp.zeros((spec.n_clients,), jnp.int32)
+
+                        def cal_cond(carry):
+                            return carry[4]
+
+                        def cal_body(carry):
+                            eng, srv, rsv, total, _ = carry
+                            if spec.calendar_impl == "bucketed":
+                                b = calendar_batch_bucketed(
+                                    eng, t_end, steps=steps,
+                                    levels=spec.ladder_levels,
+                                    anticipation_ns=0,
+                                    allow_limit_break=spec
+                                    .allow_limit_break,
+                                    use_pallas=False)
+                            else:
+                                win = ring_window(eng, steps,
+                                                  use_pallas=False)
+                                b = calendar_batch(
+                                    eng, t_end, steps=steps,
+                                    anticipation_ns=0,
+                                    allow_limit_break=spec
+                                    .allow_limit_break,
+                                    heads=(win.arr, win.cost))
+                            ok = (b.count > 0) & \
+                                (total + b.count <= q)
+                            eng = jax.tree.map(
+                                lambda new, old:
+                                jnp.where(ok, new, old),
+                                b.state, eng)
+                            srv = srv + jnp.where(ok, b.served, 0)
+                            rsv = rsv + jnp.where(ok, b.served_resv,
+                                                  0)
+                            total = (total
+                                     + jnp.where(ok, b.count, 0)
+                                     ).astype(jnp.int32)
+                            return (eng, srv, rsv, total, ok)
+
+                        eng, cal_srv, cal_rsv, cal_total, _ = \
+                            lax.while_loop(
+                                cal_cond, cal_body,
+                                (eng, zc, zc, jnp.int32(0),
+                                 jnp.bool_(True)))
 
                     def cond(carry):
                         _eng, total, last, _d, _gt = carry
@@ -399,9 +494,12 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
                                             1).astype(jnp.int32)
                         # pack the committed prefix at the buffer
                         # offset (invalid rows scatter out of range
-                        # and drop)
+                        # and drop; the buffer holds only the prefix-
+                        # loop decisions -- calendar serves are folded
+                        # as counts)
                         j = jnp.arange(kb, dtype=jnp.int32)
-                        pos = jnp.where(j < batch.count, total + j, q)
+                        pos = jnp.where(j < batch.count,
+                                        total - cal_total + j, q)
                         dbuf = jax.tree.map(
                             lambda buf, vals:
                             buf.at[pos].set(vals, mode="drop"),
@@ -411,11 +509,18 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
 
                     eng, _total, _last, dbuf, gt = lax.while_loop(
                         cond, body,
-                        (eng, jnp.int32(0), jnp.int32(1), d0,
+                        (eng, cal_total, jnp.int32(1), d0,
                          jnp.int32(0)))
+                    if use_cal:
+                        return eng, dbuf, gt, cal_srv, cal_rsv
                     return eng, dbuf, gt
 
-                engine, decs, gts = jax.vmap(per_server_run)(engine)
+                if use_cal:
+                    engine, decs, gts, cal_srv, cal_rsv = \
+                        jax.vmap(per_server_run)(engine)
+                else:
+                    engine, decs, gts = jax.vmap(per_server_run)(
+                        engine)
                 trips = (trips + lax.psum(gts.sum(), SERVER_AXIS)
                          ).astype(jnp.int32)
             else:
@@ -435,6 +540,14 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
 
             tracker = jax.vmap(per_server_track)(
                 tracker, decs.slot, decs.cost, decs.phase, served)
+            if use_cal:
+                # calendar serves arrive as per-client totals; the
+                # counts fold computes the same sums as the decision-
+                # stream fold (per-client cost is constant here)
+                tracker = jax.vmap(
+                    lambda trk, s_, r_: tracker_track_counts(
+                        trk, s_, r_, load.cost))(tracker, cal_srv,
+                                                 cal_rsv)
 
             # stats + completion feedback (one [S_local, q] scatter-add
             # per phase; q is small)
@@ -450,6 +563,13 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
             done_here = jax.vmap(
                 lambda i, v: jnp.zeros((c,), jnp.int32).at[i].add(
                     v.astype(jnp.int32)))(idx, one)
+            if use_cal:
+                sresv = sresv + cal_rsv.astype(jnp.int64)
+                sprop = sprop + (cal_srv - cal_rsv).astype(jnp.int64)
+                slast = jnp.maximum(
+                    slast, jnp.where(cal_srv > 0, t_end_b,
+                                     jnp.int64(0)))
+                done_here = done_here + cal_srv
             completions = lax.psum(done_here.sum(axis=0), SERVER_AXIS)
 
             sends = n  # every shard computed the same [C] send counts
@@ -508,13 +628,21 @@ def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
                    slices_per_launch: int = 64,
                    max_launches: int = 200,
                    check_guards: bool = True,
-                   select_impl: str = "sort"):
+                   select_impl: str = "sort",
+                   calendar_impl: Optional[str] = None,
+                   calendar_steps: int = 8,
+                   ladder_levels: int = 4):
     """Run to completion (all clients' ops served) or the launch cap.
 
     ``check_guards`` (default on) raises after any launch whose prefix
     batches tripped a rebase guard -- the invariant init_device_sim
     validates statically, made CHECKED so future edits that weaken the
     validation surface instead of silently under-serving.
+
+    ``calendar_impl`` (None|"minstop"|"bucketed") front-loads each
+    slice with sortless calendar batches (DeviceSimSpec.calendar_impl)
+    -- service stays exactly the q-step serial stream, pinned by
+    tests/test_calendar_bucketed.py.
 
     Returns (sim, spec, report_str)."""
     if mesh is None:
@@ -526,7 +654,10 @@ def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
         if total % n_dev != 0:
             mesh = make_mesh(1)
     sim, spec = init_device_sim(cfg, ring_capacity=ring_capacity,
-                                select_impl=select_impl)
+                                select_impl=select_impl,
+                                calendar_impl=calendar_impl,
+                                calendar_steps=calendar_steps,
+                                ladder_levels=ladder_levels)
     sim = shard_device_sim(sim, mesh)
     step = jax.jit(functools.partial(
         device_sim_step, spec=spec, mesh=mesh,

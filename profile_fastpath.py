@@ -18,6 +18,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from __graft_entry__ import _preloaded_state
@@ -80,6 +81,49 @@ def measure_scan(name, make_body, state, init):
     return t
 
 
+def _zipf_state(n, ring, depth):
+    """cfg4-like Zipf-64 skew over the preload: the calendar A/B's
+    honest shape (uniform weights give minstop nothing to lose -- the
+    min-stop IS everyone's stop; the ladder's gain is the skew)."""
+    from dmclock_tpu.core.timebase import rate_to_inv_ns
+
+    st = _preloaded_state(n, depth, ring=ring)
+    w = 1.0 / np.arange(1, n + 1) ** 1.1
+    w = np.clip(w / w[n // 2], 0.5, 64.0)
+    rng = np.random.default_rng(7)
+    rng.shuffle(w)
+    winv = np.asarray([rate_to_inv_ns(x) for x in w], np.int64)
+    c = np.arange(n)
+    phase = ((c * 2654435761) & 0xFFFFF) / float(1 << 20)
+    jitter = (phase * 2.0 * winv).astype(np.int64)
+    return st._replace(weight_inv=jnp.asarray(winv),
+                       head_prop=jnp.asarray(winv + jitter))
+
+
+def measure_calendar(name, state, *, impl, levels, m_lo=4, m_hi=12,
+                     steps=8):
+    """Calendar-epoch A/B row (minstop vs bucketed ladder): marginal
+    batch cost AND marginal decisions -- the two impls commit
+    different amounts per batch, so dec/s is the honest comparison,
+    not us/batch alone."""
+    mk = lambda m: jax.jit(functools.partial(       # noqa: E731
+        fastpath.scan_calendar_epoch, m=m, steps=steps,
+        anticipation_ns=0, calendar_impl=impl, ladder_levels=levels))
+    f_lo, f_hi = mk(m_lo), mk(m_hi)
+    now = jnp.int64(0)
+    jax.device_get(state_digest(f_lo(state, now).state))
+    ep_hi = f_hi(state, now)
+    jax.device_get(state_digest(ep_hi.state))
+    t_lo = _time_call(f_lo, state, now)
+    t_hi = _time_call(f_hi, state, now)
+    t = (t_hi - t_lo) / (m_hi - m_lo)
+    counts = np.asarray(jax.device_get(ep_hi.count))
+    d = counts[m_lo:].sum() / (m_hi - m_lo)   # marginal batches only
+    print(f"{name:52s} {t*1e6:9.1f} us/batch  "
+          f"({d:7.0f} dec/batch, {d/max(t, 1e-12)/1e6:5.1f} M dec/s)")
+    return t, d
+
+
 def _high_rate_state(n, ring):
     """_preloaded_state with client rates x1000 (weights 1000..4000/s):
     per-serve tag advance ~1e6 ns, so a whole epoch's virtual-time
@@ -119,6 +163,19 @@ def main():
                   k=k, tag_width=32)
     measure_epoch(f"scan_prefix_epoch m=64 window_m=8 (k={k})", state,
                   m_lo=16, m_hi=64, k=k, window_m=8)
+
+    # -- calendar engine: minstop vs the bucketed stop-key ladder on a
+    # Zipf-64-skewed backlog (the cfg4 cutter shape; docs/ENGINE.md).
+    # The ladder fuses L measure+commit boundaries per launch, so its
+    # batch costs ~L x more and must commit ~L x more to win -- the
+    # dec/s column is the verdict.
+    zs = _zipf_state(n, 128, 96)
+    measure_calendar("scan_calendar_epoch minstop (steps=8)", zs,
+                     impl="minstop", levels=1)
+    measure_calendar("scan_calendar_epoch bucketed L=4 (steps=8)", zs,
+                     impl="bucketed", levels=4)
+    measure_calendar("scan_calendar_epoch bucketed L=8 (steps=8)", zs,
+                     impl="bucketed", levels=8)
 
     # -- selection core of _prefix_select: the 5-array 2-key i32 sort
     # plus the cumulative-min prefix validation

@@ -125,14 +125,22 @@ def main() -> int:
         # and radix epochs are bit-identical in DECISIONS but not in
         # cost, so their rates form separate histories (a radix session
         # judged against sort medians would flap in both directions).
-        # Rows without the tag predate the knob == "sort".
+        # Rows without the tag predate the knob == "sort".  The
+        # calendar commit scheme splits the series the same way:
+        # bucketed sessions must not pollute minstop medians (rows
+        # without the tag predate the knob == "minstop").
         impl = row.get("select_impl", "sort")
+        cal = row.get("calendar_impl", "minstop")
         tag = f"{wl}[{impl}]" if impl != "sort" else wl
+        if cal != "minstop":
+            tag += f"[{cal}]"
         hist = [r["workloads"][wl]["dps"] for _, r in prior
                 if wl in r.get("workloads", {})
                 and "dps" in r["workloads"][wl]
                 and r["workloads"][wl].get("select_impl",
-                                           "sort") == impl]
+                                           "sort") == impl
+                and r["workloads"][wl].get("calendar_impl",
+                                           "minstop") == cal]
         if len(hist) < args.min_records:
             print(f"bench_guard: {tag}: {dps/1e6:.1f}M "
                   f"({len(hist)} prior record(s) -- not judged)")
@@ -141,13 +149,17 @@ def main() -> int:
         floor = med / args.tolerance
         verdict = "OK" if dps >= floor else "REGRESSION"
         # a load-generator-capped run under-reports the engine: worth
-        # seeing next to any REGRESSION verdict before panicking
+        # seeing next to any REGRESSION verdict before panicking; for
+        # calendar workloads decisions-per-pass is the per-launch
+        # commit depth the bucketed ladder exists to raise
         bb = row.get("bounded_by")
+        dpp = row.get("decisions_per_pass")
         print(f"bench_guard: {tag}: newest {dps/1e6:.1f}M vs median "
               f"{med/1e6:.1f}M over {len(hist)} sessions "
               f"(floor {floor/1e6:.1f}M at tolerance "
               f"{args.tolerance:g}x) -- {verdict}"
-              + (f" [bounded by {bb}]" if bb else ""))
+              + (f" [bounded by {bb}]" if bb else "")
+              + (f" [{dpp:.0f} dec/pass]" if dpp else ""))
         if dps < floor:
             status = 1
     if status:

@@ -93,6 +93,75 @@ assert digests["sort"] == digests["radix"], \
 print("radix/sort parity smoke ok")
 EOF
 
+echo "== calendar minstop/bucketed digest gate (cpu backend) =="
+# the bucketed stop-key ladder's exactness currency: (1) ladder_levels=1
+# must be BIT-IDENTICAL to the minstop path (same boundary, same ops on
+# the same values); (2) a ladder of L levels must equal the COMPOSITION
+# of L sequential minstop batches exactly (committed set + final state
+# digest) while committing strictly more per launch than one minstop
+# batch on the seeded Zipf-skewed cfg4-like workload.
+timeout -k 30 900 python - <<'EOF'
+import functools, hashlib
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np, jax.numpy as jnp
+from __graft_entry__ import _preloaded_state
+from dmclock_tpu.core.timebase import rate_to_inv_ns
+from dmclock_tpu.engine.fastpath import (calendar_batch,
+                                         calendar_batch_bucketed,
+                                         scan_calendar_epoch)
+from profile_util import state_digest
+
+N = 2048
+st = _preloaded_state(N, 24, ring=32)
+w = np.clip(1.0 / np.arange(1, N + 1) ** 1.1
+            / (1.0 / (N // 2) ** 1.1), 0.5, 64.0)
+rng = np.random.default_rng(7); rng.shuffle(w)
+winv = np.asarray([rate_to_inv_ns(x) for x in w], np.int64)
+st = st._replace(weight_inv=jnp.asarray(winv),
+                 head_prop=jnp.asarray(winv))
+now = jnp.int64(0)
+
+def digest(ep):
+    h = hashlib.sha256()
+    for arr in (ep.count, ep.resv_count, ep.served, ep.progress_ok):
+        h.update(jax.device_get(arr).tobytes())
+    h.update(jax.device_get(state_digest(ep.state)).tobytes())
+    return h.hexdigest()
+
+eps = {}
+for impl, lv in (("minstop", 1), ("bucketed", 1)):
+    eps[impl] = jax.jit(functools.partial(
+        scan_calendar_epoch, m=3, steps=8, anticipation_ns=0,
+        calendar_impl=impl, ladder_levels=lv))(st, now)
+d_min, d_b1 = digest(eps["minstop"]), digest(eps["bucketed"])
+assert d_min == d_b1, f"L=1 ladder != minstop: {d_min[:16]} vs {d_b1[:16]}"
+print(f"L=1 ladder bit-identical to minstop ({d_min[:16]}, "
+      f"{int(jax.device_get(eps['minstop'].count).sum())} decisions)")
+
+L = 4
+bb = jax.jit(functools.partial(
+    calendar_batch_bucketed, steps=8, levels=L))(st, now)
+s, served = st, np.zeros(N, np.int32)
+tot = 0; first = None
+for _ in range(L):
+    b = jax.jit(functools.partial(calendar_batch, steps=8))(s, now)
+    if first is None:
+        first = int(b.count)
+    tot += int(b.count); served += np.asarray(jax.device_get(b.served))
+    s = b.state
+assert tot == int(bb.count), (tot, int(bb.count))
+assert np.array_equal(served, np.asarray(jax.device_get(bb.served)))
+assert bool(jax.device_get(state_digest(bb.state)
+                           == state_digest(s))), "final state diverged"
+assert int(bb.count) > first, \
+    f"ladder committed no more per launch ({int(bb.count)} vs {first})"
+print(f"bucketed L={L} == {L}x minstop composition "
+      f"({int(bb.count)} decisions/launch vs minstop {first})")
+print("calendar digest gate ok")
+EOF
+
 echo "== chaos smoke (seeded dropout+restart; zero-fault digest gate) =="
 # the robustness spine (docs/ROBUSTNESS.md): (1) an all-benign
 # FaultPlan must be BIT-IDENTICAL to running with no fault plumbing at

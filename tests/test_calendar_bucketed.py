@@ -1,0 +1,419 @@
+"""Differential tests for the bucketed calendar ladder.
+
+``calendar_batch_bucketed`` promises: the committed SET (per-client
+decision / constraint-phase / limit-break counts) and the final state
+are EXACTLY the serial engine's after ``count`` decisions -- the same
+contract as the minstop ``calendar_batch`` (test_calendar.py), with L
+fused refreshed-budget boundaries per launch instead of one.  The
+zero-ladder configuration (levels=1) must be BIT-identical to the
+minstop path, and the epoch/device-sim/metrics plumbing must be
+invisible to the decision stream.
+
+Split from test_calendar.py for the same per-process XLA-CPU memory
+reason (conftest).  The compile-heavy shapes (the population/L drive
+matrices, the fuzz matrix, tag32, the sharded device-sim parity)
+carry ``@pytest.mark.slow``: the quick tier-1 sweep (-m 'not slow')
+keeps the acceptance pins -- L=1 bitwise identity, mid-ladder budget
+refresh vs serial, commits-more-per-launch, quantile planner, metrics
+bit-identity -- and scripts/run_tests.sh (CI) runs everything.
+"""
+
+import functools
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmclock_tpu.core import ClientInfo
+from dmclock_tpu.core.timebase import NS_PER_SEC
+from dmclock_tpu.engine import kernels
+
+from engine_helpers import (assert_states_equal, build_state,
+                            deep_state)
+from test_prefix import mixed_qos_state, serial_run_lb
+
+S = NS_PER_SEC
+
+# module-level jit cache: the drive loops call the same (steps,
+# levels, allow) config many times, and an un-jitted call re-traces
+# and re-compiles the whole L-level scan every time
+_JIT: dict = {}
+
+
+def ladder_batch(state, now, steps, levels, *, allow=False,
+                 anticipation_ns=0):
+    from dmclock_tpu.engine.fastpath import calendar_batch_bucketed
+
+    key = ("ladder", state.capacity, state.ring_capacity, steps,
+           levels, allow, anticipation_ns)
+    if key not in _JIT:
+        _JIT[key] = jax.jit(functools.partial(
+            calendar_batch_bucketed, steps=steps, levels=levels,
+            anticipation_ns=anticipation_ns, allow_limit_break=allow))
+    return _JIT[key](state, jnp.int64(now))
+
+
+def minstop_batch(state, now, steps):
+    from dmclock_tpu.engine.fastpath import calendar_batch
+
+    key = ("minstop", state.capacity, state.ring_capacity, steps)
+    if key not in _JIT:
+        _JIT[key] = jax.jit(functools.partial(calendar_batch,
+                                              steps=steps))
+    return _JIT[key](state, jnp.int64(now))
+
+
+def check_ladder_vs_serial(state, now, steps, levels, *, allow=False,
+                           anticipation_ns=0):
+    """One bucketed batch vs the serial engine run for `count` steps:
+    committed SET (per-client decision/phase/limit-break counts) and
+    final state must match exactly."""
+    b = ladder_batch(state, now, steps, levels, allow=allow,
+                     anticipation_ns=anticipation_ns)
+    c = int(b.count)
+    assert c == int(np.asarray(b.level_count).sum())
+    if c == 0:
+        assert_states_equal(b.state, state)
+        _, ser = serial_run_lb(state, now, 1, allow)
+        if bool(b.progress_ok):
+            assert ser.type[0] != kernels.RETURNING, \
+                "ladder committed 0 but serial engine would serve"
+        return b.state, 0
+    ser_state, ser = serial_run_lb(state, now, c, allow)
+    assert (ser.type == kernels.RETURNING).all()
+    n = state.capacity
+    served = np.zeros(n, np.int32)
+    np.add.at(served, ser.slot, 1)
+    assert np.array_equal(served, jax.device_get(b.served)), \
+        "per-client decision counts diverge"
+    resv = np.zeros(n, np.int32)
+    np.add.at(resv, ser.slot[ser.phase == 0], 1)
+    assert np.array_equal(resv, jax.device_get(b.served_resv)), \
+        "per-client constraint-phase counts diverge"
+    lbc = np.zeros(n, np.int32)
+    np.add.at(lbc, ser.slot[ser.limit_break], 1)
+    assert np.array_equal(lbc, jax.device_get(b.lb)), \
+        "per-client limit-break counts diverge"
+    assert_states_equal(b.state, ser_state)
+    return b.state, c
+
+
+def zipf64_state(n=10, depth=32):
+    """The cfg4 cutter shape: one weight-64 heavy client among
+    weight-1 clients (test_calendar.py's skew, deeper)."""
+    infos = {0: ClientInfo(0, 64, 0)}
+    for c in range(1, n):
+        infos[c] = ClientInfo(0, 1, 0)
+    return deep_state(infos, depth=depth)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("levels", [1, 2])
+def test_ladder_uniform_population(levels):
+    """Uniform weights: every client stops at ~the same key, so the
+    ladder's levels advance the whole population L slabs per launch."""
+    infos = {c: ClientInfo(0, 2, 0) for c in range(8)}
+    state = deep_state(infos, depth=24)
+    st, c = check_ladder_vs_serial(state, 60 * S, 6, levels)
+    assert c > 0
+    # drive to drain, every batch exact
+    for _ in range(12):
+        st, c = check_ladder_vs_serial(st, 60 * S, 6, levels)
+        if c == 0:
+            break
+    assert int(np.asarray(st.depth).sum()) == 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("levels", [2, 8])
+def test_ladder_zipf64_population(levels):
+    """Zipf-64 skew: the heavy client budget-stops early and truncates
+    every minstop batch; the ladder must still be exactly serial."""
+    state = zipf64_state(n=10, depth=32)
+    st = state
+    for _ in range(4):
+        st, c = check_ladder_vs_serial(st, 500 * S, 8, levels)
+        if c == 0:
+            break
+
+
+def test_ladder_commits_more_per_launch_on_skew():
+    """The perf claim at batch granularity: on the Zipf-64 shape a
+    4-level ladder commits strictly more decisions in ONE launch than
+    the minstop batch (the acceptance-criterion currency)."""
+    state = zipf64_state(n=10, depth=32)
+    b_min = minstop_batch(state, 500 * S, 8)
+    b_lad = ladder_batch(state, 500 * S, 8, 4)
+    assert int(b_lad.count) > int(b_min.count), \
+        (int(b_lad.count), int(b_min.count))
+    assert int(np.asarray(b_lad.level_count)[0]) == int(b_min.count)
+
+
+def test_ladder_budget_exhaustion_mid_ladder():
+    """steps budget exhaustion mid-ladder: a single deep client with
+    steps=4 exhausts its budget at EVERY level boundary; each level
+    must refresh the budget and continue exactly where it stopped."""
+    infos = {0: ClientInfo(0, 1, 0)}
+    adds = [(0, 1 * S, 1, 1, 1) for _ in range(20)]
+    state = build_state(infos, adds, capacity=8, ring=32)
+    b = ladder_batch(state, 100 * S, 4, 3)
+    # 3 levels x 4-step budget, 20 queued: every level commits its
+    # full budget (the ladder's whole point)
+    assert np.array_equal(np.asarray(b.level_count), [4, 4, 4])
+    check_ladder_vs_serial(state, 100 * S, 4, 3)
+
+
+def test_ladder_l1_bit_identical_to_minstop():
+    """levels=1 must reproduce calendar_batch bit for bit: same
+    committed counts, same final state -- the digest-gate contract."""
+    for state, now in ((zipf64_state(n=8, depth=16), 500 * S),
+                       mixed_qos_state(n=8, depth=10)):
+        st_m, st_l = state, state
+        for _ in range(3):
+            bm = minstop_batch(st_m, now, 6)
+            bl = ladder_batch(st_l, now, 6, 1)
+            assert int(bm.count) == int(bl.count)
+            for f in ("units", "served", "served_resv", "lb"):
+                assert np.array_equal(jax.device_get(getattr(bm, f)),
+                                      jax.device_get(getattr(bl, f))), f
+            assert bool(bm.progress_ok) == bool(bl.progress_ok)
+            assert_states_equal(bm.state, bl.state)
+            st_m, st_l = bm.state, bl.state
+
+
+@pytest.mark.slow
+def test_ladder_mixed_regimes_and_allow():
+    """Interleaved constraint/weight regimes and the AtLimit::Allow
+    third class ride the ladder exactly."""
+    state, now = mixed_qos_state(n=8, depth=12)
+    st = state
+    for _ in range(4):
+        st, c = check_ladder_vs_serial(st, now, 6, 3)
+        if c == 0:
+            break
+    rng = random.Random(77)
+    infos = {c: ClientInfo(rng.choice([0, 0.5, 1.0]),
+                           rng.uniform(0.5, 3),
+                           rng.choice([0, 2.0, 4.0]))
+             for c in range(8)}
+    state = deep_state(infos, depth=6, capacity=16)
+    now2 = 4 * S
+    st = state
+    for _ in range(4):
+        st, c = check_ladder_vs_serial(st, now2, 4, 2, allow=True)
+        if c == 0:
+            now2 += 2 * S
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [81, 82, 83])
+def test_fuzz_ladder_matches_serial(seed):
+    """Random QoS mixes / costs / arrivals under random ladder depths:
+    bucketed batches replay the serial engine exactly."""
+    rng = random.Random(seed)
+    n = rng.randint(2, 12)
+    infos = {}
+    for c in range(n):
+        kind = rng.randrange(4)
+        if kind == 0:
+            infos[c] = ClientInfo(rng.uniform(0.5, 3), 0, 0)
+        elif kind == 1:
+            infos[c] = ClientInfo(0, rng.uniform(0.5, 4), 0)
+        elif kind == 2:
+            infos[c] = ClientInfo(rng.uniform(0.5, 2),
+                                  rng.uniform(0.5, 4),
+                                  rng.uniform(4, 9))
+        else:
+            infos[c] = ClientInfo(rng.uniform(0.5, 3),
+                                  rng.uniform(0.5, 3), 0)
+    adds = []
+    t = 1 * S
+    for _ in range(rng.randint(20, 100)):
+        c = rng.randrange(n)
+        t += rng.randint(0, S // 4)
+        delta = rng.randint(1, 5)
+        adds.append((c, t, rng.randint(1, 3), delta,
+                     rng.randint(1, delta)))
+    state = build_state(infos, adds, capacity=16)
+    steps, levels = rng.choice([4, 8]), rng.choice([2, 3])
+    now = t + rng.randint(0, 6) * S
+    st = state
+    for _ in range(8):
+        st, c = check_ladder_vs_serial(st, now, steps, levels)
+        if c == 0:
+            now += rng.randint(1, 5) * S
+
+
+def test_quantile_ladder_matches_numpy():
+    """kernels.radix_quantile_ladder == numpy CDF quantiles of the
+    finite stop keys (the histogram planner view)."""
+    from dmclock_tpu.engine.fastpath import calendar_stop_ladder
+
+    state = zipf64_state(n=12, depth=16)
+    lad, stop = calendar_stop_ladder(state, jnp.int64(500 * S),
+                                     steps=6, levels=4)
+    stop = np.asarray(jax.device_get(stop))
+    fin = np.sort(stop[stop < kernels.KEY_INF])
+    assert fin.size > 0
+    want = fin[[max(int(np.ceil(i * fin.size / 4)), 1) - 1
+                for i in (1, 2, 3, 4)]]
+    assert np.array_equal(np.asarray(jax.device_get(lad)), want)
+    # rank-1 of the histogram walk IS the min (the ladder boundary)
+    assert int(kernels.radix_kth_key(jnp.asarray(stop), 1)) \
+        == int(fin.min())
+
+
+@pytest.mark.slow
+def test_bucketed_epoch_matches_batches():
+    """scan_calendar_epoch(calendar_impl="bucketed") == the sequence
+    of calendar_batch_bucketed calls, including per-level counts."""
+    from dmclock_tpu.engine.fastpath import scan_calendar_epoch
+
+    state, now = mixed_qos_state(n=8, depth=10)
+    m, steps, levels = 4, 6, 2
+    ep = scan_calendar_epoch(state, jnp.int64(now), m, steps=steps,
+                             anticipation_ns=0,
+                             calendar_impl="bucketed",
+                             ladder_levels=levels)
+    assert ep.level_count.shape == (m, levels)
+    st = state
+    total_served = np.zeros(state.capacity, np.int32)
+    for i in range(m):
+        b = ladder_batch(st, now, steps, levels)
+        assert int(b.count) == int(jax.device_get(ep.count)[i])
+        assert np.array_equal(np.asarray(b.level_count),
+                              np.asarray(ep.level_count)[i])
+        assert bool(b.progress_ok) == \
+            bool(jax.device_get(ep.progress_ok)[i])
+        total_served += jax.device_get(b.served)
+        st = b.state
+    assert np.array_equal(total_served, jax.device_get(ep.served))
+    assert_states_equal(ep.state, st)
+
+
+def test_bucketed_epoch_metrics_identical():
+    """with_metrics must be invisible to the bucketed decision stream,
+    and the ladder rows must account the levels exactly."""
+    from dmclock_tpu.engine.fastpath import scan_calendar_epoch
+    from dmclock_tpu.obs import device as obsdev
+
+    state = zipf64_state(n=8, depth=16)
+    kw = dict(steps=6, anticipation_ns=0, calendar_impl="bucketed",
+              ladder_levels=3)
+    now = jnp.int64(500 * S)
+    ep_off = scan_calendar_epoch(state, now, 2, **kw)
+    ep_on = scan_calendar_epoch(state, now, 2, with_metrics=True,
+                                **kw)
+    for f in ("count", "resv_count", "progress_ok", "served",
+              "level_count"):
+        assert bool(jnp.array_equal(getattr(ep_off, f),
+                                    getattr(ep_on, f))), \
+            f"bucketed epoch field {f} diverged with metrics on"
+    assert_states_equal(ep_off.state, ep_on.state)
+    m = obsdev.metrics_dict(ep_on.metrics)
+    lvls = np.asarray(ep_on.level_count)
+    assert m["decisions_total"] == int(lvls.sum())
+    assert m["calendar_ladder_levels_used"] == int((lvls > 0).sum())
+    assert m["calendar_ladder_base_decisions"] == int(lvls[:, 0].sum())
+    assert m["calendar_ladder_fallbacks"] == 0
+
+
+@pytest.mark.slow
+def test_bucketed_epoch_tag32_exact():
+    """The int32 tag carry composes with the bucketed path: on a
+    window-fitting (high-rate) state tag_width=32 must be
+    bit-identical to tag_width=64."""
+    from dmclock_tpu.engine.fastpath import scan_calendar_epoch
+
+    infos = {c: ClientInfo(0, 1000.0 + 500 * (c % 3), 0)
+             for c in range(6)}
+    state = deep_state(infos, depth=12)
+    kw = dict(steps=4, anticipation_ns=0, calendar_impl="bucketed",
+              ladder_levels=2)
+    now = jnp.int64(2 * S)
+    e64 = scan_calendar_epoch(state, now, 2, tag_width=64, **kw)
+    e32 = scan_calendar_epoch(state, now, 2, tag_width=32, **kw)
+    assert bool(jax.device_get(e32.progress_ok).all()), \
+        "tag32 window tripped on the high-rate shape"
+    for f in ("count", "resv_count", "progress_ok", "served",
+              "level_count"):
+        assert bool(jnp.array_equal(getattr(e64, f),
+                                    getattr(e32, f))), f
+    assert_states_equal(e64.state, e32.state)
+
+
+# ----------------------------------------------------------------------
+# device_sim plumbing: the calendar serve path is invisible to service
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh8():
+    from dmclock_tpu.sim import device_sim as DS
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return DS.make_mesh(8)
+
+
+@pytest.mark.slow
+def test_device_sim_calendar_serve_parity(mesh8):
+    """DeviceSimSpec.calendar_impl front-loads slices with sortless
+    calendar batches; service must be EXACTLY the default path's
+    (both are the q-step serial stream), for minstop and bucketed --
+    the full DeviceSim pytree must match.
+
+    (Historical note pinning the boundary-read choice: with the ladder
+    boundary computed through the dense-histogram walk instead of the
+    equal-valued ``jnp.min``, THIS program -- the ladder under the
+    8-shard shard_map sim -- deterministically SIGFPE'd this stack's
+    XLA:CPU compiler.  The commit boundary therefore reads the first
+    order statistic as a plain min; see _calendar_batch_core.)"""
+    import dataclasses
+
+    from dmclock_tpu.sim import device_sim as DS
+    from dmclock_tpu.sim.config import (ClientGroup, ServerGroup,
+                                        SimConfig)
+
+    groups = [ClientGroup(client_count=24, client_total_ops=10 ** 9,
+                          client_iops_goal=2000,
+                          client_outstanding_ops=60,
+                          client_reservation=100.0, client_limit=0.0,
+                          client_weight=2.0,
+                          client_server_select_range=8)]
+    cfg = SimConfig(client_groups=1, server_groups=1,
+                    cli_group=groups,
+                    srv_group=[ServerGroup(server_count=8,
+                                           server_iops=20000.0,
+                                           server_threads=1)])
+    sim, spec = DS.init_device_sim(cfg)
+    outs = {}
+    for spc in (spec,
+                dataclasses.replace(spec, calendar_impl="minstop"),
+                dataclasses.replace(spec, calendar_impl="bucketed",
+                                    ladder_levels=3)):
+        sm = DS.shard_device_sim(sim, mesh8)
+        step = jax.jit(functools.partial(
+            DS.device_sim_step, spec=spc, mesh=mesh8, slices=8))
+        for _ in range(3):
+            sm = step(sm)
+        outs[spc.calendar_impl] = jax.block_until_ready(sm)
+        # three shard_map sim programs in one process: drop each
+        # spec's compiled state before the next (conftest's XLA-CPU
+        # footprint note)
+        jax.clear_caches()
+    base = outs[None]
+    for name in ("minstop", "bucketed"):
+        sm = outs[name]
+        for f in ("served_resv", "served_prop", "last_served", "t"):
+            assert bool(jnp.array_equal(getattr(base, f),
+                                        getattr(sm, f))), (name, f)
+        for f, x, y in zip(type(base.tracker)._fields, base.tracker,
+                           sm.tracker):
+            assert bool(jnp.array_equal(x, y)), (name, "tracker", f)
+        for f, x, y in zip(type(base.engine)._fields, base.engine,
+                           sm.engine):
+            assert bool(jnp.array_equal(x, y)), (name, "engine", f)
+    assert int(np.asarray(base.served_resv).sum()
+               + np.asarray(base.served_prop).sum()) > 0

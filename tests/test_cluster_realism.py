@@ -168,6 +168,52 @@ def test_midrun_creation_borrowing(mesh8):
                cost_of=lambda c: 1 + (c % 2))
 
 
+def test_metrics_mesh_merge_matches_host(mesh8):
+    """Healthy-path in-graph metrics merge (ROADMAP multichip psum
+    item): cluster_step(with_metrics=True) psums counter rows and
+    pmaxes hwm rows across the mesh; the merged vector must equal the
+    host-side metrics_combine_np over the per-shard vectors, and the
+    decision stream must be bit-identical with the flag off."""
+    from dmclock_tpu.obs import device as obsdev
+
+    n_servers, n_clients, k = 8, 10, 16
+    infos = [ClientInfo(10.0, 1.0 + (c % 3), 0.0)
+             for c in range(n_clients)]
+    rinv = jnp.asarray([i.reservation_inv_ns for i in infos],
+                       jnp.int64)
+    winv = jnp.asarray([i.weight_inv_ns for i in infos], jnp.int64)
+    linv = jnp.asarray([i.limit_inv_ns for i in infos], jnp.int64)
+    cl = CL.init_cluster(n_servers, n_clients)
+    cl = CL.install_clients(cl, rinv, winv, linv)
+    cl = CL.shard_cluster(cl, mesh8)
+    arrivals = jnp.ones((n_servers, n_clients), jnp.int32)
+    step_off = functools.partial(CL.cluster_step, mesh=mesh8, cost=1,
+                                 decisions_per_step=k,
+                                 advance_ns=10 ** 8)
+    step_on = functools.partial(step_off, with_metrics=True)
+
+    jit_off, jit_on = jax.jit(step_off), jax.jit(step_on)
+    cl_off, cl_on = cl, cl
+    total = np.zeros(obsdev.NUM_METRICS, np.int64)
+    for _ in range(3):
+        cl_off, d_off = jit_off(cl_off, arrivals)
+        cl_on, d_on, shard_met, merged = jit_on(cl_on, arrivals)
+        for a, b in zip(jax.tree.leaves(d_off), jax.tree.leaves(d_on)):
+            assert bool(jnp.array_equal(a, b)), \
+                "decisions diverged with metrics on"
+        shard_np = np.asarray(jax.device_get(shard_met))
+        assert shard_np.shape == (n_servers, obsdev.NUM_METRICS)
+        host = obsdev.metrics_combine_np(
+            np.zeros(obsdev.NUM_METRICS, np.int64), *shard_np)
+        assert np.array_equal(host, np.asarray(jax.device_get(merged))), \
+            "in-graph mesh merge != host-side combine"
+        total = obsdev.metrics_combine_np(total, host)
+    md = obsdev.metrics_dict(total)
+    assert md["decisions_total"] > 0
+    assert md["decisions_reservation"] + md["decisions_priority"] == \
+        md["decisions_total"]
+
+
 @pytest.mark.skipif(os.environ.get("DMCLOCK_FULLSCALE") != "1",
                     reason="large-scale cluster parity is minutes-long; "
                     "run via scripts/run_fullscale.py (CI)")
