@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import time
 
 import jax
@@ -780,6 +781,42 @@ def bench_frontier(points=((2, 64), (3, 64), (6, 64), (12, 64)), *,
     return None, rows
 
 
+def _with_ladder(ladder, cfg: dict, fn):
+    """Run one workload under the degradation ladder
+    (robust.guarded.DegradationLadder): a failed run whose config
+    still has a fast path engaged (radix selection, bucketed
+    calendar, tag32 carry) steps that knob down to its proven-exact
+    twin and retries, instead of losing the whole session to one
+    wedged fast path.  A device-side failure (XlaRuntimeError -- the
+    wedged-kernel shape) IS ladder-eligible; only a backend that is
+    plainly dead (init/connect failure messages) re-raises for the
+    cpu-fallback machinery, since no fast-path concession can revive
+    it.  Returns (result_row, effective_cfg)."""
+    import sys
+
+    while True:
+        c = ladder.apply(cfg)
+        try:
+            return fn(**c), c
+        except (AssertionError, RuntimeError) as e:
+            msg = str(e).lower()
+            if isinstance(e, RuntimeError) and \
+                    ("unable to initialize" in msg
+                     or "failed to connect" in msg):
+                raise           # dead backend, not a fast-path fault
+            # device errors count as launch failures, tripped guard
+            # asserts as guard trips -- same escalation either way
+            stepped = ladder.note_epoch(
+                c, guard_trips=int(isinstance(e, AssertionError)),
+                launch_failures=int(isinstance(e, RuntimeError)))
+            if not stepped:
+                raise           # nothing left to concede
+            step = ladder.steps[-1]
+            print(f"# ladder: {step.knob} {step.from_value} -> "
+                  f"{step.to_value} after {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+
 def _is_backend_error(e: BaseException) -> bool:
     """A device-launch failure that means the BACKEND is unusable, not
     that the bench is buggy: the tunneled runtime can pass the
@@ -888,28 +925,43 @@ def main() -> None:
                     "and the benchmark history record; bench_guard "
                     "keeps non-'none' (chaos) sessions out of the "
                     "clean-run regression medians")
+    ap.add_argument("--supervised", action="store_true",
+                    default=os.environ.get("DMCLOCK_SUPERVISED")
+                    == "1",
+                    help="tag this session as running under the "
+                    "robust.supervisor (set automatically via "
+                    "DMCLOCK_SUPERVISED=1 in supervised "
+                    "environments); with DMCLOCK_RESTARTS > 0 the "
+                    "history record carries the restart count and "
+                    "bench_guard keeps the run out of the clean-run "
+                    "medians")
+    ap.add_argument("--no-ladder", action="store_true",
+                    help="disable the degradation ladder (a failed "
+                    "fast-path workload raises instead of stepping "
+                    "down to its exact twin and retrying)")
     args = ap.parse_args()
+    restarts = int(os.environ.get("DMCLOCK_RESTARTS", "0") or 0)
     if args.target_latency:
         args.mode = "frontier"
     if args.metrics_port is not None:
-        # best-effort: a failed bind (port taken, privileged) must not
-        # kill the session before the JSON line can be emitted
-        try:
-            import atexit
+        # fail-soft inside start_http_server: a failed bind (port
+        # taken, privileged) must not kill the session before the
+        # JSON line can be emitted
+        import atexit
 
-            from dmclock_tpu.obs import start_http_server
-            http_srv = start_http_server(port=args.metrics_port)
+        from dmclock_tpu.obs import start_http_server
+        http_srv = start_http_server(port=args.metrics_port)
+        if http_srv is not None:
             print(f"# metrics: serving {http_srv.url}",
                   file=sys.stderr)
             atexit.register(http_srv.close)
-        except (OSError, OverflowError) as e:
-            # OverflowError: out-of-range port from CPython's bind()
-            print(f"# metrics: endpoint disabled ({e})",
-                  file=sys.stderr)
 
     backend, fallback, backend_err = _resolve_backend()
     backend_fallback = None   # "dispatch" after a launch-time switch
     wm = args.device_metrics == "on"
+    from dmclock_tpu.robust.guarded import DegradationLadder
+    ladder = DegradationLadder(enabled=not args.no_ladder,
+                               threshold=1)
 
     def emit(out: dict) -> None:
         """THE json line: every exit path goes through here so the
@@ -918,6 +970,14 @@ def main() -> None:
         # chaos sessions self-identify so the regression series stays
         # clean (scripts/bench_guard.py; docs/ROBUSTNESS.md)
         out["fault_plan"] = args.fault_plan
+        # supervised/resumed sessions self-identify the same way: a
+        # restart-bearing run's rates include recovery work, not the
+        # engine alone
+        if args.supervised:
+            out["supervised"] = True
+            out["restarts"] = restarts
+        if ladder.steps_taken:
+            out["degradation_ladder"] = ladder.describe()
         if fallback:
             out["fallback"] = True
         if backend_err:
@@ -960,7 +1020,9 @@ def main() -> None:
         try:
             _record_history({"frontier_" + str(r["m"]): r
                              for r in rows},
-                            fault_plan=args.fault_plan)
+                            fault_plan=args.fault_plan,
+                            supervised=args.supervised,
+                            restarts=restarts)
         except OSError:
             pass
         return
@@ -979,20 +1041,31 @@ def main() -> None:
             impls = ("sort", "radix") if args.select_impl == "both" \
                 else (args.select_impl,)
             for impl in impls:
-                key = "serve" if impl == "sort" else "serve_radix"
-                results[key] = bench_serve_only(select_impl=impl,
-                                                **serve_kw)
+                row, eff = _with_ladder(
+                    ladder, {"select_impl": impl},
+                    lambda select_impl: bench_serve_only(
+                        select_impl=select_impl, **serve_kw))
+                # key by the EFFECTIVE impl: a ladder step-down must
+                # not masquerade as the requested fast path's history
+                # series (setdefault: if radix degraded into sort and
+                # sort already ran, the duplicate row is dropped)
+                key = "serve" if eff["select_impl"] == "sort" \
+                    else "serve_radix"
+                results.setdefault(key, row)
         if args.mode in ("all", "cfg3") and backend != "cpu":
             # 10k clients, uniform QoS, Poisson arrivals; weight
             # regime.  Rounds are small (~130k decisions, ~7ms), so
             # the chains must be long for the differenced pairs to
             # clear tunnel jitter
-            results["cfg3"] = bench_sustained(
-                10_000, 4096, 32, 60, zipf=False, resv_rate=100.0,
-                dt_round_ns=100_000_000, ring=256, depth0=128,
-                rounds_lo=20, with_metrics=wm,
-                select_impl="radix" if args.select_impl == "radix"
-                else "sort")
+            results["cfg3"], _ = _with_ladder(
+                ladder,
+                {"select_impl": "radix" if args.select_impl == "radix"
+                 else "sort"},
+                lambda select_impl: bench_sustained(
+                    10_000, 4096, 32, 60, zipf=False, resv_rate=100.0,
+                    dt_round_ns=100_000_000, ring=256, depth0=128,
+                    rounds_lo=20, with_metrics=wm,
+                    select_impl=select_impl))
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster
@@ -1011,15 +1084,20 @@ def main() -> None:
                 if args.calendar_impl == "both" \
                 else (args.calendar_impl,)
             for cal in cals:
-                key = "cfg4" if cal == "minstop" else "cfg4_bucketed"
-                results[key] = bench_sustained(
-                    100_000, 0, 3, 40, zipf=True,
-                    resv_rate=1200.0, dt_round_ns=50_000_000,
-                    waves=64, rounds_lo=12, latency_rounds=100,
-                    calendar_steps=64, target_resv_share=0.5, reps=4,
-                    with_metrics=wm, calendar_impl=cal,
-                    ladder_levels=args.ladder_levels,
-                    conformance_out=args.conformance_out)
+                row, eff = _with_ladder(
+                    ladder, {"calendar_impl": cal},
+                    lambda calendar_impl: bench_sustained(
+                        100_000, 0, 3, 40, zipf=True,
+                        resv_rate=1200.0, dt_round_ns=50_000_000,
+                        waves=64, rounds_lo=12, latency_rounds=100,
+                        calendar_steps=64, target_resv_share=0.5,
+                        reps=4, with_metrics=wm,
+                        calendar_impl=calendar_impl,
+                        ladder_levels=args.ladder_levels,
+                        conformance_out=args.conformance_out))
+                key = "cfg4" if eff["calendar_impl"] == "minstop" \
+                    else "cfg4_bucketed"
+                results.setdefault(key, row)
         return results
 
     with trace_ctx:
@@ -1079,7 +1157,9 @@ def main() -> None:
             f"upper bounds)")
 
     try:
-        _record_history(results, fault_plan=args.fault_plan)
+        _record_history(results, fault_plan=args.fault_plan,
+                        supervised=args.supervised, restarts=restarts,
+                        ladder_steps=ladder.describe())
     except OSError as e:      # telemetry must never eat the results
         print(f"# history record failed: {e}", file=sys.stderr)
     final = {
@@ -1113,14 +1193,19 @@ def main() -> None:
     emit(final)
 
 
-def _record_history(results: dict, fault_plan: str = "none") -> None:
+def _record_history(results: dict, fault_plan: str = "none",
+                    supervised: bool = False, restarts: int = 0,
+                    ladder_steps=None) -> None:
     """Append this session's rates to benchmark/history/ for the
     drift-aware regression guard (scripts/bench_guard.py).  CPU
     (backend-fallback) sessions are recorded too, tagged
     ``"fallback": true`` so the trajectory stays unbroken -- the guard
     annotates them and keeps them out of the accelerator medians.
     ``fault_plan`` != "none" marks a chaos session: recorded for the
-    trajectory, excluded from the clean-run medians."""
+    trajectory, excluded from the clean-run medians.  ``supervised``
+    / ``restarts`` mark a session run under robust.supervisor: a
+    restart-bearing run's wall time includes recovery (resume +
+    replay), so the guard excludes it the same way."""
     from pathlib import Path
 
     if not results:
@@ -1139,6 +1224,11 @@ def _record_history(results: dict, fault_plan: str = "none") -> None:
                  if isinstance(v, (int, float, str, bool))}
             for wl, row in results.items()},
     }
+    if supervised:
+        rec["supervised"] = True
+        rec["restarts"] = int(restarts)
+    if ladder_steps:
+        rec["degradation_ladder"] = ladder_steps
     if platform == "cpu":
         rec["fallback"] = True
     out = hist / f"bench_{int(time.time())}.json"

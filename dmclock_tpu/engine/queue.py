@@ -42,7 +42,7 @@ from ..core.recs import Phase, ReqParams
 from ..core.scheduler import AtLimit, NextReqType, PullReq
 from ..core.tags import tag_calc
 from ..core.timebase import MAX_TAG, MIN_TAG, sec_to_ns
-from ..robust.guarded import retry_with_backoff
+from ..robust.guarded import RECOVERABLE_ERRORS, retry_with_backoff
 from . import kernels
 from .kernels import (OP_ADD, OP_CREATE, OP_NOP, FUTURE, NONE, RETURNING,
                       IngestOps)
@@ -236,6 +236,11 @@ class TpuPullPriorityQueue:
         self.retry_base_s = float(retry_base_s)
         self._retry_sleep = retry_sleep or _walltime.sleep
         self.guard_retries = 0
+        # launches whose bounded retries were EXHAUSTED (the error
+        # surfaced to the caller; distinct from guard_retries, which
+        # counts recovered attempts) -- the degradation ladder's
+        # launch-failure escalation signal
+        self.launch_failures = 0
         self.invalid_cost_rejects = 0
 
         # speculative decision buffer (see _pull_spec)
@@ -279,14 +284,21 @@ class TpuPullPriorityQueue:
         with bounded exponential backoff instead of raising out of the
         serving layer.  Launches are pure jit calls, so a failed
         attempt commits nothing -- callers rebind state only from the
-        returned value."""
+        returned value.  A launch that exhausts its retries bumps
+        ``launch_failures`` -- the escalation signal the degradation
+        ladder (``robust.guarded.DegradationLadder``) steps down on --
+        before re-raising."""
         def on_retry(_attempt, _exc):
             self.guard_retries += 1
 
-        return retry_with_backoff(
-            lambda: fn(*args), retries=self.device_retries,
-            base_s=self.retry_base_s, on_retry=on_retry,
-            sleep=self._retry_sleep)
+        try:
+            return retry_with_backoff(
+                lambda: fn(*args), retries=self.device_retries,
+                base_s=self.retry_base_s, on_retry=on_retry,
+                sleep=self._retry_sleep)
+        except RECOVERABLE_ERRORS:
+            self.launch_failures += 1
+            raise
 
     def _drain_and_launch(self, fused_fn, plain_fn, *args):
         """The guarded commit-nothing form of every op-consuming
@@ -700,6 +712,9 @@ class TpuPullPriorityQueue:
             ("dmclock_guard_retries_total", "guard_retries",
              "device launches retried after a transient failure "
              "(guarded-commit contract, docs/ROBUSTNESS.md)"),
+            ("dmclock_launch_failures_total", "launch_failures",
+             "device launches that exhausted their bounded retries "
+             "(degradation-ladder escalation signal)"),
             ("dmclock_invalid_cost_rejects_total",
              "invalid_cost_rejects",
              "adds rejected for a non-positive cost (EINVAL, "

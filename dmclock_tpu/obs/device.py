@@ -17,6 +17,7 @@ high-water marks by ``maximum``:
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 # -- indices -----------------------------------------------------------
 MET_DECISIONS = 0       # decisions committed (all phases)
@@ -54,7 +55,19 @@ MET_CAL_LADDER_FALLBACKS = 14  # bucketed calendar: batches whose
 #                                with candidates present -- the
 #                                serial-fallback analog; remaining
 #                                levels of that batch are wasted)
-NUM_METRICS = 15
+MET_LADDER_STEPS = 15     # degradation-ladder step-downs taken
+#                           (robust.guarded.DegradationLadder:
+#                           bucketed->minstop, radix->sort,
+#                           tag32->int64; docs/ROBUSTNESS.md).  Reads
+#                           zero when the ladder is disabled or never
+#                           engaged (the zero-cost-when-off gate).
+MET_SUPERVISOR_RESUMES = 16  # supervisor restarts that resumed from a
+#                              rotation checkpoint (robust.supervisor).
+#                              A resume_* row: crash-equivalence
+#                              compares metric totals MODULO this row
+#                              (an interrupted run legitimately differs
+#                              here and nowhere else).
+NUM_METRICS = 17
 
 METRIC_NAMES = (
     "decisions_total", "decisions_reservation", "decisions_priority",
@@ -62,14 +75,24 @@ METRIC_NAMES = (
     "rebase_guard_trips", "ingest_drops", "rebase_fallbacks",
     "server_dropouts", "tracker_resyncs", "faults_injected",
     "calendar_ladder_levels_used", "calendar_ladder_base_decisions",
-    "calendar_ladder_fallbacks",
+    "calendar_ladder_fallbacks", "degradation_ladder_steps",
+    "supervisor_resumes",
 )
 
-# the max-accumulated rows (everything else adds)
+# rows an interrupted-and-resumed run may legitimately grow relative
+# to its uninterrupted reference (the "modulo resume_* rows" clause of
+# the crash-equivalence digest gate; robust.supervisor)
+RESUME_ROWS = (MET_SUPERVISOR_RESUMES,)
+
+# the max-accumulated rows (everything else adds).  The mask is a
+# HOST (numpy) constant on purpose: this module is imported lazily
+# from inside jitted code paths, and a module-level jnp array built
+# under an active trace would leak a tracer into the global --
+# jnp.where folds the numpy constant in at trace time either way.
 _HWM_ROWS = (MET_RING_HWM,)
-_HWM_MASK = jnp.zeros((NUM_METRICS,), dtype=bool)
+_HWM_MASK = np.zeros((NUM_METRICS,), dtype=bool)
 for _i in _HWM_ROWS:
-    _HWM_MASK = _HWM_MASK.at[_i].set(True)
+    _HWM_MASK[_i] = True
 
 
 def metrics_zero() -> jnp.ndarray:
@@ -90,13 +113,14 @@ def metrics_delta(*, decisions=0, resv=0, prop=0, limit_break=0,
                   server_dropouts=0, tracker_resyncs=0,
                   faults_injected=0, cal_ladder_levels_used=0,
                   cal_ladder_base_decisions=0,
-                  cal_ladder_fallbacks=0) -> jnp.ndarray:
+                  cal_ladder_fallbacks=0, ladder_steps=0,
+                  supervisor_resumes=0) -> jnp.ndarray:
     """Build a one-batch delta vector from scalar contributions."""
     rows = [decisions, resv, prop, limit_break, stalls, ring_hwm,
             guard_trips, ingest_drops, rebase_fallbacks,
             server_dropouts, tracker_resyncs, faults_injected,
             cal_ladder_levels_used, cal_ladder_base_decisions,
-            cal_ladder_fallbacks]
+            cal_ladder_fallbacks, ladder_steps, supervisor_resumes]
     return jnp.stack([jnp.asarray(r, dtype=jnp.int64) for r in rows])
 
 

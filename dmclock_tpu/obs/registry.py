@@ -309,6 +309,17 @@ class MetricsHTTPServer:
         reg = registry if registry is not None else default_registry()
         self.registry = reg
 
+        class ReuseServer(ThreadingHTTPServer):
+            # SO_REUSEADDR pinned EXPLICITLY (it is also the stdlib
+            # HTTPServer default): a supervisor-restarted runner
+            # depends on rebinding its scrape port immediately
+            # instead of waiting out the dead incarnation's TIME_WAIT
+            # sockets (docs/ROBUSTNESS.md scrape-port-loss fault), so
+            # the contract must not silently ride on an upstream
+            # default
+            allow_reuse_address = True
+            daemon_threads = True
+
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API)
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
@@ -330,7 +341,7 @@ class MetricsHTTPServer:
             def log_message(self, *_args):  # scrapes are not news
                 pass
 
-        self._srv = ThreadingHTTPServer((host, port), Handler)
+        self._srv = ReuseServer((host, port), Handler)
         self.host = host
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(
@@ -356,8 +367,29 @@ class MetricsHTTPServer:
 
 
 def start_http_server(registry: Optional[MetricsRegistry] = None,
-                      port: int = 0,
-                      host: str = "127.0.0.1") -> MetricsHTTPServer:
+                      port: int = 0, host: str = "127.0.0.1", *,
+                      fail_soft: bool = True
+                      ) -> Optional[MetricsHTTPServer]:
     """Start a background scrape endpoint over ``registry`` (default:
-    the process-wide registry)."""
-    return MetricsHTTPServer(registry, port=port, host=host)
+    the process-wide registry).
+
+    Telemetry must never kill the run it observes: with ``fail_soft``
+    (the default) a bind failure -- the port still held by another
+    process, a previous incarnation not fully torn down, a privileged
+    port -- logs a warning and returns ``None`` instead of raising,
+    so repeated calls on the same port degrade to "no scrape
+    endpoint" rather than an exception out of the serving layer
+    (docs/ROBUSTNESS.md).  The server itself binds with
+    ``SO_REUSEADDR``, so a supervisor-restarted runner normally
+    rebinds its old port cleanly."""
+    try:
+        return MetricsHTTPServer(registry, port=port, host=host)
+    except (OSError, OverflowError) as e:
+        # OverflowError: out-of-range port from CPython's bind()
+        if not fail_soft:
+            raise
+        import sys
+
+        print(f"# metrics: scrape endpoint disabled "
+              f"({host}:{port}: {e})", file=sys.stderr)
+        return None

@@ -10,31 +10,46 @@
 - ``robust.guarded`` -- the guarded-commit contract: device guard
   trips commit nothing and the host retries with bounded exponential
   backoff (``retry_with_backoff``; used by the TPU queue around every
-  device launch).
+  device launch), plus the :class:`DegradationLadder` escalation
+  policy (bucketed->minstop, radix->sort, tag32->int64).
+- ``robust.host_faults`` -- :class:`HostFaultPlan`: the fault
+  vocabulary aimed at the host process (seeded SIGKILL points by
+  decision count or checkpoint save stage, checkpoint corruption
+  during save, scrape-port loss).  Imported lazily (it pulls in
+  ``utils.checkpoint``).
+- ``robust.supervisor`` -- runs bench/sim epoch loops as resumable
+  jobs: rotating crash-safe checkpoints at epoch boundaries, bounded
+  restarts, exactly-once resume, and the crash-equivalence digest
+  gate.  Imported lazily.
 
 This ``__init__`` stays light (``engine.queue`` imports
-``robust.guarded`` at module load): ``robust.cluster`` resolves on
-first attribute access.
+``robust.guarded`` at module load): ``robust.cluster``,
+``robust.host_faults``, and ``robust.supervisor`` resolve on first
+attribute access.
 """
 
 from . import faults, guarded
 from .faults import (FaultPlan, FaultStep, describe, plan_events,
                      plan_step, sample_plan, single_outage_plan,
                      zero_plan)
-from .guarded import (RECOVERABLE_ERRORS, GuardedEpoch,
+from .guarded import (LADDER_RUNGS, RECOVERABLE_ERRORS,
+                      DegradationLadder, GuardedEpoch, LadderStep,
                       retry_with_backoff, run_epoch_guarded)
 
 __all__ = [
-    "faults", "guarded", "cluster",
+    "faults", "guarded", "cluster", "host_faults", "supervisor",
     "FaultPlan", "FaultStep", "zero_plan", "sample_plan",
     "single_outage_plan", "plan_step", "plan_events", "describe",
     "retry_with_backoff", "run_epoch_guarded", "GuardedEpoch",
-    "RECOVERABLE_ERRORS",
+    "RECOVERABLE_ERRORS", "DegradationLadder", "LadderStep",
+    "LADDER_RUNGS",
 ]
+
+_LAZY_MODULES = ("cluster", "host_faults", "supervisor")
 
 
 def __getattr__(name):
-    if name == "cluster":
+    if name in _LAZY_MODULES:
         import importlib
-        return importlib.import_module(".cluster", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

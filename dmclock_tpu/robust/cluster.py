@@ -179,6 +179,18 @@ def _one_server_step_faulty(engine, tracker, now, arr, view_d, view_r,
     return engine, tracker, now, view_d, view_r, up, met, decs
 
 
+def _merge_held_metrics(metrics: jnp.ndarray, mesh) -> jnp.ndarray:
+    """Mesh-merge the [S, NUM_METRICS] held-view vectors in-graph
+    (counters psum, hwm pmax); the result is replicated, one vector."""
+    spec = P(SERVER_AXIS)
+    fn = shard_map(
+        lambda m: obsdev.metrics_mesh_reduce(
+            obsdev.metrics_combine_axis(m), SERVER_AXIS),
+        mesh=mesh, in_specs=(spec,), out_specs=P(),
+        check_vma=False)
+    return fn(metrics)
+
+
 def robust_cluster_step(rc: RobustClusterState, arrivals: jnp.ndarray,
                         cost, mesh, *,
                         fault: Optional[FaultStep] = None,
@@ -186,13 +198,23 @@ def robust_cluster_step(rc: RobustClusterState, arrivals: jnp.ndarray,
                         max_arrivals: int = 1,
                         anticipation_ns: int = 0,
                         allow_limit_break: bool = False,
-                        advance_ns: int = 0):
+                        advance_ns: int = 0,
+                        with_merged: bool = False):
     """One cluster step under an optional :class:`FaultStep`.
 
     ``fault=None`` (STATIC) delegates to the plain ``cluster_step`` --
     the fault plumbing costs nothing when unused, and the views /
     transition bookkeeping are untouched (they re-sync on the next
     faulty step).  Pure; jit with ``mesh``/config bound via partial.
+
+    ``with_merged`` (STATIC) additionally returns the mesh-merged
+    total of the per-shard held-view metric vectors -- counters psum,
+    hwm rows pmax via ``obs.device.metrics_mesh_reduce``, the same
+    in-graph collective the healthy path's
+    ``cluster_step(with_metrics=True)`` got in PR-4 -- replicated
+    across the mesh, so cluster fault totals need no host gather even
+    mid-chaos.  Pinned merged == host-summed under a nonzero plan in
+    ``tests/test_cluster_realism.py``.
     """
     if fault is None:
         cluster, decs = CL.cluster_step(
@@ -200,7 +222,13 @@ def robust_cluster_step(rc: RobustClusterState, arrivals: jnp.ndarray,
             decisions_per_step=decisions_per_step,
             max_arrivals=max_arrivals, anticipation_ns=anticipation_ns,
             allow_limit_break=allow_limit_break, advance_ns=advance_ns)
-        return rc._replace(cluster=cluster), decs
+        rc = rc._replace(cluster=cluster)
+        if not with_merged:
+            return rc, decs
+        # no fault plumbing ran, but the caller still wants the
+        # merged view of the HELD metrics (frozen this step)
+        merged = _merge_held_metrics(rc.metrics, mesh)
+        return rc, decs, merged
 
     cost = jnp.asarray(cost, dtype=jnp.int64)
     f_up = jnp.asarray(fault.up, dtype=bool)
@@ -216,24 +244,37 @@ def robust_cluster_step(rc: RobustClusterState, arrivals: jnp.ndarray,
             anticipation_ns=anticipation_ns,
             allow_limit_break=allow_limit_break,
             max_arrivals=max_arrivals)
-        return jax.vmap(step)(engine, tracker, now, arr, view_d,
-                              view_r, up_prev, met, up, skew, delay,
-                              dup)
+        out = jax.vmap(step)(engine, tracker, now, arr, view_d,
+                             view_r, up_prev, met, up, skew, delay,
+                             dup)
+        if not with_merged:
+            return out
+        # local reduce over this shard's servers, then the mesh
+        # collective: counters psum, hwm pmax (associative +
+        # commutative, so mesh order cannot matter)
+        merged = obsdev.metrics_mesh_reduce(
+            obsdev.metrics_combine_axis(out[6]), SERVER_AXIS)
+        return out + (merged,)
 
     spec = P(SERVER_AXIS)
+    out_specs = (spec,) * 8 + ((P(),) if with_merged else ())
     fn = shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(spec,) * 12, out_specs=(spec,) * 8,
+        in_specs=(spec,) * 12, out_specs=out_specs,
         check_vma=False)
     now0 = rc.cluster.now + jnp.int64(advance_ns)
-    engine, tracker, now, view_d, view_r, up_prev, met, decs = fn(
+    outs = fn(
         rc.cluster.engine, rc.cluster.tracker, now0, arrivals,
         rc.view_delta, rc.view_rho, rc.up_prev, rc.metrics,
         f_up, f_skew, f_delay, f_dup)
+    engine, tracker, now, view_d, view_r, up_prev, met, decs = \
+        outs[:8]
     rc = RobustClusterState(
         cluster=ClusterState(engine=engine, tracker=tracker, now=now),
         view_delta=view_d, view_rho=view_r, up_prev=up_prev,
         metrics=met)
+    if with_merged:
+        return rc, decs, outs[8]
     return rc, decs
 
 

@@ -247,3 +247,119 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                         rebase_fallbacks=rebase_fb,
                         serial_fallbacks=serial_fb,
                         retries=retry_count[0])
+
+
+# ----------------------------------------------------------------------
+# escalation / degradation ladder (docs/ROBUSTNESS.md)
+# ----------------------------------------------------------------------
+
+# Rung order is cheapest-concession-first: each (knob, fast, safe)
+# step trades a fast path for its always-exact twin, and every rung is
+# already pinned bit-identical/exact by the differential suites
+# (tests/test_calendar_bucketed.py, tests/test_radix.py), so a
+# degraded run is SLOWER, never DIVERGENT.
+LADDER_RUNGS = (
+    ("calendar_impl", "bucketed", "minstop"),
+    ("select_impl", "radix", "sort"),
+    ("tag_width", 32, 64),
+)
+
+
+class LadderStep(NamedTuple):
+    """One recorded step-down."""
+
+    knob: str
+    from_value: object
+    to_value: object
+    reason: str     # "guard_trips" | "launch_failures" | "resumed"
+
+
+class DegradationLadder:
+    """Escalation policy over the guarded-commit contract: when an
+    epoch loop keeps tripping guards or exhausting launch retries for
+    ``threshold`` consecutive epochs, step down ONE rung of
+    :data:`LADDER_RUNGS` (the first still engaged in the caller's
+    config) and keep serving.  Disabled (``enabled=False``) it is
+    inert: ``apply`` is the identity and ``note_epoch`` never steps --
+    the zero-cost-when-off gate pins a disabled ladder's obs row at 0.
+
+    The engaged-rung set is tiny host state; :meth:`encode` /
+    :meth:`load` round-trip it through an int64 vector so the
+    supervisor can carry ladder position inside its rotation
+    checkpoints (a resumed run must keep serving at the same degraded
+    operating point, or the replay would diverge from the
+    uninterrupted run)."""
+
+    def __init__(self, enabled: bool = True, threshold: int = 2):
+        self.enabled = bool(enabled)
+        self.threshold = max(int(threshold), 1)
+        self.steps: list = []       # LadderStep, in engagement order
+        self._consecutive = 0
+
+    @property
+    def steps_taken(self) -> int:
+        return len(self.steps)
+
+    def _engaged(self, knob: str) -> bool:
+        return any(s.knob == knob for s in self.steps)
+
+    def apply(self, cfg: dict) -> dict:
+        """Map a config through the engaged rungs (a knob already at
+        its safe value is untouched)."""
+        out = dict(cfg)
+        for knob, fast, safe in LADDER_RUNGS:
+            if self._engaged(knob) and out.get(knob) == fast:
+                out[knob] = safe
+        return out
+
+    def can_step(self, cfg: dict) -> bool:
+        """True while a rung is still engageable for ``cfg`` -- the
+        retry loops use this to bound re-attempts: a failure with
+        nothing left to concede must surface, not spin."""
+        return self.enabled and any(
+            cfg.get(knob) == fast and not self._engaged(knob)
+            for knob, fast, _safe in LADDER_RUNGS)
+
+    def note_epoch(self, cfg: dict, *, guard_trips: int = 0,
+                   launch_failures: int = 0) -> int:
+        """Observe one epoch's fault counters (POST-``apply`` config).
+        Returns the number of step-downs taken (0 or 1); a clean epoch
+        resets the consecutive-trip counter."""
+        if not self.enabled:
+            return 0
+        if not (guard_trips or launch_failures):
+            self._consecutive = 0
+            return 0
+        self._consecutive += 1
+        if self._consecutive < self.threshold:
+            return 0
+        self._consecutive = 0
+        for knob, fast, safe in LADDER_RUNGS:
+            if cfg.get(knob) == fast and not self._engaged(knob):
+                self.steps.append(LadderStep(
+                    knob, fast, safe,
+                    "guard_trips" if guard_trips else "launch_failures"))
+                return 1
+        return 0    # fully degraded already; nothing left to concede
+
+    def describe(self) -> list:
+        """JSON-able step list for bench lines / history records."""
+        return [{"knob": s.knob, "from": s.from_value,
+                 "to": s.to_value, "reason": s.reason}
+                for s in self.steps]
+
+    # -- checkpoint round-trip (int64[R + 1]: engaged flags + counter)
+    def encode(self):
+        import numpy as np
+        vec = [1 if self._engaged(knob) else 0
+               for knob, _, _ in LADDER_RUNGS]
+        return np.asarray(vec + [self._consecutive], dtype=np.int64)
+
+    def load(self, vec) -> None:
+        import numpy as np
+        vec = np.asarray(vec, dtype=np.int64)
+        assert vec.shape == (len(LADDER_RUNGS) + 1,), vec.shape
+        self.steps = [LadderStep(knob, fast, safe, "resumed")
+                      for flag, (knob, fast, safe)
+                      in zip(vec[:-1], LADDER_RUNGS) if flag]
+        self._consecutive = int(vec[-1])

@@ -1,0 +1,273 @@
+"""Host-level fault plans: the PR-3 fault vocabulary aimed at the
+runner process itself (docs/ROBUSTNESS.md).
+
+``robust.faults`` injects *device/cluster* faults (dropout, stale
+counters, skew, duplicated completions); a :class:`HostFaultPlan`
+injects the failures that kill the HOST half of a run:
+
+- **kill by decision count** (``kill_at_decisions``): SIGKILL the
+  runner the first time the cumulative decision total crosses a
+  point -- mid-interval between two rotation checkpoints, the worst
+  place to die;
+- **kill during a checkpoint save** (``kill_at_save``): die INSIDE
+  ``utils.checkpoint.save_pytree`` at a named ``_crash_hook`` stage of
+  a given epoch's save -- the torn-snapshot scenarios the atomic save
+  path exists for;
+- **checkpoint corruption during save** (``corrupt_save_at``): the
+  save commits, then payload bytes rot underneath it (flipped via the
+  ``_post_commit_hook`` seam) -- resume must fall back past the
+  corrupt entry to the newest intact rotation snapshot;
+- **scrape-port loss** (``drop_scrape_at``): the metrics HTTP endpoint
+  vanishes at an epoch boundary; the runner must rebind soft
+  (``obs.registry.start_http_server`` fail-soft + ``SO_REUSEADDR``)
+  without perturbing the run.
+
+Plans are host data sampled once from a seed (PCG64, stable across
+runs) or built explicitly; an empty plan (:func:`zero_host_plan`) is
+pinned bit-identical to running with no supervisor fault plumbing at
+all (the zero-host-fault gate, ``tests/test_supervisor.py`` +
+``scripts/ci.sh`` crash smoke).
+
+The :class:`HostFaultInjector` arms a plan against a live job loop.
+Every point fires **exactly once across restarts**: the injector
+appends the point id to a ``host_faults.fired`` write-ahead file
+(flush + fsync) *before* acting, so a resumed process -- which replays
+the same deterministic decision stream through the same thresholds --
+skips already-fired points instead of dying in a loop.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..utils import checkpoint as ckpt_mod
+
+
+class HostKill(BaseException):
+    """In-process stand-in for SIGKILL (a BaseException, so no
+    ``except Exception`` inside the job can swallow it) -- what the
+    trampoline-mode injector raises at a plan point."""
+
+
+class HostFaultPlan(NamedTuple):
+    """Deterministic host fault schedule.  All fields are tuples of
+    plain ints/strs so a plan JSON-round-trips into the spawn-mode
+    child process unchanged."""
+
+    kill_at_decisions: Tuple[int, ...] = ()
+    # (epoch, stage) pairs; stage from utils.checkpoint.SAVE_STAGES
+    kill_at_save: Tuple[Tuple[int, str], ...] = ()
+    corrupt_save_at: Tuple[int, ...] = ()     # epochs whose save rots
+    drop_scrape_at: Tuple[int, ...] = ()      # epochs losing the port
+
+
+def zero_host_plan() -> HostFaultPlan:
+    """The empty plan: supervisor-wrapped must be bit-identical to the
+    bare runner under it."""
+    return HostFaultPlan()
+
+
+def host_plan_events(plan: Optional[HostFaultPlan]) -> dict:
+    """Host-side ground truth of what a full run of ``plan`` injects
+    (the oracle the supervisor's restart accounting is checked
+    against: every kill point is one restart, corruption alone kills
+    nothing)."""
+    if plan is None:
+        return {"kills": 0, "save_kills": 0, "corrupt_saves": 0,
+                "scrape_drops": 0, "restarts": 0}
+    kills = len(plan.kill_at_decisions)
+    save_kills = len(plan.kill_at_save)
+    return {
+        "kills": kills,
+        "save_kills": save_kills,
+        "corrupt_saves": len(plan.corrupt_save_at),
+        "scrape_drops": len(plan.drop_scrape_at),
+        "restarts": kills + save_kills,
+    }
+
+
+def describe_host(plan: Optional[HostFaultPlan]) -> str:
+    """Compact history tag (the ``robust.faults.describe`` analog):
+    ``"none"`` for no/empty plan, else a summary naming the fault mix
+    so supervised chaos sessions self-identify in bench history."""
+    ev = host_plan_events(plan)
+    if sum(ev.values()) == 0:
+        return "none"
+    return (f"host:kill{ev['kills']}+savekill{ev['save_kills']}"
+            f"+corrupt{ev['corrupt_saves']}+scrape{ev['scrape_drops']}")
+
+
+def sample_host_plan(seed: int, *, epochs: int, est_decisions: int,
+                     kills: int = 1, save_kills: int = 0,
+                     corrupt_saves: int = 0, scrape_drops: int = 0,
+                     ckpt_every: int = 2) -> HostFaultPlan:
+    """Sample a deterministic plan from ``seed`` (PCG64; stable across
+    runs and platforms).  ``est_decisions`` bounds the kill-point
+    draw; kill points land strictly inside the run so the final state
+    still differs from the fresh one when a kill fires.  Save-stage
+    faults target epochs that actually checkpoint (multiples of
+    ``ckpt_every``, matching the supervisor's boundary rule)."""
+    rng = np.random.Generator(np.random.PCG64(seed))
+    lo = max(est_decisions // 8, 1)
+    hi = max(est_decisions - lo, lo + 1)
+    kill_pts = tuple(sorted(int(x) for x in
+                            rng.integers(lo, hi, size=kills)))
+    save_epochs = [e for e in range(epochs)
+                   if (e + 1) % max(ckpt_every, 1) == 0]
+    stages = [s for s in ckpt_mod.SAVE_STAGES if s != "done"]
+    saves = tuple(
+        (int(rng.choice(save_epochs)), str(rng.choice(stages)))
+        for _ in range(save_kills)) if save_epochs else ()
+    corrupt = tuple(int(rng.choice(save_epochs))
+                    for _ in range(corrupt_saves)) if save_epochs \
+        else ()
+    drops = tuple(int(x) for x in
+                  rng.integers(0, max(epochs, 1), size=scrape_drops))
+    return HostFaultPlan(kill_at_decisions=kill_pts,
+                         kill_at_save=saves,
+                         corrupt_save_at=corrupt,
+                         drop_scrape_at=drops)
+
+
+def plan_to_json(plan: Optional[HostFaultPlan]) -> dict:
+    if plan is None:
+        plan = zero_host_plan()
+    return {"kill_at_decisions": list(plan.kill_at_decisions),
+            "kill_at_save": [[int(e), str(s)]
+                             for e, s in plan.kill_at_save],
+            "corrupt_save_at": list(plan.corrupt_save_at),
+            "drop_scrape_at": list(plan.drop_scrape_at)}
+
+
+def plan_from_json(obj: dict) -> HostFaultPlan:
+    return HostFaultPlan(
+        kill_at_decisions=tuple(int(x)
+                                for x in obj.get("kill_at_decisions",
+                                                 ())),
+        kill_at_save=tuple((int(e), str(s))
+                           for e, s in obj.get("kill_at_save", ())),
+        corrupt_save_at=tuple(int(x)
+                              for x in obj.get("corrupt_save_at", ())),
+        drop_scrape_at=tuple(int(x)
+                             for x in obj.get("drop_scrape_at", ())))
+
+
+class HostFaultInjector:
+    """Arms a :class:`HostFaultPlan` against a running job loop.
+
+    ``kill_mode="raise"`` (the in-process trampoline) raises
+    :class:`HostKill`; ``kill_mode="sigkill"`` (the child-process
+    supervisor) SIGKILLs the interpreter -- the real thing, nothing
+    runs after it.  Either way the point id is durably appended to
+    ``<workdir>/host_faults.fired`` BEFORE the kill (write-ahead), so
+    the point fires exactly once across however many restarts the
+    supervisor grants."""
+
+    FIRED_NAME = "host_faults.fired"
+
+    def __init__(self, plan: Optional[HostFaultPlan], workdir: str,
+                 kill_mode: str = "raise"):
+        assert kill_mode in ("raise", "sigkill"), kill_mode
+        self.plan = plan if plan is not None else zero_host_plan()
+        self.kill_mode = kill_mode
+        self._fired_path = os.path.join(os.fspath(workdir),
+                                        self.FIRED_NAME)
+        self._fired = set()
+        if os.path.exists(self._fired_path):
+            with open(self._fired_path) as fh:
+                self._fired = {ln.strip() for ln in fh if ln.strip()}
+
+    @property
+    def fired(self) -> frozenset:
+        return frozenset(self._fired)
+
+    def _mark(self, point: str) -> bool:
+        """Durably record ``point`` as fired; False when it already
+        was (the replay-after-resume case)."""
+        if point in self._fired:
+            return False
+        self._fired.add(point)
+        with open(self._fired_path, "a") as fh:
+            fh.write(point + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        return True
+
+    def _kill(self, label: str) -> None:
+        if self.kill_mode == "sigkill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise HostKill(label)
+
+    # -- plan points ---------------------------------------------------
+    def after_decisions(self, total: int) -> None:
+        """Call with the cumulative decision count after each epoch;
+        the first crossing of an unfired kill point dies here."""
+        for i, point in enumerate(self.plan.kill_at_decisions):
+            if total >= point and self._mark(f"dec:{i}"):
+                self._kill(f"kill_at_decisions[{i}]={point} "
+                           f"(total {total})")
+
+    def drop_scrape(self, epoch: int) -> bool:
+        """True when this epoch's plan says the scrape port vanishes
+        (at most once per planned epoch)."""
+        hit = False
+        for i, e in enumerate(self.plan.drop_scrape_at):
+            if e == epoch and self._mark(f"scrape:{i}"):
+                hit = True
+        return hit
+
+    def around_save(self, epoch: int, save_fn):
+        """Run one checkpoint save under the plan: may die at a named
+        ``_crash_hook`` stage, and/or have the committed payload rot
+        via ``_post_commit_hook``.  Hooks are module-global, so they
+        are always uninstalled on the way out (a HostKill must not
+        leak a crash hook into the next save)."""
+        kill_stage = None
+        for i, (e, stage) in enumerate(self.plan.kill_at_save):
+            if e == epoch and f"savekill:{i}" not in self._fired:
+                kill_stage, kill_id = stage, f"savekill:{i}"
+                break
+
+        def crash_hook(stage):
+            if stage == kill_stage and self._mark(kill_id):
+                self._kill(f"kill_at_save epoch {epoch} "
+                           f"stage {stage}")
+
+        corrupt_id = None
+        for i, e in enumerate(self.plan.corrupt_save_at):
+            if e == epoch and f"corrupt:{i}" not in self._fired:
+                corrupt_id = f"corrupt:{i}"
+                break
+
+        def post_commit(path):
+            if self._mark(corrupt_id):
+                _flip_payload_byte(path)
+
+        if kill_stage is not None:
+            ckpt_mod._crash_hook = crash_hook
+        if corrupt_id is not None:
+            ckpt_mod._post_commit_hook = post_commit
+        try:
+            return save_fn()
+        finally:
+            ckpt_mod._crash_hook = None
+            ckpt_mod._post_commit_hook = None
+
+
+def _flip_payload_byte(path: str) -> None:
+    """Flip one byte in the middle of a committed snapshot's data file
+    (media rot under a just-finished save).  The sidecar is left
+    alone, so the pair fails digest verification and restore walks
+    back to an older intact rotation entry."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(size // 2)
+        b = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([b[0] ^ 0xFF]))
+        fh.flush()
+        os.fsync(fh.fileno())

@@ -1,0 +1,601 @@
+"""Crash-equivalent supervised epoch runs (docs/ROBUSTNESS.md).
+
+PR-3 made device-level faults injectable; the host process stayed a
+single point of failure.  This module closes that gap the way
+RackSched survives per-server failures through stateless re-dispatch
+(PAPERS.md): the epoch loop becomes a **resumable job** under a
+supervisor --
+
+- the job runs epochs of any of the three epoch engines through the
+  guarded-commit contract (``robust.guarded.run_epoch_guarded``),
+  ingesting Poisson arrivals drawn from a checkpointed host RNG;
+- at epoch boundaries it writes **rotating crash-safe checkpoints**
+  (``utils.checkpoint.save_pytree_rotating``) of the FULL run state:
+  engine pytree, obs metrics vector, RNG bit-generator state, the
+  decision-stream chain digest, the epoch/decision counters, and the
+  degradation-ladder position;
+- the supervisor (child process via spawn, or an in-process
+  trampoline for tests) restarts a killed job with bounded
+  exponential backoff; resume lands on the **newest intact** rotation
+  snapshot (``restore_pytree_rotating``'s fallback walk) and replays
+  forward deterministically.
+
+The headline invariant is the **crash-equivalence digest gate**: a
+run SIGKILLed at ANY :class:`~.host_faults.HostFaultPlan` point and
+resumed produces the same decision-stream digest, the same final
+engine state, and the same metric totals -- modulo the ``resume_*``
+rows (``obs.device.RESUME_ROWS``) -- as the uninterrupted run.
+Exactly-once is by construction: the digest is a sha256 **chain**
+carried inside the checkpoint, so decisions committed before the last
+snapshot are hashed exactly once, and decisions after it are replayed
+bit-identically from the restored state + RNG.
+
+On top sits the **degradation ladder**
+(``robust.guarded.DegradationLadder``): repeated guard trips or
+exhausted launch retries step the job down ``bucketed -> minstop``,
+``radix -> sort``, ``tag32 -> int64`` -- every rung an already-proven
+exact path, so a degraded run is slower, never divergent.  Ladder
+position rides in the checkpoint and in obs row
+``degradation_ladder_steps``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time as _time
+from typing import Callable, NamedTuple, Optional
+
+import numpy as np
+
+from ..utils import checkpoint as ckpt_mod
+from .guarded import (RECOVERABLE_ERRORS, DegradationLadder,
+                      run_epoch_guarded)
+from .host_faults import (HostFaultInjector, HostFaultPlan, HostKill,
+                          describe_host, plan_from_json, plan_to_json,
+                          zero_host_plan)
+
+
+class SupervisorGaveUp(RuntimeError):
+    """The job died more times than ``max_restarts`` allows."""
+
+
+@dataclasses.dataclass(frozen=True)
+class EpochJob:
+    """A deterministic, resumable epoch-loop workload -- the sim/bench
+    inner loop distilled to what the supervisor needs: everything
+    below is plain data, so a job JSON-round-trips into a spawned
+    child process and two runs of the same job are bit-identical."""
+
+    engine: str = "prefix"          # prefix | chain | calendar
+    n: int = 512                    # clients
+    depth: int = 12                 # preloaded queue depth
+    ring: int = 16
+    epochs: int = 8
+    m: int = 4                      # batches per epoch
+    k: int = 64                     # per-batch cap / calendar steps
+    chain_depth: int = 4
+    select_impl: str = "sort"
+    tag_width: int = 64
+    calendar_impl: str = "minstop"
+    ladder_levels: int = 4
+    seed: int = 11                  # arrival RNG seed
+    arrival_lam: float = 2.0        # Poisson mean arrivals/client/epoch
+    waves: int = 4
+    dt_epoch_ns: int = 10 ** 8
+    ckpt_every: int = 2             # checkpoint every N epochs
+    keep: int = 4                   # rotation depth
+    ladder: bool = False            # degradation ladder enabled
+    ladder_threshold: int = 2
+    metrics_port: Optional[int] = None   # scrape endpoint (fail-soft)
+    # offset client 0's head proportion tag (ns): past +-2^31 it
+    # deterministically trips the tag32 rebase window every epoch --
+    # the in-repo way to exercise guard trips / ladder engagement
+    tag_spread_ns: int = 0
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "EpochJob":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in obj.items() if k in fields})
+
+
+class SupervisedResult(NamedTuple):
+    """What a completed (bare or supervised) run reports."""
+
+    digest: str         # hex decision-stream chain digest
+    state_digest: str   # sha256 over the final engine state leaves
+    decisions: int
+    epochs: int
+    metrics: np.ndarray  # int64[NUM_METRICS], resume row included
+    restarts: int
+    ladder_steps: list   # DegradationLadder.describe() rows
+    # scrape-port rebinds observed by the FINAL incarnation (host
+    # telemetry, deliberately outside the checkpointed state --
+    # rebinds in killed incarnations die with them)
+    scrape_rebinds: int
+    # rotation path the FINAL incarnation resumed from (None when it
+    # started fresh) -- the newest-intact-fallback observability hook
+    resumed_from: Optional[str] = None
+
+
+def assert_crash_equivalent(interrupted: SupervisedResult,
+                            reference: SupervisedResult) -> None:
+    """The digest gate: decision stream, final state, and metric
+    totals must match bit-for-bit, modulo the resume rows an
+    interrupted run legitimately grows."""
+    from ..obs import device as obsdev
+
+    assert interrupted.digest == reference.digest, \
+        (f"decision digest diverged: {interrupted.digest[:16]} vs "
+         f"{reference.digest[:16]}")
+    assert interrupted.state_digest == reference.state_digest, \
+        "final engine state diverged"
+    assert interrupted.decisions == reference.decisions
+    a = np.asarray(interrupted.metrics, dtype=np.int64).copy()
+    b = np.asarray(reference.metrics, dtype=np.int64).copy()
+    for row in obsdev.RESUME_ROWS:
+        a[row] = b[row] = 0
+    assert np.array_equal(a, b), \
+        (f"metric totals diverged outside the resume rows: "
+         f"{a.tolist()} vs {b.tolist()}")
+
+
+# ----------------------------------------------------------------------
+# the job loop
+# ----------------------------------------------------------------------
+
+def _job_state(job: EpochJob):
+    """Deterministic preloaded engine state (the bench serve-only
+    preload shape: staggered proportion tags, ``depth`` queued ops per
+    client)."""
+    import jax.numpy as jnp
+
+    from ..core.timebase import rate_to_inv_ns
+    from ..engine import init_state
+
+    st = init_state(job.n, job.ring)
+    c = np.arange(job.n)
+    rinv = np.full(job.n, rate_to_inv_ns(100.0), dtype=np.int64)
+    winv = np.asarray([rate_to_inv_ns(1.0 + (i % 4)) for i in c],
+                      dtype=np.int64)
+    phase = ((c * 2654435761) & 0xFFFFF) / float(1 << 20)
+    jitter = (phase * 2.0 * winv).astype(np.int64)
+    if job.tag_spread_ns:
+        jitter[0] += np.int64(job.tag_spread_ns)
+    q_arr = np.zeros((job.n, job.ring), dtype=np.int64)
+    q_arr[:, :job.depth - 1] = np.tile(np.arange(1, job.depth),
+                                       (job.n, 1))
+    return st._replace(
+        active=jnp.ones(job.n, dtype=bool),
+        idle=jnp.zeros(job.n, dtype=bool),
+        order=jnp.arange(job.n, dtype=jnp.int64),
+        resv_inv=jnp.asarray(rinv),
+        weight_inv=jnp.asarray(winv),
+        head_resv=jnp.asarray(rinv),
+        head_prop=jnp.asarray(winv + jitter),
+        head_limit=jnp.full(job.n, -(1 << 62), dtype=jnp.int64),
+        depth=jnp.full(job.n, job.depth, dtype=jnp.int32),
+        q_arrival=jnp.asarray(q_arr),
+        q_cost=jnp.ones((job.n, job.ring), dtype=jnp.int64),
+    )
+
+
+def _rng_state_array(rng: np.random.Generator) -> np.ndarray:
+    """PCG64 bit-generator state as uint64[6] (128-bit state and inc
+    split lo/hi, plus the uint32 spill) -- checkpointable host RNG."""
+    s = rng.bit_generator.state
+    mask = (1 << 64) - 1
+    st, inc = s["state"]["state"], s["state"]["inc"]
+    return np.asarray([st & mask, (st >> 64) & mask,
+                       inc & mask, (inc >> 64) & mask,
+                       int(s["has_uint32"]), int(s["uinteger"])],
+                      dtype=np.uint64)
+
+
+def _rng_from_array(a) -> np.random.Generator:
+    a = np.asarray(a, dtype=np.uint64)
+    rng = np.random.Generator(np.random.PCG64(0))
+    rng.bit_generator.state = {
+        "bit_generator": "PCG64",
+        "state": {"state": int(a[0]) | (int(a[1]) << 64),
+                  "inc": int(a[2]) | (int(a[3]) << 64)},
+        "has_uint32": int(a[4]), "uinteger": int(a[5])}
+    return rng
+
+
+_DIGEST_FIELDS = ("count", "unit_count", "resv_count", "slot", "cls",
+                  "length", "phase", "cost", "lb", "served", "type")
+
+
+def _digest_update(digest: bytes, results) -> bytes:
+    """One chain-digest step: sha256(previous digest || this epoch's
+    decision arrays).  Resumable where a single running sha256 is not:
+    the 32-byte chain value rides in the checkpoint, decisions before
+    the snapshot are hashed exactly once, decisions after it replay
+    into the same chain."""
+    import jax
+
+    h = hashlib.sha256(digest)
+    for r in results:
+        for name in _DIGEST_FIELDS:
+            if hasattr(r, name):
+                a = np.asarray(jax.device_get(getattr(r, name)))
+                h.update(str(a.dtype).encode())
+                h.update(str(a.shape).encode())
+                h.update(np.ascontiguousarray(a).tobytes())
+    return h.digest()
+
+
+def _tree_digest(tree) -> str:
+    import jax
+
+    return ckpt_mod._leaf_digest(
+        [np.asarray(x) for x in jax.device_get(jax.tree.leaves(tree))])
+
+
+def _payload(job: EpochJob, state, rng, met, digest: bytes,
+             epoch: int, decisions: int, ladder_vec) -> dict:
+    return {"digest": np.frombuffer(digest, dtype=np.uint8).copy(),
+            "decisions": np.int64(decisions),
+            "engine": state,
+            "epoch": np.int64(epoch),
+            "ladder": np.asarray(ladder_vec, dtype=np.int64),
+            "metrics": np.asarray(met, dtype=np.int64),
+            "rng": _rng_state_array(rng)}
+
+
+def _payload_like(job: EpochJob) -> dict:
+    from ..obs import device as obsdev
+
+    return _payload(job, _job_state(job),
+                    np.random.Generator(np.random.PCG64(job.seed)),
+                    np.zeros(obsdev.NUM_METRICS, dtype=np.int64),
+                    b"\x00" * 32, 0, 0,
+                    DegradationLadder().encode())
+
+
+_INGEST_JIT_CACHE: dict = {}
+
+
+def _jit_ingest(job: EpochJob):
+    """Jitted superwave ingest for this job's static shape (the
+    engine/queue.py module-cache convention)."""
+    key = (job.n, job.ring, job.waves, job.dt_epoch_ns)
+    if key not in _INGEST_JIT_CACHE:
+        import jax
+        import jax.numpy as jnp
+
+        from ..engine import kernels
+
+        waves, dt_wave = job.waves, job.dt_epoch_ns // job.waves
+        cost = jnp.ones((job.n,), dtype=jnp.int64)
+
+        def ingest(st, counts, t_base):
+            wave_times = t_base + jnp.arange(waves,
+                                             dtype=jnp.int64) * dt_wave
+            return kernels.ingest_superwave(st, counts, wave_times,
+                                            cost, cost, cost,
+                                            anticipation_ns=0)
+
+        _INGEST_JIT_CACHE[key] = jax.jit(ingest)
+    return _INGEST_JIT_CACHE[key]
+
+
+def _job_loop(job: EpochJob, workdir: Optional[str],
+              injector: Optional[HostFaultInjector]
+              ) -> SupervisedResult:
+    """Run the job to completion once (restore -> epochs -> return).
+    ``workdir=None`` is the BARE runner: no restore, no checkpoints,
+    no injector -- the uninterrupted reference the digest gate
+    compares against."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs import device as obsdev
+    from ..obs.registry import start_http_server
+
+    state = _job_state(job)
+    rng = np.random.Generator(np.random.PCG64(job.seed))
+    met = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
+    digest = b"\x00" * 32
+    start_epoch = 0
+    decisions = 0
+    ladder = DegradationLadder(enabled=job.ladder,
+                               threshold=job.ladder_threshold)
+    ckpt_dir = os.path.join(workdir, "ckpt") if workdir else None
+
+    payload = None
+    resumed_from = None
+    if ckpt_dir is not None and ckpt_mod.rotation_paths(ckpt_dir):
+        # a non-empty rotation means a previous incarnation died:
+        # resume from the newest INTACT snapshot (walks past any
+        # torn/corrupted-by-plan entries).  EVERY entry torn is the
+        # worst case, not a dead end: replay from scratch is
+        # deterministic, so the run stays crash-equivalent -- it just
+        # pays the full recompute.
+        try:
+            payload, resumed_from = ckpt_mod.restore_pytree_rotating(
+                ckpt_dir, _payload_like(job))
+        except ckpt_mod.CheckpointCorruptError:
+            payload = None
+    if payload is not None:
+        # durable resume journal: MET_SUPERVISOR_RESUMES counts
+        # restarts that actually restored a snapshot -- a
+        # replay-from-scratch restart (all snapshots torn) is a
+        # RESTART but not a RESUME, and the metric exists to tell the
+        # two apart
+        with open(os.path.join(workdir, RESUME_LOG), "a") as fh:
+            fh.write(f"{resumed_from}\n")
+        state = payload["engine"]
+        rng = _rng_from_array(payload["rng"])
+        met = np.asarray(jax.device_get(payload["metrics"]),
+                         dtype=np.int64).copy()
+        digest = np.asarray(payload["digest"],
+                            dtype=np.uint8).tobytes()
+        start_epoch = int(payload["epoch"])
+        decisions = int(payload["decisions"])
+        ladder.load(jax.device_get(payload["ladder"]))
+
+    scrape = None
+    scrape_port = job.metrics_port
+    scrape_rebinds = 0
+    base_cfg = {"select_impl": job.select_impl,
+                "tag_width": job.tag_width,
+                "calendar_impl": job.calendar_impl}
+    ingest = _jit_ingest(job) if job.arrival_lam > 0 else None
+
+    try:
+        for epoch in range(start_epoch, job.epochs):
+            if scrape_port is not None and scrape is None:
+                scrape = start_http_server(port=scrape_port)
+                if scrape is not None:
+                    scrape_port = scrape.port   # pin ephemeral binds
+                    if epoch > start_epoch:
+                        scrape_rebinds += 1
+            if injector is not None and injector.drop_scrape(epoch) \
+                    and scrape is not None:
+                scrape.close()      # the plan yanks the port; the
+                scrape = None       # loop rebinds next boundary
+
+            t_base = jnp.int64(epoch * job.dt_epoch_ns)
+            if ingest is not None:
+                headroom = job.ring - np.asarray(
+                    jax.device_get(state.depth), dtype=np.int64)
+                counts = np.minimum(
+                    rng.poisson(job.arrival_lam, job.n),
+                    np.minimum(headroom, job.waves)
+                ).astype(np.int32)
+                state = ingest(state, jnp.asarray(counts), t_base)
+            while True:
+                cfg = ladder.apply(base_cfg)
+                try:
+                    ep = run_epoch_guarded(
+                        state,
+                        epoch * job.dt_epoch_ns + job.dt_epoch_ns,
+                        engine=job.engine, m=job.m, k=job.k,
+                        chain_depth=job.chain_depth, with_metrics=True,
+                        select_impl=cfg["select_impl"],
+                        tag_width=cfg["tag_width"],
+                        calendar_impl=cfg["calendar_impl"],
+                        ladder_levels=job.ladder_levels)
+                    break
+                except RECOVERABLE_ERRORS:
+                    # bounded retries EXHAUSTED inside the guarded
+                    # runner -- the ladder's launch-failure signal
+                    # (recovered retries, ep.retries > 0, are NOT an
+                    # escalation: the launch succeeded).  Each failed
+                    # ATTEMPT counts toward the threshold, so the
+                    # escalation is reachable at any threshold:
+                    # below it the same path is re-attempted, at it a
+                    # rung steps down, and with nothing left to
+                    # concede (or the ladder off) the error surfaces
+                    # to the supervisor's restart loop -- at most
+                    # threshold * rungs attempts per epoch.
+                    if not ladder.can_step(cfg):
+                        raise
+                    met[obsdev.MET_LADDER_STEPS] += \
+                        ladder.note_epoch(cfg, launch_failures=1)
+            state = ep.state
+            decisions += ep.count
+            digest = _digest_update(digest, ep.results)
+            for r in ep.results:
+                if hasattr(r, "metrics"):
+                    met = obsdev.metrics_combine_np(
+                        met, jax.device_get(r.metrics))
+            stepped = ladder.note_epoch(
+                cfg,
+                guard_trips=ep.rebase_fallbacks + ep.serial_fallbacks)
+            met[obsdev.MET_LADDER_STEPS] += stepped
+
+            if injector is not None:
+                injector.after_decisions(decisions)
+            if ckpt_dir is not None and \
+                    ((epoch + 1) % job.ckpt_every == 0
+                     or epoch + 1 == job.epochs):
+                payload = _payload(job, state, rng, met, digest,
+                                   epoch + 1, decisions,
+                                   ladder.encode())
+
+                def save(payload=payload):
+                    return ckpt_mod.save_pytree_rotating(
+                        ckpt_dir, payload, keep=job.keep)
+
+                if injector is not None:
+                    injector.around_save(epoch, save)
+                else:
+                    save()
+    finally:
+        if scrape is not None:
+            scrape.close()
+
+    return SupervisedResult(
+        digest=hashlib.sha256(digest).hexdigest(),
+        state_digest=_tree_digest(state),
+        decisions=decisions, epochs=job.epochs,
+        metrics=met, restarts=0,
+        ladder_steps=ladder.describe(),
+        scrape_rebinds=scrape_rebinds,
+        resumed_from=resumed_from)
+
+
+def run_job(job: EpochJob) -> SupervisedResult:
+    """The bare runner: the uninterrupted, unsupervised reference.
+    The zero-host-fault gate pins ``run_supervised(job, wd,
+    zero_host_plan())`` bit-identical to this."""
+    return _job_loop(job, None, None)
+
+
+# ----------------------------------------------------------------------
+# the supervisor
+# ----------------------------------------------------------------------
+
+JOB_FILE = "job.json"
+RESULT_FILE = "result.json"
+RESUME_LOG = "resume.log"
+
+
+class _ChildKilled(RuntimeError):
+    """Spawn-mode child died (signal or nonzero exit) before writing
+    its result."""
+
+
+# what the restart loop treats as "the runner died": plan kills
+# (trampoline HostKill, spawn child death) AND a recoverable device/
+# transport error that survived the guarded runner's bounded retries
+# and the ladder -- in both modes that run is gone, but an intact
+# rotation checkpoint remains to resume from.  Genuine caller bugs
+# (ValueError, plain RuntimeError) still surface immediately in
+# trampoline mode.
+_RESTART_ERRORS = (HostKill, _ChildKilled) + RECOVERABLE_ERRORS
+
+
+def run_supervised(job: EpochJob, workdir,
+                   plan: Optional[HostFaultPlan] = None, *,
+                   mode: str = "trampoline", max_restarts: int = 8,
+                   backoff_base_s: float = 0.01, backoff_max_s: float = 1.0,
+                   sleep: Callable[[float], None] = _time.sleep
+                   ) -> SupervisedResult:
+    """Run ``job`` to completion under the supervisor, injecting
+    ``plan`` (None/empty = no host faults), restarting a killed job
+    with bounded exponential backoff until it completes or
+    ``max_restarts`` is exhausted (:class:`SupervisorGaveUp`).
+
+    ``mode="trampoline"`` restarts in-process (plan kills raise
+    :class:`HostKill`; fast, what the test matrix uses);
+    ``mode="spawn"`` runs each incarnation as a child interpreter and
+    plan kills are REAL ``SIGKILL`` -- the CI crash smoke's mode.
+    ``workdir`` must be fresh per logical run (it holds the rotation
+    checkpoints, the fired-points journal, and -- in spawn mode --
+    the job/result files)."""
+    assert mode in ("trampoline", "spawn"), mode
+    workdir = os.fspath(workdir)
+    os.makedirs(workdir, exist_ok=True)
+    restarts = 0
+    while True:
+        try:
+            if mode == "trampoline":
+                injector = HostFaultInjector(plan, workdir,
+                                             kill_mode="raise")
+                result = _job_loop(job, workdir, injector)
+            else:
+                result = _spawn_once(job, workdir, plan)
+            break
+        except _RESTART_ERRORS as e:
+            restarts += 1
+            if restarts > max_restarts:
+                raise SupervisorGaveUp(
+                    f"{restarts - 1} restarts exhausted "
+                    f"(last kill: {e})") from e
+            sleep(min(backoff_base_s * (2.0 ** (restarts - 1)),
+                      backoff_max_s))
+    from ..obs import device as obsdev
+
+    met = np.asarray(result.metrics, dtype=np.int64).copy()
+    # the resume row counts restarts that restored a snapshot (the
+    # durable journal every incarnation appends to), NOT raw restart
+    # attempts: a replay-from-scratch restart pays a full recompute
+    # and must read as zero resumes
+    resumes = 0
+    resume_log = os.path.join(workdir, RESUME_LOG)
+    if os.path.exists(resume_log):
+        with open(resume_log) as fh:
+            resumes = sum(1 for ln in fh if ln.strip())
+    met[obsdev.MET_SUPERVISOR_RESUMES] = resumes
+    return result._replace(metrics=met, restarts=restarts)
+
+
+def _spawn_once(job: EpochJob, workdir: str,
+                plan: Optional[HostFaultPlan]) -> SupervisedResult:
+    """One child-process incarnation: write the job file, run
+    ``python -m dmclock_tpu.robust.supervisor <workdir>``, read the
+    result back.  A SIGKILLed child leaves no result file and raises
+    :class:`_ChildKilled` for the restart loop."""
+    job_path = os.path.join(workdir, JOB_FILE)
+    res_path = os.path.join(workdir, RESULT_FILE)
+    if os.path.exists(res_path):
+        os.unlink(res_path)
+    with open(job_path, "w") as fh:
+        json.dump({"job": job.to_json(),
+                   "plan": plan_to_json(plan)}, fh)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dmclock_tpu.robust.supervisor",
+         workdir], cwd=os.getcwd(), env=os.environ.copy())
+    if proc.returncode != 0 or not os.path.exists(res_path):
+        raise _ChildKilled(f"child exited {proc.returncode} "
+                           f"({describe_host(plan)})")
+    with open(res_path) as fh:
+        obj = json.load(fh)
+    return SupervisedResult(
+        digest=obj["digest"], state_digest=obj["state_digest"],
+        decisions=int(obj["decisions"]), epochs=int(obj["epochs"]),
+        metrics=np.asarray(obj["metrics"], dtype=np.int64),
+        restarts=0, ladder_steps=obj["ladder_steps"],
+        scrape_rebinds=int(obj["scrape_rebinds"]),
+        resumed_from=obj.get("resumed_from"))
+
+
+def _child_main(workdir: str) -> int:
+    """Spawn-mode child entry: run one incarnation of the job in
+    ``<workdir>/job.json`` with REAL SIGKILL plan points, then write
+    the result atomically.  Platform comes from ``JAX_PLATFORMS`` set
+    by the parent's environment (the image's boot shim ignores plain
+    env vars, so apply it via jax.config before any backend use)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    import jax
+
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    jax.config.update("jax_enable_x64", True)
+
+    with open(os.path.join(workdir, JOB_FILE)) as fh:
+        obj = json.load(fh)
+    job = EpochJob.from_json(obj["job"])
+    plan = plan_from_json(obj.get("plan", {}))
+    injector = HostFaultInjector(plan, workdir, kill_mode="sigkill")
+    result = _job_loop(job, workdir, injector)
+    res_path = os.path.join(workdir, RESULT_FILE)
+    tmp = res_path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"digest": result.digest,
+                   "state_digest": result.state_digest,
+                   "decisions": result.decisions,
+                   "epochs": result.epochs,
+                   "metrics": np.asarray(result.metrics).tolist(),
+                   "ladder_steps": result.ladder_steps,
+                   "scrape_rebinds": result.scrape_rebinds,
+                   "resumed_from": result.resumed_from}, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, res_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_child_main(sys.argv[1]))

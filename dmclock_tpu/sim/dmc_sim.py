@@ -96,8 +96,12 @@ def main(argv=None) -> int:
     if args.metrics_port is not None:
         from ..obs import MetricsRegistry, start_http_server
         registry = MetricsRegistry()
+        # fail-soft: a taken port (e.g. a supervisor restarting this
+        # sim while the old incarnation drains) logs a warning and
+        # runs without a scrape endpoint instead of dying
         http_srv = start_http_server(registry, port=args.metrics_port)
-        print(f"# metrics: serving {http_srv.url}")
+        if http_srv is not None:
+            print(f"# metrics: serving {http_srv.url}")
     try:
         sim = run_sim(cfg, model=args.model, seed=args.seed,
                       server_mode=args.server_mode,

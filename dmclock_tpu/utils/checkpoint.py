@@ -49,6 +49,15 @@ class CheckpointCorruptError(RuntimeError):
 # "sidecar_written", "done"); tests raise from it to simulate a kill
 _crash_hook: Optional[Callable[[str], None]] = None
 
+# fault seam: called with the committed path after a save fully
+# commits (data + sidecar durable, .prev pruned).  robust.host_faults
+# flips payload bytes from it to model media corruption racing a save;
+# restore must then fall back to an older intact rotation entry.
+_post_commit_hook: Optional[Callable[[str], None]] = None
+
+SAVE_STAGES = ("data_written", "data_synced", "data_renamed",
+               "sidecar_written", "done")
+
 
 def _crash(stage: str) -> None:
     if _crash_hook is not None:
@@ -158,6 +167,8 @@ def save_pytree(path, tree: Any) -> None:
         for old in (_prev(path), _sidecar(_prev(path))):
             if os.path.exists(old):
                 os.unlink(old)
+        if _post_commit_hook is not None:
+            _post_commit_hook(path)
     finally:
         for tmp in (tmp_data, tmp_side):
             if os.path.exists(tmp):
@@ -235,6 +246,14 @@ def _rotation_entries(dirpath: str) -> List[Tuple[int, str]]:
         if m:
             out.append((int(m.group(1)), os.path.join(dirpath, name)))
     return sorted(out)
+
+
+def rotation_paths(dirpath) -> List[str]:
+    """Snapshot paths in a rotation directory, oldest to newest (the
+    supervisor's resume log and the corruption-fault targeting both
+    need the on-disk view without reaching into the module's
+    privates)."""
+    return [p for _, p in _rotation_entries(os.fspath(dirpath))]
 
 
 def save_pytree_rotating(dirpath, tree: Any, keep: int = 4) -> str:

@@ -71,6 +71,28 @@ def is_chaos(rec: dict) -> bool:
     return rec.get("fault_plan", "none") != "none"
 
 
+def is_restarted(rec: dict) -> bool:
+    """A supervised session that actually restarted (bench.py under
+    robust.supervisor with DMCLOCK_RESTARTS > 0): its wall time
+    includes resume + replay recovery work, so like a chaos session
+    it extends the trajectory but never enters -- and is never judged
+    against -- the clean-run medians.  A supervised run with ZERO
+    restarts is a clean run (the zero-host-fault gate pins it
+    bit-identical to the bare runner)."""
+    return bool(rec.get("supervised")) and int(rec.get("restarts",
+                                                       0) or 0) > 0
+
+
+def is_degraded(rec: dict) -> bool:
+    """A session where the degradation ladder stepped a fast path
+    down mid-run (bench.py records the step list): the rates are
+    honest for the EFFECTIVE impl, but the step itself means
+    something failed -- the record must neither seed clean-run
+    medians nor pass silently as a normal session, or a real
+    fast-path regression could masquerade as a benign step-down."""
+    return bool(rec.get("degradation_ladder"))
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tolerance", type=float, default=2.0,
@@ -94,8 +116,34 @@ def main() -> int:
         print(f"bench_guard: {n_chaos} chaos (fault-injection) "
               "record(s) in history -- excluded from clean-run "
               "medians")
+    n_restarted = sum(1 for _, r in recs if is_restarted(r))
+    if n_restarted:
+        print(f"bench_guard: {n_restarted} restart-bearing "
+              "supervised record(s) in history -- excluded from "
+              "clean-run medians")
+    n_degraded = sum(1 for _, r in recs if is_degraded(r))
+    if n_degraded:
+        print(f"bench_guard: {n_degraded} ladder-degraded record(s) "
+              "in history -- excluded from clean-run medians")
 
     newest_name, newest = recs[-1]
+    if is_degraded(newest):
+        steps = newest.get("degradation_ladder")
+        print(f"bench_guard: newest record {newest_name} stepped the "
+              f"degradation ladder ({steps}) -- a fast path FAILED "
+              "mid-session and was retried on its exact twin; "
+              "investigate the step reason before trusting this "
+              "session; not judged against clean-run history; pass",
+              file=sys.stderr)
+        return 0
+    if is_restarted(newest):
+        print(f"bench_guard: newest record {newest_name} is a "
+              f"supervised session with "
+              f"{newest.get('restarts')} restart(s) -- its rates "
+              "include resume/replay recovery; recorded for the "
+              "trajectory, not judged against clean-run history; "
+              "pass")
+        return 0
     if is_chaos(newest):
         print(f"bench_guard: newest record {newest_name} is a chaos "
               f"session (fault_plan "
@@ -115,7 +163,8 @@ def main() -> int:
     dev = newest.get("device")
     prior = [(n, r) for n, r in recs[:-1]
              if r.get("device") == dev and not is_fallback(r)
-             and not is_chaos(r)]
+             and not is_chaos(r) and not is_restarted(r)
+             and not is_degraded(r)]
     status = 0
     for wl, row in sorted(newest.get("workloads", {}).items()):
         dps = row.get("dps")

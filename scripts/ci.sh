@@ -226,6 +226,58 @@ print(f"chaos smoke ok (plan {F.describe(plan)}; fault counters match "
       "the injected plan exactly; surviving servers within contract)")
 EOF
 
+echo "== crash smoke (supervised SIGKILL + resume; crash-equivalence digest gate) =="
+# the host-fault spine (docs/ROBUSTNESS.md): (1) the zero-host-fault
+# gate -- a supervisor-wrapped run with an empty HostFaultPlan and the
+# ladder disabled must be BIT-IDENTICAL to the bare runner (digest,
+# final state, metric vector, ladder rows zero); (2) the
+# crash-equivalence gate -- a child-process run REALLY SIGKILLed at a
+# fixed decision count and resumed from the rotation checkpoint must
+# match the uninterrupted reference bit-for-bit (modulo the resume
+# metric row).
+timeout -k 30 900 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import os, tempfile
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"   # inherited by the spawn child
+from dmclock_tpu.obs import device as obsdev
+from dmclock_tpu.robust import host_faults as HF, supervisor as SV
+
+# cfg4-flavored short run: calendar engine, bucketed stop-key ladder
+job = SV.EpochJob(engine="calendar", calendar_impl="bucketed",
+                  ladder_levels=2, n=512, depth=10, ring=16, epochs=6,
+                  m=2, k=8, seed=17, arrival_lam=2.0, waves=4,
+                  ckpt_every=2)
+ref = SV.run_job(job)
+print(f"reference: {ref.decisions} decisions, digest {ref.digest[:16]}")
+
+with tempfile.TemporaryDirectory() as wd:
+    r0 = SV.run_supervised(job, wd, HF.zero_host_plan())
+SV.assert_crash_equivalent(r0, ref)
+assert r0.restarts == 0 and np.array_equal(r0.metrics, ref.metrics)
+assert r0.metrics[obsdev.MET_LADDER_STEPS] == 0
+assert r0.metrics[obsdev.MET_SUPERVISOR_RESUMES] == 0
+print("zero-host-fault gate ok (supervisor-wrapped == bare runner, "
+      "bit-identical; ladder rows zero)")
+
+# kill at the FULL decision count: fires at the last epoch boundary,
+# after two rotation snapshots exist -- the resume must come from one
+kill_at = ref.decisions
+with tempfile.TemporaryDirectory() as wd:
+    plan = HF.HostFaultPlan(kill_at_decisions=(kill_at,))
+    r1 = SV.run_supervised(job, wd, plan, mode="spawn")
+SV.assert_crash_equivalent(r1, ref)
+assert r1.restarts == 1
+assert r1.metrics[obsdev.MET_SUPERVISOR_RESUMES] == 1
+assert r1.resumed_from is not None, \
+    "resume must land on a rotation snapshot, not replay from scratch"
+print(f"crash smoke ok (child SIGKILLed at {kill_at} decisions, "
+      f"resumed from {os.path.basename(r1.resumed_from)}; digest + "
+      "final state + metrics bit-identical modulo resume rows)")
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

@@ -246,6 +246,83 @@ def test_kill_during_inplace_overwrite_keeps_old_snapshot(tmp_path,
     assert not os.path.exists(str(p) + ".prev")
 
 
+# ----------------------------------------------------------------------
+# kill-during-save x SUPERVISED RESUME: every crash stage is followed
+# by a full supervised resume that must land on the newest intact
+# rotation snapshot and pass the crash-equivalence digest gate -- not
+# merely restore without error (robust.supervisor;
+# docs/ROBUSTNESS.md)
+# ----------------------------------------------------------------------
+
+_SUP_CACHE: dict = {}
+
+
+def _supervised_job_and_ref():
+    from dmclock_tpu.robust import supervisor as SV
+
+    if "job" not in _SUP_CACHE:
+        # ckpt_every=1 so the epoch-1 save always has an intact
+        # epoch-0 predecessor to land on when it tears
+        _SUP_CACHE["job"] = SV.EpochJob(
+            engine="prefix", n=64, depth=6, ring=10, epochs=4, m=2,
+            k=32, seed=13, arrival_lam=1.0, waves=2, ckpt_every=1)
+        _SUP_CACHE["ref"] = SV.run_job(_SUP_CACHE["job"])
+    return _SUP_CACHE["job"], _SUP_CACHE["ref"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stage", ["data_written", "data_synced",
+                                   "data_renamed", "sidecar_written",
+                                   "done"])
+def test_kill_during_save_then_supervised_resume(tmp_path, stage):
+    """Kill inside the epoch-1 checkpoint save at every _crash_hook
+    stage.  Pre-commit stages tear ckpt-00000002, so resume must land
+    on the intact epoch-0 snapshot (ckpt-00000001); a kill after full
+    commit ("done") must resume from the JUST-written snapshot, not
+    an older one.  Either way the resumed run is bit-identical to the
+    uninterrupted reference."""
+    from dmclock_tpu.robust import host_faults as HF
+    from dmclock_tpu.robust import supervisor as SV
+
+    job, ref = _supervised_job_and_ref()
+    plan = HF.HostFaultPlan(kill_at_save=((1, stage),))
+    res = SV.run_supervised(job, tmp_path, plan)
+    SV.assert_crash_equivalent(res, ref)
+    assert res.restarts == 1
+    want = "ckpt-00000002" if stage == "done" else "ckpt-00000001"
+    assert res.resumed_from is not None and \
+        res.resumed_from.endswith(want), \
+        (f"kill at {stage}: resumed from {res.resumed_from}, "
+         f"expected the newest intact snapshot {want}")
+    # and the completed run's rotation ends on an intact final-epoch
+    # snapshot a NEXT run could resume from
+    payload, path = restore_pytree_rotating(
+        str(tmp_path / "ckpt"), SV._payload_like(job))
+    assert int(payload["epoch"]) == job.epochs
+    from dmclock_tpu.utils.checkpoint import rotation_paths
+    assert path == rotation_paths(tmp_path / "ckpt")[-1]
+
+
+@pytest.mark.slow
+def test_corrupted_newest_snapshot_supervised_resume(tmp_path):
+    """The corruption-during-save fault: the epoch-1 snapshot commits
+    and then rots; a later kill forces a resume that must walk PAST
+    the corrupt newest entry to the intact epoch-0 one and still pass
+    the digest gate."""
+    from dmclock_tpu.robust import host_faults as HF
+    from dmclock_tpu.robust import supervisor as SV
+
+    job, ref = _supervised_job_and_ref()
+    plan = HF.HostFaultPlan(
+        corrupt_save_at=(1,),
+        kill_at_decisions=(max(3 * ref.decisions // 4, 1),))
+    res = SV.run_supervised(job, tmp_path, plan)
+    SV.assert_crash_equivalent(res, ref)
+    assert res.restarts == 1
+    assert res.resumed_from is not None and \
+        res.resumed_from.endswith("ckpt-00000001")
+
+
 def test_double_crash_keeps_newest_committed_snapshot(tmp_path):
     """Crash AFTER full commit but before the .prev prune, then crash
     the next save mid-commit: fallback must land on the newest fully

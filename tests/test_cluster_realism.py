@@ -214,6 +214,59 @@ def test_metrics_mesh_merge_matches_host(mesh8):
         md["decisions_total"]
 
 
+def test_robust_mesh_merge_matches_host_under_faults(mesh8):
+    """ROBUST-path in-graph metrics merge (the remaining ROADMAP
+    multichip sub-item): under a seeded NONZERO FaultPlan --
+    dropouts, stale counter views, skew, duplicated completions all
+    active -- robust_cluster_step(with_merged=True) must return a
+    mesh-merged (psum counters / pmax hwm) total of the per-shard
+    held-view vectors equal to the host-side metrics_combine_np over
+    those shards, at every step, fault rows included."""
+    import functools
+
+    from dmclock_tpu.obs import device as obsdev
+    from dmclock_tpu.robust import cluster as RC
+    from dmclock_tpu.robust import faults as F
+
+    n_servers, n_clients, steps, k = 8, 10, 6, 16
+    adv = 10 ** 8
+    infos = [ClientInfo(10.0, 1.0 + (c % 3), 0.0)
+             for c in range(n_clients)]
+    cl = CL.init_cluster(n_servers, n_clients)
+    cl = CL.install_clients(
+        cl,
+        jnp.asarray([i.reservation_inv_ns for i in infos], jnp.int64),
+        jnp.asarray([i.weight_inv_ns for i in infos], jnp.int64),
+        jnp.asarray([i.limit_inv_ns for i in infos], jnp.int64))
+    rc = RC.shard_robust(RC.init_robust(CL.shard_cluster(cl, mesh8)),
+                         mesh8)
+    plan = F.sample_plan(23, steps, n_servers, p_dropout=0.25,
+                         mean_outage_steps=2.0, p_delay=0.3,
+                         p_dup=0.2, max_skew_ns=1000)
+    assert F.plan_events(plan)["faults_injected"] > 0, \
+        "seeded plan must be nonzero for this gate"
+    step = jax.jit(functools.partial(
+        RC.robust_cluster_step, cost=1, mesh=mesh8,
+        decisions_per_step=k, advance_ns=adv, with_merged=True))
+    arrivals = jnp.ones((n_servers, n_clients), jnp.int32)
+    for t in range(steps):
+        rc, _decs, merged = step(rc, arrivals,
+                                 fault=F.plan_step(plan, t))
+        shard_np = np.asarray(jax.device_get(rc.metrics))
+        assert shard_np.shape == (n_servers, obsdev.NUM_METRICS)
+        host = obsdev.metrics_combine_np(
+            np.zeros(obsdev.NUM_METRICS, np.int64), *shard_np)
+        assert np.array_equal(host,
+                              np.asarray(jax.device_get(merged))), \
+            f"step {t}: in-graph mesh merge != host-side combine"
+    # the merged total carries the fault rows too, matching the oracle
+    totals = obsdev.metrics_dict(np.asarray(jax.device_get(merged)))
+    ev = F.plan_events(plan)
+    assert totals["server_dropouts"] == ev["server_dropouts"]
+    assert totals["tracker_resyncs"] == ev["tracker_resyncs"]
+    assert totals["faults_injected"] == ev["faults_injected"]
+
+
 @pytest.mark.skipif(os.environ.get("DMCLOCK_FULLSCALE") != "1",
                     reason="large-scale cluster parity is minutes-long; "
                     "run via scripts/run_fullscale.py (CI)")

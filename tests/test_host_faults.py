@@ -1,0 +1,159 @@
+"""Host fault plans (robust.host_faults): deterministic schedules,
+the exactly-once write-ahead injector, checkpoint corruption during
+save, and the scrape endpoint's restart resilience
+(docs/ROBUSTNESS.md)."""
+
+import os
+import urllib.request
+
+import pytest
+
+from dmclock_tpu.engine import init_state
+from dmclock_tpu.obs import MetricsRegistry, start_http_server
+from dmclock_tpu.robust import host_faults as HF
+from dmclock_tpu.utils import checkpoint as ckpt_mod
+
+
+class TestPlans:
+    def test_zero_plan_describes_none(self):
+        assert HF.describe_host(HF.zero_host_plan()) == "none"
+        assert HF.describe_host(None) == "none"
+        assert HF.host_plan_events(None)["restarts"] == 0
+
+    def test_sample_deterministic_across_calls(self):
+        kw = dict(epochs=8, est_decisions=1000, kills=2,
+                  save_kills=1, corrupt_saves=1, scrape_drops=1)
+        assert HF.sample_host_plan(7, **kw) == \
+            HF.sample_host_plan(7, **kw)
+        assert HF.sample_host_plan(7, **kw) != \
+            HF.sample_host_plan(8, **kw)
+
+    def test_sample_targets_checkpointing_epochs(self):
+        plan = HF.sample_host_plan(3, epochs=8, est_decisions=500,
+                                   save_kills=4, corrupt_saves=4,
+                                   ckpt_every=2)
+        for e, stage in plan.kill_at_save:
+            assert (e + 1) % 2 == 0, "save kill on a non-ckpt epoch"
+            assert stage in ckpt_mod.SAVE_STAGES
+        for e in plan.corrupt_save_at:
+            assert (e + 1) % 2 == 0
+
+    def test_events_oracle_and_describe(self):
+        plan = HF.HostFaultPlan(kill_at_decisions=(10, 20),
+                                kill_at_save=((1, "data_renamed"),),
+                                corrupt_save_at=(3,),
+                                drop_scrape_at=(0, 2))
+        ev = HF.host_plan_events(plan)
+        assert ev == {"kills": 2, "save_kills": 1,
+                      "corrupt_saves": 1, "scrape_drops": 2,
+                      "restarts": 3}
+        assert HF.describe_host(plan) == \
+            "host:kill2+savekill1+corrupt1+scrape2"
+
+    def test_json_round_trip(self):
+        plan = HF.sample_host_plan(5, epochs=6, est_decisions=300,
+                                   kills=2, save_kills=1,
+                                   corrupt_saves=1, scrape_drops=1)
+        assert HF.plan_from_json(HF.plan_to_json(plan)) == plan
+        assert HF.plan_from_json(HF.plan_to_json(None)) == \
+            HF.zero_host_plan()
+
+
+class TestInjector:
+    def test_kill_fires_exactly_once_across_restarts(self, tmp_path):
+        plan = HF.HostFaultPlan(kill_at_decisions=(100,))
+        inj = HF.HostFaultInjector(plan, tmp_path)
+        inj.after_decisions(50)          # below the point: no fire
+        with pytest.raises(HF.HostKill):
+            inj.after_decisions(150)
+        # a restarted incarnation (fresh injector, same workdir)
+        # replays past the same threshold without dying again
+        inj2 = HF.HostFaultInjector(plan, tmp_path)
+        inj2.after_decisions(150)
+        inj2.after_decisions(10 ** 9)
+        assert "dec:0" in inj2.fired
+
+    def test_fired_journal_is_durable_before_the_kill(self, tmp_path):
+        inj = HF.HostFaultInjector(
+            HF.HostFaultPlan(kill_at_decisions=(1,)), tmp_path)
+        with pytest.raises(HF.HostKill):
+            inj.after_decisions(5)
+        # the write-ahead journal already names the point (a SIGKILL
+        # right after would still leave it on disk)
+        fired = (tmp_path / HF.HostFaultInjector.FIRED_NAME).read_text()
+        assert "dec:0" in fired
+
+    def test_save_stage_kill_uninstalls_the_hook(self, tmp_path):
+        plan = HF.HostFaultPlan(kill_at_save=((0, "data_renamed"),))
+        inj = HF.HostFaultInjector(plan, tmp_path)
+        rot = tmp_path / "rot"
+        st = init_state(8, 4)
+        with pytest.raises(HF.HostKill):
+            inj.around_save(
+                0, lambda: ckpt_mod.save_pytree_rotating(rot, st))
+        assert ckpt_mod._crash_hook is None
+        assert ckpt_mod._post_commit_hook is None
+        # the torn entry is not restorable, and a retried save (the
+        # point is spent) commits cleanly
+        inj.around_save(
+            0, lambda: ckpt_mod.save_pytree_rotating(rot, st))
+        _, path = ckpt_mod.restore_pytree_rotating(rot, init_state(8, 4))
+        assert path == ckpt_mod.rotation_paths(rot)[-1]
+
+    def test_corrupt_save_pair_fails_verification(self, tmp_path):
+        plan = HF.HostFaultPlan(corrupt_save_at=(0,))
+        inj = HF.HostFaultInjector(plan, tmp_path)
+        rot = tmp_path / "rot"
+        st = init_state(8, 4)
+        ckpt_mod.save_pytree_rotating(rot, st)      # intact predecessor
+        inj.around_save(
+            0, lambda: ckpt_mod.save_pytree_rotating(rot, st))
+        paths = ckpt_mod.rotation_paths(rot)
+        assert len(paths) == 2
+        with pytest.raises(ckpt_mod.CheckpointCorruptError):
+            ckpt_mod.restore_pytree(paths[-1], init_state(8, 4))
+        # rotation restore walks back to the intact predecessor
+        _, path = ckpt_mod.restore_pytree_rotating(rot, init_state(8, 4))
+        assert path == paths[0]
+
+
+class TestScrapeEndpointResilience:
+    def test_repeated_start_on_taken_port_fails_soft(self, capsys):
+        reg = MetricsRegistry()
+        srv = start_http_server(reg, port=0)
+        assert srv is not None
+        try:
+            dup = start_http_server(MetricsRegistry(), port=srv.port)
+            assert dup is None, "second bind on a live port must " \
+                "fail soft, not raise"
+            assert "scrape endpoint disabled" in \
+                capsys.readouterr().err
+        finally:
+            srv.close()
+
+    def test_rebind_same_port_after_close(self):
+        """The supervisor-restart scenario: the old incarnation's
+        server is gone, the new one takes the same port immediately
+        (SO_REUSEADDR -- no TIME_WAIT stall) and serves scrapes."""
+        reg = MetricsRegistry()
+        reg.counter("dmclock_test_total", "t").inc(3)
+        srv = start_http_server(reg, port=0)
+        port = srv.port
+        srv.close()
+        srv2 = start_http_server(reg, port=port)
+        assert srv2 is not None and srv2.port == port
+        try:
+            body = urllib.request.urlopen(srv2.url,
+                                          timeout=5).read().decode()
+            assert "dmclock_test_total 3" in body
+        finally:
+            srv2.close()
+
+    def test_fail_soft_off_raises(self):
+        srv = start_http_server(MetricsRegistry(), port=0)
+        try:
+            with pytest.raises(OSError):
+                start_http_server(MetricsRegistry(), port=srv.port,
+                                  fail_soft=False)
+        finally:
+            srv.close()

@@ -1,0 +1,271 @@
+"""Supervised crash-equivalent runs (robust.supervisor;
+docs/ROBUSTNESS.md).
+
+The headline gate: a run killed at ANY HostFaultPlan point and
+resumed from the rotation checkpoint produces the same
+decision-stream digest, final engine state, and metric totals
+(modulo the resume rows) as the uninterrupted run -- for all three
+epoch engines and both select_impl/calendar_impl fast paths.  Plus
+the zero-cost-when-off gate (supervisor-wrapped == bare runner,
+bit-identical), the degradation ladder, and bounded restarts."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dmclock_tpu.obs import device as obsdev
+from dmclock_tpu.robust import host_faults as HF
+from dmclock_tpu.robust import supervisor as SV
+from dmclock_tpu.robust.guarded import (LADDER_RUNGS,
+                                        DegradationLadder)
+from dmclock_tpu.utils import checkpoint as ckpt_mod
+
+# one small job per engine/fast-path combination; module-level cache
+# of the bare reference runs (each parametrized case reuses its
+# engine's reference instead of re-running it)
+ENGINE_JOBS = {
+    "prefix-sort": SV.EpochJob(engine="prefix", select_impl="sort"),
+    "prefix-radix": SV.EpochJob(engine="prefix", select_impl="radix"),
+    "prefix-tag32": SV.EpochJob(engine="prefix", tag_width=32),
+    "chain": SV.EpochJob(engine="chain", chain_depth=3, k=32),
+    "calendar-minstop": SV.EpochJob(engine="calendar", k=4,
+                                    calendar_impl="minstop"),
+    "calendar-bucketed": SV.EpochJob(engine="calendar", k=4,
+                                     calendar_impl="bucketed",
+                                     ladder_levels=2),
+}
+ENGINE_JOBS = {
+    name: dataclasses.replace(job, n=96, depth=6, ring=10, epochs=4,
+                              m=2, seed=5, arrival_lam=1.0, waves=2,
+                              ckpt_every=2)
+    for name, job in ENGINE_JOBS.items()
+}
+
+_REFS: dict = {}
+
+
+def ref_of(name: str) -> SV.SupervisedResult:
+    if name not in _REFS:
+        _REFS[name] = SV.run_job(ENGINE_JOBS[name])
+    return _REFS[name]
+
+
+class TestCrashEquivalence:
+    @pytest.mark.parametrize("name", sorted(ENGINE_JOBS))
+    def test_kill_mid_run_resumes_bit_identical(self, tmp_path, name):
+        """SIGKILL (trampoline form) between two checkpoints -- the
+        resumed run must be bit-identical to the uninterrupted one."""
+        job, ref = ENGINE_JOBS[name], ref_of(name)
+        assert ref.decisions > 0
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(ref.decisions // 2, 1),))
+        res = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 1
+        # the resume row counts CHECKPOINT resumes only: a kill
+        # before the first rotation snapshot replays from scratch
+        # (restart without resume) and must read zero there
+        assert res.metrics[obsdev.MET_SUPERVISOR_RESUMES] == \
+            (1 if res.resumed_from else 0)
+
+    def test_two_kills_two_resumes(self, tmp_path):
+        name = "prefix-sort"
+        job, ref = ENGINE_JOBS[name], ref_of(name)
+        plan = HF.HostFaultPlan(kill_at_decisions=(
+            max(ref.decisions // 3, 1), max(2 * ref.decisions // 3, 2)))
+        res = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 2
+
+    def test_zero_host_fault_gate(self, tmp_path):
+        """Supervisor-wrapped run with an EMPTY plan and the ladder
+        disabled is bit-identical to the bare runner -- including the
+        metric vector, strictly (no resume rows, ladder rows zero)."""
+        name = "prefix-sort"
+        job, ref = ENGINE_JOBS[name], ref_of(name)
+        res = SV.run_supervised(job, tmp_path, HF.zero_host_plan())
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 0
+        assert np.array_equal(res.metrics, ref.metrics)
+        assert res.metrics[obsdev.MET_LADDER_STEPS] == 0
+        assert res.metrics[obsdev.MET_SUPERVISOR_RESUMES] == 0
+        assert res.ladder_steps == []
+
+    def test_kill_during_save_lands_on_newest_intact(self, tmp_path):
+        """A kill INSIDE the epoch-1 checkpoint save tears that
+        snapshot; resume must land on the newest intact entry and
+        still pass the digest gate, and the final rotation must end
+        on an intact final-epoch snapshot."""
+        name = "prefix-sort"
+        job, ref = ENGINE_JOBS[name], ref_of(name)
+        plan = HF.HostFaultPlan(kill_at_save=((1, "data_renamed"),))
+        res = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 1
+        payload, _ = ckpt_mod.restore_pytree_rotating(
+            str(tmp_path / "ckpt"), SV._payload_like(job))
+        assert int(payload["epoch"]) == job.epochs
+
+    def test_corrupt_save_falls_back_to_older_snapshot(self,
+                                                       tmp_path):
+        """Epoch-1's save commits then rots on disk; a later kill
+        forces a resume that must walk past the corrupt entry (to
+        scratch here -- it was the only snapshot) and stay
+        bit-identical."""
+        name = "prefix-radix"
+        job, ref = ENGINE_JOBS[name], ref_of(name)
+        plan = HF.HostFaultPlan(
+            corrupt_save_at=(1,),
+            kill_at_decisions=(max(3 * ref.decisions // 4, 1),))
+        res = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 1
+
+    def test_bounded_restarts_give_up(self, tmp_path):
+        name = "prefix-sort"
+        job, ref = ENGINE_JOBS[name], ref_of(name)
+        points = tuple(max(ref.decisions * (i + 1) // 8, i + 1)
+                       for i in range(3))
+        plan = HF.HostFaultPlan(kill_at_decisions=points)
+        with pytest.raises(SV.SupervisorGaveUp):
+            SV.run_supervised(job, tmp_path, plan, max_restarts=1)
+
+
+class TestScrapeLoss:
+    def test_scrape_drop_rebinds_and_run_unperturbed(self, tmp_path):
+        name = "prefix-sort"
+        ref = ref_of(name)
+        job = dataclasses.replace(ENGINE_JOBS[name], metrics_port=0)
+        plan = HF.HostFaultPlan(drop_scrape_at=(1,))
+        res = SV.run_supervised(job, tmp_path, plan)
+        # losing (and rebinding) the scrape port is pure telemetry:
+        # the decision stream and metrics cannot move
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 0
+        assert res.scrape_rebinds >= 1
+
+
+class TestDegradationLadder:
+    def test_rung_order_and_encode_round_trip(self):
+        ladder = DegradationLadder(threshold=2)
+        cfg = {"calendar_impl": "bucketed", "select_impl": "radix",
+               "tag_width": 32}
+        stepped = []
+        for _ in range(12):
+            c = ladder.apply(cfg)
+            if ladder.note_epoch(c, guard_trips=1):
+                stepped.append(ladder.steps[-1].knob)
+        assert stepped == [k for k, _, _ in LADDER_RUNGS]
+        assert ladder.apply(cfg) == {"calendar_impl": "minstop",
+                                     "select_impl": "sort",
+                                     "tag_width": 64}
+        # fully degraded: nothing left to concede
+        assert ladder.note_epoch(ladder.apply(cfg), guard_trips=1) == 0
+        clone = DegradationLadder(threshold=2)
+        clone.load(ladder.encode())
+        assert clone.apply(cfg) == ladder.apply(cfg)
+
+    def test_clean_epochs_reset_the_trip_counter(self):
+        ladder = DegradationLadder(threshold=2)
+        cfg = {"select_impl": "radix"}
+        assert ladder.note_epoch(cfg, guard_trips=1) == 0
+        assert ladder.note_epoch(cfg) == 0            # clean: reset
+        assert ladder.note_epoch(cfg, guard_trips=1) == 0
+        assert ladder.note_epoch(cfg, launch_failures=1) == 1
+        assert ladder.steps[0].reason == "launch_failures"
+
+    def test_disabled_ladder_is_inert(self):
+        ladder = DegradationLadder(enabled=False)
+        cfg = {"select_impl": "radix"}
+        for _ in range(5):
+            assert ladder.note_epoch(cfg, guard_trips=3) == 0
+        assert ladder.apply(cfg) == cfg and ladder.steps_taken == 0
+
+    def test_launch_failure_escalation_steps_down(self, tmp_path,
+                                                  monkeypatch):
+        """A recoverable error that survives the guarded runner's
+        bounded retries is the ladder's launch-failure signal: the
+        epoch is re-attempted on the stepped-down exact path instead
+        of dying.  Recovered retries are NOT an escalation."""
+        calls = []
+        real = SV.run_epoch_guarded
+
+        def flaky(state, now, **kw):
+            calls.append(kw["select_impl"])
+            if kw["select_impl"] == "radix":
+                raise TimeoutError("wedged tunnel")
+            return real(state, now, **kw)
+
+        monkeypatch.setattr(SV, "run_epoch_guarded", flaky)
+        # DEFAULT threshold=2: each failed attempt counts, so the
+        # second consecutive failure steps the rung -- the escalation
+        # must be reachable without tuning the threshold down
+        job = dataclasses.replace(ENGINE_JOBS["prefix-radix"],
+                                  ladder=True)
+        res = SV.run_supervised(job, tmp_path, HF.zero_host_plan())
+        assert [s["knob"] for s in res.ladder_steps] == \
+            ["select_impl"]
+        assert res.ladder_steps[0]["reason"] == "launch_failures"
+        assert res.metrics[obsdev.MET_LADDER_STEPS] == 1
+        assert res.restarts == 0          # handled below a restart
+        assert calls[:3] == ["radix", "radix", "sort"]
+
+    def test_persistent_error_restarts_then_gives_up(self, tmp_path,
+                                                     monkeypatch):
+        """With the ladder off (or exhausted), a persistent
+        recoverable error is 'the runner died': the trampoline
+        restarts from the checkpoint like a kill, bounded by
+        max_restarts."""
+        def dead(*_a, **_k):
+            raise TimeoutError("tunnel never came back")
+
+        monkeypatch.setattr(SV, "run_epoch_guarded", dead)
+        with pytest.raises(SV.SupervisorGaveUp):
+            SV.run_supervised(ENGINE_JOBS["prefix-sort"], tmp_path,
+                              HF.zero_host_plan(), max_restarts=2,
+                              backoff_base_s=0.0)
+
+    def test_supervised_tag32_trips_step_down_to_int64(self,
+                                                       tmp_path):
+        """A real ladder engagement: one client's proportion tag sits
+        past the +-2^31 ns rebase window, so every tag32 epoch trips
+        and resumes on int64 (guarded contract).  With the ladder on,
+        two consecutive trips step tag_width 32 -> 64 -- visible in
+        the obs row and the step list -- and the killed+resumed run
+        still matches its own uninterrupted reference (ladder
+        position rides in the checkpoint)."""
+        job = dataclasses.replace(
+            ENGINE_JOBS["prefix-tag32"], tag_spread_ns=2 ** 32,
+            ladder=True, ladder_threshold=2, epochs=6)
+        ref = SV.run_job(job)
+        assert ref.metrics[obsdev.MET_REBASE_FALLBACKS] >= 2
+        assert ref.metrics[obsdev.MET_LADDER_STEPS] == 1
+        assert [s["knob"] for s in ref.ladder_steps] == ["tag_width"]
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(ref.decisions // 2, 1),))
+        res = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)
+        # a resumed ladder reloads engaged rungs from the checkpoint
+        # (reason reads "resumed"); the POSITION must match exactly
+        assert [(s["knob"], s["from"], s["to"])
+                for s in res.ladder_steps] == \
+            [(s["knob"], s["from"], s["to"])
+             for s in ref.ladder_steps]
+
+
+@pytest.mark.slow
+class TestSpawnMode:
+    def test_real_sigkill_child_resumes_bit_identical(self, tmp_path,
+                                                      monkeypatch):
+        """Spawn mode: each incarnation is a child interpreter and the
+        plan point is a REAL SIGKILL -- the closest in-repo stand-in
+        for the production runner dying mid-bench."""
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        name = "prefix-sort"
+        job, ref = ENGINE_JOBS[name], ref_of(name)
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(ref.decisions // 2, 1),))
+        res = SV.run_supervised(job, tmp_path, plan, mode="spawn")
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 1
