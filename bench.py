@@ -299,7 +299,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     conformance_out: str = None,
                     select_impl: str = "sort",
                     calendar_impl: str = "minstop",
-                    ladder_levels: int = 8):
+                    ladder_levels: int = 8,
+                    telemetry: bool = True):
     """Closed loop: Poisson superwave ingest + prefix serve epoch per
     round, chained async on device; ingest IS inside the timed region.
 
@@ -313,6 +314,7 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                                              scan_chain_epoch,
                                              scan_prefix_epoch)
     from dmclock_tpu.obs import device as obsdev
+    from dmclock_tpu.obs import histograms as obshist
     from profile_util import scalar_latency, state_digest
 
     # ``split_resv`` > 0 models split-population multi-tenancy: that
@@ -356,7 +358,21 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     cost = jnp.ones((n,), dtype=jnp.int64)
     dt_wave = dt_round_ns // waves
 
-    def round_fn(st, counts, t_base):
+    # device telemetry accumulators (histograms + per-client ledger;
+    # docs/OBSERVABILITY.md): threaded through every round AS CARRIED
+    # STATE so chained rounds accumulate on device and the host
+    # fetches once, untimed, at the end -- the async-drain discipline
+    # the flight recorder uses.  The accumulation itself runs inside
+    # the timed kernels (telemetry in the data path is the point);
+    # --telemetry off A/Bs that cost, decisions bit-identical.
+    def tele_zero():
+        return (obshist.hist_zero(), obshist.ledger_zero(n)) \
+            if telemetry else ()
+
+    tele = tele_zero()
+
+    def round_fn(st, counts, t_base, tele):
+        th, tl = tele if telemetry else (None, None)
         headroom = jnp.maximum(
             st.ring_capacity - st.depth, 0).astype(jnp.int32)
         # admission clamp (the AtLimit Reject/EAGAIN analog); the drop
@@ -385,17 +401,20 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                                      anticipation_ns=0,
                                      with_metrics=with_metrics,
                                      calendar_impl=calendar_impl,
-                                     ladder_levels=ladder_levels)
+                                     ladder_levels=ladder_levels,
+                                     hists=th, ledger=tl)
             return (ep.state, ep.count, ep.progress_ok,
                     ep.resv_count, ep.served,
                     jnp.ones_like(ep.served),
-                    obsdev.metrics_combine(ep.metrics, drop_met))
+                    obsdev.metrics_combine(ep.metrics, drop_met),
+                    (ep.hists, ep.ledger) if telemetry else ())
         if chain_depth > 1:
             ep = scan_chain_epoch(st, now, m, k,
                                   chain_depth=chain_depth,
                                   anticipation_ns=0,
                                   with_metrics=with_metrics,
-                                  select_impl=select_impl)
+                                  select_impl=select_impl,
+                                  hists=th, ledger=tl)
             units = ep.slot >= 0
             lens = ep.length.astype(jnp.int32)
             # a unit's entry serve is weight-phase iff class >= 1;
@@ -406,20 +425,26 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         else:
             ep = scan_prefix_epoch(st, now, m, k, anticipation_ns=0,
                                    with_metrics=with_metrics,
-                                   select_impl=select_impl)
+                                   select_impl=select_impl,
+                                   hists=th, ledger=tl)
             srv_pos = ep.slot >= 0
             resv = jnp.sum(srv_pos & (ep.phase == 0),
                            axis=1).astype(jnp.int32)
             lens = srv_pos.astype(jnp.int32)
         return (ep.state, ep.count, ep.guards_ok, resv, ep.slot, lens,
-                obsdev.metrics_combine(ep.metrics, drop_met))
+                obsdev.metrics_combine(ep.metrics, drop_met),
+                (ep.hists, ep.ledger) if telemetry else ())
 
     # AOT lower+compile with a zero-arrivals sample (same avals as the
     # real draws, and the Poisson stream stays byte-identical to prior
     # sessions): one compilation serves the whole bench and carries the
     # per-epoch cost_analysis attribution
-    run = jax.jit(round_fn, donate_argnums=(0,)).lower(
-        state, jnp.zeros((n,), jnp.int32), jnp.int64(0)).compile()
+    # the telemetry accumulators are donated alongside the state: they
+    # are pure carried state, and an un-donated [N, 5] ledger would
+    # pay a fresh HBM allocation every round
+    run = jax.jit(round_fn, donate_argnums=(0, 3)).lower(
+        state, jnp.zeros((n,), jnp.int32), jnp.int64(0),
+        tele).compile()
     cost = epoch_cost_analysis(run)
     rng = np.random.default_rng(11)
 
@@ -443,7 +468,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     #    proportionally larger reservation floor to stay at the same
     #    phase mix.  The damped multiplicative update converges in a
     #    few iterations; the measured share is reported.
-    state, _, _, _, _, _, _ = run(state, draw(), jnp.int64(0))
+    state, _, _, _, _, _, _, tele = run(state, draw(), jnp.int64(0),
+                                        tele)
     jax.device_get(state_digest(state))
     t_base = dt_round_ns
     cal_iters = 5 if (calendar_steps or target_resv_share) else 1
@@ -453,8 +479,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         resv_total = 0
         cal_rounds = 2
         for _ in range(cal_rounds):
-            state, cnt_, _, resv_, slot, lens, _ = run(
-                state, draw(), jnp.int64(t_base))
+            state, cnt_, _, resv_, slot, lens, _, tele = run(
+                state, draw(), jnp.int64(t_base), tele)
             t_base += dt_round_ns
             resv_total += int(jax.device_get(resv_).sum())
             if calendar_steps:
@@ -516,14 +542,18 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     jax.block_until_ready(pre)
 
     met_acc = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
+    # calibration's warm-up serves pollute the distribution: reset the
+    # telemetry accumulators so the reported percentiles cover the
+    # measured steady state only
+    tele = tele_zero()
 
     def chain(idx):
-        nonlocal state, t_base, met_acc
+        nonlocal state, t_base, met_acc, tele
         t0 = time.perf_counter()
         counts_out, resv_out, guards, mets = [], [], [], []
         for i in idx:
-            state, cnt, g, resv, _, _, met_ = run(
-                state, pre[i], jnp.int64(t_base))
+            state, cnt, g, resv, _, _, met_, tele = run(
+                state, pre[i], jnp.int64(t_base), tele)
             counts_out.append(cnt)
             resv_out.append(resv)
             guards.append(g)
@@ -622,8 +652,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         # harness's table (SimReport.conformance), at bench scale
         served_c = np.zeros(n, dtype=np.int64)
         for _ in range(conformance_rounds):
-            state, _c, _g, _r, slot, lens, _m = run(
-                state, draw(), jnp.int64(t_base))
+            state, _c, _g, _r, slot, lens, _m, tele = run(
+                state, draw(), jnp.int64(t_base), tele)
             t_base += dt_round_ns
             if calendar_steps:
                 served_c += jax.device_get(slot).astype(np.int64)
@@ -703,8 +733,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         pending: deque = deque()
         marks = []
         for i in range(n_rounds):
-            state, cnt, _, _, _, _, _ = run(state, pre2[i],
-                                            jnp.int64(t_base))
+            state, cnt, _, _, _, _, _, tele = run(
+                state, pre2[i], jnp.int64(t_base), tele)
             t_base += dt_round_ns
             pending.append(cnt)
             if len(pending) >= w:
@@ -725,6 +755,29 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         out["round_ms_p50"] = float(np.percentile(samples_ms, 50))
         out["round_ms_p99"] = float(np.percentile(samples_ms, 99))
         out["round_ms_mean"] = round_est * 1e3
+
+    if telemetry:
+        # ONE untimed fetch of the device accumulators (steady-state
+        # rounds only; calibration was excluded by the reset above).
+        # p50/p90/p99 come from the log2 reservation-tardiness
+        # histogram (upper-bound-of-bucket, so never under-reported);
+        # max/mean cross the per-client ledger -- the device-truth
+        # replacement for the sims' host-side recomputation.
+        h_np = np.asarray(jax.device_get(tele[0]), dtype=np.int64)
+        led_np = np.asarray(jax.device_get(tele[1]), dtype=np.int64)
+        lt = obshist.ledger_totals(led_np)
+        for q, key in ((0.50, "tardiness_p50_ns"),
+                       (0.90, "tardiness_p90_ns"),
+                       (0.99, "tardiness_p99_ns")):
+            out[key] = obshist.hist_percentile(
+                h_np, obshist.HIST_RESV_TARDINESS, q)
+        out["tardiness_mean_ns"] = obshist.hist_mean(
+            h_np, obshist.HIST_RESV_TARDINESS)
+        out["tardiness_max_ns"] = float(lt["tardiness_max_ns"])
+        out["telemetry"] = {"histograms": obshist.hist_dict(h_np),
+                            "ledger_totals": lt}
+        out["_hist_block"] = h_np.tolist()   # registry feed; stripped
+        #                                      by main before emit
     return out
 
 
@@ -852,17 +905,40 @@ def _switch_to_cpu_backend() -> None:
             pass
 
 
+def _probe_backend_eager() -> None:
+    """Force one real eager dispatch through the backend.
+
+    ``jax.devices()`` succeeding is NOT proof the backend works: the
+    BENCH_r05 rc=1 failure raised at an eager ``_convert_element_type``
+    during the first array bind -- after device enumeration passed and
+    before any jitted dispatch, a window neither the init fallback nor
+    the dispatch fallback covered.  This probe walks that exact path
+    (eager convert + compute + fetch) so a dead tunnel is caught
+    BEFORE the bench builds any state on it."""
+    x = jnp.asarray(np.arange(4, dtype=np.int32))
+    y = (x.astype(jnp.int64) + 1).sum()       # eager convert + compute
+    # explicit raise, not assert: under PYTHONOPTIMIZE an assert (and
+    # the device_get inside it) would be stripped, silently skipping
+    # the transfer leg the probe exists to exercise
+    if int(jax.device_get(y)) != 10:
+        raise RuntimeError("backend probe computed garbage")
+
+
 def _resolve_backend():
-    """Probe the accelerator backend, falling back to CPU when setup
-    fails (BENCH_r05: the tunneled TPU runtime raised RuntimeError in
-    backend init and the whole bench crashed with rc=1 and no JSON
-    line).  Returns (platform, fallback, error_str)."""
+    """Probe the accelerator backend BEFORE any eager array creation,
+    falling back to CPU when setup fails (BENCH_r05: the tunneled TPU
+    runtime raised at backend init / first eager bind and the whole
+    bench crashed with rc=1 and no JSON line).  Returns (platform,
+    fallback, error_str)."""
     try:
-        return jax.devices()[0].platform, False, None
+        platform = jax.devices()[0].platform
+        _probe_backend_eager()
+        return platform, False, None
     except Exception as e:  # RuntimeError from backend setup, usually
         err = f"{type(e).__name__}: {e}"
         try:
-            jax.config.update("jax_platforms", "cpu")
+            _switch_to_cpu_backend()
+            _probe_backend_eager()
             return jax.devices()[0].platform, True, err
         except Exception as e2:     # even CPU failed: report, no crash
             return "none", True, f"{err}; cpu fallback: {e2}"
@@ -910,6 +986,15 @@ def main() -> None:
                     help="accumulate the on-device obs vector inside "
                     "the timed kernels (bit-identical decisions either "
                     "way; 'off' measures the metrics overhead itself)")
+    ap.add_argument("--telemetry", choices=["on", "off"],
+                    default="on",
+                    help="accumulate the device QoS telemetry plane "
+                    "(log2 histograms + per-client conformance "
+                    "ledger, obs.histograms) inside the timed "
+                    "sustained kernels; decisions are bit-identical "
+                    "either way, and the JSON line carries "
+                    "p50/p90/p99 reservation tardiness from the "
+                    "device ledger ('off' measures the overhead)")
     ap.add_argument("--conformance-out", metavar="FILE", default=None,
                     help="write the cfg4 per-client conformance table "
                     "as JSONL")
@@ -959,6 +1044,7 @@ def main() -> None:
     backend, fallback, backend_err = _resolve_backend()
     backend_fallback = None   # "dispatch" after a launch-time switch
     wm = args.device_metrics == "on"
+    tele_on = args.telemetry == "on"
     from dmclock_tpu.robust.guarded import DegradationLadder
     ladder = DegradationLadder(enabled=not args.no_ladder,
                                threshold=1)
@@ -1065,7 +1151,7 @@ def main() -> None:
                     10_000, 4096, 32, 60, zipf=False, resv_rate=100.0,
                     dt_round_ns=100_000_000, ring=256, depth0=128,
                     rounds_lo=20, with_metrics=wm,
-                    select_impl=select_impl))
+                    select_impl=select_impl, telemetry=tele_on))
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster
@@ -1094,7 +1180,8 @@ def main() -> None:
                         reps=4, with_metrics=wm,
                         calendar_impl=calendar_impl,
                         ladder_levels=args.ladder_levels,
-                        conformance_out=args.conformance_out))
+                        conformance_out=args.conformance_out,
+                        telemetry=tele_on))
                 key = "cfg4" if eff["calendar_impl"] == "minstop" \
                     else "cfg4_bucketed"
                 results.setdefault(key, row)
@@ -1102,20 +1189,35 @@ def main() -> None:
 
     with trace_ctx:
         try:
-            results = run_workloads(backend)
-        except RuntimeError as e:
-            if not _is_backend_error(e):
-                raise
-            # the init-time probe passed but the FIRST dispatch
-            # raised (BENCH_r05): switch to cpu and re-enter, keeping
-            # the guaranteed JSON line
-            print(f"# backend failed at dispatch ({e}); "
-                  "re-entering on cpu", file=sys.stderr)
+            try:
+                results = run_workloads(backend)
+            except RuntimeError as e:
+                if not _is_backend_error(e):
+                    raise
+                # the init-time probe passed but the FIRST dispatch
+                # raised (BENCH_r05): switch to cpu and re-enter,
+                # keeping the guaranteed JSON line
+                print(f"# backend failed at dispatch ({e}); "
+                      "re-entering on cpu", file=sys.stderr)
+                backend_err = f"{type(e).__name__}: {e}"
+                _switch_to_cpu_backend()
+                backend, fallback = "cpu", True
+                backend_fallback = "dispatch"
+                results = run_workloads("cpu")
+        except Exception as e:
+            # the unkillable-bench contract (ROADMAP): EVERY round
+            # exits rc=0 with a valid JSON line, even when the tunnel
+            # dies mid-run in a shape no fallback anticipated -- a
+            # null round (BENCH_r05) costs the trajectory more than a
+            # tagged failure record does
+            import traceback
+            traceback.print_exc()
             backend_err = f"{type(e).__name__}: {e}"
-            _switch_to_cpu_backend()
-            backend, fallback = "cpu", True
-            backend_fallback = "dispatch"
-            results = run_workloads("cpu")
+            emit({"metric": f"bench failed mid-run "
+                            f"({type(e).__name__}); no usable rate",
+                  "value": 0.0, "unit": "decisions/sec/chip",
+                  "vs_baseline": 0.0, "error": backend_err})
+            return
 
     if not results:
         emit({"metric": "sustained workloads skipped on cpu fallback "
@@ -1156,6 +1258,19 @@ def main() -> None:
             f"{r4.get('round_ms_p99', 0):.0f}ms tunnel-inclusive "
             f"upper bounds)")
 
+    # device histogram blocks feed the live scrape registry per
+    # workload (proper Prometheus _bucket/_sum/_count families), then
+    # leave the result rows -- the JSON line carries the readable
+    # "telemetry" digest instead of the raw block twice
+    for wl, row in results.items():
+        hb = row.pop("_hist_block", None)
+        if hb is not None:
+            from dmclock_tpu.obs import default_registry
+            from dmclock_tpu.obs import histograms as obshist
+            obshist.publish_hists(default_registry(),
+                                  np.asarray(hb, dtype=np.int64),
+                                  labels={"workload": wl})
+
     try:
         _record_history(results, fault_plan=args.fault_plan,
                         supervised=args.supervised, restarts=restarts,
@@ -1190,6 +1305,18 @@ def main() -> None:
                if "bounded_by" in row}
     if bounded:
         final["bounded_by"] = bounded
+    # real tardiness percentiles from the device telemetry plane (the
+    # sims' host-computed table, replaced by device truth at bench
+    # scale); log2-quantized upper bounds, never under-reported
+    tard = {wl: {"p50": row["tardiness_p50_ns"],
+                 "p90": row["tardiness_p90_ns"],
+                 "p99": row["tardiness_p99_ns"],
+                 "mean": row["tardiness_mean_ns"],
+                 "max": row["tardiness_max_ns"]}
+            for wl, row in results.items()
+            if "tardiness_p99_ns" in row}
+    if tard:
+        final["tardiness_ns"] = tard
     emit(final)
 
 

@@ -77,6 +77,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..core.timebase import MAX_TAG, MIN_TAG
 from ..obs import device as obsdev
+from ..obs import flight as obsflight
+from ..obs import histograms as obshist
 from . import kernels
 from .kernels import (KEY_INF, NONE, RETURNING, Decision, _make_tag,
                       _fold_prev)
@@ -987,6 +989,10 @@ class PrefixEpoch(NamedTuple):
     lb: jnp.ndarray        # bool[M, k]  limit-break serves (Allow)
     metrics: jnp.ndarray   # int64[NUM_METRICS] (zeros unless
     #                        with_metrics; rides the same readback)
+    # telemetry plane (None unless the caller passed an accumulator):
+    hists: object = None   # int64[NUM_HISTS, NUM_BUCKETS+1]
+    ledger: object = None  # int64[N, LED_COLS]
+    flight: object = None  # obs.flight.FlightState
 
 
 def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
@@ -1021,13 +1027,117 @@ def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
         cal_ladder_fallbacks=ladder_fallbacks))
 
 
+def _telemetry_delta(st_post: EngineState, now, cls, key, served_pc,
+                     resv_pc, lb_pc, count, with_hists: bool,
+                     with_ledger: bool):
+    """One batch/level's telemetry contribution (``obs.histograms``):
+    pure reductions over the entry classification the batch already
+    computed and the pre/post depth delta, so the decision stream
+    cannot be perturbed.  Returns ``(hist_delta | None,
+    ledger_delta | None)``; the caller folds them gated on batch
+    liveness (the tag32 dead-batch rule, exactly like
+    ``_batch_metrics``).
+
+    Tardiness/latency are ENTRY-HEAD observations: ``max(now - key,
+    0)`` against the committed unit's unified entry key -- the
+    reservation deadline for class-0 entries, the effective proportion
+    tag for class-1/2 entries (0 = served at/ahead of its virtual
+    tag).  The stall observation is the time until the earliest queued
+    head becomes eligible, read from the post-batch state."""
+    m = served_pc > 0
+    tard = jnp.maximum(jnp.asarray(now, jnp.int64) - key, 0)
+    resv_entry = m & (cls == CLS_RESV)
+    w_entry = m & (cls >= CLS_WEIGHT) & (cls < CLS_NONE)
+    hd = ld = None
+    if with_hists:
+        hd = obshist.hist_zero()
+        hd = obshist.hist_observe(hd, obshist.HIST_DECISION_LATENCY,
+                                  tard, w_entry)
+        hd = obshist.hist_observe(hd, obshist.HIST_RESV_TARDINESS,
+                                  tard, resv_entry)
+        queued = st_post.active & (st_post.depth > 0)
+        stalled = (count == 0) & jnp.any(queued)
+        next_elig = jnp.min(jnp.where(
+            queued, jnp.minimum(st_post.head_resv, st_post.head_limit),
+            MAX_TAG))
+        hd = obshist.hist_observe_scalar(
+            hd, obshist.HIST_LIMIT_STALL,
+            jnp.maximum(next_elig - now, 0), stalled)
+        hd = obshist.hist_observe_scalar(
+            hd, obshist.HIST_COMMIT_SIZE, count.astype(jnp.int64), 1)
+    if with_ledger:
+        t = jnp.where(resv_entry, tard, 0)
+        ld = jnp.stack([served_pc.astype(jnp.int64),
+                        resv_pc.astype(jnp.int64),
+                        lb_pc.astype(jnp.int64), t, t], axis=1)
+    return hd, ld
+
+
+def _tele_init(state: EngineState, hists, ledger, flight) -> dict:
+    """Normalize the three optional telemetry accumulators into the
+    tele carry dict (presence of a key IS the static on-flag)."""
+    tele = {}
+    if hists is not None:
+        tele["h"] = jnp.asarray(hists, dtype=jnp.int64)
+    if ledger is not None:
+        ledger = jnp.asarray(ledger, dtype=jnp.int64)
+        assert ledger.shape == (state.capacity, obshist.LED_COLS), \
+            f"ledger shape {ledger.shape} != " \
+            f"({state.capacity}, {obshist.LED_COLS})"
+        tele["l"] = ledger
+    if flight is not None:
+        tele["f"] = flight
+    return tele
+
+
+def _tele_fold(tele: dict, hd, ld, live) -> dict:
+    """Fold one batch's histogram/ledger deltas, gated on liveness."""
+    out = dict(tele)
+    if "h" in tele:
+        out["h"] = obshist.hist_fold(tele["h"], hd, live)
+    if "l" in tele:
+        out["l"] = obshist.ledger_fold(tele["l"], ld, live)
+    return out
+
+
+def _tele_entry_fold(tele: dict, st: EngineState, post_state,
+                     now, allow: bool, count, live):
+    """The shared prefix/chain telemetry fold: batch-entry
+    classification, depth-delta served counts, the entry-head
+    resv/limit-break derivation, and the gated histogram/ledger fold
+    -- ONE implementation so the two sorted engines' entry-head
+    semantics cannot drift.  Returns ``(tele, key_e)`` (the entry
+    keys feed each engine's own flight record)."""
+    cls_e, key_e = _classify(st, now, allow)
+    served_pc = (st.depth - post_state.depth).astype(jnp.int32)
+    srv = served_pc > 0
+    w_entry = srv & (cls_e >= CLS_WEIGHT) & (cls_e < CLS_NONE)
+    hd, ld = _telemetry_delta(
+        post_state, now, cls_e, key_e, served_pc,
+        served_pc - w_entry.astype(jnp.int32),
+        (srv & (cls_e == CLS_LB)).astype(jnp.int32),
+        count, "h" in tele, "l" in tele)
+    return _tele_fold(tele, hd, ld, live), key_e
+
+
+def _tele_flight(tele: dict, slot, cls, tag, cost, live) -> dict:
+    if "f" not in tele:
+        return tele
+    out = dict(tele)
+    out["f"] = obsflight.flight_record(tele["f"], slot, cls, tag,
+                                       cost, live=live)
+    return out
+
+
 def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
                       anticipation_ns: int,
                       allow_limit_break: bool = False,
                       with_metrics: bool = False,
                       select_impl: str = "sort",
                       tag_width: int = 64,
-                      window_m: int | None = None) -> PrefixEpoch:
+                      window_m: int | None = None,
+                      hists=None, ledger=None,
+                      flight=None) -> PrefixEpoch:
     """Run m flat prefix-commit batches of up to k decisions on device.
 
     EVERY batch commits its own exact prefix, so the concatenated
@@ -1060,6 +1170,16 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
     growing the unrolled window-select chain past ``window_m`` rows
     (the chain's cost scales with the window width -- PROFILE.md).
     Must divide m; None = one m-row window (the original layout).
+
+    ``hists`` / ``ledger`` / ``flight`` (each None = off; presence is
+    the static flag) are INITIAL telemetry accumulators
+    (``obs.histograms.hist_zero()`` / ``ledger_zero(N)`` /
+    ``obs.flight.flight_init(R)`` or the previous epoch's outputs, so
+    chained epochs accumulate on device with one final fetch).  They
+    ride the scan carry next to the metrics vector and come back as
+    the epoch result's ``hists``/``ledger``/``flight`` fields; the
+    decision stream and final state are bit-identical with telemetry
+    on or off (tests/test_telemetry.py).
     """
     assert tag_width in (32, 64), tag_width
     w = m if window_m is None else min(int(window_m), m)
@@ -1068,22 +1188,24 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     met0 = obsdev.metrics_zero()
+    tele0 = _tele_init(state, hists, ledger, flight)
+    need_class = bool(tele0)
     if narrow32:
         tc = _TagCarry32(state)
         mutable0, ok0 = tc.narrow(mutable0_64)
         if with_metrics:
             met0 = obsdev.metrics_combine(met0, obsdev.metrics_delta(
                 rebase_fallbacks=(~ok0).astype(jnp.int64)))
-        carry0 = (mutable0, met0, ~ok0)
+        carry0 = (mutable0, met0, tele0, ~ok0)
     else:
-        carry0 = (mutable0_64, met0)
+        carry0 = (mutable0_64, met0, tele0)
 
     def body(window, carry, _):
         if narrow32:
-            mut, met, dead = carry
+            mut, met, tele, dead = carry
             st = EngineState(**invariant, **tc.widen(mut))
         else:
-            mut, met = carry
+            mut, met, tele = carry
             st = EngineState(**invariant, **mut)
         batch = speculate_prefix_batch(
             st, now, k, anticipation_ns=anticipation_ns,
@@ -1116,7 +1238,18 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
                 prop=count - resv, lb=jnp.sum(lb),
                 guards_ok=batch.guards_ok, rebase_fallback=trip,
                 live=good)
-        carry = (mut, met, dead) if narrow32 else (mut, met)
+        if need_class:
+            # entry classification recomputed for telemetry only (a
+            # cheap dense pass; the decision stream is untouched)
+            tele, key_e = _tele_entry_fold(
+                tele, st, batch.state, now, allow_limit_break,
+                batch.count, good)
+            tele = _tele_flight(
+                tele, slot,
+                phase.astype(jnp.int64) + lb.astype(jnp.int64),
+                jnp.take(key_e, jnp.maximum(slot, 0)), cost, good)
+        carry = (mut, met, tele, dead) if narrow32 \
+            else (mut, met, tele)
         return carry, out
 
     def run_chunk(carry, _):
@@ -1133,7 +1266,7 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
         outs = jax.tree_util.tree_map(
             lambda a: a.reshape((m,) + a.shape[2:]), outs)
     count, guards, slot, phase, cost, lb = outs
-    mutable, metrics = carry[0], carry[1]
+    mutable, metrics, tele = carry[0], carry[1], carry[2]
     if narrow32:
         state = EngineState(**invariant,
                             **tc.restore(mutable, mutable0_64, ok0))
@@ -1141,7 +1274,8 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
         state = EngineState(**invariant, **mutable)
     return PrefixEpoch(state=state, count=count, guards_ok=guards,
                        slot=slot, phase=phase, cost=cost, lb=lb,
-                       metrics=metrics)
+                       metrics=metrics, hists=tele.get("h"),
+                       ledger=tele.get("l"), flight=tele.get("f"))
 
 
 class ChainEpoch(NamedTuple):
@@ -1156,6 +1290,10 @@ class ChainEpoch(NamedTuple):
     length: jnp.ndarray      # int8[M, k]  unit decisions
     metrics: jnp.ndarray     # int64[NUM_METRICS] (zeros unless
     #                          with_metrics)
+    # telemetry plane (None unless the caller passed an accumulator)
+    hists: object = None
+    ledger: object = None
+    flight: object = None
 
 
 def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
@@ -1164,35 +1302,41 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
                      use_pallas: bool | None = None,
                      with_metrics: bool = False,
                      select_impl: str = "sort",
-                     tag_width: int = 64) -> ChainEpoch:
+                     tag_width: int = 64,
+                     hists=None, ledger=None,
+                     flight=None) -> ChainEpoch:
     """Run m chained prefix batches on device.  Each batch prefetches
     its own ``chain_depth``-row ring window (one barrel-shift ring
     pass per batch; a shared per-epoch window would need m *
     chain_depth rows of unrolled selects, which costs more than the
-    rotate at chain depths > 1).  ``select_impl`` / ``tag_width`` as
-    in :func:`scan_prefix_epoch`."""
+    rotate at chain depths > 1).  ``select_impl`` / ``tag_width`` /
+    the ``hists``/``ledger``/``flight`` telemetry accumulators as in
+    :func:`scan_prefix_epoch` (flight records here are per UNIT, the
+    cost column carrying the unit's decision count)."""
     assert chain_depth <= state.ring_capacity
     assert tag_width in (32, 64), tag_width
     narrow32 = tag_width == 32
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     met0 = obsdev.metrics_zero()
+    tele0 = _tele_init(state, hists, ledger, flight)
+    need_class = bool(tele0)
     if narrow32:
         tc = _TagCarry32(state)
         mutable0, ok0 = tc.narrow(mutable0_64)
         if with_metrics:
             met0 = obsdev.metrics_combine(met0, obsdev.metrics_delta(
                 rebase_fallbacks=(~ok0).astype(jnp.int64)))
-        carry0 = (mutable0, met0, ~ok0)
+        carry0 = (mutable0, met0, tele0, ~ok0)
     else:
-        carry0 = (mutable0_64, met0)
+        carry0 = (mutable0_64, met0, tele0)
 
     def body(carry, _):
         if narrow32:
-            mut, met, dead = carry
+            mut, met, tele, dead = carry
             st = EngineState(**invariant, **tc.widen(mut))
         else:
-            mut, met = carry
+            mut, met, tele = carry
             st = EngineState(**invariant, **mut)
         win = ring_window(st, chain_depth, use_pallas=use_pallas)
         batch = speculate_chain_batch(
@@ -1231,12 +1375,21 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
                 lb=jnp.sum(units & (cls >= CLS_LB)),
                 guards_ok=batch.guards_ok, rebase_fallback=trip,
                 live=good)
-        carry = (mut, met, dead) if narrow32 else (mut, met)
+        if need_class:
+            tele, key_e = _tele_entry_fold(
+                tele, st, batch.state, now, allow_limit_break,
+                batch.count, good)
+            tele = _tele_flight(
+                tele, slot, cls.astype(jnp.int64),
+                jnp.take(key_e, jnp.maximum(slot, 0)),
+                length.astype(jnp.int64), good)
+        carry = (mut, met, tele, dead) if narrow32 \
+            else (mut, met, tele)
         return carry, out
 
     carry, (count, units, guards, slot, cls, length) = \
         lax.scan(body, carry0, None, length=m)
-    mutable, metrics = carry[0], carry[1]
+    mutable, metrics, tele = carry[0], carry[1], carry[2]
     if narrow32:
         state = EngineState(**invariant,
                             **tc.restore(mutable, mutable0_64, ok0))
@@ -1244,7 +1397,9 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
         state = EngineState(**invariant, **mutable)
     return ChainEpoch(state=state, count=count, unit_count=units,
                       guards_ok=guards, slot=slot, cls=cls,
-                      length=length, metrics=metrics)
+                      length=length, metrics=metrics,
+                      hists=tele.get("h"), ledger=tele.get("l"),
+                      flight=tele.get("f"))
 
 
 def make_prefix_runner(k: int, *, anticipation_ns: int = 0,
@@ -1663,22 +1818,32 @@ class CalendarLadderBatch(NamedTuple):
 def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
                           steps: int, levels: int,
                           anticipation_ns: int, allow: bool,
-                          use_pallas):
+                          use_pallas, with_hists: bool = False,
+                          with_ledger: bool = False):
     """The fused ladder: a lax.scan over L levels, each a full
     window-prefetch + measure + histogram boundary + commit from the
     previous level's committed state.  Carries only the mutable epoch
     fields (the ring pair and QoS identity stay loop-invariant,
-    exactly like the epoch scans).  Returns ``(mut', acc, outs)`` with
-    ``acc`` the [N] per-client counters summed over levels and
-    ``outs`` the per-level (count, resv_count, bound, stall) stacks."""
+    exactly like the epoch scans).  Returns ``(mut', acc, tele_delta,
+    outs)`` with ``acc`` the [N] per-client counters summed over
+    levels, ``tele_delta`` the zero-based histogram/ledger deltas
+    accumulated per LEVEL (so a level equals one minstop batch and
+    bucketed-L telemetry equals the L-batch composition exactly; the
+    caller folds the deltas gated on batch liveness), and ``outs`` the
+    per-level (count, resv_count, bound, stall) stacks."""
     n = invariant["active"].shape[-1]
     acc0 = dict(units=jnp.zeros((n,), jnp.int32),
                 served=jnp.zeros((n,), jnp.int32),
                 served_resv=jnp.zeros((n,), jnp.int32),
                 lb=jnp.zeros((n,), jnp.int32))
+    tacc0 = {}
+    if with_hists:
+        tacc0["h"] = obshist.hist_zero()
+    if with_ledger:
+        tacc0["l"] = obshist.ledger_zero(n)
 
     def level(carry, _):
-        mut, acc = carry
+        mut, acc, tacc = carry
         st = EngineState(**invariant, **mut)
         win = ring_window(st, steps, use_pallas=use_pallas)
         arr_rows, cost_rows = _heads_rows((win.arr, win.cost), steps)
@@ -1690,16 +1855,31 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
                    served=acc["served"] + batch.served,
                    served_resv=acc["served_resv"] + batch.served_resv,
                    lb=acc["lb"] + batch.lb)
+        if with_hists or with_ledger:
+            # per-LEVEL entry classification: level i starts from the
+            # exact serial state at boundary i-1, so these are the
+            # same observations L sequential minstop batches would
+            # record
+            cls_e, key_e = _classify(st, now, allow)
+            hd, ld = _telemetry_delta(
+                batch.state, now, cls_e, key_e, batch.served,
+                batch.served_resv, batch.lb, batch.count,
+                with_hists, with_ledger)
+            tacc = dict(tacc)
+            if with_hists:
+                tacc["h"] = obshist.hist_combine(tacc["h"], hd)
+            if with_ledger:
+                tacc["l"] = obshist.ledger_combine(tacc["l"], ld)
         # a level that commits nothing WITH candidates present is a
         # ladder stall: progress_ok's per-level analog (later levels
         # deterministically repeat it -- same state, same boundary)
         stall = ~batch.progress_ok
-        return (new_mut, acc), (batch.count, batch.resv_count, b_eff,
-                                stall)
+        return (new_mut, acc, tacc), (batch.count, batch.resv_count,
+                                      b_eff, stall)
 
-    (mut, acc), outs = lax.scan(level, (mut, acc0), None,
-                                length=levels)
-    return mut, acc, outs
+    (mut, acc, tacc), outs = lax.scan(level, (mut, acc0, tacc0), None,
+                                      length=levels)
+    return mut, acc, tacc, outs
 
 
 def calendar_batch_bucketed(state: EngineState, now, *, steps: int,
@@ -1719,10 +1899,11 @@ def calendar_batch_bucketed(state: EngineState, now, *, steps: int,
     assert levels >= 1, "the ladder needs at least one level"
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mut0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
-    mut, acc, (count, resv, bound, stall) = _calendar_ladder_scan(
-        invariant, mut0, now, steps=steps, levels=levels,
-        anticipation_ns=anticipation_ns, allow=allow_limit_break,
-        use_pallas=use_pallas)
+    mut, acc, _tacc, (count, resv, bound, stall) = \
+        _calendar_ladder_scan(
+            invariant, mut0, now, steps=steps, levels=levels,
+            anticipation_ns=anticipation_ns, allow=allow_limit_break,
+            use_pallas=use_pallas)
     total = jnp.sum(count).astype(jnp.int32)
     return CalendarLadderBatch(
         state=EngineState(**invariant, **mut),
@@ -1778,6 +1959,10 @@ class CalendarEpoch(NamedTuple):
     #                           (L = ladder_levels for "bucketed", 1
     #                           for "minstop"; bench decisions-per-
     #                           level attribution)
+    # telemetry plane (None unless the caller passed an accumulator)
+    hists: object = None
+    ledger: object = None
+    flight: object = None
 
 
 def scan_calendar_epoch(state: EngineState, now, m: int, *,
@@ -1787,7 +1972,9 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                         with_metrics: bool = False,
                         tag_width: int = 64,
                         calendar_impl: str = "minstop",
-                        ladder_levels: int = 8) -> CalendarEpoch:
+                        ladder_levels: int = 8,
+                        hists=None, ledger=None,
+                        flight=None) -> CalendarEpoch:
     """Run m calendar batches on device (each prefetches its own
     ``steps``-row ring window).  ``tag_width`` as in
     :func:`scan_prefix_epoch` (a window trip reports
@@ -1800,7 +1987,15 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
     batch (see the bucketed section comment), so one launch commits
     what took ``ladder_levels`` minstop batches.  Both produce exact
     serial prefixes; ``ladder_levels=1`` is bit-identical to
-    "minstop" (ci.sh digest gate)."""
+    "minstop" (ci.sh digest gate).
+
+    ``hists`` / ``ledger`` / ``flight`` telemetry accumulators as in
+    :func:`scan_prefix_epoch`.  Histogram/ledger observations are per
+    LEVEL (a bucketed ladder level == one minstop batch, so bucketed-L
+    telemetry equals the L-batch minstop composition exactly); flight
+    records are per CLIENT per BATCH (the calendar engine emits
+    per-client counts, not an ordered stream), the cost column
+    carrying the client's committed decisions."""
     assert tag_width in (32, 64), tag_width
     assert calendar_impl in _CAL_IMPLS, calendar_impl
     bucketed = calendar_impl == "bucketed"
@@ -1811,30 +2006,43 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     served0 = jnp.zeros((state.capacity,), dtype=jnp.int32)
     met0 = obsdev.metrics_zero()
+    tele0 = _tele_init(state, hists, ledger, flight)
+    need_tele = bool(tele0)
     if narrow32:
         tc = _TagCarry32(state)
         mutable0, ok0 = tc.narrow(mutable0_64)
         if with_metrics:
             met0 = obsdev.metrics_combine(met0, obsdev.metrics_delta(
                 rebase_fallbacks=(~ok0).astype(jnp.int64)))
-        carry0 = (mutable0, served0, met0, ~ok0)
+        carry0 = (mutable0, served0, met0, tele0, ~ok0)
     else:
-        carry0 = (mutable0_64, served0, met0)
+        carry0 = (mutable0_64, served0, met0, tele0)
 
     def body(carry, _):
         if narrow32:
-            mut, acc, met, dead = carry
+            mut, acc, met, tele, dead = carry
             st = EngineState(**invariant, **tc.widen(mut))
         else:
-            mut, acc, met = carry
+            mut, acc, met, tele = carry
             st = EngineState(**invariant, **mut)
+        hd = ld = None
+        if need_tele:
+            # batch-entry classification, shared by the minstop
+            # telemetry delta and the flight records (ONE definition,
+            # so the two cannot drift); the bucketed ladder computes
+            # its own per-LEVEL classification internally, and XLA
+            # drops this one when nothing reads it
+            cls_e, key_e = _classify(st, now, allow_limit_break)
         if bucketed:
             mut_in = {f: getattr(st, f) for f in _EPOCH_MUTABLE}
-            new_mut, lacc, (lvl_count, lvl_resv, _bound, lvl_stall) = \
+            new_mut, lacc, tdelta, \
+                (lvl_count, lvl_resv, _bound, lvl_stall) = \
                 _calendar_ladder_scan(
                     invariant, mut_in, now, steps=steps,
                     levels=levels, anticipation_ns=anticipation_ns,
-                    allow=allow_limit_break, use_pallas=use_pallas)
+                    allow=allow_limit_break, use_pallas=use_pallas,
+                    with_hists="h" in tele, with_ledger="l" in tele)
+            hd, ld = tdelta.get("h"), tdelta.get("l")
             batch_state = EngineState(**invariant, **new_mut)
             count = jnp.sum(lvl_count).astype(jnp.int32)
             resv_count = jnp.sum(lvl_resv).astype(jnp.int32)
@@ -1863,6 +2071,11 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
             base_decs = count.astype(jnp.int64)
             new_mut = {f: getattr(batch.state, f)
                        for f in _EPOCH_MUTABLE}
+            if "h" in tele or "l" in tele:
+                hd, ld = _telemetry_delta(
+                    batch.state, now, cls_e, key_e, batch.served,
+                    batch.served_resv, batch.lb, batch.count,
+                    "h" in tele, "l" in tele)
         trip = jnp.bool_(False)
         good = jnp.bool_(True)
         if narrow32:
@@ -1892,13 +2105,25 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                 ladder_levels_used=levels_used,
                 ladder_base_decisions=base_decs,
                 ladder_fallbacks=ladder_fb)
-        carry = (mut, acc + served, met, dead) if narrow32 \
-            else (mut, acc + served, met)
+        if need_tele:
+            tele = _tele_fold(tele, hd, ld, good)
+            if "f" in tele:
+                # per-client-per-batch records (the calendar engine
+                # emits counts, not a stream); GATED served, so a
+                # dead batch records nothing
+                iota = jnp.arange(st.capacity, dtype=jnp.int32)
+                tele = _tele_flight(
+                    tele, jnp.where(served > 0, iota, -1),
+                    cls_e.astype(jnp.int64), key_e,
+                    served.astype(jnp.int64), good)
+        carry = (mut, acc + served, met, tele, dead) if narrow32 \
+            else (mut, acc + served, met, tele)
         return carry, out
 
     carry, (count, resv, ok, lvls) = lax.scan(body, carry0, None,
                                               length=m)
     mutable, served, metrics = carry[0], carry[1], carry[2]
+    tele = carry[3]
     if narrow32:
         state = EngineState(**invariant,
                             **tc.restore(mutable, mutable0_64, ok0))
@@ -1906,4 +2131,6 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
         state = EngineState(**invariant, **mutable)
     return CalendarEpoch(state=state, count=count, resv_count=resv,
                          progress_ok=ok, served=served,
-                         metrics=metrics, level_count=lvls)
+                         metrics=metrics, level_count=lvls,
+                         hists=tele.get("h"), ledger=tele.get("l"),
+                         flight=tele.get("f"))

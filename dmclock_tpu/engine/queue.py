@@ -229,6 +229,14 @@ class TpuPullPriorityQueue:
         self.prop_sched_count = 0
         self.limit_break_sched_count = 0
 
+        # host-side per-slot conformance ledger mirroring the device
+        # ledger schema (obs.histograms LED_* columns): the pull queue
+        # serves through engine_run, which emits no per-decision tags,
+        # so the tardiness columns stay 0 here -- ops/resv/lb are
+        # exact, and the sims cross-check them against their own
+        # host-recomputed conformance tables (docs/OBSERVABILITY.md)
+        self._ledger = np.zeros((capacity, 5), dtype=np.int64)
+
         # guarded-commit telemetry (docs/ROBUSTNESS.md): launches
         # retried after a transient device error, and adds rejected
         # for an invalid cost (nothing committed either way)
@@ -352,6 +360,9 @@ class TpuPullPriorityQueue:
             q_arrival=_grow_rows(st.q_arrival, new_n, 0),
             q_cost=_grow_rows(st.q_cost, new_n, 0),
         )
+        self._ledger = np.vstack(
+            [self._ledger,
+             np.zeros((new_n - old_n, 5), dtype=np.int64)])
         self._free.extend(range(new_n - 1, old_n - 1, -1))
 
     def _grow_ring(self) -> None:
@@ -486,14 +497,18 @@ class TpuPullPriorityQueue:
         if dtype == RETURNING:
             client = self._client_of[dslot]
             request, _arr, _cost = self._payloads[dslot].popleft()
+            led = self._ledger[dslot]
+            led[0] += 1                      # LED_OPS
             if dphase == 0:
                 self.reserv_sched_count += 1
+                led[1] += 1                  # LED_RESV_OPS
                 phase = Phase.RESERVATION
             else:
                 self.prop_sched_count += 1
                 phase = Phase.PRIORITY
             if dlimit_break:
                 self.limit_break_sched_count += 1
+                led[2] += 1                  # LED_LIMIT_BREAKS
             self._last_tick[dslot] = self.tick
             return PullReq(NextReqType.RETURNING, client=client,
                            request=request, phase=phase, cost=int(dcost))
@@ -726,6 +741,35 @@ class TpuPullPriorityQueue:
         registry.gauge("dmclock_clients", "tracked client records",
                        labels=labels).set_function(
             lambda: len(self._slot_of))
+        # ledger column totals as callback gauges (per-client series
+        # would explode the scrape; the table drains via ledger_rows)
+        for col, cname in ((0, "ops"), (1, "resv_ops"),
+                           (2, "limit_breaks")):
+            registry.gauge(f"dmclock_ledger_{cname}",
+                           "host conformance-ledger column total "
+                           "(pull-queue mirror of the device ledger "
+                           "schema; docs/OBSERVABILITY.md)",
+                           labels=labels).set_function(
+                lambda c=col: self._ledger_total(c))
+
+    def _ledger_total(self, col: int) -> int:
+        """Scrape-thread read of a ledger column under the data lock:
+        the serve path mutates rows (and _grow_capacity swaps the
+        whole array) under ``data_mtx``, and an unlocked sum could
+        report mutually inconsistent column totals mid-serve."""
+        with self.data_mtx:
+            return int(self._ledger[:, col].sum())
+
+    def ledger_rows(self) -> Dict[Any, np.ndarray]:
+        """Per-client conformance-ledger rows (client id -> int64[5]
+        in the ``obs.histograms`` LED_* column order).  The pull
+        queue's host mirror of the device ledger: ops/resv/lb exact,
+        tardiness columns 0 (engine_run emits no per-decision tags).
+        Sims cross-check their host-recomputed conformance tables
+        against this (``SimReport.ledger_check``)."""
+        with self.data_mtx:
+            return {cid: self._ledger[slot].copy()
+                    for cid, slot in self._slot_of.items()}
 
     # ------------------------------------------------------------------
     # inspection (host mirrors; reference :545-564)
@@ -942,6 +986,10 @@ class TpuPullPriorityQueue:
                     del self._payloads[slot]
                     del self._last_tick[slot]
                     self._host_idle.discard(slot)
+                    # recycled slots start with a fresh ledger row --
+                    # a new tenant must not inherit the old one's
+                    # conformance history
+                    self._ledger[slot] = 0
                     self._free.append(slot)
             if len(erase_slots) < self.erase_max:
                 self._last_erase_point = 0

@@ -17,6 +17,13 @@ Three tiers, cheapest first:
    scheduling decisions and an end-of-run per-client conformance table
    (delivered rate vs reservation/weight/limit).
 
+Plus the device telemetry plane (``obs.histograms``, ``obs.flight``):
+log2-bucketed latency/tardiness/stall/commit-size histograms and a
+per-client conformance ledger accumulated inside the epoch scans, and
+an HBM flight recorder of the last R commit records drained only at
+epoch/checkpoint boundaries -- distributions in the data path, not the
+control path.
+
 See ``docs/OBSERVABILITY.md`` for metric names and schemas.
 """
 
@@ -24,11 +31,11 @@ from .registry import (Counter, Gauge, Histogram, MetricsHTTPServer,
                        MetricsRegistry, TimerMetric, default_registry,
                        start_http_server)
 from .trace import DecisionTrace, validate_trace_file
-from . import device
+from . import device, flight, histograms
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "TimerMetric",
     "default_registry", "MetricsHTTPServer", "start_http_server",
     "DecisionTrace", "validate_trace_file",
-    "device",
+    "device", "flight", "histograms",
 ]

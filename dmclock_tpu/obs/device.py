@@ -11,7 +11,15 @@ flag off, the compiled program -- is bit-identical to the pre-metrics
 kernels (pinned by ``tests/test_obs.py``).
 
 Vector layout (int64[NUM_METRICS]); counters accumulate by addition,
-high-water marks by ``maximum``:
+high-water marks by ``maximum``.
+
+The scalar vector is the cheapest tier of the device telemetry plane;
+``obs.histograms`` (log2-bucketed QoS distributions + the per-client
+conformance ledger) and ``obs.flight`` (the HBM flight recorder) ride
+the same scan carries under the same bit-identical-decisions contract
+and merge through the same psum/pmax collective path
+(``metrics_mesh_reduce`` / ``hist_mesh_reduce`` /
+``ledger_mesh_reduce``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,131 @@
+"""HBM flight recorder: the last R decision records, written in-graph.
+
+A microsecond-scale scheduler cannot afford a host-side decision trace
+in the data path (``obs.trace`` costs a JSONL row per decision on the
+host), but postmortems need to know WHAT the engine was committing
+right before a crash.  The flight recorder is the middle ground: a
+fixed-size ring of the most recent R commit records living in device
+memory, written by the epoch scans with dense scatter rows (no host
+involvement), and drained by the host ONLY at epoch/checkpoint
+boundaries -- ``jax.device_get`` stays off the hot path, and the ring
+rides in the supervisor's rotation checkpoints so a SIGKILLed run's
+resume replays it bit-identically (crash equivalence extends to
+telemetry; ``robust.supervisor``).
+
+Record granularity follows each engine's commit unit (the engines emit
+sets, not per-decision streams):
+
+- prefix epoch: one record per DECISION (client, phase-class, unified
+  entry key, cost);
+- chain epoch: one record per UNIT (cost column = the unit's decision
+  count);
+- calendar epoch: one record per CLIENT per BATCH (cost column = the
+  client's committed decisions that batch).
+
+Columns (int64): ``seq`` (monotone global record number -- drain
+orders by it and wraparound is visible as a seq gap), ``batch`` (the
+recording batch's global index), ``client`` (slot), ``cls`` (unified
+class: 0 reservation / 1 weight / 2 limit-break), ``tag`` (unified
+entry key), ``cost``.  Unwritten rows carry seq -1.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+FLIGHT_FIELDS = ("seq", "batch", "client", "cls", "tag", "cost")
+FLIGHT_COLS = len(FLIGHT_FIELDS)
+
+
+class FlightState(NamedTuple):
+    """The device-resident ring + its monotone cursors.  ``seq`` is
+    the count of records ever written (the next record's number);
+    ``batch`` counts live batches recorded.  The ring slot of record
+    ``s`` is ``s % R``, so the buffer always holds the newest
+    ``min(seq, R)`` records."""
+
+    buf: jnp.ndarray    # int64[R, FLIGHT_COLS]; seq column -1 = empty
+    seq: jnp.ndarray    # int64 scalar
+    batch: jnp.ndarray  # int64 scalar
+
+
+def flight_init(records: int) -> FlightState:
+    """Fresh ring of ``records`` rows (the R knob; ~48 bytes/row)."""
+    assert records >= 1, "the flight ring needs at least one row"
+    buf = jnp.full((records, FLIGHT_COLS), jnp.int64(-1))
+    return FlightState(buf=buf, seq=jnp.int64(0), batch=jnp.int64(0))
+
+
+def flight_record(fl: FlightState, slot, cls, tag, cost,
+                  live=True) -> FlightState:
+    """Append one batch's commit records in-graph.
+
+    ``slot`` (int32[k], -1 = no record) selects the valid rows --
+    callers pass the engines' already-masked outputs, so a gated
+    (tag32-dead) batch whose slots are all -1 writes nothing.
+    Validity need not be a contiguous prefix (the calendar engine's
+    dense per-client mask is scattered); ranks come from a cumsum.
+    When one batch carries more than R records only the NEWEST R are
+    materialized (deterministically -- duplicate ring indices never
+    reach the scatter), but ``seq`` still advances by the full count,
+    so the drop is visible as a seq gap."""
+    r = fl.buf.shape[0]
+    slot = jnp.asarray(slot)
+    live = jnp.asarray(live, dtype=bool)
+    mask = (slot >= 0) & live
+    rank = jnp.cumsum(mask.astype(jnp.int64)) - 1
+    total = jnp.sum(mask.astype(jnp.int64))
+    keep = mask & (rank >= total - r)
+    idx = jnp.where(keep, (fl.seq + rank) % r, r).astype(jnp.int32)
+    rows = jnp.stack([
+        fl.seq + rank,
+        jnp.broadcast_to(fl.batch, slot.shape),
+        slot.astype(jnp.int64),
+        jnp.asarray(cls, dtype=jnp.int64),
+        jnp.asarray(tag, dtype=jnp.int64),
+        jnp.asarray(cost, dtype=jnp.int64),
+    ], axis=1)
+    buf = fl.buf.at[idx].set(rows, mode="drop")
+    return FlightState(buf=buf, seq=fl.seq + total,
+                       batch=fl.batch + live.astype(jnp.int64))
+
+
+def flight_drain(fl: FlightState) -> list:
+    """Host drain: ONE ``device_get`` of the ring, decoded into dict
+    records ordered oldest -> newest.  Call only at epoch/checkpoint
+    boundaries -- this is the async seam that keeps the recorder off
+    the hot path."""
+    import jax
+
+    buf, seq = jax.device_get((fl.buf, fl.seq))
+    buf = np.asarray(buf, dtype=np.int64)
+    valid = buf[:, 0] >= 0
+    rows = buf[valid]
+    rows = rows[np.argsort(rows[:, 0], kind="stable")]
+    out = [dict(zip(FLIGHT_FIELDS, (int(x) for x in row)))
+           for row in rows]
+    return out
+
+
+def flight_dump(fl: FlightState, path: str) -> int:
+    """Drain the ring to a JSONL file (the supervisor's --flight-dump
+    crash hook); returns the record count.  Telemetry must never kill
+    what it observes -- callers wrap this in a best-effort guard."""
+    import json
+
+    records = flight_drain(fl)
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")
+    return len(records)
+
+
+def flight_from_arrays(buf, seq, batch) -> FlightState:
+    """Rebuild a FlightState from checkpointed numpy leaves
+    (``robust.supervisor`` payload round-trip)."""
+    return FlightState(buf=jnp.asarray(buf, dtype=jnp.int64),
+                       seq=jnp.asarray(seq, dtype=jnp.int64),
+                       batch=jnp.asarray(batch, dtype=jnp.int64))

@@ -138,6 +138,17 @@ class Histogram(_Metric):
                 self.counts[i] += 1
                 break
 
+    def set_counts(self, counts, sum_value: float) -> None:
+        """Overwrite the per-bucket counts wholesale -- the drain for
+        device-accumulated histograms (``obs.histograms``), whose
+        blocks are already cumulative per run: re-observing them would
+        double-count, so the publisher SETS."""
+        assert len(counts) == len(self.buckets), \
+            f"{len(counts)} counts for {len(self.buckets)} buckets"
+        self.counts = [int(c) for c in counts]
+        self.sum = float(sum_value)
+        self.count = sum(self.counts)
+
     def sample_rows(self):
         rows = []
         cum = 0
@@ -295,6 +306,11 @@ class MetricsHTTPServer:
 
     - ``GET /metrics`` (or ``/``) -> Prometheus text exposition 0.0.4
     - ``GET /metrics.json``       -> the JSON ``snapshot()``
+    - ``GET /healthz``            -> ``{"status": "ok"}`` liveness
+      probe that touches NO registry drain -- the supervisor polls it
+      after a scrape-port rebind to confirm the new incarnation's
+      endpoint is actually serving (docs/ROBUSTNESS.md), and a probe
+      must not pay for (or fail on) a metrics drain
 
     Drains are read lazily per request (callback gauges, timer merges),
     so serving a scrape costs the hot path nothing.  ``port=0`` binds
@@ -329,6 +345,9 @@ class MetricsHTTPServer:
                 elif path == "/metrics.json":
                     body = reg.snapshot_json().encode()
                     ctype = "application/json"
+                elif path == "/healthz":
+                    body = b'{"status": "ok"}'
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
@@ -352,6 +371,10 @@ class MetricsHTTPServer:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}/metrics"
+
+    @property
+    def healthz_url(self) -> str:
+        return f"http://{self.host}:{self.port}/healthz"
 
     def close(self) -> None:
         self._srv.shutdown()

@@ -89,6 +89,14 @@ class GuardedEpoch(NamedTuple):
     rebase_fallbacks: int    # tag32 window trips resumed on int64
     serial_fallbacks: int    # order/cost guard trips resumed serially
     retries: int             # transient device errors retried
+    # telemetry accumulators after the LAST scan attempt (pass-through
+    # state: a tag32 resume continues accumulating from the first
+    # attempt's outputs; the rare serial fallback's decisions are not
+    # telemetered -- docs/OBSERVABILITY.md).  None when the caller
+    # passed none in.
+    hists: object = None
+    ledger: object = None
+    flight: object = None
 
 
 _EPOCHS = {"prefix": "scan_prefix_epoch", "chain": "scan_chain_epoch",
@@ -101,8 +109,12 @@ _EPOCHS = {"prefix": "scan_prefix_epoch", "chain": "scan_chain_epoch",
 _EPOCH_JIT_CACHE: dict = {}
 
 
-def _jit_epoch(engine: str, m_run: int, kw: dict):
-    key = (engine, m_run, tuple(sorted(kw.items())))
+def _jit_epoch(engine: str, m_run: int, kw: dict, tele_sig=()):
+    """``tele_sig`` is the tuple of telemetry accumulator names the
+    wrapped call threads through as TRACED arguments (they must not be
+    closed over -- a partial-bound array would constant-fold into the
+    compiled program and break the module-cache reuse)."""
+    key = (engine, m_run, tuple(sorted(kw.items())), tele_sig)
     if key not in _EPOCH_JIT_CACHE:
         import functools
 
@@ -110,8 +122,13 @@ def _jit_epoch(engine: str, m_run: int, kw: dict):
 
         from ..engine import fastpath
         fn = getattr(fastpath, _EPOCHS[engine])
-        _EPOCH_JIT_CACHE[key] = jax.jit(
-            functools.partial(fn, m=m_run, **kw))
+        if tele_sig:
+            def run(st, t, tele):
+                return fn(st, t, m=m_run, **kw, **tele)
+            _EPOCH_JIT_CACHE[key] = jax.jit(run)
+        else:
+            _EPOCH_JIT_CACHE[key] = jax.jit(
+                functools.partial(fn, m=m_run, **kw))
     return _EPOCH_JIT_CACHE[key]
 
 
@@ -154,6 +171,7 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                       calendar_impl: str = "minstop",
                       ladder_levels: int = 8,
                       skew_ns: int = 0,
+                      hists=None, ledger=None, flight=None,
                       retries: int = 3, base_s: float = 0.05,
                       sleep: Callable[[float], None] = _time.sleep,
                       on_retry=None) -> GuardedEpoch:
@@ -170,6 +188,14 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
     sees ``now + skew_ns``.  With ``skew_ns=0`` the first attempt is
     the untouched epoch call -- bit-identical to no wrapper at all
     (chaos differential gate).
+
+    ``hists`` / ``ledger`` / ``flight`` (None = off) are the telemetry
+    accumulators of ``fastpath.scan_*_epoch``: pass-through state, so
+    a tag32 window trip's int64 resume continues accumulating from
+    the first attempt's outputs and the returned accumulators cover
+    the whole epoch.  The serial-engine fallback (never observed in
+    practice) passes them through untouched -- its decisions are not
+    telemetered.
     """
     import jax
     import jax.numpy as jnp
@@ -197,18 +223,35 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
         if on_retry is not None:
             on_retry(attempt, exc)
 
+    tele = {}
+    if hists is not None:
+        tele["hists"] = hists
+    if ledger is not None:
+        tele["ledger"] = ledger
+    if flight is not None:
+        tele["flight"] = flight
+    tele_sig = tuple(sorted(tele))
+
     def attempt(st, t, m_run, width):
-        fn = _jit_epoch(engine, m_run, {**kw, "tag_width": width})
+        fn = _jit_epoch(engine, m_run, {**kw, "tag_width": width},
+                        tele_sig)
+        call = (lambda: fn(st, t, tele)) if tele_sig \
+            else (lambda: fn(st, t))
         return retry_with_backoff(
-            lambda: jax.block_until_ready(fn(st, t)),
+            lambda: jax.block_until_ready(call()),
             retries=retries, base_s=base_s, sleep=sleep,
             on_retry=count_retry)
+
+    def take_tele(ep):
+        for name in tele_sig:
+            tele[name] = getattr(ep, name)
 
     t = jnp.asarray(now, dtype=jnp.int64) + jnp.int64(skew_ns)
     results = []
     rebase_fb = serial_fb = 0
     ep = attempt(state, t, m, tag_width)
     results.append(ep)
+    take_tele(ep)
     total = _epoch_count(engine, ep)
     state = ep.state
     guards = _guard_vec(engine, ep)
@@ -221,6 +264,7 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
             rebase_fb = 1
             ep2 = attempt(state, t, remaining, 64)
             results.append(ep2)
+            take_tele(ep2)
             g2 = _guard_vec(engine, ep2)
             total += _epoch_count(engine, ep2)
             state = ep2.state
@@ -246,7 +290,10 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                         results=tuple(results),
                         rebase_fallbacks=rebase_fb,
                         serial_fallbacks=serial_fb,
-                        retries=retry_count[0])
+                        retries=retry_count[0],
+                        hists=tele.get("hists"),
+                        ledger=tele.get("ledger"),
+                        flight=tele.get("flight"))
 
 
 # ----------------------------------------------------------------------

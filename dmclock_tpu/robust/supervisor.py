@@ -96,6 +96,16 @@ class EpochJob:
     # deterministically trips the tag32 rebase window every epoch --
     # the in-repo way to exercise guard trips / ladder engagement
     tag_spread_ns: int = 0
+    # device telemetry plane (obs.histograms / obs.flight): the
+    # accumulators ride the rotation checkpoints, so crash equivalence
+    # extends to telemetry (histograms + ledger + flight ring of a
+    # killed-and-resumed run == the uninterrupted run, bit-identical)
+    with_hists: bool = False        # log2 QoS histograms
+    with_ledger: bool = False       # per-client conformance ledger
+    flight_records: int = 0         # HBM flight-recorder rows (0=off)
+    flight_dump: Optional[str] = None  # JSONL path the flight ring is
+    #                                    dumped to when an incarnation
+    #                                    crashes (--flight-dump)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -123,6 +133,12 @@ class SupervisedResult(NamedTuple):
     # rotation path the FINAL incarnation resumed from (None when it
     # started fresh) -- the newest-intact-fallback observability hook
     resumed_from: Optional[str] = None
+    # telemetry plane (None when the job ran with it off); numpy
+    # arrays, compared bit-for-bit by the crash-equivalence gate
+    hists: Optional[np.ndarray] = None      # [NUM_HISTS, BUCKETS+1]
+    ledger: Optional[np.ndarray] = None     # [N, LED_COLS]
+    flight_buf: Optional[np.ndarray] = None  # [R, FLIGHT_COLS]
+    flight_seq: int = 0                      # records ever written
 
 
 def assert_crash_equivalent(interrupted: SupervisedResult,
@@ -145,6 +161,20 @@ def assert_crash_equivalent(interrupted: SupervisedResult,
     assert np.array_equal(a, b), \
         (f"metric totals diverged outside the resume rows: "
          f"{a.tolist()} vs {b.tolist()}")
+    # crash equivalence extends to the telemetry plane: the
+    # accumulators ride the rotation checkpoints and the replayed
+    # decisions are bit-identical, so histograms, ledger, AND the
+    # flight ring must match exactly (no resume-row exception -- the
+    # telemetry plane has no host-restart counters)
+    for field in ("hists", "ledger", "flight_buf"):
+        x = getattr(interrupted, field)
+        y = getattr(reference, field)
+        assert (x is None) == (y is None), \
+            f"telemetry field {field} enabled on only one side"
+        if x is not None:
+            assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                f"telemetry field {field} diverged across the crash"
+    assert interrupted.flight_seq == reference.flight_seq
 
 
 # ----------------------------------------------------------------------
@@ -241,24 +271,60 @@ def _tree_digest(tree) -> str:
 
 
 def _payload(job: EpochJob, state, rng, met, digest: bytes,
-             epoch: int, decisions: int, ladder_vec) -> dict:
+             epoch: int, decisions: int, ladder_vec,
+             hists=None, ledger=None, flight=None) -> dict:
+    import jax
+
+    from ..obs import flight as obsflight
+
+    # telemetry leaves are ALWAYS present (zero-size when the job runs
+    # with that accumulator off) so the restore template's structure
+    # depends only on the job config, never on runtime state
+    z = np.zeros((0,), dtype=np.int64)
     return {"digest": np.frombuffer(digest, dtype=np.uint8).copy(),
             "decisions": np.int64(decisions),
             "engine": state,
             "epoch": np.int64(epoch),
             "ladder": np.asarray(ladder_vec, dtype=np.int64),
             "metrics": np.asarray(met, dtype=np.int64),
-            "rng": _rng_state_array(rng)}
+            "rng": _rng_state_array(rng),
+            "tele_hists": z if hists is None
+            else np.asarray(jax.device_get(hists), dtype=np.int64),
+            "tele_ledger": z if ledger is None
+            else np.asarray(jax.device_get(ledger), dtype=np.int64),
+            "tele_flight_buf":
+                np.zeros((0, obsflight.FLIGHT_COLS), dtype=np.int64)
+                if flight is None
+                else np.asarray(jax.device_get(flight.buf),
+                                dtype=np.int64),
+            "tele_flight_seq": np.int64(
+                0 if flight is None else int(flight.seq)),
+            "tele_flight_batch": np.int64(
+                0 if flight is None else int(flight.batch))}
+
+
+def _tele_init(job: EpochJob):
+    """Fresh telemetry accumulators per the job's static flags."""
+    from ..obs import flight as obsflight
+    from ..obs import histograms as obshist
+
+    hists = obshist.hist_zero() if job.with_hists else None
+    ledger = obshist.ledger_zero(job.n) if job.with_ledger else None
+    flight = obsflight.flight_init(job.flight_records) \
+        if job.flight_records else None
+    return hists, ledger, flight
 
 
 def _payload_like(job: EpochJob) -> dict:
     from ..obs import device as obsdev
 
+    hists, ledger, flight = _tele_init(job)
     return _payload(job, _job_state(job),
                     np.random.Generator(np.random.PCG64(job.seed)),
                     np.zeros(obsdev.NUM_METRICS, dtype=np.int64),
                     b"\x00" * 32, 0, 0,
-                    DegradationLadder().encode())
+                    DegradationLadder().encode(),
+                    hists=hists, ledger=ledger, flight=flight)
 
 
 _INGEST_JIT_CACHE: dict = {}
@@ -301,6 +367,8 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     from ..obs import device as obsdev
     from ..obs.registry import start_http_server
 
+    from ..obs import flight as obsflight
+
     state = _job_state(job)
     rng = np.random.Generator(np.random.PCG64(job.seed))
     met = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
@@ -309,6 +377,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     decisions = 0
     ladder = DegradationLadder(enabled=job.ladder,
                                threshold=job.ladder_threshold)
+    hists, ledger, flight = _tele_init(job)
     ckpt_dir = os.path.join(workdir, "ckpt") if workdir else None
 
     payload = None
@@ -342,6 +411,17 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
         start_epoch = int(payload["epoch"])
         decisions = int(payload["decisions"])
         ladder.load(jax.device_get(payload["ladder"]))
+        # telemetry resumes from the snapshot too -- that is what
+        # makes crash equivalence extend to the telemetry plane
+        if job.with_hists:
+            hists = jnp.asarray(payload["tele_hists"])
+        if job.with_ledger:
+            ledger = jnp.asarray(payload["tele_ledger"])
+        if job.flight_records:
+            flight = obsflight.flight_from_arrays(
+                payload["tele_flight_buf"],
+                payload["tele_flight_seq"],
+                payload["tele_flight_batch"])
 
     scrape = None
     scrape_port = job.metrics_port
@@ -359,6 +439,14 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                     scrape_port = scrape.port   # pin ephemeral binds
                     if epoch > start_epoch:
                         scrape_rebinds += 1
+                        # a rebind is only a recovery if the new
+                        # endpoint actually serves: poll /healthz
+                        # (best-effort -- telemetry must never kill
+                        # the run it observes)
+                        if not _healthz_ok(scrape):
+                            print("# supervisor: scrape rebind on "
+                                  f"port {scrape.port} failed its "
+                                  "healthz probe", file=sys.stderr)
             if injector is not None and injector.drop_scrape(epoch) \
                     and scrape is not None:
                 scrape.close()      # the plan yanks the port; the
@@ -384,7 +472,8 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                         select_impl=cfg["select_impl"],
                         tag_width=cfg["tag_width"],
                         calendar_impl=cfg["calendar_impl"],
-                        ladder_levels=job.ladder_levels)
+                        ladder_levels=job.ladder_levels,
+                        hists=hists, ledger=ledger, flight=flight)
                     break
                 except RECOVERABLE_ERRORS:
                     # bounded retries EXHAUSTED inside the guarded
@@ -404,6 +493,12 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                         ladder.note_epoch(cfg, launch_failures=1)
             state = ep.state
             decisions += ep.count
+            if job.with_hists:
+                hists = ep.hists
+            if job.with_ledger:
+                ledger = ep.ledger
+            if job.flight_records:
+                flight = ep.flight
             digest = _digest_update(digest, ep.results)
             for r in ep.results:
                 if hasattr(r, "metrics"):
@@ -421,7 +516,8 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                      or epoch + 1 == job.epochs):
                 payload = _payload(job, state, rng, met, digest,
                                    epoch + 1, decisions,
-                                   ladder.encode())
+                                   ladder.encode(), hists=hists,
+                                   ledger=ledger, flight=flight)
 
                 def save(payload=payload):
                     return ckpt_mod.save_pytree_rotating(
@@ -431,6 +527,18 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                     injector.around_save(epoch, save)
                 else:
                     save()
+    except BaseException:
+        # the crash hook: dump the flight ring's last R commit
+        # records before the incarnation dies (--flight-dump).  Best
+        # effort -- the dump must never mask the original error.
+        if job.flight_dump and flight is not None:
+            try:
+                n = obsflight.flight_dump(flight, job.flight_dump)
+                print(f"# supervisor: dumped {n} flight records to "
+                      f"{job.flight_dump}", file=sys.stderr)
+            except Exception:
+                pass
+        raise
     finally:
         if scrape is not None:
             scrape.close()
@@ -442,7 +550,30 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
         metrics=met, restarts=0,
         ladder_steps=ladder.describe(),
         scrape_rebinds=scrape_rebinds,
-        resumed_from=resumed_from)
+        resumed_from=resumed_from,
+        hists=None if hists is None
+        else np.asarray(jax.device_get(hists), dtype=np.int64),
+        ledger=None if ledger is None
+        else np.asarray(jax.device_get(ledger), dtype=np.int64),
+        flight_buf=None if flight is None
+        else np.asarray(jax.device_get(flight.buf), dtype=np.int64),
+        flight_seq=0 if flight is None else int(flight.seq))
+
+
+def _healthz_ok(scrape, timeout_s: float = 2.0) -> bool:
+    """One-shot liveness probe of a scrape endpoint's ``/healthz``
+    (obs.registry.MetricsHTTPServer) -- what a restarted incarnation
+    polls after rebinding its port to confirm the endpoint actually
+    serves again."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(scrape.healthz_url,
+                                    timeout=timeout_s) as resp:
+            return resp.status == 200 \
+                and b"ok" in resp.read()
+    except Exception:
+        return False
 
 
 def run_job(job: EpochJob) -> SupervisedResult:
@@ -552,13 +683,21 @@ def _spawn_once(job: EpochJob, workdir: str,
                            f"({describe_host(plan)})")
     with open(res_path) as fh:
         obj = json.load(fh)
+
+    def arr(key):
+        v = obj.get(key)
+        return None if v is None else np.asarray(v, dtype=np.int64)
+
     return SupervisedResult(
         digest=obj["digest"], state_digest=obj["state_digest"],
         decisions=int(obj["decisions"]), epochs=int(obj["epochs"]),
         metrics=np.asarray(obj["metrics"], dtype=np.int64),
         restarts=0, ladder_steps=obj["ladder_steps"],
         scrape_rebinds=int(obj["scrape_rebinds"]),
-        resumed_from=obj.get("resumed_from"))
+        resumed_from=obj.get("resumed_from"),
+        hists=arr("hists"), ledger=arr("ledger"),
+        flight_buf=arr("flight_buf"),
+        flight_seq=int(obj.get("flight_seq", 0)))
 
 
 def _child_main(workdir: str) -> int:
@@ -582,6 +721,9 @@ def _child_main(workdir: str) -> int:
     result = _job_loop(job, workdir, injector)
     res_path = os.path.join(workdir, RESULT_FILE)
     tmp = res_path + f".tmp.{os.getpid()}"
+    def lst(v):
+        return None if v is None else np.asarray(v).tolist()
+
     with open(tmp, "w") as fh:
         json.dump({"digest": result.digest,
                    "state_digest": result.state_digest,
@@ -590,7 +732,11 @@ def _child_main(workdir: str) -> int:
                    "metrics": np.asarray(result.metrics).tolist(),
                    "ladder_steps": result.ladder_steps,
                    "scrape_rebinds": result.scrape_rebinds,
-                   "resumed_from": result.resumed_from}, fh)
+                   "resumed_from": result.resumed_from,
+                   "hists": lst(result.hists),
+                   "ledger": lst(result.ledger),
+                   "flight_buf": lst(result.flight_buf),
+                   "flight_seq": result.flight_seq}, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, res_path)

@@ -59,7 +59,14 @@ def main(argv=None) -> int:
                    "trace; default 1M)")
     p.add_argument("--conformance", action="store_true",
                    help="print the per-client QoS conformance table "
-                   "(delivered rate vs reservation/weight/limit)")
+                   "(delivered rate vs reservation/weight/limit), "
+                   "plus reservation-tardiness percentiles when the "
+                   "backend materializes tags")
+    p.add_argument("--ledger-check", action="store_true",
+                   help="cross-check backend conformance ledgers "
+                   "(device-truth per-client served/reservation "
+                   "counts) against the host-recomputed sim stats; "
+                   "exits nonzero on a mismatch")
     p.add_argument("--metrics-out", metavar="FILE", default=None,
                    help="dump the metrics registry at exit: Prometheus "
                    "text (.prom/.txt) or JSON snapshot (.json)")
@@ -115,6 +122,31 @@ def main(argv=None) -> int:
     print(report.format(show_intervals=args.intervals))
     if args.conformance:
         print(report.format_conformance())
+        pct = report.tardiness_percentiles()
+        if pct is not None:
+            print("-- reservation tardiness (log2-quantized upper "
+                  "bounds) --")
+            print(f"p50 {pct['p50_ns']:.0f} ns | "
+                  f"p90 {pct['p90_ns']:.0f} ns | "
+                  f"p99 {pct['p99_ns']:.0f} ns | "
+                  f"mean {pct['mean_ns']:.0f} ns "
+                  f"({pct['count']} constraint serves)")
+    if args.ledger_check:
+        chk = report.ledger_check()
+        if chk is None:
+            print("# ledger-check: no backend exposes a conformance "
+                  "ledger (host-recomputed stats are the only "
+                  "record); pass")
+        elif chk["mismatches"]:
+            print(f"# ledger-check: FAILED -- "
+                  f"{len(chk['mismatches'])} client(s) diverge "
+                  f"between the backend ledger and the host "
+                  f"recount: {chk['mismatches'][:5]}")
+            return 1
+        else:
+            print(f"# ledger-check: ok ({chk['clients']} clients, "
+                  f"{chk['ops']} ops; backend ledger == host "
+                  "recount)")
     if trace is not None and trace.rows_dropped:
         print(f"# trace: {trace.rows_written} rows written, "
               f"{trace.rows_dropped} dropped past --trace-limit")

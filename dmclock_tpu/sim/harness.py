@@ -73,6 +73,12 @@ class ServerStats:
     reservation_ops: int = 0
     priority_ops: int = 0
     per_client_phase: Dict[Any, List[int]] = field(default_factory=dict)
+    # per-client [tardiness_sum_ns, tardiness_max_ns, resv_tag_ops]:
+    # the host half of the device conformance-ledger schema
+    # (obs.histograms LED_TARD_*), measurable only when the backend
+    # materializes tags (the oracle queues do; the TPU engine's device
+    # ledger carries its own)
+    per_client_tard: Dict[Any, List[int]] = field(default_factory=dict)
     add_request_timer: ProfileTimer = field(default_factory=ProfileTimer)
     request_complete_timer: ProfileTimer = field(default_factory=ProfileTimer)
 
@@ -101,6 +107,18 @@ def _record_service(server, client, phase: Phase, cost: int,
     server.stats.ops_completed += 1
     if phase is Phase.RESERVATION:
         server.stats.reservation_ops += 1
+        # reservation tardiness when the backend materializes tags
+        # (the device-ledger entry-head semantics, host side): how far
+        # past its reservation deadline the serve landed
+        if tag is not None:
+            tard = max(server.loop.now_ns - tag.reservation, 0)
+            row = server.stats.per_client_tard.setdefault(
+                client, [0, 0, 0])
+            row[0] += tard
+            row[1] = max(row[1], tard)
+            row[2] += 1
+            if server.tard_hist is not None:
+                server.tard_hist.observe(tard)
     else:
         server.stats.priority_ops += 1
 
@@ -129,6 +147,7 @@ class SimulatedServer:
         self.stats = ServerStats()
         self.trace = trace
         self.decision_trace = decision_trace
+        self.tard_hist = None    # registry histogram, set by Simulation
         self._wake_at: Optional[int] = None
 
     # the "network" seam: a client submits a request here
@@ -221,6 +240,7 @@ class PushSimulatedServer:
         self.stats = ServerStats()
         self.trace = trace
         self.decision_trace = decision_trace
+        self.tard_hist = None    # registry histogram, set by Simulation
         # make_queue(can_handle_f, handle_f, now_ns_f, sched_at_f,
         # capacity_f); capacity_f is the free-slot count (reference
         # has_avail_thread, sim_server.h:179) -- batch-capable queues
@@ -475,8 +495,18 @@ class Simulation:
         """Per-server hot-path stats: the host-call timers as merged
         summaries, the queue's scheduling counters via its own
         ``register_metrics`` when the backend offers one."""
+        from ..obs.histograms import BUCKET_BOUNDS
+
         server = self.servers[s]
         labels = {"server": str(s)}
+        # one shared log2 tardiness histogram across servers (the
+        # device-histogram bucket layout, so sims and bench report
+        # the same families -- docs/OBSERVABILITY.md)
+        server.tard_hist = self.registry.histogram(
+            "sim_resv_tardiness_ns",
+            "reservation tardiness of constraint-phase serves "
+            "(log2 buckets; backends that materialize tags only)",
+            buckets=BUCKET_BOUNDS)
         self.registry.timer("sim_server_add_request_ns",
                             "queue add_request latency", labels=labels,
                             source=server.stats.add_request_timer)
@@ -559,6 +589,7 @@ class SimReport:
         slack both verdicts allow.
         """
         sim = self.sim
+        tard = self._client_tardiness()
         rows = []
         for cid in sorted(sim.clients):
             c = sim.clients[cid]
@@ -569,7 +600,10 @@ class SimReport:
             rate = c.stats.ops_completed / window_s
             demand = c.stats.ops_requested / window_s
             resv_floor = min(g.client_reservation, demand)
+            t_sum, t_max, t_n = tard.get(cid, (0, 0, 0))
             rows.append({
+                "tardiness_mean_ns": t_sum / max(t_n, 1),
+                "tardiness_max_ns": t_max,
                 "client": cid,
                 "group": sim.client_group_of[cid],
                 "reservation": g.client_reservation,
@@ -586,6 +620,95 @@ class SimReport:
                 if g.client_limit > 0 else True,
             })
         return rows
+
+    def _client_tardiness(self) -> Dict[Any, Tuple[int, int, int]]:
+        """Per-client (tardiness_sum, tardiness_max, resv_tag_ops)
+        merged across servers -- the host half of the device ledger's
+        tardiness columns (zeros for backends without tags)."""
+        out: Dict[Any, List[int]] = {}
+        for s in self.sim.servers.values():
+            for cid, (t_sum, t_max, t_n) in \
+                    s.stats.per_client_tard.items():
+                row = out.setdefault(cid, [0, 0, 0])
+                row[0] += t_sum
+                row[1] = max(row[1], t_max)
+                row[2] += t_n
+        return {cid: tuple(v) for cid, v in out.items()}
+
+    def tardiness_percentiles(self) -> Optional[dict]:
+        """p50/p90/p99 reservation tardiness from the shared log2
+        histogram the servers observe into -- packed into a device-
+        histogram block row so ``obs.histograms.hist_percentile`` is
+        THE quantization math (one implementation; sims and bench
+        cannot drift).  None when no constraint-phase serve carried a
+        tag."""
+        import numpy as np
+
+        from ..obs import histograms as obshist
+
+        h = self.sim.registry.histogram("sim_resv_tardiness_ns")
+        if h.count == 0:
+            return None
+        block = np.zeros((obshist.NUM_HISTS, obshist.NUM_BUCKETS + 1),
+                         dtype=np.int64)
+        fam = obshist.HIST_RESV_TARDINESS
+        block[fam, :obshist.NUM_BUCKETS] = h.counts
+        block[fam, obshist.HIST_SUM_COL] = int(h.sum)
+        return {"count": h.count,
+                "mean_ns": obshist.hist_mean(block, fam),
+                "p50_ns": obshist.hist_percentile(block, fam, 0.50),
+                "p90_ns": obshist.hist_percentile(block, fam, 0.90),
+                "p99_ns": obshist.hist_percentile(block, fam, 0.99)}
+
+    def ledger_check(self) -> Optional[dict]:
+        """Cross-check backend conformance ledgers against the
+        harness's own host-recomputed per-client stats -- the
+        device-truth-vs-host-recount gate at sim scale.
+
+        Sums ``ledger_rows()`` over every queue backend that exposes
+        one (``engine.queue.TpuPullPriorityQueue``) and compares ops /
+        reservation-ops per client against the servers'
+        ``per_client_phase`` tables.  Only clients the backend STILL
+        tracks are judged: an erased/recycled slot's ledger row is
+        deliberately zeroed by the queue (a new tenant must not
+        inherit it), so those clients are reported under
+        ``recycled_clients`` instead of failing the gate.  Returns
+        ``{"clients", "ops", "recycled_clients", "mismatches": [...]}``
+        or None when no backend exposes a ledger (the oracle queues
+        recompute host-side only)."""
+        ledgers: Dict[Any, List[int]] = {}
+        found = False
+        for s in self.sim.servers.values():
+            queue = getattr(s, "queue", None)
+            if queue is None or not hasattr(queue, "ledger_rows"):
+                continue
+            found = True
+            for cid, row in queue.ledger_rows().items():
+                acc = ledgers.setdefault(cid, [0, 0])
+                acc[0] += int(row[0])
+                acc[1] += int(row[1])
+        if not found:
+            return None
+        host: Dict[Any, List[int]] = {}
+        for s in self.sim.servers.values():
+            for cid, (res, prio) in s.stats.per_client_phase.items():
+                acc = host.setdefault(cid, [0, 0])
+                acc[0] += res + prio
+                acc[1] += res
+        mismatches = []
+        for cid in sorted(ledgers):
+            led = ledgers[cid]
+            hst = host.get(cid, [0, 0])
+            if led != hst:
+                mismatches.append({"client": cid,
+                                   "ledger_ops": led[0],
+                                   "host_ops": hst[0],
+                                   "ledger_resv": led[1],
+                                   "host_resv": hst[1]})
+        return {"clients": len(ledgers),
+                "ops": sum(v[0] for v in ledgers.values()),
+                "recycled_clients": len(set(host) - set(ledgers)),
+                "mismatches": mismatches}
 
     def format_conformance(self, tol: float = 0.05) -> str:
         rows = self.conformance(tol=tol)

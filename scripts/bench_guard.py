@@ -19,6 +19,12 @@ Exit 0 when there is not enough history yet (the guard cannot judge a
 first session), when every workload's newest rate clears
 median/tolerance, or when run on a box with no history at all; exit 1
 on a regression.
+
+p99 reservation tardiness (the device-ledger QoS column bench.py
+records since the telemetry plane landed) is tracked as its own
+history series per workload and WARNED on -- tail QoS regressions
+surface even when throughput held, but the log2-quantized octaves
+and calibration-dependent equilibria make a hard gate flap.
 """
 
 from __future__ import annotations
@@ -211,6 +217,45 @@ def main() -> int:
               + (f" [{dpp:.0f} dec/pass]" if dpp else ""))
         if dps < floor:
             status = 1
+        # p99 reservation tardiness rides the same per-workload
+        # history as its own series: a QoS regression (tail tardiness
+        # UP past tolerance x the median) is worth a warning even
+        # when throughput held -- the paper's contract is per-client
+        # QoS, not just decisions/sec.  Warn-only: the log2 buckets
+        # quantize to octaves, and tardiness equilibria legitimately
+        # shift with calibration; a hard gate would flap.
+        p99 = row.get("tardiness_p99_ns")
+        if p99 is not None:
+            t_hist = [r["workloads"][wl]["tardiness_p99_ns"]
+                      for _, r in prior
+                      if wl in r.get("workloads", {})
+                      and "tardiness_p99_ns" in r["workloads"][wl]
+                      and r["workloads"][wl].get("select_impl",
+                                                 "sort") == impl
+                      and r["workloads"][wl].get("calendar_impl",
+                                                 "minstop") == cal]
+            if len(t_hist) < args.min_records:
+                print(f"bench_guard: {tag}: p99 tardiness "
+                      f"{p99/1e6:.2f}ms ({len(t_hist)} prior "
+                      "record(s) -- not judged)")
+            else:
+                t_med = median(t_hist)
+                # floor the median at 1ms: a perfectly-conformant
+                # history (median ~0) must not warn on nanosecond
+                # tails -- sub-ms p99 tardiness is octave-quantized
+                # noise, not a QoS regression
+                ceil = max(t_med, 1e6) * args.tolerance
+                if p99 > ceil:
+                    print(f"bench_guard: {tag}: WARNING p99 "
+                          f"tardiness {p99/1e6:.2f}ms vs median "
+                          f"{t_med/1e6:.2f}ms over {len(t_hist)} "
+                          f"sessions (> {args.tolerance:g}x) -- "
+                          "tail QoS regressed; investigate even "
+                          "though throughput held", file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: p99 tardiness "
+                          f"{p99/1e6:.2f}ms vs median "
+                          f"{t_med/1e6:.2f}ms -- OK")
     if status:
         print(f"bench_guard: FAILED on {newest_name} -- a >"
               f"{args.tolerance:g}x drop survived the drift margin; "

@@ -162,6 +162,77 @@ print(f"bucketed L={L} == {L}x minstop composition "
 print("calendar digest gate ok")
 EOF
 
+echo "== telemetry smoke (histogram/ledger digest gate + scrape) =="
+# the device telemetry plane (docs/OBSERVABILITY.md): (1) enabling
+# histograms + ledger + flight recorder must leave the decision digest
+# BIT-IDENTICAL on a prefix epoch and a bucketed calendar epoch;
+# (2) the accumulated telemetry must be self-consistent with the
+# decision stream (ledger ops == decisions, commit-size sum ==
+# decisions); (3) one histogram family must scrape as a proper
+# Prometheus histogram (_bucket/_sum/_count) from the HTTP endpoint,
+# and /healthz must answer.
+timeout -k 30 900 python - <<'EOF'
+import functools, hashlib, json, urllib.request
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np, jax.numpy as jnp
+from __graft_entry__ import _preloaded_state
+from dmclock_tpu.engine.fastpath import scan_calendar_epoch, scan_prefix_epoch
+from dmclock_tpu.obs import MetricsRegistry, MetricsHTTPServer
+from dmclock_tpu.obs import flight as obsflight
+from dmclock_tpu.obs import histograms as obshist
+
+now = jnp.int64(0)
+def digest(ep, fields):
+    h = hashlib.sha256()
+    for f in fields:
+        h.update(jax.device_get(getattr(ep, f)).tobytes())
+    return h.hexdigest()
+
+kit = dict(hists=obshist.hist_zero(), ledger=obshist.ledger_zero(2048),
+           flight=obsflight.flight_init(256))
+runs = {
+    "prefix": (functools.partial(scan_prefix_epoch, m=4, k=256,
+                                 anticipation_ns=0),
+               ("count", "slot", "phase", "cost", "lb")),
+    "calendar-bucketed": (functools.partial(
+        scan_calendar_epoch, m=2, steps=8, calendar_impl="bucketed",
+        ladder_levels=4), ("count", "resv_count", "served")),
+}
+hist_total = None
+for name, (fn, fields) in runs.items():
+    st = _preloaded_state(2048, 16, ring=16)
+    ep_off = jax.jit(fn)(st, now)
+    ep_on = jax.jit(lambda s, t: fn(s, t, **kit))(st, now)
+    d0, d1 = digest(ep_off, fields), digest(ep_on, fields)
+    assert d0 == d1, f"{name}: digest diverged with telemetry on"
+    total = int(jax.device_get(ep_on.count).sum())
+    led = np.asarray(jax.device_get(ep_on.ledger))
+    hd = obshist.hist_dict(ep_on.hists)
+    assert led[:, obshist.LED_OPS].sum() == total, name
+    assert hd["commit_size"]["sum"] == total, name
+    assert int(jax.device_get(ep_on.flight.seq)) > 0, name
+    if hist_total is None:
+        hist_total = ep_on.hists
+    print(f"{name}: telemetry digest gate ok ({total} decisions, "
+          f"digest {d0[:16]})")
+
+reg = MetricsRegistry()
+obshist.publish_hists(reg, hist_total, prefix="dmclock")
+with MetricsHTTPServer(reg, port=0) as srv:
+    with urllib.request.urlopen(srv.url, timeout=10) as resp:
+        text = resp.read().decode()
+    assert "# TYPE dmclock_decision_latency_ns histogram" in text
+    assert 'dmclock_decision_latency_ns_bucket{le="+Inf"}' in text
+    assert "dmclock_decision_latency_ns_sum" in text
+    assert "dmclock_decision_latency_ns_count" in text
+    with urllib.request.urlopen(srv.healthz_url, timeout=10) as resp:
+        assert json.loads(resp.read()) == {"status": "ok"}
+print("telemetry smoke ok (bit-identical digests; scrape serves "
+      "histogram families; /healthz answers)")
+EOF
+
 echo "== chaos smoke (seeded dropout+restart; zero-fault digest gate) =="
 # the robustness spine (docs/ROBUSTNESS.md): (1) an all-benign
 # FaultPlan must be BIT-IDENTICAL to running with no fault plumbing at

@@ -89,6 +89,57 @@ def test_fallback_prior_excluded_from_medians(monkeypatch, capsys,
     assert "excluded from medians" in out
 
 
+def write_history_tard(tmp_path, rows):
+    """rows = [(dps, p99_tardiness_ns), ...] on one device."""
+    h = tmp_path / "history"
+    h.mkdir()
+    for i, (dps, p99) in enumerate(rows):
+        (h / f"bench_{1000 + i}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": "tpu0",
+             "workloads": {"cfg4": {"dps": dps,
+                                    "tardiness_p99_ns": p99}}}))
+    return h
+
+
+def test_tardiness_series_ok_when_stable(monkeypatch, capsys,
+                                         tmp_path):
+    hist = write_history_tard(tmp_path, [(40e6, 1e6), (42e6, 2e6),
+                                         (41e6, 1.5e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "p99 tardiness" in out and "OK" in out
+
+
+def test_tardiness_regression_warns_but_passes(monkeypatch, capsys,
+                                               tmp_path):
+    # tail QoS regressed 10x while throughput held: warn-only (the
+    # log2 octaves and calibration shifts make a hard gate flap), and
+    # the throughput verdict stays the exit code
+    monkeypatch.setattr(bg, "HISTORY",
+                        write_history_tard(tmp_path,
+                                           [(40e6, 1e6), (42e6, 2e6),
+                                            (41e6, 15e6)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING p99 tardiness" in cap.err
+    assert "tail QoS regressed" in cap.err
+
+
+def test_tardiness_not_judged_without_history(monkeypatch, capsys,
+                                              tmp_path):
+    # records predating the telemetry plane carry no tardiness column
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 42e6)])
+    (hist / "bench_2000.json").write_text(json.dumps(
+        {"platform": "tpu", "device": "tpu0",
+         "workloads": {"serve": {"dps": 41e6,
+                                 "tardiness_p99_ns": 3e6}}}))
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "p99 tardiness" in out and "not judged" in out
+
+
 def test_tolerance_flag(monkeypatch, capsys, tmp_path):
     hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 40e6),
                                     ("tpu0", 15e6)])

@@ -132,6 +132,78 @@ class TestCrashEquivalence:
             SV.run_supervised(job, tmp_path, plan, max_restarts=1)
 
 
+TELE_JOB = dataclasses.replace(
+    ENGINE_JOBS["calendar-bucketed"], with_hists=True,
+    with_ledger=True, flight_records=64)
+
+
+class TestTelemetryCrashEquivalence:
+    """Crash equivalence extends to the telemetry plane: histograms,
+    ledger, and the flight ring ride the rotation checkpoints, so a
+    killed-and-resumed run's telemetry equals the uninterrupted
+    run's bit-for-bit (ISSUE-6 acceptance gate)."""
+
+    def _ref(self):
+        if "tele" not in _REFS:
+            _REFS["tele"] = SV.run_job(TELE_JOB)
+        return _REFS["tele"]
+
+    def test_reference_carries_telemetry(self):
+        ref = self._ref()
+        assert ref.hists is not None and ref.ledger is not None
+        assert ref.hists[:, :-1].sum() > 0
+        # device truth: the ledger's ops column covers every decision
+        assert ref.ledger[:, 0].sum() == ref.decisions
+        assert ref.flight_seq > 0
+        assert ref.flight_buf.shape == (64, 6)
+
+    def test_kill_mid_run_telemetry_bit_identical(self, tmp_path):
+        ref = self._ref()
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(ref.decisions // 2, 1),))
+        res = SV.run_supervised(TELE_JOB, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)   # incl. hists/ledger/
+        assert res.restarts == 1               # flight ring + seq
+
+    def test_zero_fault_telemetry_gate(self, tmp_path):
+        ref = self._ref()
+        res = SV.run_supervised(TELE_JOB, tmp_path,
+                                HF.zero_host_plan())
+        SV.assert_crash_equivalent(res, ref)
+        assert np.array_equal(res.metrics, ref.metrics)
+
+    def test_telemetry_mismatch_is_caught(self):
+        """The extended gate actually bites: a perturbed ledger cell
+        must fail the assertion."""
+        ref = self._ref()
+        bad = ref._replace(ledger=ref.ledger.copy())
+        bad.ledger[0, 0] += 1
+        with pytest.raises(AssertionError, match="ledger"):
+            SV.assert_crash_equivalent(bad, ref)
+
+    def test_flight_dump_on_crash(self, tmp_path):
+        """A killed incarnation dumps its flight ring (--flight-dump):
+        the postmortem record of what the engine was committing when
+        the host died."""
+        ref = self._ref()
+        dump = tmp_path / "flight.jsonl"
+        job = dataclasses.replace(TELE_JOB,
+                                  flight_dump=str(dump))
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(ref.decisions // 2, 1),))
+        res = SV.run_supervised(job, tmp_path / "wd", plan)
+        SV.assert_crash_equivalent(res, ref)
+        assert dump.exists(), "crash dump missing"
+        import json as _json
+        rows = [_json.loads(ln) for ln in
+                dump.read_text().splitlines()]
+        assert rows, "crash dump empty"
+        seqs = [r["seq"] for r in rows]
+        assert seqs == sorted(seqs)
+        assert all(set(r) == {"seq", "batch", "client", "cls",
+                              "tag", "cost"} for r in rows)
+
+
 class TestScrapeLoss:
     def test_scrape_drop_rebinds_and_run_unperturbed(self, tmp_path):
         name = "prefix-sort"
