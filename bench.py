@@ -44,6 +44,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dmclock_tpu.obs import spans as obsspans
+
 # LEGACY sorted-engine cfg4 reservation rate (round-4 calibration:
 # share 0.49 at the sorted engine's ~6M dec/s equilibrium; kept for
 # benchmark/run_sweeps.py's sorted-engine comparison rows).  The
@@ -53,14 +55,20 @@ import numpy as np
 CFG4_RESV_RATE = 25.0
 
 
-def _timed_chain(run, state, epochs: int):
+def _timed_chain(run, state, epochs: int, tracer=None):
     """Chain ``epochs`` async epoch calls with ONE digest sync; returns
     (state, total_decisions, wall_s, guards_ok, metrics).  Guards are
     collected for EVERY epoch: a mid-chain trip zeroes that epoch's
     counts, and checking only the final epoch would report the deflated
     rate as valid.  ``metrics`` is the combined on-device obs vector
     (zeros when the runner compiled with metrics off), fetched UNTIMED
-    after the wall clock stops."""
+    after the wall clock stops.
+
+    With a span ``tracer`` each async epoch call records a dispatch
+    span (the per-launch dispatch tax -- the call returns once
+    enqueued) and the digest sync a device_compute span (the chain's
+    device-side remainder); together they cover the chain wall, the
+    decomposition ``--spans`` reports."""
     from profile_util import state_digest
 
     from dmclock_tpu.obs import device as obsdev
@@ -68,12 +76,18 @@ def _timed_chain(run, state, epochs: int):
     t0 = time.perf_counter()
     counts, guards, mets = [], [], []
     for _ in range(epochs):
-        ep = run(state, jnp.int64(0))
-        state = ep.state
-        counts.append(ep.count)
-        guards.append(ep.guards_ok)
-        mets.append(ep.metrics)
-    jax.device_get(state_digest(state))
+        # the span covers the async call AND the result rebind: both
+        # are per-launch host bookkeeping (on cpu the rebind also
+        # absorbs wall time stolen by concurrently-running compute
+        # threads, which would otherwise be attributed to nothing)
+        with obsspans.span(tracer, "bench.epoch", "dispatch"):
+            ep = run(state, jnp.int64(0))
+            state = ep.state
+            counts.append(ep.count)
+            guards.append(ep.guards_ok)
+            mets.append(ep.metrics)
+    with obsspans.span(tracer, "bench.digest_sync", "device_compute"):
+        jax.device_get(state_digest(state))
     wall = time.perf_counter() - t0
     g_ok = all(bool(jax.device_get(g).all()) for g in guards)
     total = int(sum(int(jax.device_get(c).sum()) for c in counts))
@@ -81,6 +95,40 @@ def _timed_chain(run, state, epochs: int):
         np.zeros(obsdev.NUM_METRICS, dtype=np.int64),
         *[jax.device_get(m) for m in mets])
     return state, total, wall, g_ok, met
+
+
+def _span_window(tracer):
+    """Snapshot the tracer's per-category self-time totals at the
+    start of a timed region (None tracer -> None window)."""
+    return None if tracer is None else tracer.category_totals()
+
+
+def _span_summary(tracer, window, wall_s: float, launches: int):
+    """Close a span window over the timed chains: per-category
+    self-time deltas, the per-launch dispatch/device split, and the
+    host-overhead share of wall time -- the dispatch-tax decomposition
+    the JSON line carries (``"spans"``) and the acceptance gate
+    measures (host_prep + dispatch + device_compute + fetch + drain
+    must cover >= 95% of the measured wall)."""
+    if tracer is None or window is None:
+        return None
+    now = tracer.category_totals()
+    d = {c: now.get(c, 0) - window.get(c, 0)
+         for c in obsspans.CATEGORIES}
+    wall_ns = max(wall_s * 1e9, 1.0)
+    host_ns = d["ingest"] + d["host_prep"] + d["dispatch"] + \
+        d["fetch"] + d["drain"]
+    covered = host_ns + d["device_compute"] + d["checkpoint"]
+    launches = max(launches, 1)
+    return {
+        "launches": launches,
+        "dispatch_ms_per_launch": d["dispatch"] / launches / 1e6,
+        "device_ms_per_launch": d["device_compute"] / launches / 1e6,
+        "host_overhead_frac": host_ns / wall_ns,
+        "covered_frac": covered / wall_ns,
+        "wall_ms": wall_ns / 1e6,
+        "categories_ms": {c: v / 1e6 for c, v in d.items() if v},
+    }
 
 
 def epoch_cost_analysis(compiled) -> dict:
@@ -122,7 +170,7 @@ def bench_serve_only(k: int = 65536, m: int = 32, *,
                      depth: int = 320, reps: int = 5,
                      n: int = 100_000, with_metrics: bool = True,
                      select_impl: str = "sort", tag_width: int = 64,
-                     window_m: int | None = None):
+                     window_m: int | None = None, tracer=None):
     """Preloaded weight steady state, serving only (no ingest).
 
     DIFFERENCED chains: a short and a long chain each pay one dispatch
@@ -181,14 +229,22 @@ def bench_serve_only(k: int = 65536, m: int = 32, *,
     lat = scalar_latency()
     rates, total_d, total_pot = [], 0, 0
     met = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
+    win = _span_window(tracer)
+    wall_total = 0.0
+    launches = 0
     for rep in range(max(reps, 1)):
         if rep:
             state = _preloaded_state(n, depth, ring=depth)
-        state, _, _, _, _ = _timed_chain(run, state, 1)   # warm/compile
-        state, d_lo, t_lo, g1, m1 = _timed_chain(run, state, epochs_lo)
-        state, d_hi, t_hi, g2, m2 = _timed_chain(run, state, epochs_hi)
+        state, _, w0, _, _ = _timed_chain(run, state, 1,
+                                          tracer)   # warm/compile
+        state, d_lo, t_lo, g1, m1 = _timed_chain(run, state,
+                                                 epochs_lo, tracer)
+        state, d_hi, t_hi, g2, m2 = _timed_chain(run, state,
+                                                 epochs_hi, tracer)
         assert g1 and g2, "rebase guards tripped -- untrustworthy"
         met = obsdev_np_combine(met, m1, m2)
+        wall_total += w0 + t_lo + t_hi
+        launches += 1 + epochs_lo + epochs_hi
         if t_hi <= t_lo or t_lo < 1.2 * lat:
             continue    # jitter-inverted or RTT-floor-bound lo chain
         rates.append((d_hi - d_lo) / (t_hi - t_lo))
@@ -201,6 +257,11 @@ def bench_serve_only(k: int = 65536, m: int = 32, *,
            "fill": total_d / total_pot,
            "select_impl": select_impl, "tag_width": tag_width,
            "cost_analysis": cost}
+    sp = _span_summary(tracer, win, wall_total, launches)
+    if sp is not None:
+        out["spans"] = sp
+        out["dispatch_ms_per_launch"] = sp["dispatch_ms_per_launch"]
+        out["host_overhead_frac"] = sp["host_overhead_frac"]
     if with_metrics:
         out["device_metrics"] = obsdev.metrics_dict(met)
     return out
@@ -300,7 +361,7 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     select_impl: str = "sort",
                     calendar_impl: str = "minstop",
                     ladder_levels: int = 8,
-                    telemetry: bool = True):
+                    telemetry: bool = True, tracer=None):
     """Closed loop: Poisson superwave ingest + prefix serve epoch per
     round, chained async on device; ingest IS inside the timed region.
 
@@ -468,9 +529,11 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     #    proportionally larger reservation floor to stay at the same
     #    phase mix.  The damped multiplicative update converges in a
     #    few iterations; the measured share is reported.
-    state, _, _, _, _, _, _, tele = run(state, draw(), jnp.int64(0),
-                                        tele)
-    jax.device_get(state_digest(state))
+    with obsspans.span(tracer, "bench.round", "dispatch"):
+        state, _, _, _, _, _, _, tele = run(state, draw(),
+                                            jnp.int64(0), tele)
+    with obsspans.span(tracer, "bench.digest_sync", "device_compute"):
+        jax.device_get(state_digest(state))
     t_base = dt_round_ns
     cal_iters = 5 if (calendar_steps or target_resv_share) else 1
     from dmclock_tpu.core.timebase import rate_to_inv_ns
@@ -479,8 +542,9 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         resv_total = 0
         cal_rounds = 2
         for _ in range(cal_rounds):
-            state, cnt_, _, resv_, slot, lens, _, tele = run(
-                state, draw(), jnp.int64(t_base), tele)
+            with obsspans.span(tracer, "bench.round", "dispatch"):
+                state, cnt_, _, resv_, slot, lens, _, tele = run(
+                    state, draw(), jnp.int64(t_base), tele)
             t_base += dt_round_ns
             resv_total += int(jax.device_get(resv_).sum())
             if calendar_steps:
@@ -538,29 +602,41 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     # used instead (cheap smoke runs).
     rlo = max(rounds_lo, 0)
     n_pre = reps * (rlo + rounds) if rlo else rounds
-    pre = [draw() for _ in range(n_pre)]
-    jax.block_until_ready(pre)
+    with obsspans.span(tracer, "bench.pregen_arrivals", "host_prep"):
+        pre = [draw() for _ in range(n_pre)]
+        jax.block_until_ready(pre)
 
     met_acc = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
     # calibration's warm-up serves pollute the distribution: reset the
     # telemetry accumulators so the reported percentiles cover the
     # measured steady state only
     tele = tele_zero()
+    # span window opens HERE: the summary covers the timed chains
+    # only (calibration spans stay in the timeline but out of the
+    # dispatch-tax decomposition)
+    span_win = _span_window(tracer)
+    chain_walls = []
+    chain_launches = [0]
 
     def chain(idx):
         nonlocal state, t_base, met_acc, tele
         t0 = time.perf_counter()
         counts_out, resv_out, guards, mets = [], [], [], []
         for i in idx:
-            state, cnt, g, resv, _, _, met_, tele = run(
-                state, pre[i], jnp.int64(t_base), tele)
-            counts_out.append(cnt)
-            resv_out.append(resv)
-            guards.append(g)
-            mets.append(met_)
+            with obsspans.span(tracer, "bench.round", "dispatch"):
+                state, cnt, g, resv, _, _, met_, tele = run(
+                    state, pre[i], jnp.int64(t_base), tele)
+                counts_out.append(cnt)
+                resv_out.append(resv)
+                guards.append(g)
+                mets.append(met_)
             t_base += dt_round_ns
-        jax.device_get(state_digest(state))
+        with obsspans.span(tracer, "bench.digest_sync",
+                           "device_compute"):
+            jax.device_get(state_digest(state))
         wall = time.perf_counter() - t0
+        chain_walls.append(wall)
+        chain_launches[0] += len(idx)
         assert all(bool(jax.device_get(g).all()) for g in guards), \
             "rebase guards tripped -- counts are not trustworthy"
         cnts = np.concatenate([jax.device_get(c) for c in counts_out])
@@ -611,6 +687,15 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
            "mean_depth": mean_depth,
            "select_impl": select_impl,
            "cost_analysis": cost}
+    sp = _span_summary(tracer, span_win, sum(chain_walls),
+                       chain_launches[0])
+    if sp is not None:
+        out["spans"] = sp
+        # scalars ride the history record as their own bench_guard
+        # series (a dispatch-tax regression is a structural
+        # regression even when dec/s holds)
+        out["dispatch_ms_per_launch"] = sp["dispatch_ms_per_launch"]
+        out["host_overhead_frac"] = sp["host_overhead_frac"]
     if calendar_steps:
         # decisions per device launch (pass = one calendar batch):
         # the bucketed-vs-minstop acceptance currency -- the ladder's
@@ -652,8 +737,9 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         # harness's table (SimReport.conformance), at bench scale
         served_c = np.zeros(n, dtype=np.int64)
         for _ in range(conformance_rounds):
-            state, _c, _g, _r, slot, lens, _m, tele = run(
-                state, draw(), jnp.int64(t_base), tele)
+            with obsspans.span(tracer, "bench.round", "dispatch"):
+                state, _c, _g, _r, slot, lens, _m, tele = run(
+                    state, draw(), jnp.int64(t_base), tele)
             t_base += dt_round_ns
             if calendar_steps:
                 served_c += jax.device_get(slot).astype(np.int64)
@@ -733,8 +819,9 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         pending: deque = deque()
         marks = []
         for i in range(n_rounds):
-            state, cnt, _, _, _, _, _, tele = run(
-                state, pre2[i], jnp.int64(t_base), tele)
+            with obsspans.span(tracer, "bench.round", "dispatch"):
+                state, cnt, _, _, _, _, _, tele = run(
+                    state, pre2[i], jnp.int64(t_base), tele)
             t_base += dt_round_ns
             pending.append(cnt)
             if len(pending) >= w:
@@ -998,6 +1085,18 @@ def main() -> None:
     ap.add_argument("--conformance-out", metavar="FILE", default=None,
                     help="write the cfg4 per-client conformance table "
                     "as JSONL")
+    ap.add_argument("--spans", action="store_true",
+                    help="collect host spans (obs.spans) through "
+                    "calibration + the timed chains and report the "
+                    "per-launch dispatch-tax decomposition "
+                    "(dispatch_ms_per_launch, device_ms_per_launch, "
+                    "host_overhead_frac, per-category breakdown) in "
+                    "the JSON line; decisions are bit-identical "
+                    "either way (spans are host-side only)")
+    ap.add_argument("--trace-out", metavar="FILE.json", default=None,
+                    help="write the collected spans as a Chrome "
+                    "trace-event / Perfetto timeline (implies "
+                    "--spans); load in chrome://tracing")
     ap.add_argument("--metrics-port", type=int, metavar="PORT",
                     default=None,
                     help="serve the live default metrics registry over "
@@ -1045,9 +1144,22 @@ def main() -> None:
     backend_fallback = None   # "dispatch" after a launch-time switch
     wm = args.device_metrics == "on"
     tele_on = args.telemetry == "on"
+    if args.trace_out:
+        args.spans = True
+    tracer = obsspans.SpanTracer() if args.spans else None
+    watchdog = None
+    if tracer is not None:
+        # steady-state watchdog: warns live when the launch cadence
+        # stalls or the dispatch share breaches its threshold
+        # (docs/OBSERVABILITY.md tracing plane)
+        from dmclock_tpu.obs import default_registry
+        from dmclock_tpu.obs.watchdog import Watchdog
+        watchdog = Watchdog(tracer, interval_s=2.0,
+                            stall_after_s=60.0,
+                            registry=default_registry()).start()
     from dmclock_tpu.robust.guarded import DegradationLadder
     ladder = DegradationLadder(enabled=not args.no_ladder,
-                               threshold=1)
+                               threshold=1, tracer=tracer)
 
     def emit(out: dict) -> None:
         """THE json line: every exit path goes through here so the
@@ -1070,6 +1182,20 @@ def main() -> None:
             out["backend_error"] = backend_err
         if backend_fallback:
             out["backend_fallback"] = backend_fallback
+        if watchdog is not None:
+            watchdog.close()
+            if watchdog.warnings:
+                out["watchdog_warnings"] = watchdog.warnings[-8:]
+        if tracer is not None and args.trace_out:
+            # export on EVERY exit path (the emit contract): a failed
+            # run's timeline is exactly when you want the trace
+            try:
+                from dmclock_tpu.obs import export_chrome_trace
+                n_ev = export_chrome_trace(tracer, args.trace_out)
+                print(f"# trace-out: {n_ev} spans -> "
+                      f"{args.trace_out}", file=sys.stderr)
+            except OSError as e:
+                print(f"# trace-out failed: {e}", file=sys.stderr)
         print(json.dumps(out))
 
     if backend == "none":
@@ -1120,7 +1246,7 @@ def main() -> None:
         if args.mode in ("all", "serve"):
             # the cpu fallback cannot hold a 100k x 320 backlog in
             # tolerable time; a scaled-down shape keeps the smoke alive
-            serve_kw = dict(with_metrics=wm)
+            serve_kw = dict(with_metrics=wm, tracer=tracer)
             if backend == "cpu":
                 serve_kw.update(k=1024, m=4, depth=48, n=4096,
                                 epochs_lo=1, epochs_hi=2, reps=3)
@@ -1151,7 +1277,8 @@ def main() -> None:
                     10_000, 4096, 32, 60, zipf=False, resv_rate=100.0,
                     dt_round_ns=100_000_000, ring=256, depth0=128,
                     rounds_lo=20, with_metrics=wm,
-                    select_impl=select_impl, telemetry=tele_on))
+                    select_impl=select_impl, telemetry=tele_on,
+                    tracer=tracer))
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster
@@ -1181,7 +1308,7 @@ def main() -> None:
                         calendar_impl=calendar_impl,
                         ladder_levels=args.ladder_levels,
                         conformance_out=args.conformance_out,
-                        telemetry=tele_on))
+                        telemetry=tele_on, tracer=tracer))
                 key = "cfg4" if eff["calendar_impl"] == "minstop" \
                     else "cfg4_bucketed"
                 results.setdefault(key, row)
@@ -1270,6 +1397,13 @@ def main() -> None:
             obshist.publish_hists(default_registry(),
                                   np.asarray(hb, dtype=np.int64),
                                   labels={"workload": wl})
+        if "spans" in row:
+            # span-derived dispatch-tax gauges ride the same scrape
+            # endpoint as the histogram families
+            from dmclock_tpu.obs import (default_registry,
+                                         publish_span_gauges)
+            publish_span_gauges(default_registry(), row["spans"],
+                                labels={"workload": wl})
 
     try:
         _record_history(results, fault_plan=args.fault_plan,
@@ -1308,6 +1442,12 @@ def main() -> None:
     # real tardiness percentiles from the device telemetry plane (the
     # sims' host-computed table, replaced by device truth at bench
     # scale); log2-quantized upper bounds, never under-reported
+    # the per-launch dispatch-tax decomposition per workload (span
+    # tracer; the before/after currency for the streaming-loop PR)
+    span_rows = {wl: row["spans"] for wl, row in results.items()
+                 if "spans" in row}
+    if span_rows:
+        final["spans"] = span_rows
     tard = {wl: {"p50": row["tardiness_p50_ns"],
                  "p90": row["tardiness_p90_ns"],
                  "p99": row["tardiness_p99_ns"],

@@ -42,6 +42,7 @@ from ..core.recs import Phase, ReqParams
 from ..core.scheduler import AtLimit, NextReqType, PullReq
 from ..core.tags import tag_calc
 from ..core.timebase import MAX_TAG, MIN_TAG, sec_to_ns
+from ..obs import spans as _spans
 from ..robust.guarded import RECOVERABLE_ERRORS, retry_with_backoff
 from . import kernels
 from .kernels import (OP_ADD, OP_CREATE, OP_NOP, FUTURE, NONE, RETURNING,
@@ -178,7 +179,15 @@ class TpuPullPriorityQueue:
                  retry_base_s: float = 0.05,
                  retry_sleep: Callable[[float], None] = None,
                  monotonic_clock: Callable[[], float] =
-                 _walltime.monotonic):
+                 _walltime.monotonic,
+                 # time-domain tracing (obs.spans.SpanTracer or None):
+                 # host-side spans around every launch -- pack ->
+                 # dispatch -> device wait -> fetch -> fold -- the
+                 # per-launch dispatch-tax decomposition
+                 # (docs/OBSERVABILITY.md tracing plane).  None (the
+                 # default) is a single None-check per site; decisions
+                 # are bit-identical either way
+                 tracer=None):
         assert delayed_tag_calc, \
             "the TPU engine is DelayedTagCalc by construction"
         # a bare number passed for at_limit is a RejectThreshold and
@@ -192,6 +201,7 @@ class TpuPullPriorityQueue:
             self.at_limit = AtLimit.REJECT
             self.reject_threshold_ns = int(at_limit)
         self.client_info_f = client_info_f
+        self.tracer = tracer
         self.anticipation_timeout_ns = int(anticipation_timeout_ns)
         # host immediate-mode limit mirror (REJECT admission):
         # slot -> (prev_limit, prev_arrival, limit_inv, info cache)
@@ -298,10 +308,22 @@ class TpuPullPriorityQueue:
         before re-raising."""
         def on_retry(_attempt, _exc):
             self.guard_retries += 1
+            _spans.instant(self.tracer, "queue.retry", "retry",
+                           error=type(_exc).__name__)
+
+        def one_attempt():
+            # the dispatch span wraps ONE attempt's jit call (a jitted
+            # launch returns once dispatched, so this IS the
+            # per-launch dispatch tax) -- never the backoff sleeps
+            # between failed attempts, which would inflate
+            # dispatch_ms_per_launch by retry_base_s per retry (the
+            # guarded runner scopes its spans the same way)
+            with _spans.span(self.tracer, "queue.launch", "dispatch"):
+                return fn(*args)
 
         try:
             return retry_with_backoff(
-                lambda: fn(*args), retries=self.device_retries,
+                one_attempt, retries=self.device_retries,
                 base_s=self.retry_base_s, on_retry=on_retry,
                 sleep=self._retry_sleep)
         except RECOVERABLE_ERRORS:
@@ -316,7 +338,8 @@ class TpuPullPriorityQueue:
         drained rows if the launch ultimately fails so a later attempt
         (or a recovered device) still applies them."""
         rows = self._pending
-        ops = self._build_ops()
+        with _spans.span(self.tracer, "queue.pack_ops", "host_prep"):
+            ops = self._build_ops()
         if ops is None:
             if plain_fn is None:
                 return None
@@ -428,7 +451,8 @@ class TpuPullPriorityQueue:
             return errno.EINVAL
         if time_ns is None:
             time_ns = sec_to_ns(_walltime.time())
-        with self.data_mtx:
+        with _spans.span(self.tracer, "queue.add", "ingest"), \
+                self.data_mtx:
             self.tick += 1
             slot = self._slot_of.get(client_id)
             created = slot is None
@@ -525,10 +549,26 @@ class TpuPullPriorityQueue:
             self.state, dec = self._drain_and_launch(
                 self._jit_ingest_run(1, False),
                 self._jit_run(1, False), now_ns)
-            d = jax.device_get(dec)
-            return self._decision_to_pullreq(
-                int(d[0, 0]), int(d[1, 0]), int(d[2, 0]),
-                int(d[3, 0]), int(d[4, 0]), bool(d[5, 0]))
+            d = self._traced_fetch(dec)
+            with _spans.span(self.tracer, "queue.fold", "drain"):
+                return self._decision_to_pullreq(
+                    int(d[0, 0]), int(d[1, 0]), int(d[2, 0]),
+                    int(d[3, 0]), int(d[4, 0]), bool(d[5, 0]))
+
+    def _traced_fetch(self, dec):
+        """Fetch a decision array, decomposed for the tracing plane:
+        with a tracer attached the device wait (``block_until_ready``)
+        and the host transfer (``device_get``) are separate spans, so
+        per-launch wall time splits into dispatch / device_compute /
+        fetch instead of lumping into one blocking fetch.  Without a
+        tracer this is exactly the old single ``device_get`` (no extra
+        sync)."""
+        if self.tracer is None:
+            return jax.device_get(dec)
+        with self.tracer.span("queue.device_wait", "device_compute"):
+            jax.block_until_ready(dec)
+        with self.tracer.span("queue.fetch", "fetch"):
+            return jax.device_get(dec)
 
     # ------------------------------------------------------------------
     # speculative decision buffer
@@ -590,7 +630,12 @@ class TpuPullPriorityQueue:
             self._spec_size, self.at_limit is AtLimit.ALLOW,
             self.anticipation_timeout_ns), pre, now_ns)
         self.state = st
-        d, horizon = jax.device_get((dec, hz))
+        if self.tracer is not None:
+            with self.tracer.span("queue.device_wait",
+                                  "device_compute"):
+                jax.block_until_ready((dec, hz))
+        with _spans.span(self.tracer, "queue.fetch", "fetch"):
+            d, horizon = jax.device_get((dec, hz))
         first = (int(d[0, 0]), int(d[1, 0]), int(d[2, 0]),
                  int(d[3, 0]), int(d[4, 0]), bool(d[5, 0]))
         self._spec_pre = pre
@@ -687,7 +732,7 @@ class TpuPullPriorityQueue:
             self.state, dec = self._drain_and_launch(
                 self._jit_ingest_run(max_decisions, advance_now),
                 self._jit_run(max_decisions, advance_now), now_ns)
-            d = jax.device_get(dec)
+            d = self._traced_fetch(dec)
             for i in range(d.shape[1]):
                 pr = self._decision_to_pullreq(
                     int(d[0, i]), int(d[1, i]), int(d[2, i]),

@@ -24,18 +24,33 @@ an HBM flight recorder of the last R commit records drained only at
 epoch/checkpoint boundaries -- distributions in the data path, not the
 control path.
 
+And the time-domain tracing plane (``obs.spans``,
+``obs.trace_export``, ``obs.watchdog``): a thread-safe ns-resolution
+host span tracer (nested spans, fixed category taxonomy, bounded
+ring), Chrome trace-event / Perfetto export so any run produces a
+``chrome://tracing``-loadable timeline, and a steady-state watchdog
+that warns on launch-cadence stalls and dispatch-share breaches.
+Spans are host-side only, never in-graph -- decisions are
+bit-identical with tracing on or off.
+
 See ``docs/OBSERVABILITY.md`` for metric names and schemas.
 """
 
 from .registry import (Counter, Gauge, Histogram, MetricsHTTPServer,
                        MetricsRegistry, TimerMetric, default_registry,
-                       start_http_server)
+                       publish_span_gauges, start_http_server)
 from .trace import DecisionTrace, validate_trace_file
-from . import device, flight, histograms
+from .spans import SpanTracer
+from .trace_export import export_chrome_trace, validate_chrome_trace
+from .watchdog import Watchdog
+from . import device, flight, histograms, spans, trace_export
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "TimerMetric",
     "default_registry", "MetricsHTTPServer", "start_http_server",
+    "publish_span_gauges",
     "DecisionTrace", "validate_trace_file",
-    "device", "flight", "histograms",
+    "SpanTracer", "export_chrome_trace", "validate_chrome_trace",
+    "Watchdog",
+    "device", "flight", "histograms", "spans", "trace_export",
 ]

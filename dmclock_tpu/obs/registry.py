@@ -187,6 +187,13 @@ class TimerMetric(_Metric):
             comb.combine(t)
         return comb
 
+    def _reentries(self) -> int:
+        """Reentrant start() calls across the sources (ProfileTimer
+        counts them when a running timer is restarted -- the abandoned
+        in-flight interval deflates count/sum, so the stat must be
+        VISIBLE at the drain or the discard stays silent)."""
+        return sum(getattr(t, "reentries", 0) for t in self._sources)
+
     def sample_rows(self):
         c = self._combined()
         return [("_count", {}, c.count),
@@ -194,13 +201,15 @@ class TimerMetric(_Metric):
                 ("_min", {}, c.low_ns or 0),
                 ("_max", {}, c.high_ns or 0),
                 ("_mean", {}, c.mean_ns()),
-                ("_stddev", {}, c.std_dev_ns())]
+                ("_stddev", {}, c.std_dev_ns()),
+                ("_reentries", {}, self._reentries())]
 
     def value_obj(self):
         c = self._combined()
         return {"count": c.count, "sum_ns": c.sum_ns,
                 "min_ns": c.low_ns or 0, "max_ns": c.high_ns or 0,
-                "mean_ns": c.mean_ns(), "stddev_ns": c.std_dev_ns()}
+                "mean_ns": c.mean_ns(), "stddev_ns": c.std_dev_ns(),
+                "reentries": self._reentries()}
 
 
 class MetricsRegistry:
@@ -284,6 +293,38 @@ class MetricsRegistry:
 
     def snapshot_json(self, **json_kw) -> str:
         return json.dumps(self.snapshot(), **json_kw)
+
+
+def publish_span_gauges(registry: MetricsRegistry, summary: dict,
+                        labels: Optional[Dict[str, str]] = None
+                        ) -> None:
+    """Expose span-derived dispatch-tax gauges from a bench/sim span
+    summary (the dict ``bench.py --spans`` computes from
+    ``obs.spans.SpanTracer`` category deltas over its timed chains) so
+    the Prometheus endpoint serves them alongside the histogram
+    families:
+
+    - ``dmclock_dispatch_ms_per_launch`` -- host dispatch self-time
+      per device launch (the ~17 ms tunnel tax, PROFILE.md 17-18);
+    - ``dmclock_device_ms_per_launch`` -- device-side time per launch;
+    - ``dmclock_host_overhead_frac`` -- host-side (non-device) share
+      of the measured wall time.
+    """
+    rows = (
+        ("dmclock_dispatch_ms_per_launch", "dispatch_ms_per_launch",
+         "host dispatch self-time per device launch over the timed "
+         "region (span tracer; docs/OBSERVABILITY.md tracing plane)"),
+        ("dmclock_device_ms_per_launch", "device_ms_per_launch",
+         "device-side time per launch over the timed region (span "
+         "tracer)"),
+        ("dmclock_host_overhead_frac", "host_overhead_frac",
+         "host-side (dispatch + prep + fetch + drain) share of the "
+         "measured wall time (span tracer)"),
+    )
+    for name, key, help_text in rows:
+        if key in summary:
+            registry.gauge(name, help_text,
+                           labels=labels).set(float(summary[key]))
 
 
 _DEFAULT = MetricsRegistry()

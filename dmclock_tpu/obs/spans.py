@@ -1,0 +1,321 @@
+"""Time-domain tracing plane: structured host spans.
+
+The repo can already tell you *what* happened (metrics vector,
+histograms, conformance ledger, flight ring) but not *when* or where
+wall time went: the ~17 ms/launch dispatch tax behind the ROADMAP's
+streaming-serve-loop item exists only as hand-run PROFILE.md
+experiments (findings 17-18).  This module is the instrument that
+prices every round-trip continuously -- a thread-safe, ns-resolution
+structured span tracer for **host-side** events:
+
+- spans nest (per-thread stacks), carry one of the fixed
+  :data:`CATEGORIES`, and record wall ``ts``/``dur`` from
+  ``perf_counter_ns`` plus **self time** (duration minus child spans),
+  so category sums attribute wall time without double counting;
+- storage is a bounded in-memory ring (past the cap the oldest rows
+  drop, counted) with per-(name, category) aggregates that survive the
+  ring wrapping -- ``dispatch_ms_per_launch`` stays exact over a
+  million-launch bench;
+- export: JSONL (one row per span), Chrome trace-event / Perfetto JSON
+  via ``obs.trace_export`` (loadable in ``chrome://tracing``), and an
+  epoch-boundary ``drain_jsonl`` the supervisor flushes alongside its
+  rotation checkpoints so the span stream survives a SIGKILL restart.
+
+**Spans are host-side only, never in-graph**: a tracer observes wall
+time around device launches; it cannot perturb a decision.  The
+tracing-off path is a single ``None`` check per call site
+(:func:`span` returns a shared no-op context manager), gated in CI to
+bit-identical decisions and ~zero overhead.  See
+``docs/OBSERVABILITY.md`` ("Tracing plane") for the schema and
+category taxonomy.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time as _walltime
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+# The fixed category taxonomy (docs/OBSERVABILITY.md).  Every span
+# carries exactly one; the exporter validates against this set so a
+# typo'd category fails in CI instead of silently fragmenting the
+# attribution tables.
+CATEGORIES = ("ingest", "host_prep", "dispatch", "device_compute",
+              "fetch", "drain", "checkpoint", "retry")
+
+# JSONL row schema (docs/OBSERVABILITY.md): ts/dur/self in ns from
+# perf_counter_ns (monotonic within a process -- NOT comparable across
+# restarts; the supervisor's drained stream is per-incarnation).
+ROW_FIELDS = ("name", "cat", "ts", "dur", "self", "tid", "depth",
+              "args")
+
+
+class _NullSpan:
+    """Shared no-op context manager: the entire tracing-off cost."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(tracer: Optional["SpanTracer"], name: str, cat: str, **args):
+    """``with span(tracer, name, cat):`` -- a no-op when ``tracer`` is
+    None, so call sites need no branching and the off path costs one
+    function call + a None test."""
+    if tracer is None:
+        return _NULL_SPAN
+    return tracer.span(name, cat, **args)
+
+
+def instant(tracer: Optional["SpanTracer"], name: str, cat: str,
+            **args) -> None:
+    """Zero-duration event (a retry, a ladder step) -- no-op when
+    ``tracer`` is None."""
+    if tracer is not None:
+        tracer.instant(name, cat, **args)
+
+
+class _Span:
+    """One open span; the context manager ``SpanTracer.span`` returns.
+    Mutable slots only -- allocation per span is the on-path cost, and
+    it is a few hundred ns."""
+
+    __slots__ = ("_tr", "name", "cat", "args", "t0", "child_ns",
+                 "depth")
+
+    def __init__(self, tracer, name, cat, args):
+        self._tr = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.t0 = 0
+        self.child_ns = 0
+        self.depth = 0
+
+    def __enter__(self):
+        self._tr._push(self)
+        return self
+
+    def __exit__(self, *exc):
+        self._tr._pop(self)
+        return False
+
+
+class SpanTracer:
+    """Thread-safe ns-resolution structured span tracer.
+
+    ``limit`` bounds the in-memory ring (rows past it drop oldest
+    first, counted in ``spans_dropped``); the per-(name, cat)
+    aggregates and per-category self-time totals are unbounded and
+    exact regardless of ring wrap.  ``clock_ns`` is injectable for
+    deterministic tests.
+    """
+
+    def __init__(self, limit: int = 200_000,
+                 clock_ns: Callable[[], int] =
+                 _walltime.perf_counter_ns):
+        self.limit = int(limit)
+        self._clock = clock_ns
+        self._mtx = threading.Lock()
+        self._ring: deque = deque(maxlen=self.limit)
+        self._local = threading.local()
+        self.spans_recorded = 0
+        self.spans_dropped = 0
+        # spans lost to broken enter/exit discipline (a child left
+        # open when its parent exited, a double __exit__): their rows
+        # and time are NOT recorded, so the loss must at least be
+        # countable
+        self.spans_leaked = 0
+        # per-category SELF time + span count: parents never double
+        # count their children, so summing categories attributes wall
+        # time exactly (the >=95%-of-wall acceptance gate's currency)
+        self._cat_self: Dict[str, int] = {c: 0 for c in CATEGORIES}
+        self._cat_count: Dict[str, int] = {c: 0 for c in CATEGORIES}
+        # (name, cat) -> [count, total_ns, self_ns]
+        self._agg: Dict[Tuple[str, str], List[int]] = {}
+        # cat -> last span-end timestamp (watchdog stall detection)
+        self._last_end: Dict[str, int] = {}
+
+    # -- recording -----------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def span(self, name: str, cat: str, **args) -> _Span:
+        # a real raise, not an assert: under PYTHONOPTIMIZE an assert
+        # strips and a typo'd category would silently fragment the
+        # attribution tables (the ProfileTimer double-start lesson)
+        if cat not in CATEGORIES:
+            raise ValueError(f"unknown span category {cat!r} "
+                             f"(taxonomy: {CATEGORIES})")
+        return _Span(self, name, cat, args or None)
+
+    def _push(self, sp: _Span) -> None:
+        st = self._stack()
+        sp.depth = len(st)
+        st.append(sp)
+        sp.t0 = self._clock()
+
+    def _pop(self, sp: _Span) -> None:
+        end = self._clock()
+        st = self._stack()
+        if sp not in st:
+            # double __exit__, or a child exiting after its parent
+            # already popped through it: recording again would
+            # duplicate (or fabricate) a row -- count the discipline
+            # break instead of corrupting the stack
+            with self._mtx:
+                self.spans_leaked += 1
+            return
+        # tolerate exits out of order (a caller leaking an open child
+        # while the parent exits): pop through to this span, counting
+        # each leaked child -- their rows are lost, not silent
+        leaked = 0
+        while st[-1] is not sp:
+            st.pop()
+            leaked += 1
+        st.pop()
+        if leaked:
+            with self._mtx:
+                self.spans_leaked += leaked
+        dur = end - sp.t0
+        if st:
+            st[-1].child_ns += dur
+        self._record(sp.name, sp.cat, sp.t0, dur,
+                     dur - sp.child_ns, sp.depth, sp.args)
+
+    def instant(self, name: str, cat: str, **args) -> None:
+        if cat not in CATEGORIES:
+            raise ValueError(f"unknown span category {cat!r} "
+                             f"(taxonomy: {CATEGORIES})")
+        self._record(name, cat, self._clock(), 0, 0,
+                     len(self._stack()), args or None)
+
+    def _record(self, name, cat, ts, dur, self_ns, depth, args) -> None:
+        row = {"name": name, "cat": cat, "ts": ts, "dur": dur,
+               "self": self_ns, "tid": threading.get_ident(),
+               "depth": depth, "args": args}
+        with self._mtx:
+            if len(self._ring) == self.limit:
+                self.spans_dropped += 1
+            self._ring.append(row)
+            self.spans_recorded += 1
+            self._cat_self[cat] = self._cat_self.get(cat, 0) + self_ns
+            self._cat_count[cat] = self._cat_count.get(cat, 0) + 1
+            a = self._agg.get((name, cat))
+            if a is None:
+                self._agg[(name, cat)] = [1, dur, self_ns]
+            else:
+                a[0] += 1
+                a[1] += dur
+                a[2] += self_ns
+            self._last_end[cat] = ts + dur
+
+    # -- reading -------------------------------------------------------
+    def rows(self) -> List[dict]:
+        """Snapshot of the ring (oldest first), without clearing."""
+        with self._mtx:
+            return list(self._ring)
+
+    def drain(self) -> List[dict]:
+        """Take everything currently in the ring and clear it -- the
+        epoch-boundary flush primitive (aggregates are untouched)."""
+        with self._mtx:
+            rows = list(self._ring)
+            self._ring.clear()
+            return rows
+
+    def category_totals(self) -> Dict[str, int]:
+        """cat -> accumulated SELF time ns (copy)."""
+        with self._mtx:
+            return dict(self._cat_self)
+
+    def category_counts(self) -> Dict[str, int]:
+        with self._mtx:
+            return dict(self._cat_count)
+
+    def last_end_ns(self, cat: str) -> Optional[int]:
+        """End timestamp of the most recent span in ``cat`` (watchdog
+        stall detection); None before the first one closes."""
+        with self._mtx:
+            return self._last_end.get(cat)
+
+    def name_stats(self) -> Dict[Tuple[str, str], Tuple[int, int, int]]:
+        """(name, cat) -> (count, total_ns, self_ns); exact past ring
+        wrap."""
+        with self._mtx:
+            return {k: tuple(v) for k, v in self._agg.items()}
+
+    def summary(self) -> dict:
+        """JSON-able rollup (what bench.py embeds per workload)."""
+        with self._mtx:
+            return {
+                "spans": self.spans_recorded,
+                "dropped": self.spans_dropped,
+                "leaked": self.spans_leaked,
+                "categories": {
+                    c: {"count": self._cat_count.get(c, 0),
+                        "self_ns": self._cat_self.get(c, 0)}
+                    for c in CATEGORIES if self._cat_count.get(c, 0)},
+                "by_name": {
+                    f"{name}|{cat}": {"count": v[0], "total_ns": v[1],
+                                      "self_ns": v[2]}
+                    for (name, cat), v in self._agg.items()},
+            }
+
+    # -- export --------------------------------------------------------
+    def export_jsonl(self, path: str) -> int:
+        """Write every ring row as JSONL (the raw-span interchange
+        format ``scripts/trace_report.py`` and ``trace_export``
+        consume).  Returns the row count."""
+        rows = self.rows()
+        with open(path, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r, separators=(",", ":")) + "\n")
+        return len(rows)
+
+    def drain_jsonl(self, path: str) -> int:
+        """APPEND the un-flushed rows to ``path`` and clear the ring --
+        the supervisor calls this at every checkpoint boundary, so the
+        span stream survives a SIGKILL restart with at most one
+        epoch's spans lost (the same durability window as the PR-5
+        rotation checkpoints)."""
+        rows = self.drain()
+        if not rows:
+            return 0
+        with open(path, "a") as fh:
+            for r in rows:
+                fh.write(json.dumps(r, separators=(",", ":")) + "\n")
+            fh.flush()
+        return len(rows)
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Read a span JSONL stream back (skips blank lines; raises
+    ``ValueError`` on a malformed row)."""
+    rows = []
+    with open(path) as fh:
+        for i, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not JSON: {e}")
+            if not isinstance(row, dict) or "name" not in row \
+                    or "ts" not in row:
+                raise ValueError(f"{path}:{i}: not a span row")
+            rows.append(row)
+    return rows

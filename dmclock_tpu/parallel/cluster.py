@@ -283,6 +283,71 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
     return ClusterState(engine=engine, tracker=tracker, now=now), decs
 
 
+# Module-level jit cache for the healthy-path round driver (the
+# engine/queue.py _JIT_CACHE convention): one compiled cluster_step
+# program per (mesh, static-config) pair.
+_ROUNDS_JIT_CACHE: dict = {}
+
+
+def mesh_step_jit(cache: dict, step_fn, mesh: Mesh, cfg: tuple):
+    """Shared module-jit-cache helper for mesh step drivers (this
+    module's healthy rounds and ``robust.cluster``'s faulty steps):
+    one compiled ``jax.jit(partial(step_fn, mesh=mesh, <cfg>))`` per
+    (mesh, static-config) pair.  ``cfg`` is the five-tuple
+    (decisions_per_step, max_arrivals, anticipation_ns,
+    allow_limit_break, advance_ns).  The unhashable-mesh id() fallback
+    lives HERE so a jax-version fix lands in one place."""
+    try:
+        key = (mesh,) + cfg
+        hash(key)
+    except TypeError:            # unhashable mesh on some jax versions
+        key = (id(mesh),) + cfg
+    if key not in cache:
+        (decisions_per_step, max_arrivals, anticipation_ns,
+         allow_limit_break, advance_ns) = cfg
+        cache[key] = jax.jit(functools.partial(
+            step_fn, mesh=mesh,
+            decisions_per_step=decisions_per_step,
+            max_arrivals=max_arrivals,
+            anticipation_ns=anticipation_ns,
+            allow_limit_break=allow_limit_break,
+            advance_ns=advance_ns))
+    return cache[key]
+
+
+def run_cluster_rounds(cluster: ClusterState, arrivals_seq, cost,
+                       mesh: Mesh, *, decisions_per_step: int,
+                       max_arrivals: int = 1, anticipation_ns: int = 0,
+                       allow_limit_break: bool = False,
+                       advance_ns: int = 0, tracer=None):
+    """Drive ``arrivals_seq.shape[0]`` healthy cluster steps from the
+    host -- the happy-path twin of ``robust.cluster.run_with_plan``,
+    so the tracing plane prices the mesh round-trip structure the same
+    way on both paths.  ``tracer`` (``obs.spans.SpanTracer`` or None)
+    records one ``cluster.round`` dispatch span per step (the whole
+    shard_map launch) and a ``cluster.fetch`` span per decision
+    readback; decisions are bit-identical with or without it.
+    Returns ``(cluster, decs_seq)`` with per-step decisions fetched to
+    host numpy."""
+    from ..obs import spans as _spans
+
+    step = mesh_step_jit(_ROUNDS_JIT_CACHE, cluster_step, mesh,
+                         (decisions_per_step, max_arrivals,
+                          anticipation_ns, allow_limit_break,
+                          advance_ns))
+    arrivals_seq = np.asarray(arrivals_seq)
+    n_servers = cluster.now.shape[0]
+    decs_seq = []
+    for t in range(arrivals_seq.shape[0]):
+        with _spans.span(tracer, "cluster.round", "dispatch",
+                         step=t, servers=n_servers):
+            cluster, decs = step(cluster,
+                                 jnp.asarray(arrivals_seq[t]), cost)
+        with _spans.span(tracer, "cluster.fetch", "fetch", step=t):
+            decs_seq.append(jax.device_get(decs))
+    return cluster, decs_seq
+
+
 def create_clients(cluster: ClusterState, new_mask: jnp.ndarray,
                    resv_inv: jnp.ndarray, weight_inv: jnp.ndarray,
                    limit_inv: jnp.ndarray, mesh: Mesh) -> ClusterState:

@@ -281,27 +281,15 @@ def robust_cluster_step(rc: RobustClusterState, arrivals: jnp.ndarray,
 # Module-level jit cache (the engine/queue.py _JIT_CACHE convention):
 # a fresh jax.jit(partial(...)) per run_with_plan call would recompile
 # the whole shard_map cluster program for every run of identical
-# static config -- the CI chaos smoke alone runs three.
+# static config -- the CI chaos smoke alone runs three.  The cache
+# keying (incl. the unhashable-mesh fallback) is shared with the
+# healthy-path driver: parallel.cluster.mesh_step_jit.
 _STEP_JIT_CACHE: dict = {}
 
 
 def _jit_step(mesh, cfg: tuple):
-    try:
-        key = (mesh,) + cfg
-        hash(key)
-    except TypeError:        # unhashable mesh on some jax versions
-        key = (id(mesh),) + cfg
-    if key not in _STEP_JIT_CACHE:
-        (decisions_per_step, max_arrivals, anticipation_ns,
-         allow_limit_break, advance_ns) = cfg
-        _STEP_JIT_CACHE[key] = jax.jit(functools.partial(
-            robust_cluster_step, mesh=mesh,
-            decisions_per_step=decisions_per_step,
-            max_arrivals=max_arrivals,
-            anticipation_ns=anticipation_ns,
-            allow_limit_break=allow_limit_break,
-            advance_ns=advance_ns))
-    return _STEP_JIT_CACHE[key]
+    return CL.mesh_step_jit(_STEP_JIT_CACHE, robust_cluster_step,
+                            mesh, cfg)
 
 
 def run_with_plan(rc: RobustClusterState, arrivals, cost, mesh,
@@ -309,20 +297,31 @@ def run_with_plan(rc: RobustClusterState, arrivals, cost, mesh,
                   decisions_per_step: int, max_arrivals: int = 1,
                   anticipation_ns: int = 0,
                   allow_limit_break: bool = False,
-                  advance_ns: int = 0):
+                  advance_ns: int = 0, tracer=None):
     """Drive ``arrivals.shape[0]`` cluster steps under ``plan`` (None =
     no fault plumbing at all).  Returns ``(rc, decs_seq)`` with the
     per-step decisions fetched to host numpy -- the stream the chaos
-    digest and the conformance table are computed from."""
+    digest and the conformance table are computed from.
+
+    ``tracer`` (``obs.spans.SpanTracer`` or None) records one
+    ``cluster.round`` dispatch span per step (the whole-mesh launch;
+    args carry the step index and whether a fault was applied) and a
+    ``cluster.fetch`` span for the decision readback -- host-side
+    only, the decision stream is bit-identical either way."""
+    from ..obs import spans as _spans
+
     step = _jit_step(mesh, (decisions_per_step, max_arrivals,
                             anticipation_ns, allow_limit_break,
                             advance_ns))
     decs_seq = []
     for t in range(np.asarray(arrivals).shape[0]):
         fault = plan_step(plan, t) if plan is not None else None
-        rc, decs = step(rc, jnp.asarray(arrivals[t]), cost,
-                        fault=fault)
-        decs_seq.append(jax.device_get(decs))
+        with _spans.span(tracer, "cluster.round", "dispatch",
+                         step=t, faulty=fault is not None):
+            rc, decs = step(rc, jnp.asarray(arrivals[t]), cost,
+                            fault=fault)
+        with _spans.span(tracer, "cluster.fetch", "fetch", step=t):
+            decs_seq.append(jax.device_get(decs))
     return rc, decs_seq
 
 

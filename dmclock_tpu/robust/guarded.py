@@ -174,7 +174,7 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                       hists=None, ledger=None, flight=None,
                       retries: int = 3, base_s: float = 0.05,
                       sleep: Callable[[float], None] = _time.sleep,
-                      on_retry=None) -> GuardedEpoch:
+                      on_retry=None, tracer=None) -> GuardedEpoch:
     """Run one epoch of any of the three epoch engines under the
     guarded-commit contract, host side included.
 
@@ -196,11 +196,19 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
     the whole epoch.  The serial-engine fallback (never observed in
     practice) passes them through untouched -- its decisions are not
     telemetered.
+
+    ``tracer`` (``obs.spans.SpanTracer`` or None) records host spans
+    around each launch -- ``guarded.dispatch`` (the jit call) and
+    ``guarded.device_wait`` (the ``block_until_ready``) -- plus
+    ``retry`` instants for backoff retries and the tag32/serial
+    resumes.  Host-side only: decisions are bit-identical with or
+    without it (ci.sh tracing smoke).
     """
     import jax
     import jax.numpy as jnp
 
     from ..engine import kernels
+    from ..obs import spans as _spans
 
     assert engine in _EPOCHS, engine
     kw = dict(anticipation_ns=anticipation_ns,
@@ -220,6 +228,8 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
 
     def count_retry(attempt, exc):
         retry_count[0] += 1
+        _spans.instant(tracer, "guarded.retry", "retry",
+                       error=type(exc).__name__)
         if on_retry is not None:
             on_retry(attempt, exc)
 
@@ -237,9 +247,19 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                         tele_sig)
         call = (lambda: fn(st, t, tele)) if tele_sig \
             else (lambda: fn(st, t))
+
+        def one():
+            # dispatch (the async jit call) and the device wait are
+            # separate spans: their ratio is the dispatch tax
+            with _spans.span(tracer, "guarded.dispatch", "dispatch",
+                             engine=engine, m=m_run):
+                out = call()
+            with _spans.span(tracer, "guarded.device_wait",
+                             "device_compute"):
+                return jax.block_until_ready(out)
+
         return retry_with_backoff(
-            lambda: jax.block_until_ready(call()),
-            retries=retries, base_s=base_s, sleep=sleep,
+            one, retries=retries, base_s=base_s, sleep=sleep,
             on_retry=count_retry)
 
     def take_tele(ep):
@@ -262,6 +282,8 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
             # the remaining batches on the int64 path (exactness pinned
             # by tests/test_radix.py)
             rebase_fb = 1
+            _spans.instant(tracer, "guarded.rebase_resume", "retry",
+                           remaining=remaining)
             ep2 = attempt(state, t, remaining, 64)
             results.append(ep2)
             take_tele(ep2)
@@ -274,13 +296,23 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
             # order/cost guard (or calendar no-progress) on the exact
             # path: fall back to the serial engine for the rest
             serial_fb = 1
+            _spans.instant(tracer, "guarded.serial_resume", "retry",
+                           remaining=remaining)
             steps = max(remaining, 1) * max(k, 1)
             run = _jit_serial(steps, allow_limit_break,
                               anticipation_ns)
+
+            def serial_one():
+                with _spans.span(tracer, "guarded.dispatch",
+                                 "dispatch", engine="serial"):
+                    out = run(state, t)
+                with _spans.span(tracer, "guarded.device_wait",
+                                 "device_compute"):
+                    return jax.block_until_ready(out)
+
             st2, _, decs = retry_with_backoff(
-                lambda: jax.block_until_ready(run(state, t)),
-                retries=retries, base_s=base_s, sleep=sleep,
-                on_retry=count_retry)
+                serial_one, retries=retries, base_s=base_s,
+                sleep=sleep, on_retry=count_retry)
             import numpy as np
             total += int((np.asarray(decs.type)
                           == kernels.RETURNING).sum())
@@ -337,11 +369,15 @@ class DegradationLadder:
     operating point, or the replay would diverge from the
     uninterrupted run)."""
 
-    def __init__(self, enabled: bool = True, threshold: int = 2):
+    def __init__(self, enabled: bool = True, threshold: int = 2,
+                 tracer=None):
         self.enabled = bool(enabled)
         self.threshold = max(int(threshold), 1)
         self.steps: list = []       # LadderStep, in engagement order
         self._consecutive = 0
+        # optional obs.spans.SpanTracer: step-downs record a "retry"
+        # instant so the timeline shows WHEN the run degraded
+        self.tracer = tracer
 
     @property
     def steps_taken(self) -> int:
@@ -383,9 +419,13 @@ class DegradationLadder:
         self._consecutive = 0
         for knob, fast, safe in LADDER_RUNGS:
             if cfg.get(knob) == fast and not self._engaged(knob):
-                self.steps.append(LadderStep(
-                    knob, fast, safe,
-                    "guard_trips" if guard_trips else "launch_failures"))
+                reason = "guard_trips" if guard_trips \
+                    else "launch_failures"
+                self.steps.append(LadderStep(knob, fast, safe, reason))
+                if self.tracer is not None:
+                    self.tracer.instant(
+                        "ladder.step", "retry", knob=knob,
+                        to=str(safe), reason=reason)
                 return 1
         return 0    # fully degraded already; nothing left to concede
 

@@ -106,6 +106,16 @@ class EpochJob:
     flight_dump: Optional[str] = None  # JSONL path the flight ring is
     #                                    dumped to when an incarnation
     #                                    crashes (--flight-dump)
+    # time-domain tracing plane (obs.spans): span JSONL path, APPENDED
+    # to at every checkpoint boundary -- and ONLY there: a resume
+    # replays from the last snapshot, so flushing past it would
+    # double-count the replayed epochs' spans.  The stream survives a
+    # SIGKILL restart with exactly the rotation checkpoints'
+    # durability window.  Spans are host-side wall time --
+    # per-incarnation timestamps, deliberately OUTSIDE the
+    # checkpointed state (crash equivalence is about decisions, not
+    # about how long the host took)
+    span_log: Optional[str] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -365,6 +375,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     import jax.numpy as jnp
 
     from ..obs import device as obsdev
+    from ..obs import spans as _spans
     from ..obs.registry import start_http_server
 
     from ..obs import flight as obsflight
@@ -375,8 +386,10 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     digest = b"\x00" * 32
     start_epoch = 0
     decisions = 0
+    tracer = _spans.SpanTracer() if job.span_log else None
     ladder = DegradationLadder(enabled=job.ladder,
-                               threshold=job.ladder_threshold)
+                               threshold=job.ladder_threshold,
+                               tracer=tracer)
     hists, ledger, flight = _tele_init(job)
     ckpt_dir = os.path.join(workdir, "ckpt") if workdir else None
 
@@ -390,8 +403,11 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
         # deterministic, so the run stays crash-equivalent -- it just
         # pays the full recompute.
         try:
-            payload, resumed_from = ckpt_mod.restore_pytree_rotating(
-                ckpt_dir, _payload_like(job))
+            with _spans.span(tracer, "supervisor.resume",
+                             "checkpoint"):
+                payload, resumed_from = \
+                    ckpt_mod.restore_pytree_rotating(
+                        ckpt_dir, _payload_like(job))
         except ckpt_mod.CheckpointCorruptError:
             payload = None
     if payload is not None:
@@ -433,6 +449,14 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
 
     try:
         for epoch in range(start_epoch, job.epochs):
+            # epoch span entered/exited explicitly: the loop body
+            # stays flat, and a crash mid-epoch simply leaves the span
+            # open -- the tracer dies with the incarnation and the
+            # flushed stream keeps every COMPLETED epoch (the same
+            # at-most-one-epoch-lost window as the checkpoints)
+            _ep_span = _spans.span(tracer, "supervisor.epoch",
+                                   "host_prep", epoch=epoch)
+            _ep_span.__enter__()
             if scrape_port is not None and scrape is None:
                 scrape = start_http_server(port=scrape_port)
                 if scrape is not None:
@@ -454,13 +478,15 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
 
             t_base = jnp.int64(epoch * job.dt_epoch_ns)
             if ingest is not None:
-                headroom = job.ring - np.asarray(
-                    jax.device_get(state.depth), dtype=np.int64)
-                counts = np.minimum(
-                    rng.poisson(job.arrival_lam, job.n),
-                    np.minimum(headroom, job.waves)
-                ).astype(np.int32)
-                state = ingest(state, jnp.asarray(counts), t_base)
+                with _spans.span(tracer, "supervisor.ingest",
+                                 "ingest"):
+                    headroom = job.ring - np.asarray(
+                        jax.device_get(state.depth), dtype=np.int64)
+                    counts = np.minimum(
+                        rng.poisson(job.arrival_lam, job.n),
+                        np.minimum(headroom, job.waves)
+                    ).astype(np.int32)
+                    state = ingest(state, jnp.asarray(counts), t_base)
             while True:
                 cfg = ladder.apply(base_cfg)
                 try:
@@ -473,7 +499,8 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                         tag_width=cfg["tag_width"],
                         calendar_impl=cfg["calendar_impl"],
                         ladder_levels=job.ladder_levels,
-                        hists=hists, ledger=ledger, flight=flight)
+                        hists=hists, ledger=ledger, flight=flight,
+                        tracer=tracer)
                     break
                 except RECOVERABLE_ERRORS:
                     # bounded retries EXHAUSTED inside the guarded
@@ -499,11 +526,12 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 ledger = ep.ledger
             if job.flight_records:
                 flight = ep.flight
-            digest = _digest_update(digest, ep.results)
-            for r in ep.results:
-                if hasattr(r, "metrics"):
-                    met = obsdev.metrics_combine_np(
-                        met, jax.device_get(r.metrics))
+            with _spans.span(tracer, "supervisor.digest", "drain"):
+                digest = _digest_update(digest, ep.results)
+                for r in ep.results:
+                    if hasattr(r, "metrics"):
+                        met = obsdev.metrics_combine_np(
+                            met, jax.device_get(r.metrics))
             stepped = ladder.note_epoch(
                 cfg,
                 guard_trips=ep.rebase_fallbacks + ep.serial_fallbacks)
@@ -514,19 +542,38 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             if ckpt_dir is not None and \
                     ((epoch + 1) % job.ckpt_every == 0
                      or epoch + 1 == job.epochs):
-                payload = _payload(job, state, rng, met, digest,
-                                   epoch + 1, decisions,
-                                   ladder.encode(), hists=hists,
-                                   ledger=ledger, flight=flight)
+                with _spans.span(tracer, "supervisor.checkpoint_save",
+                                 "checkpoint", epoch=epoch + 1):
+                    payload = _payload(job, state, rng, met, digest,
+                                       epoch + 1, decisions,
+                                       ladder.encode(), hists=hists,
+                                       ledger=ledger, flight=flight)
 
-                def save(payload=payload):
-                    return ckpt_mod.save_pytree_rotating(
-                        ckpt_dir, payload, keep=job.keep)
+                    def save(payload=payload):
+                        return ckpt_mod.save_pytree_rotating(
+                            ckpt_dir, payload, keep=job.keep)
 
-                if injector is not None:
-                    injector.around_save(epoch, save)
-                else:
-                    save()
+                    if injector is not None:
+                        injector.around_save(epoch, save)
+                    else:
+                        save()
+                _ep_span.__exit__(None, None, None)
+                # flush spans ONLY at checkpoint boundaries, right
+                # after the snapshot commits: a resume replays from
+                # the last checkpoint, so any span flushed PAST it
+                # would appear twice in the stream after a
+                # crash+resume (replayed epochs re-record).  Spans and
+                # checkpoints share one durability window by
+                # construction: what is flushed is exactly what will
+                # never be replayed.
+                if tracer is not None:
+                    tracer.drain_jsonl(job.span_log)
+            else:
+                _ep_span.__exit__(None, None, None)
+                if tracer is not None and ckpt_dir is None:
+                    # bare/unsupervised runner: nothing ever replays,
+                    # per-epoch flushes are safe
+                    tracer.drain_jsonl(job.span_log)
     except BaseException:
         # the crash hook: dump the flight ring's last R commit
         # records before the incarnation dies (--flight-dump).  Best
@@ -538,11 +585,20 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                       f"{job.flight_dump}", file=sys.stderr)
             except Exception:
                 pass
+        # deliberately NO span flush here: rows recorded since the
+        # last checkpoint boundary describe epochs a resume will
+        # REPLAY, and flushing them would double-count those epochs
+        # in the stream.  Un-flushed spans die with the incarnation --
+        # exactly the checkpoint durability window the span_log
+        # contract documents.
         raise
     finally:
         if scrape is not None:
             scrape.close()
 
+    if tracer is not None:   # e.g. a resume landing past the last
+        tracer.drain_jsonl(job.span_log)  # epoch records only the
+    #                                       resume span
     return SupervisedResult(
         digest=hashlib.sha256(digest).hexdigest(),
         state_digest=_tree_digest(state),

@@ -23,7 +23,8 @@ from .harness import Simulation
 def run_sim(cfg: SimConfig, model: str = "dmclock", seed: int = 12345,
             record_trace: bool = False,
             server_mode: str = "pull",
-            registry=None, decision_trace=None) -> Simulation:
+            registry=None, decision_trace=None,
+            tracer=None) -> Simulation:
     _pull_factory, tracker_factory = models.get(model)
     if server_mode == "push":
         queue_factory = models.get_push(model)
@@ -31,7 +32,8 @@ def run_sim(cfg: SimConfig, model: str = "dmclock", seed: int = 12345,
         queue_factory = _pull_factory
     sim = Simulation(cfg, queue_factory, tracker_factory, seed=seed,
                      record_trace=record_trace, server_mode=server_mode,
-                     registry=registry, decision_trace=decision_trace)
+                     registry=registry, decision_trace=decision_trace,
+                     tracer=tracer)
     sim.run()
     return sim
 
@@ -57,6 +59,12 @@ def main(argv=None) -> int:
     p.add_argument("--trace-limit", type=int, default=1_000_000,
                    help="max trace rows before dropping (bounded "
                    "trace; default 1M)")
+    p.add_argument("--trace-out", metavar="FILE.json", default=None,
+                   help="write a Chrome trace-event / Perfetto "
+                   "timeline of host spans (ingest / dispatch wall "
+                   "time per server; obs.spans) -- loadable in "
+                   "chrome://tracing; decisions are bit-identical "
+                   "with or without it")
     p.add_argument("--conformance", action="store_true",
                    help="print the per-client QoS conformance table "
                    "(delivered rate vs reservation/weight/limit), "
@@ -98,6 +106,10 @@ def main(argv=None) -> int:
         p.error(f"cannot read config file: {e}")
     trace = DecisionTrace(args.trace, limit=args.trace_limit) \
         if args.trace else None
+    tracer = None
+    if args.trace_out:
+        from ..obs import SpanTracer
+        tracer = SpanTracer()
     registry = None
     http_srv = None
     if args.metrics_port is not None:
@@ -112,12 +124,28 @@ def main(argv=None) -> int:
     try:
         sim = run_sim(cfg, model=args.model, seed=args.seed,
                       server_mode=args.server_mode,
-                      registry=registry, decision_trace=trace)
+                      registry=registry, decision_trace=trace,
+                      tracer=tracer)
     finally:
         if trace is not None:
             trace.close()
         if http_srv is not None:
             http_srv.close()
+        if tracer is not None:
+            # export even on a crashed run (the timeline of a failed
+            # sim is exactly when you want it), but FAIL-SOFT: an
+            # unwritable path must neither fail a healthy run after
+            # all the work nor mask the sim's own exception from
+            # inside this finally block
+            try:
+                from ..obs import export_chrome_trace
+                n_ev = export_chrome_trace(tracer, args.trace_out)
+                print(f"# trace-out: {n_ev} spans -> "
+                      f"{args.trace_out} (chrome://tracing; "
+                      f"{tracer.spans_dropped} dropped past the "
+                      "ring)")
+            except OSError as e:
+                print(f"# trace-out failed: {e}", file=sys.stderr)
     report = sim.report()
     print(report.format(show_intervals=args.intervals))
     if args.conformance:

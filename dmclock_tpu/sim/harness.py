@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core import NS_PER_SEC, Phase, ReqParams
+from ..obs import spans as _spans
 from ..obs.registry import MetricsRegistry
 from ..utils.profile import ProfileCombiner, ProfileTimer
 from .config import ClientGroup, ServerGroup, SimConfig
@@ -136,7 +137,7 @@ class SimulatedServer:
                  queue, loop: EventLoop,
                  client_resp_f: Callable[[Any, Any, Phase, int, Any], None],
                  trace: Optional[list] = None,
-                 decision_trace=None):
+                 decision_trace=None, tracer=None):
         self.id = server_id
         self.queue = queue
         self.loop = loop
@@ -147,6 +148,7 @@ class SimulatedServer:
         self.stats = ServerStats()
         self.trace = trace
         self.decision_trace = decision_trace
+        self.tracer = tracer     # obs.spans tracer (None = off)
         self.tard_hist = None    # registry histogram, set by Simulation
         self._wake_at: Optional[int] = None
 
@@ -156,23 +158,27 @@ class SimulatedServer:
              cost: int) -> None:
         t = self.stats.add_request_timer
         t.start()
-        self.queue.add_request(request, client_id, req_params,
-                               time_ns=self.loop.now_ns, cost=cost)
+        with _spans.span(self.tracer, "sim.add", "ingest"):
+            self.queue.add_request(request, client_id, req_params,
+                                   time_ns=self.loop.now_ns, cost=cost)
         t.stop()
         self._dispatch()
 
     def _dispatch(self) -> None:
         while self.busy < self.threads:
             free = self.threads - self.busy
-            if free > 1 and hasattr(self.queue, "pull_batch"):
-                # batched consumption: pull_batch(now, n) is defined as
-                # n successive pulls at the SAME now -- exactly this
-                # loop -- so the trace is identical with fewer device
-                # launches (reference free-slot count has_avail_thread,
-                # sim_server.h:179)
-                batch = self.queue.pull_batch(self.loop.now_ns, free)
-            else:
-                batch = [self.queue.pull_request(self.loop.now_ns)]
+            with _spans.span(self.tracer, "sim.pull", "dispatch",
+                             server=self.id):
+                if free > 1 and hasattr(self.queue, "pull_batch"):
+                    # batched consumption: pull_batch(now, n) is
+                    # defined as n successive pulls at the SAME now --
+                    # exactly this loop -- so the trace is identical
+                    # with fewer device launches (reference free-slot
+                    # count has_avail_thread, sim_server.h:179)
+                    batch = self.queue.pull_batch(self.loop.now_ns,
+                                                  free)
+                else:
+                    batch = [self.queue.pull_request(self.loop.now_ns)]
             done = False
             for pr in batch:
                 if pr.is_retn():
@@ -230,7 +236,7 @@ class PushSimulatedServer:
                  make_queue, loop: EventLoop,
                  client_resp_f: Callable[[Any, Any, Phase, int, Any], None],
                  trace: Optional[list] = None,
-                 decision_trace=None):
+                 decision_trace=None, tracer=None):
         self.id = server_id
         self.loop = loop
         self.client_resp_f = client_resp_f
@@ -240,6 +246,7 @@ class PushSimulatedServer:
         self.stats = ServerStats()
         self.trace = trace
         self.decision_trace = decision_trace
+        self.tracer = tracer     # obs.spans tracer (None = off)
         self.tard_hist = None    # registry histogram, set by Simulation
         # make_queue(can_handle_f, handle_f, now_ns_f, sched_at_f,
         # capacity_f); capacity_f is the free-slot count (reference
@@ -256,8 +263,12 @@ class PushSimulatedServer:
              cost: int) -> None:
         t = self.stats.add_request_timer
         t.start()
-        self.queue.add_request(request, client_id, req_params,
-                               time_ns=self.loop.now_ns, cost=cost)
+        with _spans.span(self.tracer, "sim.add", "ingest"):
+            # push-mode adds DISPATCH from inside add_request (the
+            # queue drives handle_f); the ingest span covers both --
+            # the push sim's per-add cost is the unit of interest
+            self.queue.add_request(request, client_id, req_params,
+                                   time_ns=self.loop.now_ns, cost=cost)
         t.stop()
 
     def _sched_at(self, when_ns: int) -> None:
@@ -390,7 +401,7 @@ class Simulation:
                  seed: int = 12345, record_trace: bool = False,
                  server_mode: str = "pull",
                  registry: Optional[MetricsRegistry] = None,
-                 decision_trace=None):
+                 decision_trace=None, tracer=None):
         assert server_mode in ("pull", "push")
         self.server_mode = server_mode
         self.cfg = cfg
@@ -399,6 +410,11 @@ class Simulation:
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.decision_trace = decision_trace
+        # time-domain tracing (obs.spans.SpanTracer or None): the
+        # servers record ingest (add_request) and dispatch (pull)
+        # spans, so `dmc_sim --trace-out` yields a Perfetto timeline
+        # of where the sim's wall time went; decisions bit-identical
+        self.tracer = tracer
         self._rng = random.Random(seed)
         self._done_clients = set()
 
@@ -443,14 +459,16 @@ class Simulation:
                 self.servers[s] = PushSimulatedServer(
                     s, g.server_iops, g.server_threads, make_queue,
                     self.loop, self._client_resp, trace=self.trace,
-                    decision_trace=self.decision_trace)
+                    decision_trace=self.decision_trace,
+                    tracer=self.tracer)
             else:
                 q = queue_factory(s, client_info_f, anticipation_ns,
                                   cfg.server_soft_limit)
                 self.servers[s] = SimulatedServer(
                     s, g.server_iops, g.server_threads, q, self.loop,
                     self._client_resp, trace=self.trace,
-                    decision_trace=self.decision_trace)
+                    decision_trace=self.decision_trace,
+                    tracer=self.tracer)
             self._register_server_metrics(s)
 
         self.clients: Dict[int, SimulatedClient] = {}

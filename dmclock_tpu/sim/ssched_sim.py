@@ -5,9 +5,10 @@ Equivalent of the reference's ``ssched_sim``
 over the simple FIFO queue.  Unlike the reference (hardcoded params),
 this accepts the same INI configs as dmc_sim -- and the same
 observability flags (``--trace``, ``--conformance``,
-``--ledger-check``, ``--metrics-port``); the FIFO queue materializes
-no tags and no ledger, so the tardiness percentiles and ledger
-cross-check degrade to their documented no-backend paths.
+``--ledger-check``, ``--metrics-port``, ``--trace-out`` for a
+Perfetto span timeline); the FIFO queue materializes no tags and no
+ledger, so the tardiness percentiles and ledger cross-check degrade
+to their documented no-backend paths.
 """
 
 from __future__ import annotations

@@ -48,9 +48,16 @@ class ProfileTimer(_ProfileBase):
     def __init__(self):
         super().__init__()
         self._start_ns = None
+        # start() calls that found the timer already running: the
+        # in-flight interval is abandoned and the timer restarts
+        # cleanly (under PYTHONOPTIMIZE the old assert stripped and
+        # the discard was SILENT -- a reentrant caller deflated its
+        # own count/sum without a trace)
+        self.reentries = 0
 
     def start(self) -> None:
-        assert self._start_ns is None, "timer already started"
+        if self._start_ns is not None:
+            self.reentries += 1
         self._start_ns = _walltime.perf_counter_ns()
 
     def stop(self) -> None:

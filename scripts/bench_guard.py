@@ -25,6 +25,13 @@ records since the telemetry plane landed) is tracked as its own
 history series per workload and WARNED on -- tail QoS regressions
 surface even when throughput held, but the log2-quantized octaves
 and calibration-dependent equilibria make a hard gate flap.
+
+dispatch_ms_per_launch (the span-tracer dispatch-tax column bench.py
+records under --spans) gets the same treatment: its own per-workload
+series, warn-only on >tolerance regressions -- the dispatch tax can
+regress structurally (a lost fusion, an extra sync) while dec/s holds
+because the chains amortize it, and it is the before/after currency
+of the streaming-serve-loop work (ROADMAP #1).
 """
 
 from __future__ import annotations
@@ -171,6 +178,18 @@ def main() -> int:
              if r.get("device") == dev and not is_fallback(r)
              and not is_chaos(r) and not is_restarted(r)
              and not is_degraded(r)]
+    def series(wl, key, impl, cal):
+        """Prior values of one per-workload scalar column, filtered to
+        the same fast-path identity (select_impl + calendar_impl) the
+        throughput series uses."""
+        return [r["workloads"][wl][key] for _, r in prior
+                if wl in r.get("workloads", {})
+                and key in r["workloads"][wl]
+                and r["workloads"][wl].get("select_impl",
+                                           "sort") == impl
+                and r["workloads"][wl].get("calendar_impl",
+                                           "minstop") == cal]
+
     status = 0
     for wl, row in sorted(newest.get("workloads", {}).items()):
         dps = row.get("dps")
@@ -226,14 +245,7 @@ def main() -> int:
         # shift with calibration; a hard gate would flap.
         p99 = row.get("tardiness_p99_ns")
         if p99 is not None:
-            t_hist = [r["workloads"][wl]["tardiness_p99_ns"]
-                      for _, r in prior
-                      if wl in r.get("workloads", {})
-                      and "tardiness_p99_ns" in r["workloads"][wl]
-                      and r["workloads"][wl].get("select_impl",
-                                                 "sort") == impl
-                      and r["workloads"][wl].get("calendar_impl",
-                                                 "minstop") == cal]
+            t_hist = series(wl, "tardiness_p99_ns", impl, cal)
             if len(t_hist) < args.min_records:
                 print(f"bench_guard: {tag}: p99 tardiness "
                       f"{p99/1e6:.2f}ms ({len(t_hist)} prior "
@@ -256,6 +268,38 @@ def main() -> int:
                     print(f"bench_guard: {tag}: p99 tardiness "
                           f"{p99/1e6:.2f}ms vs median "
                           f"{t_med/1e6:.2f}ms -- OK")
+        # dispatch tax per launch (bench.py --spans) as its own
+        # series: the chains amortize dispatch, so dec/s can hold
+        # while the per-launch tax regresses structurally -- and the
+        # streaming-loop PR's win must show up HERE.  Warn-only: the
+        # shared tunnel's dispatch cost drifts by the hour like the
+        # rates do, and a hard gate would flap.
+        disp = row.get("dispatch_ms_per_launch")
+        if disp is not None:
+            d_hist = series(wl, "dispatch_ms_per_launch", impl, cal)
+            if len(d_hist) < args.min_records:
+                print(f"bench_guard: {tag}: dispatch "
+                      f"{disp:.2f}ms/launch ({len(d_hist)} prior "
+                      "record(s) -- not judged)")
+            else:
+                d_med = median(d_hist)
+                # floor the median at 1ms: sub-ms dispatch medians
+                # (cpu boxes) would make µs jitter read as a 2x
+                # regression
+                ceil = max(d_med, 1.0) * args.tolerance
+                if disp > ceil:
+                    print(f"bench_guard: {tag}: WARNING dispatch "
+                          f"{disp:.2f}ms/launch vs median "
+                          f"{d_med:.2f}ms over {len(d_hist)} "
+                          f"sessions (> {args.tolerance:g}x) -- the "
+                          "per-launch dispatch tax regressed; "
+                          "throughput may still hold because the "
+                          "chains amortize it; investigate",
+                          file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: dispatch "
+                          f"{disp:.2f}ms/launch vs median "
+                          f"{d_med:.2f}ms -- OK")
     if status:
         print(f"bench_guard: FAILED on {newest_name} -- a >"
               f"{args.tolerance:g}x drop survived the drift margin; "

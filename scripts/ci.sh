@@ -233,6 +233,98 @@ print("telemetry smoke ok (bit-identical digests; scrape serves "
       "histogram families; /healthz answers)")
 EOF
 
+echo "== tracing smoke (span schema + tracing on/off digest gate) =="
+# the time-domain tracing plane (docs/OBSERVABILITY.md): (1) tracing
+# on vs off must leave decisions BIT-IDENTICAL on all three epoch
+# engines (spans are host-side only, never in-graph); (2) the off
+# path's per-call cost is a None check -- bound it, so the <=1%
+# wall-overhead contract cannot silently rot; (3) a sim run with
+# --trace-out must export a chrome://tracing-loadable trace that
+# passes schema validation (monotonic ts, matched begin/end nesting,
+# category taxonomy) with category self-time sums ~= the spanned wall;
+# (4) scripts/trace_report.py must reproduce the attribution table.
+timeout -k 30 900 python - <<'EOF'
+import hashlib, io, json, re, sys, tempfile, time
+from contextlib import redirect_stdout
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from __graft_entry__ import _preloaded_state
+from dmclock_tpu.obs import SpanTracer, validate_chrome_trace
+from dmclock_tpu.obs import spans as obsspans
+from dmclock_tpu.robust.guarded import run_epoch_guarded
+
+# (1) tracing on/off decision digests, all three epoch engines
+def digest(ep):
+    h = hashlib.sha256()
+    for r in ep.results:
+        for name in ("count", "slot", "phase", "cost", "served",
+                     "length"):
+            if hasattr(r, name):
+                h.update(np.asarray(
+                    jax.device_get(getattr(r, name))).tobytes())
+    return h.hexdigest()
+
+tracer = SpanTracer()
+for engine in ("prefix", "chain", "calendar"):
+    eps = {}
+    # the calendar engine reads k as its per-client serve-step budget,
+    # bounded by the ring window
+    k = 8 if engine == "calendar" else 64
+    for tr in (None, tracer):
+        st = _preloaded_state(1024, 8, ring=16)
+        eps[tr is None] = run_epoch_guarded(
+            st, 10 ** 9, engine=engine, m=2, k=k, tracer=tr)
+    d_off, d_on = digest(eps[True]), digest(eps[False])
+    assert d_off == d_on, f"{engine}: tracing changed decisions"
+    print(f"{engine}: tracing on/off digest gate ok ({d_off[:16]})")
+
+# (2) tracing-off per-call cost: spans.span(None, ...) is one None
+# check; a generous 20us/call bound catches gross regressions without
+# flapping on a loaded CI box
+t0 = time.perf_counter_ns()
+N = 20000
+for _ in range(N):
+    with obsspans.span(None, "x", "dispatch"):
+        pass
+per_call = (time.perf_counter_ns() - t0) / N
+assert per_call < 20_000, f"tracing-off path costs {per_call:.0f}ns/call"
+print(f"tracing-off path: {per_call:.0f} ns/call (bound 20us)")
+
+# (3) sim --trace-out export + schema validation
+from dmclock_tpu.sim import dmc_sim
+trace_out = tempfile.mktemp(suffix=".json")
+buf = io.StringIO()
+t0 = time.perf_counter_ns()
+with redirect_stdout(buf):
+    rc = dmc_sim.main(["-c", "configs/dmc_sim_example.conf",
+                       "--trace-out", trace_out])
+wall_ns = time.perf_counter_ns() - t0
+assert rc == 0, f"dmc_sim exited {rc}"
+stats = validate_chrome_trace(trace_out)   # raises on any violation
+assert stats["events"] > 100, stats
+assert set(stats["cat_count"]) <= set(obsspans.CATEGORIES)
+# spanned self-time can never exceed the run's wall; it must also be
+# a real share of it (the sim's event loop is ingest+dispatch-bound)
+assert stats["span_ns"] <= 1.05 * wall_ns, (stats["span_ns"], wall_ns)
+assert stats["span_ns"] >= 0.10 * wall_ns, (stats["span_ns"], wall_ns)
+print(f"sim trace-out ok ({stats['events']} events, "
+      f"{stats['span_ns']/1e6:.0f}ms spanned of "
+      f"{wall_ns/1e6:.0f}ms wall, schema valid)")
+
+# (4) the attribution report reproduces from the export
+import subprocess
+out = subprocess.run(
+    [sys.executable, "scripts/trace_report.py", trace_out],
+    capture_output=True, text=True)
+assert out.returncode == 0, out.stderr
+assert "dispatch-vs-compute ratio" in out.stdout
+assert re.search(r"sim\.pull\s+dispatch", out.stdout), out.stdout
+print("trace_report attribution table ok")
+print("tracing smoke ok")
+EOF
+
 echo "== chaos smoke (seeded dropout+restart; zero-fault digest gate) =="
 # the robustness spine (docs/ROBUSTNESS.md): (1) an all-benign
 # FaultPlan must be BIT-IDENTICAL to running with no fault plumbing at

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Span-trace attribution report + before/after diff.
+
+Turns a span file (Chrome trace-event JSON from ``--trace-out``, or
+the tracer's raw JSONL, e.g. a supervisor ``span_log``) into the
+PROFILE.md-style attribution table the hand-run dispatch-tax
+experiments (findings 17-18) produced manually:
+
+    python scripts/trace_report.py trace.json
+
+prints per-(name, category) rows -- count, total / self time, mean,
+and per-span duration percentiles -- sorted by self time, the
+per-category rollup, and the **dispatch-vs-compute ratio** (host
+``dispatch`` self-time over ``device_compute`` self-time: how many
+seconds of launching the run paid per second of device work).
+
+    python scripts/trace_report.py after.json --diff before.json
+
+diffs two trace files by (name, category): delta count / total / self
+/ mean per row plus the ratio shift -- the before/after tool for the
+streaming-serve-loop refactor (ROADMAP #1): run the same bench with
+``--trace-out`` on both sides and the diff prices exactly what the
+restructuring bought.
+
+Exit status: 0 ok, 2 bad input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dmclock_tpu.obs.spans import CATEGORIES                 # noqa: E402
+from dmclock_tpu.obs.trace_export import (load_rows,         # noqa: E402
+                                          rows_self_times)
+
+
+def _percentile(sorted_vals: List[int], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return float(sorted_vals[idx])
+
+
+def aggregate(rows: List[dict]) -> Dict[Tuple[str, str], dict]:
+    """(name, cat) -> {count, total_ns, self_ns, durs (sorted)};
+    self time from the canonical ``trace_export.rows_self_times``
+    sweep (recorded ``self`` fields trusted, Chrome rows swept)."""
+    selfs = rows_self_times(rows)
+    agg: Dict[Tuple[str, str], dict] = {}
+    for r, self_ns in zip(rows, selfs):
+        key = (r["name"], r.get("cat", "?"))
+        a = agg.setdefault(key, {"count": 0, "total_ns": 0,
+                                 "self_ns": 0, "durs": []})
+        a["count"] += 1
+        a["total_ns"] += r["dur"]
+        a["self_ns"] += self_ns
+        a["durs"].append(r["dur"])
+    for a in agg.values():
+        a["durs"].sort()
+    return agg
+
+
+def cat_rollup(agg) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for (_name, cat), a in agg.items():
+        c = out.setdefault(cat, {"count": 0, "self_ns": 0})
+        c["count"] += a["count"]
+        c["self_ns"] += a["self_ns"]
+    return out
+
+
+def dispatch_ratio(cats: Dict[str, dict]) -> float:
+    """dispatch self-time per unit of device_compute self-time; inf
+    (represented as -1) when no device time was observed."""
+    dev = cats.get("device_compute", {}).get("self_ns", 0)
+    disp = cats.get("dispatch", {}).get("self_ns", 0)
+    return disp / dev if dev else (-1.0 if disp else 0.0)
+
+
+def _ms(ns: float) -> str:
+    return f"{ns / 1e6:.2f}"
+
+
+def print_report(path: str, agg, cats, top: int) -> None:
+    print(f"== span attribution: {path} ==")
+    print(f"{'name':<28} {'cat':<14} {'count':>8} {'total ms':>10} "
+          f"{'self ms':>10} {'mean us':>9} {'p50 us':>8} {'p90 us':>8} "
+          f"{'p99 us':>8}")
+    ranked = sorted(agg.items(), key=lambda kv: -kv[1]["self_ns"])
+    for (name, cat), a in ranked[:top]:
+        durs = a["durs"]
+        mean_us = a["total_ns"] / max(a["count"], 1) / 1e3
+        print(f"{name:<28} {cat:<14} {a['count']:>8} "
+              f"{_ms(a['total_ns']):>10} {_ms(a['self_ns']):>10} "
+              f"{mean_us:>9.1f} "
+              f"{_percentile(durs, 0.50) / 1e3:>8.1f} "
+              f"{_percentile(durs, 0.90) / 1e3:>8.1f} "
+              f"{_percentile(durs, 0.99) / 1e3:>8.1f}")
+    if len(ranked) > top:
+        print(f"  ... {len(ranked) - top} more rows (--top)")
+    print("-- categories (self time) --")
+    total = sum(c["self_ns"] for c in cats.values()) or 1
+    for cat in CATEGORIES:
+        if cat in cats:
+            c = cats[cat]
+            print(f"  {cat:<16} {_ms(c['self_ns']):>10} ms "
+                  f"({100.0 * c['self_ns'] / total:5.1f}%)  "
+                  f"{c['count']} spans")
+    ratio = dispatch_ratio(cats)
+    label = "inf (no device spans)" if ratio < 0 else f"{ratio:.3f}"
+    print(f"dispatch-vs-compute ratio: {label} "
+          "(host dispatch self-time / device_compute self-time)")
+
+
+def print_diff(path_a: str, path_b: str, agg_a, agg_b, top: int
+               ) -> None:
+    """``path_a`` is the AFTER file, ``path_b`` the baseline."""
+    print(f"== span diff: {path_a} vs baseline {path_b} ==")
+    keys = set(agg_a) | set(agg_b)
+    zero = {"count": 0, "total_ns": 0, "self_ns": 0, "durs": []}
+    rows = []
+    for k in keys:
+        a, b = agg_a.get(k, zero), agg_b.get(k, zero)
+        rows.append((k, a["self_ns"] - b["self_ns"], a, b))
+    rows.sort(key=lambda r: -abs(r[1]))
+    print(f"{'name':<28} {'cat':<14} {'d count':>8} {'d total ms':>11} "
+          f"{'d self ms':>10} {'d mean us':>10}")
+    for (name, cat), dself, a, b in rows[:top]:
+        mean_a = a["total_ns"] / max(a["count"], 1) / 1e3
+        mean_b = b["total_ns"] / max(b["count"], 1) / 1e3
+        print(f"{name:<28} {cat:<14} {a['count'] - b['count']:>+8} "
+              f"{(a['total_ns'] - b['total_ns']) / 1e6:>+11.2f} "
+              f"{dself / 1e6:>+10.2f} {mean_a - mean_b:>+10.1f}")
+    ca, cb = cat_rollup(agg_a), cat_rollup(agg_b)
+    ra, rb = dispatch_ratio(ca), dispatch_ratio(cb)
+    fmt = lambda r: "inf" if r < 0 else f"{r:.3f}"  # noqa: E731
+    print(f"dispatch-vs-compute ratio: {fmt(rb)} -> {fmt(ra)}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="span-trace attribution report "
+                    "(Chrome trace JSON or span JSONL)")
+    ap.add_argument("trace", help="trace file (--trace-out JSON or "
+                    "span_log JSONL)")
+    ap.add_argument("--diff", metavar="BASELINE", default=None,
+                    help="diff against a baseline trace (before/after "
+                    "tool: TRACE is the after side)")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows to print (default 20)")
+    args = ap.parse_args(argv)
+
+    try:
+        rows = load_rows(args.trace)
+        if not rows:
+            print(f"trace_report: {args.trace}: no spans",
+                  file=sys.stderr)
+            return 2
+        agg = aggregate(rows)
+        if args.diff:
+            base = aggregate(load_rows(args.diff))
+            print_diff(args.trace, args.diff, agg, base, args.top)
+        else:
+            print_report(args.trace, agg, cat_rollup(agg), args.top)
+        return 0
+    except (OSError, ValueError, KeyError) as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

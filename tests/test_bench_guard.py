@@ -140,6 +140,68 @@ def test_tardiness_not_judged_without_history(monkeypatch, capsys,
     assert "p99 tardiness" in out and "not judged" in out
 
 
+def write_history_dispatch(tmp_path, rows):
+    """rows = [(dps, dispatch_ms_per_launch), ...] on one device."""
+    h = tmp_path / "history"
+    h.mkdir()
+    for i, (dps, disp) in enumerate(rows):
+        (h / f"bench_{1000 + i}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": "tpu0",
+             "workloads": {"cfg4": {
+                 "dps": dps, "dispatch_ms_per_launch": disp}}}))
+    return h
+
+
+def test_dispatch_series_ok_when_stable(monkeypatch, capsys,
+                                        tmp_path):
+    hist = write_history_dispatch(tmp_path, [(40e6, 17.0), (42e6, 16.0),
+                                             (41e6, 18.5)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "dispatch" in out and "OK" in out
+
+
+def test_dispatch_regression_warns_but_passes(monkeypatch, capsys,
+                                              tmp_path):
+    # the per-launch dispatch tax tripled while dec/s held (the chains
+    # amortize it): warn-only, throughput stays the exit code
+    monkeypatch.setattr(bg, "HISTORY",
+                        write_history_dispatch(
+                            tmp_path, [(40e6, 17.0), (42e6, 16.0),
+                                       (41e6, 55.0)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING dispatch" in cap.err
+    assert "dispatch tax regressed" in cap.err
+
+
+def test_dispatch_submillisecond_median_floored(monkeypatch, capsys,
+                                                tmp_path):
+    # cpu boxes measure ~µs dispatch; the 1ms floor keeps jitter from
+    # reading as a 2x regression
+    hist = write_history_dispatch(tmp_path, [(40e6, 0.01), (42e6, 0.02),
+                                             (41e6, 0.9)])
+    rc, _ = run_guard(monkeypatch, capsys, hist)
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING dispatch" not in cap.err
+
+
+def test_dispatch_not_judged_without_history(monkeypatch, capsys,
+                                             tmp_path):
+    # records predating --spans carry no dispatch column
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 42e6)])
+    (hist / "bench_2000.json").write_text(json.dumps(
+        {"platform": "tpu", "device": "tpu0",
+         "workloads": {"serve": {"dps": 41e6,
+                                 "dispatch_ms_per_launch": 17.0}}}))
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "dispatch" in out and "not judged" in out
+
+
 def test_tolerance_flag(monkeypatch, capsys, tmp_path):
     hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 40e6),
                                     ("tpu0", 15e6)])

@@ -228,6 +228,40 @@ class TestProfileCombiner:
         assert (comb.count, comb.sum_ns, comb.low_ns, comb.high_ns) \
             == (1, 500, 500, 500)
 
+    def test_double_start_restarts_cleanly_and_counts(self):
+        # regression: start() on a running timer used to assert (and
+        # under PYTHONOPTIMIZE silently discard the in-flight
+        # interval); now it restarts cleanly and counts a reentry
+        t = ProfileTimer()
+        t.start()
+        t.start()                 # reentrant start: abandon + restart
+        t.stop()
+        assert t.reentries == 1
+        assert t.count == 1       # exactly one interval accumulated
+        assert t.sum_ns >= 0
+        t.start()
+        t.stop()
+        assert t.reentries == 1 and t.count == 2
+        # a stop without a start still asserts (a stop cannot invent
+        # an interval)
+        with pytest.raises(AssertionError):
+            ProfileTimer().stop()
+
+    def test_reentries_visible_at_the_drain(self):
+        # the abandoned interval deflates count/sum, so the stat must
+        # surface in the registry drain or the discard stays silent
+        reg = MetricsRegistry()
+        t1, t2 = ProfileTimer(), ProfileTimer()
+        t1.start()
+        t1.start()
+        t1.stop()
+        reg.timer("x_ns", source=t1)
+        reg.timer("x_ns", source=t2)
+        tm = reg.timer("x_ns")
+        assert tm.value_obj()["reentries"] == 1
+        assert ("_reentries", {}, 1) in tm.sample_rows()
+        assert "x_ns_reentries 1" in reg.prometheus()
+
 
 # ----------------------------------------------------------------------
 # host registry

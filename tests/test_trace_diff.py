@@ -1,0 +1,116 @@
+"""Tests for scripts/trace_diff.py (the first-divergence decision-
+trace triage tool from PR-2, previously untested): identical streams,
+a single mid-stream divergence, truncated files, malformed input, and
+the --ignore/--limit knobs."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+_spec = importlib.util.spec_from_file_location(
+    "trace_diff", REPO / "scripts" / "trace_diff.py")
+trace_diff = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(trace_diff)
+
+
+def row(t, client, phase="priority", cost=1, server=0, tag=None):
+    return {"t": t, "server": server, "client": client,
+            "phase": phase, "cost": cost, "tag": tag}
+
+
+def write(path, rows):
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def stream(n, start=0):
+    return [row(10 ** 9 + i * 10 ** 6, i % 3) for i in range(start, n)]
+
+
+def test_identical_traces(tmp_path, capsys):
+    a = write(tmp_path / "a.jsonl", stream(50))
+    b = write(tmp_path / "b.jsonl", stream(50))
+    assert trace_diff.main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert "identical (50 decisions)" in out
+
+
+def test_single_divergence_reports_field_and_both_rows(tmp_path,
+                                                       capsys):
+    rows_a = stream(50)
+    rows_b = stream(50)
+    rows_b[17] = dict(rows_b[17], client=99, cost=7)
+    a = write(tmp_path / "a.jsonl", rows_a)
+    b = write(tmp_path / "b.jsonl", rows_b)
+    assert trace_diff.main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "divergence at decision 17" in out
+    assert "client" in out and "cost" in out
+    assert "client=99" in out         # both rows printed
+    assert out.count(a) == 1 and out.count(b) == 1
+
+
+def test_truncated_stream_is_divergence(tmp_path, capsys):
+    a = write(tmp_path / "a.jsonl", stream(30))
+    b = write(tmp_path / "b.jsonl", stream(40))
+    assert trace_diff.main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "ended after 30 decisions" in out
+    assert "<stream ended>" in out
+
+
+def test_empty_vs_nonempty(tmp_path, capsys):
+    a = write(tmp_path / "a.jsonl", [])
+    b = write(tmp_path / "b.jsonl", stream(3))
+    assert trace_diff.main([a, b]) == 1
+    assert "ended after 0 decisions" in capsys.readouterr().out
+
+
+def test_null_tag_vs_triple_not_divergent(tmp_path, capsys):
+    # backends that materialize no host-side tags emit null; a
+    # null-vs-triple pair is NOT a divergence (schema contract)
+    rows_a = [row(1, 0, tag=[5, 6, 7]), row(2, 1, tag=[8, 9, 10])]
+    rows_b = [row(1, 0, tag=None), row(2, 1, tag=None)]
+    a = write(tmp_path / "a.jsonl", rows_a)
+    b = write(tmp_path / "b.jsonl", rows_b)
+    assert trace_diff.main([a, b]) == 0
+    # but two PRESENT, differing triples are
+    rows_b2 = [row(1, 0, tag=[5, 6, 7]), row(2, 1, tag=[8, 9, 999])]
+    b2 = write(tmp_path / "b2.jsonl", rows_b2)
+    assert trace_diff.main([a, b2]) == 1
+    assert "tag" in capsys.readouterr().out
+
+
+def test_malformed_input_exits_2(tmp_path, capsys):
+    a = write(tmp_path / "a.jsonl", stream(2))
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"t": 1, "server": 0}\nnot json\n')
+    assert trace_diff.main([a, str(bad)]) == 2
+    assert "trace_diff:" in capsys.readouterr().err
+
+
+def test_missing_file_exits_2(tmp_path, capsys):
+    a = write(tmp_path / "a.jsonl", stream(2))
+    assert trace_diff.main([a, str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_ignore_and_limit_flags(tmp_path, capsys):
+    # server differs everywhere (the cross-backend default ignores
+    # it); --ignore '' makes it count
+    rows_a = stream(10)
+    rows_b = [dict(r, server=1) for r in rows_a]
+    a = write(tmp_path / "a.jsonl", rows_a)
+    b = write(tmp_path / "b.jsonl", rows_b)
+    assert trace_diff.main([a, b]) == 0
+    assert trace_diff.main([a, b, "--ignore", ""]) == 1
+    capsys.readouterr()
+    # --limit stops before a late divergence
+    rows_b2 = stream(10)
+    rows_b2[8] = dict(rows_b2[8], cost=5)
+    b2 = write(tmp_path / "b2.jsonl", rows_b2)
+    assert trace_diff.main([a, b2, "--limit", "5"]) == 0
+    assert "--limit reached" in capsys.readouterr().out
+    assert trace_diff.main([a, b2]) == 1
