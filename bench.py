@@ -361,6 +361,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     select_impl: str = "sort",
                     calendar_impl: str = "minstop",
                     ladder_levels: int = 8,
+                    engine_loop: str = "round",
+                    stream_chunk: int = 8,
                     telemetry: bool = True, tracer=None):
     """Closed loop: Poisson superwave ingest + prefix serve epoch per
     round, chained async on device; ingest IS inside the timed region.
@@ -369,7 +371,17 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     (reservation floor + weight share of the surplus), so the loop is
     sustained: queues hover around depth0 instead of draining.
     Admission is clamped to ring headroom on device (the AtLimit
-    Reject/EAGAIN analog, reference dmclock_server.h:989-993)."""
+    Reject/EAGAIN analog, reference dmclock_server.h:989-993).
+
+    ``engine_loop`` (docs/ENGINE.md): "round" launches one fused
+    ingest+serve round per dispatch (the PR-1..7 shape); "stream"
+    fuses ``stream_chunk`` consecutive rounds into ONE launch (a
+    ``lax.scan`` over the identical round body, so decisions are
+    bit-identical) with the pre-generated Poisson draws uploaded as a
+    block -- the launches-per-decision killer the streaming serve
+    loop exists for.  Calibration / conformance / latency rounds stay
+    on the round program either way (they are untimed and need the
+    per-round slot outputs)."""
     from dmclock_tpu.engine import kernels
     from dmclock_tpu.engine.fastpath import (scan_calendar_epoch,
                                              scan_chain_epoch,
@@ -506,8 +518,48 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     run = jax.jit(round_fn, donate_argnums=(0, 3)).lower(
         state, jnp.zeros((n,), jnp.int32), jnp.int64(0),
         tele).compile()
-    cost = epoch_cost_analysis(run)
+    # NOT named `cost`: round_fn closes over the per-client cost
+    # vector of that name, and the stream chunk re-traces round_fn
+    # lazily -- shadowing it with this dict would poison the trace
+    cost_attr = epoch_cost_analysis(run)
     rng = np.random.default_rng(11)
+
+    assert engine_loop in ("round", "stream"), engine_loop
+    stream_on = engine_loop == "stream"
+    stream_chunk = max(int(stream_chunk), 1)
+    _chunk_jits: dict = {}
+
+    def chunk_run(c: int):
+        """One device launch covering ``c`` rounds: a ``lax.scan``
+        over the IDENTICAL round body (same integer ops in the same
+        order -- decisions bit-identical to the round loop, gated in
+        ci.sh), state + telemetry donated as carried HBM state,
+        per-round count/guards/resv/metrics stacking in HBM as scan
+        outputs and drained once per chunk.  AOT lower+compile (the
+        round program's discipline): a lazy first-call compile would
+        land inside the first timed chain and read as launch cost."""
+        if c not in _chunk_jits:
+            from jax import lax
+
+            def chunk_fn(st, counts_c, t0, tele):
+                def body(carry, xs):
+                    st, tele = carry
+                    counts, i = xs
+                    out = round_fn(st, counts, t0 + i * dt_round_ns,
+                                   tele)
+                    return (out[0], out[7]), (out[1], out[2], out[3],
+                                              out[6])
+
+                (st, tele), outs = lax.scan(
+                    body, (st, tele),
+                    (counts_c, jnp.arange(c, dtype=jnp.int64)))
+                return st, outs, tele
+
+            _chunk_jits[c] = jax.jit(
+                chunk_fn, donate_argnums=(0, 3)).lower(
+                state, jnp.zeros((c, n), jnp.int32), jnp.int64(0),
+                tele).compile()
+        return _chunk_jits[c]
 
     def draw():
         return jnp.asarray(
@@ -605,6 +657,27 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     with obsspans.span(tracer, "bench.pregen_arrivals", "host_prep"):
         pre = [draw() for _ in range(n_pre)]
         jax.block_until_ready(pre)
+        # stream mode uploads each chunk's draws as one [c, N] block;
+        # stacking is load-generator work, pre-paid like the draws --
+        # and the per-round list is then DEAD on the stream path, so
+        # drop it rather than carry a second full copy of the draws
+        # (83 MB at the cfg4 shape) through the timed chains
+        pre_all = None
+        if stream_on:
+            pre_all = jax.block_until_ready(jnp.stack(pre))
+            pre = None
+    if stream_on:
+        # AOT-compile every chunk length the timed chains will use,
+        # BEFORE the timing window opens (chain lengths split into
+        # stream_chunk-sized launches plus one remainder each)
+        lens = set()
+        for L in ((rlo, rounds) if rlo else (rounds,)):
+            if L >= stream_chunk:
+                lens.add(stream_chunk)
+            if L % stream_chunk:
+                lens.add(L % stream_chunk)
+        for c in sorted(lens):
+            chunk_run(c)
 
     met_acc = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
     # calibration's warm-up serves pollute the distribution: reset the
@@ -622,28 +695,57 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         nonlocal state, t_base, met_acc, tele
         t0 = time.perf_counter()
         counts_out, resv_out, guards, mets = [], [], [], []
-        for i in idx:
-            with obsspans.span(tracer, "bench.round", "dispatch"):
-                state, cnt, g, resv, _, _, met_, tele = run(
-                    state, pre[i], jnp.int64(t_base), tele)
-                counts_out.append(cnt)
-                resv_out.append(resv)
-                guards.append(g)
-                mets.append(met_)
-            t_base += dt_round_ns
+        launches = 0
+        if stream_on:
+            # one launch per stream chunk of rounds; idx is always a
+            # contiguous range here, so the pre-stacked draw block
+            # slices straight onto the device
+            idx = list(idx)
+            pos = 0
+            while pos < len(idx):
+                c = min(stream_chunk, len(idx) - pos)
+                i0 = idx[pos]
+                with obsspans.span(tracer, "bench.chunk", "dispatch",
+                                   rounds=c):
+                    state, outs, tele = chunk_run(c)(
+                        state, pre_all[i0:i0 + c],
+                        jnp.int64(t_base), tele)
+                    counts_out.append(outs[0])
+                    guards.append(outs[1])
+                    resv_out.append(outs[2])
+                    mets.append(outs[3])
+                t_base += c * dt_round_ns
+                launches += 1
+                pos += c
+        else:
+            for i in idx:
+                with obsspans.span(tracer, "bench.round", "dispatch"):
+                    state, cnt, g, resv, _, _, met_, tele = run(
+                        state, pre[i], jnp.int64(t_base), tele)
+                    counts_out.append(cnt)
+                    resv_out.append(resv)
+                    guards.append(g)
+                    mets.append(met_)
+                t_base += dt_round_ns
+                launches += 1
         with obsspans.span(tracer, "bench.digest_sync",
                            "device_compute"):
             jax.device_get(state_digest(state))
         wall = time.perf_counter() - t0
         chain_walls.append(wall)
-        chain_launches[0] += len(idx)
+        chain_launches[0] += launches
         assert all(bool(jax.device_get(g).all()) for g in guards), \
             "rebase guards tripped -- counts are not trustworthy"
-        cnts = np.concatenate([jax.device_get(c) for c in counts_out])
-        rs = np.concatenate([jax.device_get(r) for r in resv_out])
+        # ravel: stream chunks stack per-round rows on a leading axis
+        cnts = np.concatenate([np.asarray(jax.device_get(c)).ravel()
+                               for c in counts_out])
+        rs = np.concatenate([np.asarray(jax.device_get(r)).ravel()
+                             for r in resv_out])
         # metrics ride the same round outputs, fetched untimed
-        met_acc = obsdev_np_combine(
-            met_acc, *[jax.device_get(mv) for mv in mets])
+        met_rows = [row for mv in mets
+                    for row in np.atleast_2d(np.asarray(
+                        jax.device_get(mv), dtype=np.int64))]
+        met_acc = obsdev_np_combine(met_acc, *met_rows)
         return int(cnts.sum()), wall, cnts, rs
 
     if rlo:
@@ -686,7 +788,15 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
            "resv_phase_frac": resv_frac,
            "mean_depth": mean_depth,
            "select_impl": select_impl,
-           "cost_analysis": cost}
+           "engine_loop": engine_loop,
+           "cost_analysis": cost_attr}
+    # launches-per-decision is the streaming loop's acceptance
+    # currency (ROADMAP #1): decisions_per_launch counts the TIMED
+    # chains' device launches only, so round vs stream compare the
+    # same measured region
+    out["decisions_per_launch"] = total / max(chain_launches[0], 1)
+    if stream_on:
+        out["stream_chunk"] = stream_chunk
     sp = _span_summary(tracer, span_win, sum(chain_walls),
                        chain_launches[0])
     if sp is not None:
@@ -696,6 +806,13 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         # regression even when dec/s holds)
         out["dispatch_ms_per_launch"] = sp["dispatch_ms_per_launch"]
         out["host_overhead_frac"] = sp["host_overhead_frac"]
+        # per-decision amortized dispatch: what one decision pays in
+        # dispatch tax when a single launch covers a whole stream
+        # chunk (docs/OBSERVABILITY.md)
+        sp["decisions_per_launch"] = out["decisions_per_launch"]
+        out["dispatch_ns_per_decision"] = sp["dispatch_ns_per_decision"] = \
+            sp["dispatch_ms_per_launch"] * 1e6 \
+            / max(out["decisions_per_launch"], 1e-9)
     if calendar_steps:
         # decisions per device launch (pass = one calendar batch):
         # the bucketed-vs-minstop acceptance currency -- the ladder's
@@ -810,7 +927,7 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         # the RTT, not the rounds
         lat_rt = scalar_latency()
         # device-side seconds per round, from the differenced median
-        round_est = (total / max(len(pre), 1)) / max(dps, 1.0)
+        round_est = (total / max(n_pre, 1)) / max(dps, 1.0)
         w = max(4, int(np.ceil(2.0 * lat_rt / max(round_est, 1e-4))))
         w = min(w, max(latency_rounds // 4, 4))
         n_rounds = latency_rounds + w
@@ -1068,6 +1185,23 @@ def main() -> None:
     ap.add_argument("--ladder-levels", type=int, default=8,
                     metavar="L",
                     help="ladder levels per bucketed calendar batch")
+    ap.add_argument("--engine-loop",
+                    choices=["round", "stream", "both"],
+                    default="round",
+                    help="sustained-workload loop structure "
+                    "(docs/ENGINE.md): 'round' = one fused "
+                    "ingest+serve launch per round (the historical "
+                    "shape); 'stream' = one launch per "
+                    "--stream-chunk rounds (lax.scan over the "
+                    "identical round body, decisions bit-identical; "
+                    "launches-per-decision down by the chunk "
+                    "factor); 'both' runs each sustained workload "
+                    "under each and reports e.g. cfg4 + cfg4_stream "
+                    "(separate bench_guard series).  serve-only has "
+                    "no ingest loop and ignores this")
+    ap.add_argument("--stream-chunk", type=int, default=8,
+                    metavar="R",
+                    help="rounds fused per stream-loop launch")
     ap.add_argument("--device-metrics", choices=["on", "off"],
                     default="on",
                     help="accumulate the on-device obs vector inside "
@@ -1243,6 +1377,8 @@ def main() -> None:
 
     def run_workloads(backend: str) -> dict:
         results = {}
+        loops = ("round", "stream") if args.engine_loop == "both" \
+            else (args.engine_loop,)
         if args.mode in ("all", "serve"):
             # the cpu fallback cannot hold a 100k x 320 backlog in
             # tolerable time; a scaled-down shape keeps the smoke alive
@@ -1264,21 +1400,44 @@ def main() -> None:
                 key = "serve" if eff["select_impl"] == "sort" \
                     else "serve_radix"
                 results.setdefault(key, row)
-        if args.mode in ("all", "cfg3") and backend != "cpu":
+        if args.mode in ("all", "cfg3") and \
+                (backend != "cpu" or args.mode == "cfg3"):
             # 10k clients, uniform QoS, Poisson arrivals; weight
             # regime.  Rounds are small (~130k decisions, ~7ms), so
             # the chains must be long for the differenced pairs to
-            # clear tunnel jitter
-            results["cfg3"], _ = _with_ladder(
-                ladder,
-                {"select_impl": "radix" if args.select_impl == "radix"
-                 else "sort"},
-                lambda select_impl: bench_sustained(
-                    10_000, 4096, 32, 60, zipf=False, resv_rate=100.0,
-                    dt_round_ns=100_000_000, ring=256, depth0=128,
-                    rounds_lo=20, with_metrics=wm,
-                    select_impl=select_impl, telemetry=tele_on,
-                    tracer=tracer))
+            # clear tunnel jitter.  An EXPLICIT --mode cfg3 on the
+            # cpu fallback runs a scaled-down shape: the
+            # round-vs-stream ingest+serve A/B (PROFILE.md finding
+            # 19) needs a sustained workload on cpu-only boxes too,
+            # and platform=cpu already keeps the record out of the
+            # accelerator medians (bench_guard is_fallback)
+            if backend == "cpu":
+                cfg3_shape = dict(n=2048, k=512, m=8, rounds=24,
+                                  zipf=False, resv_rate=50.0,
+                                  dt_round_ns=100_000_000, ring=64,
+                                  depth0=48, waves=16, rounds_lo=8,
+                                  reps=2)
+            else:
+                cfg3_shape = dict(n=10_000, k=4096, m=32, rounds=60,
+                                  zipf=False, resv_rate=100.0,
+                                  dt_round_ns=100_000_000, ring=256,
+                                  depth0=128, rounds_lo=20)
+            for loop in loops:
+                key = "cfg3" if loop == "round" else "cfg3_stream"
+                sh = dict(cfg3_shape)
+                sh_pos = (sh.pop("n"), sh.pop("k"), sh.pop("m"),
+                          sh.pop("rounds"))
+                results[key], _ = _with_ladder(
+                    ladder,
+                    {"select_impl": "radix"
+                     if args.select_impl == "radix" else "sort"},
+                    lambda select_impl, loop=loop, sh=sh,
+                    sh_pos=sh_pos: bench_sustained(
+                        *sh_pos, **sh, with_metrics=wm,
+                        select_impl=select_impl,
+                        engine_loop=loop,
+                        stream_chunk=args.stream_chunk,
+                        telemetry=tele_on, tracer=tracer))
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster
@@ -1297,21 +1456,28 @@ def main() -> None:
                 if args.calendar_impl == "both" \
                 else (args.calendar_impl,)
             for cal in cals:
-                row, eff = _with_ladder(
-                    ladder, {"calendar_impl": cal},
-                    lambda calendar_impl: bench_sustained(
-                        100_000, 0, 3, 40, zipf=True,
-                        resv_rate=1200.0, dt_round_ns=50_000_000,
-                        waves=64, rounds_lo=12, latency_rounds=100,
-                        calendar_steps=64, target_resv_share=0.5,
-                        reps=4, with_metrics=wm,
-                        calendar_impl=calendar_impl,
-                        ladder_levels=args.ladder_levels,
-                        conformance_out=args.conformance_out,
-                        telemetry=tele_on, tracer=tracer))
-                key = "cfg4" if eff["calendar_impl"] == "minstop" \
-                    else "cfg4_bucketed"
-                results.setdefault(key, row)
+                for loop in loops:
+                    row, eff = _with_ladder(
+                        ladder, {"calendar_impl": cal},
+                        lambda calendar_impl, loop=loop:
+                        bench_sustained(
+                            100_000, 0, 3, 40, zipf=True,
+                            resv_rate=1200.0, dt_round_ns=50_000_000,
+                            waves=64, rounds_lo=12,
+                            latency_rounds=100,
+                            calendar_steps=64, target_resv_share=0.5,
+                            reps=4, with_metrics=wm,
+                            calendar_impl=calendar_impl,
+                            ladder_levels=args.ladder_levels,
+                            engine_loop=loop,
+                            stream_chunk=args.stream_chunk,
+                            conformance_out=args.conformance_out,
+                            telemetry=tele_on, tracer=tracer))
+                    key = "cfg4" if eff["calendar_impl"] == "minstop" \
+                        else "cfg4_bucketed"
+                    if loop == "stream":
+                        key += "_stream"
+                    results.setdefault(key, row)
         return results
 
     with trace_ctx:
@@ -1353,9 +1519,11 @@ def main() -> None:
               "value": 0.0, "unit": "decisions/sec/chip",
               "vs_baseline": 0.0})
         return
-    c4 = results.get("cfg4") or results.get("cfg4_bucketed")
-    primary = c4 or results.get("cfg3") or results.get("serve") \
-        or next(iter(results.values()))
+    c4 = results.get("cfg4") or results.get("cfg4_bucketed") \
+        or results.get("cfg4_stream") \
+        or results.get("cfg4_bucketed_stream")
+    primary = c4 or results.get("cfg3") or results.get("cfg3_stream") \
+        or results.get("serve") or next(iter(results.values()))
     parts = []
     for key in ("serve", "serve_radix"):
         if key in results:
@@ -1368,8 +1536,16 @@ def main() -> None:
         parts.append(f"cfg3 10k-client Poisson sustained "
                      f"{r['dps']/1e6:.1f}M (fill {r['fill']:.2f}, "
                      f"depth {r['mean_depth']:.0f})")
+    if "cfg3_stream" in results:
+        r = results["cfg3_stream"]
+        parts.append(f"cfg3[stream] {r['dps']/1e6:.1f}M "
+                     f"({r['decisions_per_launch']:.0f} dec/launch, "
+                     f"chunk {r.get('stream_chunk', 0)})")
     for key, label in (("cfg4", "cfg4"),
-                       ("cfg4_bucketed", "cfg4[bucketed]")):
+                       ("cfg4_bucketed", "cfg4[bucketed]"),
+                       ("cfg4_stream", "cfg4[stream]"),
+                       ("cfg4_bucketed_stream",
+                        "cfg4[bucketed,stream]")):
         r4 = results.get(key)
         if not r4:
             continue

@@ -2134,3 +2134,54 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                          metrics=metrics, level_count=lvls,
                          hists=tele.get("h"), ledger=tele.get("l"),
                          flight=tele.get("f"))
+
+
+# ----------------------------------------------------------------------
+# epoch-engine dispatch: the one registry + kwargs normalization
+# ----------------------------------------------------------------------
+#
+# Every epoch body doubles as a STREAM STEP: the guarded runner
+# (robust.guarded), the streaming chunk program (engine.stream), and
+# any future caller must resolve "engine name -> scan fn + the kwargs
+# that engine actually takes" IDENTICALLY, or a knob silently applied
+# to one loop and not the other would break the stream-vs-round
+# digest gate.  One implementation here; callers never hand-build the
+# kwarg dicts.
+
+EPOCH_ENGINES = ("prefix", "chain", "calendar")
+
+
+def epoch_scan_fn(engine: str):
+    """The epoch-scan callable for ``engine`` (raises KeyError on an
+    unknown name)."""
+    return {"prefix": scan_prefix_epoch, "chain": scan_chain_epoch,
+            "calendar": scan_calendar_epoch}[engine]
+
+
+def epoch_scan_kwargs(engine: str, *, k: int = 0, chain_depth: int = 4,
+                      select_impl: str = "sort", tag_width: int = 64,
+                      window_m: int | None = None,
+                      calendar_impl: str = "minstop",
+                      ladder_levels: int = 8,
+                      anticipation_ns: int = 0,
+                      allow_limit_break: bool = False,
+                      with_metrics: bool = False) -> dict:
+    """Normalize the shared knob set into the kwargs ``engine``'s scan
+    accepts: prefix reads k/select_impl/window_m, chain reads
+    k/select_impl/chain_depth, and the calendar engine has no [k] cap
+    -- k doubles as its per-client serve-step budget (``steps``)."""
+    if engine not in EPOCH_ENGINES:
+        raise ValueError(f"unknown epoch engine {engine!r} "
+                         f"(one of {EPOCH_ENGINES})")
+    kw = dict(anticipation_ns=anticipation_ns,
+              allow_limit_break=allow_limit_break,
+              with_metrics=with_metrics, tag_width=tag_width)
+    if engine == "prefix":
+        kw.update(k=k, select_impl=select_impl, window_m=window_m)
+    elif engine == "chain":
+        kw.update(k=k, select_impl=select_impl,
+                  chain_depth=chain_depth)
+    else:
+        kw.update(steps=max(k, 1), calendar_impl=calendar_impl,
+                  ladder_levels=ladder_levels)
+    return kw

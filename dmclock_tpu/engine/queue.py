@@ -120,6 +120,57 @@ def _shared_jit_run_horizon(steps: int, allow: bool,
     return _JIT_CACHE[key]
 
 
+def _stream_windows(s, t0, dt, *, steps: int, chunks: int, allow: bool,
+                    anticipation_ns: int):
+    """``chunks`` consecutive engine_run windows in one scan: window
+    ``c`` serves up to ``steps`` decisions at ``t0 + c * dt``, each on
+    the committed state of the previous one -- exactly what ``chunks``
+    sequential ``pull_batch`` launches compute.  The ONE window body
+    shared by both streaming jit factories, so the schedule and
+    decision packing cannot drift between them."""
+    def body(st, i):
+        st, _, dec = kernels.engine_run(
+            st, t0 + i * dt, steps, allow_limit_break=allow,
+            anticipation_ns=anticipation_ns, advance_now=False)
+        return st, _pack_decisions(dec)
+
+    return jax.lax.scan(body, s, jnp.arange(chunks, dtype=jnp.int64))
+
+
+def _shared_jit_run_stream(steps: int, chunks: int, allow: bool,
+                           anticipation_ns: int):
+    """The pull queue's streaming dispatch (docs/ENGINE.md
+    "engine_loop"): the :func:`_stream_windows` scan as ONE launch,
+    all packed decision blocks stacking in HBM and draining once."""
+    key = ("run_stream", steps, chunks, allow, anticipation_ns)
+    if key not in _JIT_CACHE:
+        def run(s, t0, dt):
+            return _stream_windows(
+                s, t0, dt, steps=steps, chunks=chunks, allow=allow,
+                anticipation_ns=anticipation_ns)
+        _JIT_CACHE[key] = jax.jit(run)
+    return _JIT_CACHE[key]
+
+
+def _shared_jit_ingest_run_stream(steps: int, chunks: int, allow: bool,
+                                  anticipation_ns: int):
+    """Fused flush + streaming serve: pending op rows ingest once at
+    window 0, then the chunked serve scan -- one launch where the
+    sequential form pays ``1 + chunks``."""
+    key = ("ingest_run_stream", steps, chunks, allow, anticipation_ns)
+    if key not in _JIT_CACHE:
+        ant = anticipation_ns
+
+        def fused(s, packed, t0, dt):
+            s = kernels.ingest(s, _unpack_ops(packed),
+                               anticipation_ns=ant)
+            return _stream_windows(
+                s, t0, dt, steps=steps, chunks=chunks, allow=allow,
+                anticipation_ns=ant)
+        _JIT_CACHE[key] = jax.jit(fused)
+    return _JIT_CACHE[key]
+
+
 def _shared_jit_ingest_run(steps: int, advance_now: bool, allow: bool,
                            anticipation_ns: int):
     key = ("ingest_run", steps, advance_now, allow, anticipation_ns)
@@ -744,6 +795,51 @@ class TpuPullPriorityQueue:
                 else:
                     out.append(pr)
                     break
+            return out
+
+    def pull_batch_stream(self, t0_ns: int, dt_ns: int, chunks: int,
+                          max_decisions: int) -> List[List[PullReq]]:
+        """``chunks`` consecutive ``pull_batch`` windows in ONE device
+        launch -- the streaming serve loop at the pull-queue layer
+        (docs/ENGINE.md "engine_loop"): window ``c`` serves at ``t0 +
+        c * dt`` on the committed state of window ``c - 1``, the
+        decision blocks accumulate in HBM, and the host drains them
+        once per chunk instead of once per window.  Pending adds flush
+        fused into window 0, and the launch runs under the same
+        guarded-commit retry contract as every other launch (state
+        rebinds only on success) -- dispatch and retry both at
+        stream-chunk granularity.
+
+        Bit-identical to ``chunks`` sequential ``pull_batch(t0 + c *
+        dt, max_decisions)`` calls with no adds interleaved (pinned in
+        tests/test_stream.py).  Returns one decision list per window,
+        each terminated like ``pull_batch``'s."""
+        assert chunks >= 1 and max_decisions >= 1
+        with self.data_mtx:
+            out: List[List[PullReq]] = []
+            self._settle_spec()
+            self.state, packs = self._drain_and_launch(
+                _shared_jit_ingest_run_stream(
+                    max_decisions, chunks,
+                    self.at_limit is AtLimit.ALLOW,
+                    self.anticipation_timeout_ns),
+                _shared_jit_run_stream(
+                    max_decisions, chunks,
+                    self.at_limit is AtLimit.ALLOW,
+                    self.anticipation_timeout_ns),
+                t0_ns, dt_ns)
+            d_all = self._traced_fetch(packs)   # [chunks, 6, steps]
+            for c in range(chunks):
+                d = d_all[c]
+                rows: List[PullReq] = []
+                for i in range(d.shape[1]):
+                    pr = self._decision_to_pullreq(
+                        int(d[0, i]), int(d[1, i]), int(d[2, i]),
+                        int(d[3, i]), int(d[4, i]), bool(d[5, i]))
+                    rows.append(pr)
+                    if not pr.is_retn():
+                        break
+                out.append(rows)
             return out
 
     # ------------------------------------------------------------------

@@ -144,12 +144,18 @@ class SpanTracer:
         self._agg: Dict[Tuple[str, str], List[int]] = {}
         # cat -> last span-end timestamp (watchdog stall detection)
         self._last_end: Dict[str, int] = {}
+        # tid -> that thread's open-span stack, for cross-thread
+        # in-flight reads (open_categories); registered once per
+        # thread, so the hot path stays lock-free
+        self._all_stacks: Dict[int, list] = {}
 
     # -- recording -----------------------------------------------------
     def _stack(self) -> list:
         st = getattr(self._local, "stack", None)
         if st is None:
             st = self._local.stack = []
+            with self._mtx:
+                self._all_stacks[threading.get_ident()] = st
         return st
 
     def span(self, name: str, cat: str, **args) -> _Span:
@@ -164,8 +170,13 @@ class SpanTracer:
     def _push(self, sp: _Span) -> None:
         st = self._stack()
         sp.depth = len(st)
-        st.append(sp)
+        # t0 BEFORE the append: the cross-thread readers
+        # (oldest_open_ns / open_categories) walk the stack lock-free,
+        # and a span visible with t0 still 0 would read as infinitely
+        # old -- defeating the watchdog's in-flight stall suppression
+        # it exists to serve
         sp.t0 = self._clock()
+        st.append(sp)
 
     def _pop(self, sp: _Span) -> None:
         end = self._clock()
@@ -250,6 +261,70 @@ class SpanTracer:
         stall detection); None before the first one closes."""
         with self._mtx:
             return self._last_end.get(cat)
+
+    def _live_stacks(self):
+        """Snapshot (tid, stack) pairs for LIVE threads, pruning dead
+        threads' stacks as a side effect.  A thread that exited with
+        spans still open is a discipline break: its orphans are folded
+        into ``spans_leaked`` and its registry entry dropped, so they
+        neither report as in-flight work forever (which would
+        permanently blind the watchdog's stall check) nor pin the
+        registry's memory under thread churn.  Best-effort snapshot:
+        the stacks mutate lock-free on their owning threads, so a span
+        entered/exited mid-walk may be missed or double-seen for one
+        poll -- fine for a sampler."""
+        with self._mtx:
+            items = list(self._all_stacks.items())
+        alive = {t.ident for t in threading.enumerate()}
+        live = []
+        dead = []
+        for tid, st in items:
+            if tid not in alive:
+                dead.append((tid, len(tuple(st))))
+            else:
+                live.append((tid, st))
+        if dead:
+            with self._mtx:
+                # ONE fresh alive snapshot under the lock (the
+                # recording hot path contends on this mutex, so the
+                # critical section must stay O(threads), not
+                # O(dead x threads)): CPython reuses thread idents,
+                # and a new thread may have re-registered a dead key
+                # since the first snapshot
+                alive2 = {t.ident for t in threading.enumerate()}
+                for tid, leaked in dead:
+                    if tid in self._all_stacks and tid not in alive2:
+                        self._all_stacks.pop(tid)
+                        self.spans_leaked += leaked
+        return live
+
+    def open_categories(self) -> Dict[str, int]:
+        """cat -> number of spans currently OPEN across all threads --
+        the watchdog's in-flight-dispatch awareness: a fused stream
+        launch legitimately runs for seconds with no dispatch span
+        COMPLETING, but the blocked ``device_wait`` span is open the
+        whole time, and an open launch is not a stalled cadence."""
+        out: Dict[str, int] = {}
+        for _tid, st in self._live_stacks():
+            for sp in tuple(st):
+                out[sp.cat] = out.get(sp.cat, 0) + 1
+        return out
+
+    def oldest_open_ns(self, cats=("dispatch", "device_compute")
+                       ) -> Optional[int]:
+        """Start timestamp of the OLDEST currently-open span in
+        ``cats`` across live threads (None when nothing is open) --
+        what bounds the watchdog's in-flight stall suppression: an
+        open launch suppresses the stall warning only while it is
+        younger than the wedge threshold, so a launch the runtime
+        wedged INSIDE still surfaces."""
+        oldest = None
+        for _tid, st in self._live_stacks():
+            for sp in tuple(st):
+                if sp.cat in cats and \
+                        (oldest is None or sp.t0 < oldest):
+                    oldest = sp.t0
+        return oldest
 
     def name_stats(self) -> Dict[Tuple[str, str], Tuple[int, int, int]]:
         """(name, cat) -> (count, total_ns, self_ns); exact past ring

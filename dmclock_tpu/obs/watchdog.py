@@ -10,7 +10,11 @@ structured health warnings while a run is still going -- the
   completed for longer than ``stall_after_s`` while at least one had
   before -- the serve loop stopped launching (a wedged tunnel, a host
   deadlock), the failure mode PR-3's guarded retries paper over one
-  launch at a time but cannot see across launches;
+  launch at a time but cannot see across launches.  Streaming-aware:
+  an OPEN dispatch/device_compute span (a fused stream chunk
+  legitimately runs for seconds per launch) or a recent
+  drain-category heartbeat (the stream loop emits one per drain
+  point) counts as a live cadence, never a stall;
 - **dispatch share**: over the last sampling window, host ``dispatch``
   self-time exceeds ``dispatch_share_warn`` of the
   dispatch+device_compute total -- the run is paying more to LAUNCH
@@ -58,6 +62,7 @@ class Watchdog:
                  stall_after_s: float = 5.0,
                  dispatch_share_warn: float = 0.6,
                  min_window_ns: int = 1_000_000,
+                 in_flight_max_s: Optional[float] = None,
                  registry=None,
                  log: Callable[[str], None] = _stderr_log,
                  clock_ns: Callable[[], int] =
@@ -65,6 +70,14 @@ class Watchdog:
         self.tracer = tracer
         self.interval_s = float(interval_s)
         self.stall_after_ns = int(stall_after_s * 1e9)
+        # how long an OPEN dispatch/device_compute span may suppress
+        # the stall warning: a fused stream chunk legitimately runs
+        # far past stall_after_s inside one launch, but a launch the
+        # runtime wedged INSIDE must still surface -- default 10x the
+        # stall threshold
+        self.in_flight_max_ns = int(
+            (10.0 * stall_after_s if in_flight_max_s is None
+             else in_flight_max_s) * 1e9)
         self.dispatch_share_warn = float(dispatch_share_warn)
         self.min_window_ns = int(min_window_ns)
         self._log = log
@@ -102,9 +115,28 @@ class Watchdog:
         counts = self.tracer.category_counts()
 
         # launch-cadence stall: dispatch spans have happened before,
-        # none since, and the last one ended too long ago
+        # none since, and the last one ended too long ago.  Two
+        # streaming-mode exceptions (docs/OBSERVABILITY.md), or every
+        # healthy fused stream chunk would fire this:
+        #  - in-flight awareness: an OPEN dispatch/device_compute span
+        #    means a launch is dispatched or the host is blocked on
+        #    its result -- a chunk running for seconds is work, not
+        #    silence.  BOUNDED by in_flight_max_ns: a launch the
+        #    runtime wedged INSIDE (the original failure mode this
+        #    check exists for) stops suppressing once the open span
+        #    outlives the wedge threshold;
+        #  - stream heartbeat: the serve loop emits a drain-category
+        #    instant at every drain point, so recent drain activity
+        #    proves the loop is alive between launches.
         last = self.tracer.last_end_ns("dispatch")
+        open_t0 = self.tracer.oldest_open_ns()
+        launch_in_flight = open_t0 is not None and \
+            now_ns - open_t0 <= self.in_flight_max_ns
+        hb = self.tracer.last_end_ns("drain")
+        hb_recent = hb is not None and \
+            now_ns - hb <= self.stall_after_ns
         if last is not None and \
+                not launch_in_flight and not hb_recent and \
                 counts.get("dispatch", 0) == \
                 self._prev_count.get("dispatch", 0) and \
                 now_ns - last > self.stall_after_ns:

@@ -99,9 +99,6 @@ class GuardedEpoch(NamedTuple):
     flight: object = None
 
 
-_EPOCHS = {"prefix": "scan_prefix_epoch", "chain": "scan_chain_epoch",
-           "calendar": "scan_calendar_epoch"}
-
 # Module-level jit cache keyed by the static epoch configuration (the
 # engine/queue.py _JIT_CACHE convention): a fresh jax.jit(partial(...))
 # per call would retrace + recompile the whole epoch program on EVERY
@@ -121,7 +118,7 @@ def _jit_epoch(engine: str, m_run: int, kw: dict, tele_sig=()):
         import jax
 
         from ..engine import fastpath
-        fn = getattr(fastpath, _EPOCHS[engine])
+        fn = fastpath.epoch_scan_fn(engine)
         if tele_sig:
             def run(st, t, tele):
                 return fn(st, t, m=m_run, **kw, **tele)
@@ -207,23 +204,17 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
     import jax
     import jax.numpy as jnp
 
-    from ..engine import kernels
+    from ..engine import fastpath, kernels
     from ..obs import spans as _spans
 
-    assert engine in _EPOCHS, engine
-    kw = dict(anticipation_ns=anticipation_ns,
-              allow_limit_break=allow_limit_break,
-              with_metrics=with_metrics, tag_width=tag_width)
-    if engine == "prefix":
-        kw.update(k=k, select_impl=select_impl, window_m=window_m)
-    elif engine == "chain":
-        kw.update(k=k, select_impl=select_impl,
-                  chain_depth=chain_depth)
-    else:
-        # the calendar batch has no [k] cap; k doubles as its
-        # per-client serve-step budget
-        kw.update(steps=max(k, 1), calendar_impl=calendar_impl,
-                  ladder_levels=ladder_levels)
+    assert engine in fastpath.EPOCH_ENGINES, engine
+    kw = fastpath.epoch_scan_kwargs(
+        engine, k=k, chain_depth=chain_depth, select_impl=select_impl,
+        tag_width=tag_width, window_m=window_m,
+        calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break,
+        with_metrics=with_metrics)
     retry_count = [0]
 
     def count_retry(attempt, exc):
@@ -326,6 +317,173 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                         hists=tele.get("hists"),
                         ledger=tele.get("ledger"),
                         flight=tele.get("flight"))
+
+
+class StreamGuarded(NamedTuple):
+    """Result of :func:`run_stream_chunk_guarded` -- one stream chunk
+    of epochs, drained and normalized to per-epoch rows so the caller
+    (``robust.supervisor``'s stream loop) runs the exact same chain
+    digest / metric-fold / ladder bookkeeping as the round loop."""
+
+    state: object            # EngineState after the whole chunk
+    epochs: tuple            # per-epoch tuples of raw result objects
+    #                          (digest-ready, run order -- exactly
+    #                          what GuardedEpoch.results holds)
+    counts: tuple            # per-epoch decisions committed (int)
+    guard_trips: tuple       # per-epoch rebase+serial fallback count
+    #                          (0 on a clean chunk)
+    stream_fallback: int     # 1 when the chunk tripped a guard and
+    #                          was discarded + re-run on the round
+    #                          path (slower, never divergent)
+    retries: int             # transient device errors retried
+    hists: object = None     # telemetry accumulators after the chunk
+    ledger: object = None
+    flight: object = None
+
+
+def run_stream_chunk_guarded(state, epoch0: int, counts, *,
+                             engine: str, epochs: int, m: int,
+                             k: int = 0, chain_depth: int = 4,
+                             dt_epoch_ns: int, waves: int,
+                             anticipation_ns: int = 0,
+                             allow_limit_break: bool = False,
+                             with_metrics: bool = True,
+                             select_impl: str = "sort",
+                             tag_width: int = 64,
+                             window_m: Optional[int] = None,
+                             calendar_impl: str = "minstop",
+                             ladder_levels: int = 8,
+                             hists=None, ledger=None, flight=None,
+                             retries: int = 3, base_s: float = 0.05,
+                             sleep: Callable[[float], None] =
+                             _time.sleep,
+                             on_retry=None, tracer=None,
+                             overlap: Optional[Callable[[], None]]
+                             = None) -> StreamGuarded:
+    """Run one fused ingest+serve stream chunk (``engine.stream``)
+    under the guarded-commit contract, at STREAM-CHUNK granularity:
+
+    - the single chunk launch retries transient device failures with
+      bounded backoff exactly like the per-epoch launches do;
+    - ``overlap()`` (idempotent; may be None) is invoked after the
+      launch is DISPATCHED and before the host blocks on it -- the
+      double-buffer seam where the caller pre-generates chunk T+1's
+      superwave draws while the device runs chunk T;
+    - a guard trip ANYWHERE in the chunk (tag32 window, order/cost
+      rebase, calendar no-progress) discards the whole chunk and
+      re-runs its epochs one by one on the proven round path
+      (``run_epoch_guarded``) from the retained entry state + entry
+      telemetry -- bit-identical to the round loop by construction,
+      since the round loop IS the fallback.  ``stream_fallback``
+      reports it; the entry state/telemetry are therefore never
+      donated to the chunk launch.
+
+    ``counts`` is ``int32[epochs, N]`` of RAW (unclamped) Poisson
+    draws, or None for a no-ingest stream; the chunk clamps on device
+    with the identical integer math the round loop's host clamp uses.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import stream as stream_mod
+    from ..obs import spans as _spans
+
+    epochs = int(epochs)
+    do_ingest = counts is not None
+    fn = stream_mod.jit_stream_chunk(
+        engine=engine, epochs=epochs, m=m, k=k,
+        chain_depth=chain_depth, dt_epoch_ns=dt_epoch_ns, waves=waves,
+        anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break, with_metrics=with_metrics,
+        select_impl=select_impl, tag_width=tag_width,
+        window_m=window_m, calendar_impl=calendar_impl,
+        ladder_levels=ladder_levels, ingest=do_ingest, donate=False)
+    retry_count = [0]
+
+    def count_retry(attempt, exc):
+        retry_count[0] += 1
+        _spans.instant(tracer, "stream.retry", "retry",
+                       error=type(exc).__name__)
+        if on_retry is not None:
+            on_retry(attempt, exc)
+
+    counts_dev = None if counts is None \
+        else jnp.asarray(counts, dtype=jnp.int32)
+
+    def one():
+        with _spans.span(tracer, "stream.dispatch", "dispatch",
+                         engine=engine, epochs=epochs):
+            out = fn(state, jnp.int64(epoch0), counts_dev,
+                     hists, ledger, flight)
+        if overlap is not None:
+            overlap()     # host pregen rides the device's chunk time
+        with _spans.span(tracer, "stream.device_wait",
+                         "device_compute"):
+            return jax.block_until_ready(out)
+
+    out = retry_with_backoff(one, retries=retries, base_s=base_s,
+                             sleep=sleep, on_retry=count_retry)
+
+    guard_field = stream_mod.STREAM_GUARD_FIELD[engine]
+    guards = np.asarray(jax.device_get(out.outs[guard_field]))
+    if bool(guards.all()):
+        fetched = jax.device_get(out.outs)
+        views = tuple(stream_mod.epoch_view(engine, fetched, i)
+                      for i in range(epochs))
+        return StreamGuarded(
+            state=out.state, epochs=tuple((v,) for v in views),
+            counts=tuple(stream_mod.epoch_decisions(engine, fetched, i)
+                         for i in range(epochs)),
+            guard_trips=(0,) * epochs, stream_fallback=0,
+            retries=retry_count[0], hists=out.hists,
+            ledger=out.ledger, flight=out.flight)
+
+    # a guard tripped somewhere in the chunk: the fused program cannot
+    # run the tag32/serial resumes mid-scan, so the whole chunk is
+    # discarded (its outputs never reach the digest) and its epochs
+    # replay on the round path from the RETAINED entry state -- the
+    # epochs before the trip recompute bit-identically (pure integer
+    # programs), the tripped one resumes exactly as the round loop
+    # would have
+    _spans.instant(tracer, "stream.fallback", "retry", engine=engine,
+                   epochs=epochs)
+    ingest_step = stream_mod.jit_ingest_step(
+        dt_epoch_ns=dt_epoch_ns, waves=waves) if do_ingest else None
+    st = state
+    cur = {"hists": hists, "ledger": ledger, "flight": flight}
+    ep_rows, count_rows, trip_rows = [], [], []
+    for i in range(epochs):
+        t_base = (int(epoch0) + i) * int(dt_epoch_ns)
+        if ingest_step is not None:
+            st = ingest_step(st, counts_dev[i], jnp.int64(t_base))
+        ep = run_epoch_guarded(
+            st, t_base + int(dt_epoch_ns), engine=engine, m=m, k=k,
+            chain_depth=chain_depth, anticipation_ns=anticipation_ns,
+            allow_limit_break=allow_limit_break,
+            with_metrics=with_metrics, select_impl=select_impl,
+            tag_width=tag_width, window_m=window_m,
+            calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+            hists=cur["hists"], ledger=cur["ledger"],
+            flight=cur["flight"], retries=retries, base_s=base_s,
+            sleep=sleep, on_retry=on_retry, tracer=tracer)
+        st = ep.state
+        if cur["hists"] is not None:
+            cur["hists"] = ep.hists
+        if cur["ledger"] is not None:
+            cur["ledger"] = ep.ledger
+        if cur["flight"] is not None:
+            cur["flight"] = ep.flight
+        retry_count[0] += ep.retries
+        ep_rows.append(ep.results)
+        count_rows.append(ep.count)
+        trip_rows.append(ep.rebase_fallbacks + ep.serial_fallbacks)
+    return StreamGuarded(
+        state=st, epochs=tuple(ep_rows), counts=tuple(count_rows),
+        guard_trips=tuple(trip_rows), stream_fallback=1,
+        retries=retry_count[0], hists=cur["hists"],
+        ledger=cur["ledger"], flight=cur["flight"])
 
 
 # ----------------------------------------------------------------------

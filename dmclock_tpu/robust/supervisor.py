@@ -37,6 +37,15 @@ exhausted launch retries step the job down ``bucketed -> minstop``,
 exact path, so a degraded run is slower, never divergent.  Ladder
 position rides in the checkpoint and in obs row
 ``degradation_ladder_steps``.
+
+``EpochJob(engine_loop="stream")`` swaps the per-epoch launch
+structure for the always-on streaming serve loop (``engine.stream``;
+docs/ENGINE.md "engine_loop"): one fused ingest+serve+commit device
+launch per checkpoint-boundary chunk, double-buffered superwave
+pregen, drains only at the boundaries -- decisions digest-pinned
+bit-identical to the round loop, and every invariant above (crash
+equivalence, telemetry, the ladder) carries over unchanged
+(``_stream_epochs``).
 """
 
 from __future__ import annotations
@@ -116,6 +125,20 @@ class EpochJob:
     # checkpointed state (crash equivalence is about decisions, not
     # about how long the host took)
     span_log: Optional[str] = None
+    # engine loop structure (docs/ENGINE.md "engine_loop"): "round"
+    # launches the admission readback + ingest + epoch separately per
+    # epoch (the PR-5 shape, ~3 tunnel round-trips/epoch); "stream"
+    # fuses ingest+serve+commit for EVERY epoch between two checkpoint
+    # boundaries into ONE device launch (engine.stream), with the
+    # decision stream / metrics / telemetry accumulating in HBM, the
+    # host pre-generating chunk T+1's superwave draws while the device
+    # runs chunk T (double buffer), and drains only at the PR-5
+    # checkpoint boundaries.  Decisions are digest-pinned
+    # bit-identical to "round" (ci.sh streaming smoke); a guard trip
+    # inside a chunk falls back to the round path for that chunk
+    # (robust.guarded.run_stream_chunk_guarded), so crash equivalence
+    # and the degradation ladder survive unchanged.
+    engine_loop: str = "round"
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -149,6 +172,10 @@ class SupervisedResult(NamedTuple):
     ledger: Optional[np.ndarray] = None     # [N, LED_COLS]
     flight_buf: Optional[np.ndarray] = None  # [R, FLIGHT_COLS]
     flight_seq: int = 0                      # records ever written
+    # stream chunks that tripped a guard and re-ran on the round path
+    # (engine_loop="stream" only; deterministic, so it replays to the
+    # same value across a crash+resume)
+    stream_fallbacks: int = 0
 
 
 def assert_crash_equivalent(interrupted: SupervisedResult,
@@ -291,13 +318,20 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
     # with that accumulator off) so the restore template's structure
     # depends only on the job config, never on runtime state
     z = np.zeros((0,), dtype=np.int64)
+    # rng may be the live Generator (round loop) or a state array
+    # snapshot (stream loop: the double buffer draws chunk T+1 BEFORE
+    # boundary T's save, so the live generator is ahead of the
+    # boundary -- the snapshot taken after chunk T's own draws is what
+    # must persist, or a resume would re-draw a different stream)
+    rng_arr = np.asarray(rng, dtype=np.uint64) \
+        if isinstance(rng, np.ndarray) else _rng_state_array(rng)
     return {"digest": np.frombuffer(digest, dtype=np.uint8).copy(),
             "decisions": np.int64(decisions),
             "engine": state,
             "epoch": np.int64(epoch),
             "ladder": np.asarray(ladder_vec, dtype=np.int64),
             "metrics": np.asarray(met, dtype=np.int64),
-            "rng": _rng_state_array(rng),
+            "rng": rng_arr,
             "tele_hists": z if hists is None
             else np.asarray(jax.device_get(hists), dtype=np.int64),
             "tele_ledger": z if ledger is None
@@ -335,6 +369,57 @@ def _payload_like(job: EpochJob) -> dict:
                     b"\x00" * 32, 0, 0,
                     DegradationLadder().encode(),
                     hists=hists, ledger=ledger, flight=flight)
+
+
+class _ScrapeCtl:
+    """Scrape-endpoint lifecycle shared by the round and the stream
+    loop: (re)bind at the loop's natural host points (every epoch for
+    the round loop, every drained epoch for the stream loop), pin
+    ephemeral ports, poll ``/healthz`` after a rebind, and honor the
+    injector's port-loss points.  Host telemetry only -- deliberately
+    outside the checkpointed state."""
+
+    def __init__(self, port, start_epoch: int):
+        self.port = port
+        self.start_epoch = start_epoch
+        self.scrape = None
+        self.rebinds = 0
+
+    def tick(self, epoch: int, injector) -> None:
+        from ..obs.registry import start_http_server
+
+        if self.port is not None and self.scrape is None:
+            self.scrape = start_http_server(port=self.port)
+            if self.scrape is not None:
+                self.port = self.scrape.port   # pin ephemeral binds
+                if epoch > self.start_epoch:
+                    self.rebinds += 1
+                    # a rebind is only a recovery if the new endpoint
+                    # actually serves: poll /healthz (best-effort --
+                    # telemetry must never kill the run it observes)
+                    if not _healthz_ok(self.scrape):
+                        print("# supervisor: scrape rebind on "
+                              f"port {self.scrape.port} failed its "
+                              "healthz probe", file=sys.stderr)
+        if injector is not None and injector.drop_scrape(epoch) \
+                and self.scrape is not None:
+            self.scrape.close()      # the plan yanks the port; the
+            self.scrape = None       # loop rebinds next tick
+
+    def close(self) -> None:
+        if self.scrape is not None:
+            self.scrape.close()
+            self.scrape = None
+
+
+def _draw_counts(rng: np.random.Generator, job: EpochJob,
+                 epochs: int) -> np.ndarray:
+    """RAW per-epoch Poisson draws ``int32[epochs, N]`` in epoch order
+    -- the identical ``rng.poisson(lam, n)`` consumption sequence the
+    round loop makes, so pre-generating a chunk ahead (the double
+    buffer) advances the generator exactly as per-epoch draws would."""
+    return np.stack([rng.poisson(job.arrival_lam, job.n)
+                     .astype(np.int32) for _ in range(epochs)])
 
 
 _INGEST_JIT_CACHE: dict = {}
@@ -376,7 +461,6 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
 
     from ..obs import device as obsdev
     from ..obs import spans as _spans
-    from ..obs.registry import start_http_server
 
     from ..obs import flight as obsflight
 
@@ -439,12 +523,18 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 payload["tele_flight_seq"],
                 payload["tele_flight_batch"])
 
-    scrape = None
-    scrape_port = job.metrics_port
-    scrape_rebinds = 0
+    scr = _ScrapeCtl(job.metrics_port, start_epoch)
     base_cfg = {"select_impl": job.select_impl,
                 "tag_width": job.tag_width,
                 "calendar_impl": job.calendar_impl}
+    stream_fallbacks = 0
+
+    if job.engine_loop == "stream":
+        return _stream_epochs(job, injector, ckpt_dir, scr,
+                              base_cfg, state, rng, met, digest,
+                              start_epoch, decisions, ladder, tracer,
+                              hists, ledger, flight, resumed_from)
+    assert job.engine_loop == "round", job.engine_loop
     ingest = _jit_ingest(job) if job.arrival_lam > 0 else None
 
     try:
@@ -457,24 +547,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             _ep_span = _spans.span(tracer, "supervisor.epoch",
                                    "host_prep", epoch=epoch)
             _ep_span.__enter__()
-            if scrape_port is not None and scrape is None:
-                scrape = start_http_server(port=scrape_port)
-                if scrape is not None:
-                    scrape_port = scrape.port   # pin ephemeral binds
-                    if epoch > start_epoch:
-                        scrape_rebinds += 1
-                        # a rebind is only a recovery if the new
-                        # endpoint actually serves: poll /healthz
-                        # (best-effort -- telemetry must never kill
-                        # the run it observes)
-                        if not _healthz_ok(scrape):
-                            print("# supervisor: scrape rebind on "
-                                  f"port {scrape.port} failed its "
-                                  "healthz probe", file=sys.stderr)
-            if injector is not None and injector.drop_scrape(epoch) \
-                    and scrape is not None:
-                scrape.close()      # the plan yanks the port; the
-                scrape = None       # loop rebinds next boundary
+            scr.tick(epoch, injector)
 
             t_base = jnp.int64(epoch * job.dt_epoch_ns)
             if ingest is not None:
@@ -593,12 +666,21 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
         # contract documents.
         raise
     finally:
-        if scrape is not None:
-            scrape.close()
+        scr.close()
 
     if tracer is not None:   # e.g. a resume landing past the last
         tracer.drain_jsonl(job.span_log)  # epoch records only the
     #                                       resume span
+    return _build_result(job, state, digest, decisions, met, ladder,
+                         scr.rebinds, resumed_from, hists, ledger,
+                         flight, stream_fallbacks)
+
+
+def _build_result(job, state, digest, decisions, met, ladder,
+                  scrape_rebinds, resumed_from, hists, ledger, flight,
+                  stream_fallbacks: int) -> SupervisedResult:
+    import jax
+
     return SupervisedResult(
         digest=hashlib.sha256(digest).hexdigest(),
         state_digest=_tree_digest(state),
@@ -613,7 +695,184 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
         else np.asarray(jax.device_get(ledger), dtype=np.int64),
         flight_buf=None if flight is None
         else np.asarray(jax.device_get(flight.buf), dtype=np.int64),
-        flight_seq=0 if flight is None else int(flight.seq))
+        flight_seq=0 if flight is None else int(flight.seq),
+        stream_fallbacks=stream_fallbacks)
+
+
+def _stream_epochs(job: EpochJob, injector, ckpt_dir,
+                   scr: _ScrapeCtl, base_cfg: dict, state, rng, met,
+                   digest: bytes, start_epoch: int, decisions: int,
+                   ladder, tracer, hists, ledger, flight,
+                   resumed_from) -> SupervisedResult:
+    """The always-on streaming serve loop (docs/ENGINE.md
+    "engine_loop"): one fused device launch per stream chunk (= the
+    epochs between two PR-5 checkpoint boundaries), with the host
+    pre-generating chunk T+1's superwave draws while the device runs
+    chunk T and draining the HBM-accumulated decision stream /
+    metrics / telemetry only at the boundary.
+
+    Crash-equivalence discipline: the RNG state that rides each
+    boundary's checkpoint is the snapshot taken right after THAT
+    chunk's draws -- the double buffer's lookahead draws stay out of
+    the persisted state, so a resumed incarnation re-draws them
+    bit-identically.  The per-epoch drain bookkeeping (chain digest,
+    metric fold, ladder notes, injector kill points) is the round
+    loop's, run over the drained per-epoch rows in epoch order."""
+    import jax
+
+    from ..engine import stream as stream_mod
+    from ..obs import device as obsdev
+    from ..obs import flight as obsflight
+    from ..obs import spans as _spans
+    from .guarded import run_stream_chunk_guarded
+
+    stream_fallbacks = 0
+    do_ingest = job.arrival_lam > 0
+    try:
+        counts = None
+        rng_ckpt = _rng_state_array(rng)
+        if do_ingest and start_epoch < job.epochs:
+            with _spans.span(tracer, "stream.pregen", "host_prep"):
+                e1 = next(stream_mod.chunk_bounds(
+                    start_epoch, job.epochs, job.ckpt_every))[1]
+                counts = _draw_counts(rng, job, e1 - start_epoch)
+            rng_ckpt = _rng_state_array(rng)
+        for e0, b in stream_mod.chunk_bounds(start_epoch, job.epochs,
+                                             job.ckpt_every):
+            # bind/maintain the scrape endpoint BEFORE the fused
+            # launch: the round loop serves /metrics from epoch 0, and
+            # a first chunk can run for seconds -- the drain-time
+            # per-epoch ticks below only honor the plan's port-loss
+            # points (drop_scrape fires exactly once, so this pre-tick
+            # cannot double-fire them)
+            scr.tick(e0, injector)
+            # the double buffer: chunk T+1's draws happen between the
+            # chunk launch's dispatch and its device wait (the overlap
+            # seam run_stream_chunk_guarded exposes).  Idempotent: a
+            # retried launch must not re-advance the generator.
+            nxt: dict = {}
+
+            def overlap(b=b):
+                if "rng" in nxt:
+                    return
+                if do_ingest and b < job.epochs:
+                    with _spans.span(tracer, "stream.pregen",
+                                     "host_prep"):
+                        b1 = next(stream_mod.chunk_bounds(
+                            b, job.epochs, job.ckpt_every))[1]
+                        nxt["counts"] = _draw_counts(rng, job, b1 - b)
+                nxt["rng"] = _rng_state_array(rng)
+
+            while True:
+                cfg = ladder.apply(base_cfg)
+                try:
+                    g = run_stream_chunk_guarded(
+                        state, e0, counts, engine=job.engine,
+                        epochs=b - e0, m=job.m, k=job.k,
+                        chain_depth=job.chain_depth,
+                        dt_epoch_ns=job.dt_epoch_ns, waves=job.waves,
+                        with_metrics=True,
+                        select_impl=cfg["select_impl"],
+                        tag_width=cfg["tag_width"],
+                        calendar_impl=cfg["calendar_impl"],
+                        ladder_levels=job.ladder_levels,
+                        hists=hists, ledger=ledger, flight=flight,
+                        tracer=tracer, overlap=overlap)
+                    break
+                except RECOVERABLE_ERRORS:
+                    # retries exhausted at stream-chunk granularity:
+                    # the same ladder escalation as the round loop,
+                    # re-attempting the chunk on the stepped-down
+                    # config (overlap is idempotent, so the retry
+                    # cannot re-advance the RNG)
+                    if not ladder.can_step(cfg):
+                        raise
+                    met[obsdev.MET_LADDER_STEPS] += \
+                        ladder.note_epoch(cfg, launch_failures=1)
+            if "rng" not in nxt:
+                overlap()     # e.g. every dispatch attempt failed
+                #               fast; draw synchronously
+            state = g.state
+            if job.with_hists:
+                hists = g.hists
+            if job.with_ledger:
+                ledger = g.ledger
+            if job.flight_records:
+                flight = g.flight
+            stream_fallbacks += g.stream_fallback
+            # the drain: per-epoch bookkeeping in epoch order, exactly
+            # the round loop's sequence (digest -> metric fold ->
+            # ladder note -> injector kill points), over the rows the
+            # chunk accumulated in HBM
+            with _spans.span(tracer, "stream.drain", "drain",
+                             chunk=b - e0):
+                for i in range(b - e0):
+                    epoch = e0 + i
+                    scr.tick(epoch, injector)
+                    decisions += g.counts[i]
+                    digest = _digest_update(digest, g.epochs[i])
+                    for r in g.epochs[i]:
+                        if hasattr(r, "metrics") and \
+                                r.metrics is not None:
+                            met = obsdev.metrics_combine_np(
+                                met, jax.device_get(r.metrics))
+                    met[obsdev.MET_LADDER_STEPS] += ladder.note_epoch(
+                        cfg, guard_trips=g.guard_trips[i])
+                    if injector is not None:
+                        injector.after_decisions(decisions)
+            # the stream heartbeat: a drain-point instant the watchdog
+            # reads as launch-cadence liveness (a fused chunk
+            # legitimately runs for seconds with no dispatch span
+            # completing -- docs/OBSERVABILITY.md)
+            _spans.instant(tracer, "stream.heartbeat", "drain",
+                           epoch=b)
+            if ckpt_dir is not None:
+                # b is a checkpoint boundary by construction
+                # (chunk_bounds); the persisted RNG state is rng_ckpt
+                # -- the snapshot covering draws for epochs < b only
+                with _spans.span(tracer, "supervisor.checkpoint_save",
+                                 "checkpoint", epoch=b):
+                    payload = _payload(job, state, rng_ckpt, met,
+                                       digest, b, decisions,
+                                       ladder.encode(), hists=hists,
+                                       ledger=ledger, flight=flight)
+
+                    def save(payload=payload):
+                        return ckpt_mod.save_pytree_rotating(
+                            ckpt_dir, payload, keep=job.keep)
+
+                    if injector is not None:
+                        injector.around_save(b - 1, save)
+                    else:
+                        save()
+                if tracer is not None:
+                    tracer.drain_jsonl(job.span_log)
+            elif tracer is not None:
+                # bare/unsupervised runner: nothing ever replays,
+                # per-chunk flushes are safe
+                tracer.drain_jsonl(job.span_log)
+            counts = nxt.get("counts")
+            rng_ckpt = nxt["rng"]
+    except BaseException:
+        # the crash hook, as in the round loop: best-effort flight
+        # dump, NO span flush (un-flushed spans describe epochs a
+        # resume will replay)
+        if job.flight_dump and flight is not None:
+            try:
+                n = obsflight.flight_dump(flight, job.flight_dump)
+                print(f"# supervisor: dumped {n} flight records to "
+                      f"{job.flight_dump}", file=sys.stderr)
+            except Exception:
+                pass
+        raise
+    finally:
+        scr.close()
+
+    if tracer is not None:
+        tracer.drain_jsonl(job.span_log)
+    return _build_result(job, state, digest, decisions, met, ladder,
+                         scr.rebinds, resumed_from, hists, ledger,
+                         flight, stream_fallbacks)
 
 
 def _healthz_ok(scrape, timeout_s: float = 2.0) -> bool:
@@ -753,7 +1012,8 @@ def _spawn_once(job: EpochJob, workdir: str,
         resumed_from=obj.get("resumed_from"),
         hists=arr("hists"), ledger=arr("ledger"),
         flight_buf=arr("flight_buf"),
-        flight_seq=int(obj.get("flight_seq", 0)))
+        flight_seq=int(obj.get("flight_seq", 0)),
+        stream_fallbacks=int(obj.get("stream_fallbacks", 0)))
 
 
 def _child_main(workdir: str) -> int:
@@ -792,7 +1052,8 @@ def _child_main(workdir: str) -> int:
                    "hists": lst(result.hists),
                    "ledger": lst(result.ledger),
                    "flight_buf": lst(result.flight_buf),
-                   "flight_seq": result.flight_seq}, fh)
+                   "flight_seq": result.flight_seq,
+                   "stream_fallbacks": result.stream_fallbacks}, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, res_path)

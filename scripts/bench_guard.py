@@ -178,17 +178,19 @@ def main() -> int:
              if r.get("device") == dev and not is_fallback(r)
              and not is_chaos(r) and not is_restarted(r)
              and not is_degraded(r)]
-    def series(wl, key, impl, cal):
+    def series(wl, key, impl, cal, loop):
         """Prior values of one per-workload scalar column, filtered to
-        the same fast-path identity (select_impl + calendar_impl) the
-        throughput series uses."""
+        the same fast-path identity (select_impl + calendar_impl +
+        engine_loop) the throughput series uses."""
         return [r["workloads"][wl][key] for _, r in prior
                 if wl in r.get("workloads", {})
                 and key in r["workloads"][wl]
                 and r["workloads"][wl].get("select_impl",
                                            "sort") == impl
                 and r["workloads"][wl].get("calendar_impl",
-                                           "minstop") == cal]
+                                           "minstop") == cal
+                and r["workloads"][wl].get("engine_loop",
+                                           "round") == loop]
 
     status = 0
     for wl, row in sorted(newest.get("workloads", {}).items()):
@@ -205,16 +207,19 @@ def main() -> int:
         # without the tag predate the knob == "minstop").
         impl = row.get("select_impl", "sort")
         cal = row.get("calendar_impl", "minstop")
+        # the engine loop splits the series exactly like the fast-path
+        # knobs do: a stream session's rates (one launch per chunk of
+        # rounds) must NEVER be median-compared against round records
+        # -- the workload keys already differ (cfg4 vs cfg4_stream),
+        # and the tag filter makes it robust even if a key collides.
+        # Rows without the tag predate the knob == "round".
+        loop = row.get("engine_loop", "round")
         tag = f"{wl}[{impl}]" if impl != "sort" else wl
         if cal != "minstop":
             tag += f"[{cal}]"
-        hist = [r["workloads"][wl]["dps"] for _, r in prior
-                if wl in r.get("workloads", {})
-                and "dps" in r["workloads"][wl]
-                and r["workloads"][wl].get("select_impl",
-                                           "sort") == impl
-                and r["workloads"][wl].get("calendar_impl",
-                                           "minstop") == cal]
+        if loop != "round" and loop not in wl:
+            tag += f"[{loop}]"
+        hist = series(wl, "dps", impl, cal, loop)
         if len(hist) < args.min_records:
             print(f"bench_guard: {tag}: {dps/1e6:.1f}M "
                   f"({len(hist)} prior record(s) -- not judged)")
@@ -228,12 +233,16 @@ def main() -> int:
         # commit depth the bucketed ladder exists to raise
         bb = row.get("bounded_by")
         dpp = row.get("decisions_per_pass")
+        # decisions-per-LAUNCH is the streaming loop's acceptance
+        # currency (one stream launch covers a whole chunk of rounds)
+        dpl = row.get("decisions_per_launch")
         print(f"bench_guard: {tag}: newest {dps/1e6:.1f}M vs median "
               f"{med/1e6:.1f}M over {len(hist)} sessions "
               f"(floor {floor/1e6:.1f}M at tolerance "
               f"{args.tolerance:g}x) -- {verdict}"
               + (f" [bounded by {bb}]" if bb else "")
-              + (f" [{dpp:.0f} dec/pass]" if dpp else ""))
+              + (f" [{dpp:.0f} dec/pass]" if dpp else "")
+              + (f" [{dpl:.0f} dec/launch]" if dpl else ""))
         if dps < floor:
             status = 1
         # p99 reservation tardiness rides the same per-workload
@@ -245,7 +254,7 @@ def main() -> int:
         # shift with calibration; a hard gate would flap.
         p99 = row.get("tardiness_p99_ns")
         if p99 is not None:
-            t_hist = series(wl, "tardiness_p99_ns", impl, cal)
+            t_hist = series(wl, "tardiness_p99_ns", impl, cal, loop)
             if len(t_hist) < args.min_records:
                 print(f"bench_guard: {tag}: p99 tardiness "
                       f"{p99/1e6:.2f}ms ({len(t_hist)} prior "
@@ -276,7 +285,8 @@ def main() -> int:
         # rates do, and a hard gate would flap.
         disp = row.get("dispatch_ms_per_launch")
         if disp is not None:
-            d_hist = series(wl, "dispatch_ms_per_launch", impl, cal)
+            d_hist = series(wl, "dispatch_ms_per_launch", impl, cal,
+                            loop)
             if len(d_hist) < args.min_records:
                 print(f"bench_guard: {tag}: dispatch "
                       f"{disp:.2f}ms/launch ({len(d_hist)} prior "

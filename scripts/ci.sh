@@ -441,6 +441,65 @@ print(f"crash smoke ok (child SIGKILLed at {kill_at} decisions, "
       "final state + metrics bit-identical modulo resume rows)")
 EOF
 
+echo "== streaming smoke (stream == round decision-digest gate) =="
+# the always-on streaming serve loop (docs/ENGINE.md "engine_loop"):
+# (1) the fused ingest+serve+commit stream chunks must produce the
+# EXACT decision digest, final state, and metric totals of the
+# round-based engine on all three epoch engines x {sort,radix} x
+# {minstop,bucketed}; (2) the zero-host-fault supervised stream gate:
+# a supervisor-wrapped stream run with an empty HostFaultPlan must be
+# bit-identical to the bare stream runner INCLUDING the telemetry
+# plane (histograms + ledger + flight ring).
+timeout -k 30 900 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import dataclasses, tempfile
+import numpy as np
+from dmclock_tpu.robust import host_faults as HF, supervisor as SV
+
+base = dict(n=160, depth=6, ring=12, epochs=4, m=2, seed=9,
+            arrival_lam=1.5, waves=3, ckpt_every=2)
+matrix = {
+    "prefix/sort": SV.EpochJob(engine="prefix", k=16,
+                               select_impl="sort", **base),
+    "prefix/radix": SV.EpochJob(engine="prefix", k=16,
+                                select_impl="radix", **base),
+    "chain/sort": SV.EpochJob(engine="chain", chain_depth=3, k=8,
+                              select_impl="sort", **base),
+    "chain/radix": SV.EpochJob(engine="chain", chain_depth=3, k=8,
+                               select_impl="radix", **base),
+    "calendar/minstop": SV.EpochJob(engine="calendar", k=4,
+                                    calendar_impl="minstop", **base),
+    "calendar/bucketed": SV.EpochJob(engine="calendar", k=4,
+                                     calendar_impl="bucketed",
+                                     ladder_levels=2, **base),
+}
+for name, jr in matrix.items():
+    js = dataclasses.replace(jr, engine_loop="stream")
+    r, s = SV.run_job(jr), SV.run_job(js)
+    assert r.decisions > 0, name
+    assert s.digest == r.digest, \
+        f"{name}: stream digest diverged from round"
+    assert s.state_digest == r.state_digest, name
+    assert np.array_equal(s.metrics, r.metrics), name
+    print(f"{name}: stream == round ({r.decisions} decisions, "
+          f"digest {r.digest[:16]})")
+
+# zero-host-fault supervised stream gate, telemetry included
+job = dataclasses.replace(
+    matrix["calendar/bucketed"], engine_loop="stream",
+    with_hists=True, with_ledger=True, flight_records=16)
+ref = SV.run_job(job)
+with tempfile.TemporaryDirectory() as wd:
+    sup = SV.run_supervised(job, wd, HF.zero_host_plan())
+SV.assert_crash_equivalent(sup, ref)
+assert sup.restarts == 0 and np.array_equal(sup.metrics, ref.metrics)
+print("zero-host-fault supervised stream gate ok (stream-wrapped + "
+      "empty plan == bare stream, bit-identical incl. telemetry)")
+print("streaming smoke ok")
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

@@ -87,21 +87,32 @@ def _ms(ns: float) -> str:
     return f"{ns / 1e6:.2f}"
 
 
-def print_report(path: str, agg, cats, top: int) -> None:
+def print_report(path: str, agg, cats, top: int,
+                 decisions: int = 0) -> None:
+    """``decisions`` > 0 adds the per-decision AMORTIZED column
+    (self_ns / decisions): under the streaming serve loop one launch
+    covers a whole chunk of rounds, so per-LAUNCH dispatch numbers
+    stop being comparable across loop modes -- per-decision cost is
+    the loop-structure-independent currency
+    (docs/OBSERVABILITY.md)."""
     print(f"== span attribution: {path} ==")
+    amort = f" {'ns/dec':>8}" if decisions else ""
     print(f"{'name':<28} {'cat':<14} {'count':>8} {'total ms':>10} "
           f"{'self ms':>10} {'mean us':>9} {'p50 us':>8} {'p90 us':>8} "
-          f"{'p99 us':>8}")
+          f"{'p99 us':>8}" + amort)
     ranked = sorted(agg.items(), key=lambda kv: -kv[1]["self_ns"])
     for (name, cat), a in ranked[:top]:
         durs = a["durs"]
         mean_us = a["total_ns"] / max(a["count"], 1) / 1e3
-        print(f"{name:<28} {cat:<14} {a['count']:>8} "
-              f"{_ms(a['total_ns']):>10} {_ms(a['self_ns']):>10} "
-              f"{mean_us:>9.1f} "
-              f"{_percentile(durs, 0.50) / 1e3:>8.1f} "
-              f"{_percentile(durs, 0.90) / 1e3:>8.1f} "
-              f"{_percentile(durs, 0.99) / 1e3:>8.1f}")
+        row = (f"{name:<28} {cat:<14} {a['count']:>8} "
+               f"{_ms(a['total_ns']):>10} {_ms(a['self_ns']):>10} "
+               f"{mean_us:>9.1f} "
+               f"{_percentile(durs, 0.50) / 1e3:>8.1f} "
+               f"{_percentile(durs, 0.90) / 1e3:>8.1f} "
+               f"{_percentile(durs, 0.99) / 1e3:>8.1f}")
+        if decisions:
+            row += f" {a['self_ns'] / decisions:>8.1f}"
+        print(row)
     if len(ranked) > top:
         print(f"  ... {len(ranked) - top} more rows (--top)")
     print("-- categories (self time) --")
@@ -109,18 +120,31 @@ def print_report(path: str, agg, cats, top: int) -> None:
     for cat in CATEGORIES:
         if cat in cats:
             c = cats[cat]
-            print(f"  {cat:<16} {_ms(c['self_ns']):>10} ms "
-                  f"({100.0 * c['self_ns'] / total:5.1f}%)  "
-                  f"{c['count']} spans")
+            line = (f"  {cat:<16} {_ms(c['self_ns']):>10} ms "
+                    f"({100.0 * c['self_ns'] / total:5.1f}%)  "
+                    f"{c['count']} spans")
+            if decisions:
+                line += f"  {c['self_ns'] / decisions:.1f} ns/dec"
+            print(line)
     ratio = dispatch_ratio(cats)
     label = "inf (no device spans)" if ratio < 0 else f"{ratio:.3f}"
     print(f"dispatch-vs-compute ratio: {label} "
           "(host dispatch self-time / device_compute self-time)")
+    if decisions:
+        disp = cats.get("dispatch", {}).get("self_ns", 0)
+        print(f"dispatch amortized: {disp / decisions:.1f} "
+              f"ns/decision over {decisions} decisions (one launch "
+              "may cover a whole stream chunk; per-decision cost is "
+              "the loop-independent comparison)")
 
 
-def print_diff(path_a: str, path_b: str, agg_a, agg_b, top: int
-               ) -> None:
-    """``path_a`` is the AFTER file, ``path_b`` the baseline."""
+def print_diff(path_a: str, path_b: str, agg_a, agg_b, top: int,
+               decisions: int = 0) -> None:
+    """``path_a`` is the AFTER file, ``path_b`` the baseline.
+    ``decisions`` > 0 adds the per-decision amortized delta column --
+    the round-vs-stream A/B covers the SAME decision count on both
+    sides by construction (stream is digest-pinned to round), so one
+    N amortizes both."""
     print(f"== span diff: {path_a} vs baseline {path_b} ==")
     keys = set(agg_a) | set(agg_b)
     zero = {"count": 0, "total_ns": 0, "self_ns": 0, "durs": []}
@@ -129,18 +153,27 @@ def print_diff(path_a: str, path_b: str, agg_a, agg_b, top: int
         a, b = agg_a.get(k, zero), agg_b.get(k, zero)
         rows.append((k, a["self_ns"] - b["self_ns"], a, b))
     rows.sort(key=lambda r: -abs(r[1]))
+    amort = f" {'d ns/dec':>9}" if decisions else ""
     print(f"{'name':<28} {'cat':<14} {'d count':>8} {'d total ms':>11} "
-          f"{'d self ms':>10} {'d mean us':>10}")
+          f"{'d self ms':>10} {'d mean us':>10}" + amort)
     for (name, cat), dself, a, b in rows[:top]:
         mean_a = a["total_ns"] / max(a["count"], 1) / 1e3
         mean_b = b["total_ns"] / max(b["count"], 1) / 1e3
-        print(f"{name:<28} {cat:<14} {a['count'] - b['count']:>+8} "
-              f"{(a['total_ns'] - b['total_ns']) / 1e6:>+11.2f} "
-              f"{dself / 1e6:>+10.2f} {mean_a - mean_b:>+10.1f}")
+        row = (f"{name:<28} {cat:<14} {a['count'] - b['count']:>+8} "
+               f"{(a['total_ns'] - b['total_ns']) / 1e6:>+11.2f} "
+               f"{dself / 1e6:>+10.2f} {mean_a - mean_b:>+10.1f}")
+        if decisions:
+            row += f" {dself / decisions:>+9.1f}"
+        print(row)
     ca, cb = cat_rollup(agg_a), cat_rollup(agg_b)
     ra, rb = dispatch_ratio(ca), dispatch_ratio(cb)
     fmt = lambda r: "inf" if r < 0 else f"{r:.3f}"  # noqa: E731
     print(f"dispatch-vs-compute ratio: {fmt(rb)} -> {fmt(ra)}")
+    if decisions:
+        da = ca.get("dispatch", {}).get("self_ns", 0) / decisions
+        db = cb.get("dispatch", {}).get("self_ns", 0) / decisions
+        print(f"dispatch amortized: {db:.1f} -> {da:.1f} ns/decision "
+              f"over {decisions} decisions")
 
 
 def main(argv=None) -> int:
@@ -154,6 +187,16 @@ def main(argv=None) -> int:
                     "tool: TRACE is the after side)")
     ap.add_argument("--top", type=int, default=20,
                     help="rows to print (default 20)")
+    ap.add_argument("--decisions", type=int, default=0, metavar="N",
+                    help="decisions the trace covers: adds the "
+                    "per-decision amortized column (self_ns / N) -- "
+                    "the loop-structure-independent cost view when "
+                    "one stream launch covers a whole chunk of "
+                    "rounds (docs/OBSERVABILITY.md).  With --diff, N "
+                    "amortizes BOTH sides, so the two traces must "
+                    "cover the same decision count (true for the "
+                    "digest-pinned round-vs-stream A/B; meaningless "
+                    "for runs of different lengths)")
     args = ap.parse_args(argv)
 
     try:
@@ -165,9 +208,11 @@ def main(argv=None) -> int:
         agg = aggregate(rows)
         if args.diff:
             base = aggregate(load_rows(args.diff))
-            print_diff(args.trace, args.diff, agg, base, args.top)
+            print_diff(args.trace, args.diff, agg, base, args.top,
+                       decisions=max(args.decisions, 0))
         else:
-            print_report(args.trace, agg, cat_rollup(agg), args.top)
+            print_report(args.trace, agg, cat_rollup(agg), args.top,
+                         decisions=max(args.decisions, 0))
         return 0
     except (OSError, ValueError, KeyError) as e:
         print(f"trace_report: {e}", file=sys.stderr)

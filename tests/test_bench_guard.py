@@ -210,3 +210,77 @@ def test_tolerance_flag(monkeypatch, capsys, tmp_path):
     rc2, _ = run_guard(monkeypatch, capsys, hist,
                        argv=("--tolerance", "3.0"))
     assert rc2 == 0              # 15M >= 40M/3
+
+
+def write_history_rows(tmp_path, rows):
+    """History records with caller-supplied workload dicts (engine_loop
+    / select_impl tags included verbatim)."""
+    h = tmp_path / "history"
+    h.mkdir()
+    for i, wl in enumerate(rows):
+        (h / f"bench_{1000 + i}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": "tpu0", "workloads": wl}))
+    return h
+
+
+def test_stream_never_compared_against_round_medians(monkeypatch,
+                                                     capsys,
+                                                     tmp_path):
+    # engine_loop splits the series even under a COLLIDING workload
+    # key: a stream session's rates (one launch per chunk) must never
+    # be judged against round medians -- here the stream newest is 8x
+    # below the round median and must read "not judged", not
+    # REGRESSION
+    hist = write_history_rows(tmp_path, [
+        {"cfg4": {"dps": 40e6}},
+        {"cfg4": {"dps": 44e6, "engine_loop": "round"}},
+        {"cfg4": {"dps": 5e6, "engine_loop": "stream"}},
+    ])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "cfg4[stream]" in out and "not judged" in out
+
+
+def test_stream_series_judged_against_its_own_history(monkeypatch,
+                                                      capsys,
+                                                      tmp_path):
+    # with enough stream records the stream series is a first-class
+    # regression gate of its own
+    hist = write_history_rows(tmp_path, [
+        {"cfg4_stream": {"dps": 80e6, "engine_loop": "stream"}},
+        {"cfg4_stream": {"dps": 90e6, "engine_loop": "stream"}},
+        {"cfg4_stream": {"dps": 10e6, "engine_loop": "stream"}},
+    ])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 1 and "REGRESSION" in out
+    assert "cfg4_stream" in out and "[stream]" not in out  # no double tag
+
+
+def test_round_medians_unpolluted_by_stream_records(monkeypatch,
+                                                    capsys, tmp_path):
+    # two same-key stream records at 25x the round rate would lift a
+    # polluted median past the newest round session's floor; the
+    # engine_loop filter keeps them out, so the round session passes
+    hist = write_history_rows(tmp_path, [
+        {"cfg4": {"dps": 20e6}},
+        {"cfg4": {"dps": 22e6, "engine_loop": "round"}},
+        {"cfg4": {"dps": 500e6, "engine_loop": "stream"}},
+        {"cfg4": {"dps": 500e6, "engine_loop": "stream"}},
+        {"cfg4": {"dps": 12e6, "engine_loop": "round"}},
+    ])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0 and "OK" in out
+
+
+def test_decisions_per_launch_printed(monkeypatch, capsys, tmp_path):
+    hist = write_history_rows(tmp_path, [
+        {"cfg4_stream": {"dps": 80e6, "engine_loop": "stream",
+                         "decisions_per_launch": 4096.0}},
+        {"cfg4_stream": {"dps": 85e6, "engine_loop": "stream",
+                         "decisions_per_launch": 4100.0}},
+        {"cfg4_stream": {"dps": 82e6, "engine_loop": "stream",
+                         "decisions_per_launch": 4098.0}},
+    ])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "dec/launch" in out

@@ -254,6 +254,19 @@ class TestTraceReport:
         assert "round" in out and "dispatch" in out
         # 4x17ms dispatch vs 4x3ms compute
         assert "dispatch-vs-compute ratio: 5.667" in out
+        assert "ns/dec" not in out      # amortized column is opt-in
+
+    def test_report_per_decision_amortized_column(self, tmp_path,
+                                                  capsys):
+        # --decisions N: the loop-structure-independent cost view
+        # when one stream launch covers a whole chunk of rounds
+        path = self._write_trace(tmp_path)
+        assert trace_report.main([path, "--decisions",
+                                  "1000000"]) == 0
+        out = capsys.readouterr().out
+        assert "ns/dec" in out
+        # 4 x 17ms dispatch self over 1M decisions = 68 ns/decision
+        assert "dispatch amortized: 68.0 ns/decision" in out
 
     def test_aggregate_self_time_sweep_on_chrome_rows(self, tmp_path):
         # chrome rows carry no "self": the sweep must subtract
@@ -401,6 +414,105 @@ class TestWatchdog:
                       clock_ns=clock)
         adv(10_000_000_000)
         assert wd.poll_once() == []
+
+    def test_no_stall_while_stream_launch_in_flight(self):
+        # the streaming regression (docs/OBSERVABILITY.md): a fused
+        # stream chunk legitimately runs for SECONDS inside one
+        # launch -- the dispatch span completed long ago, but the
+        # host sits inside an open device_wait span.  The watchdog
+        # must read the open span as a live cadence, not a stall.
+        clock, adv = make_clock()
+        tr = S.SpanTracer(clock_ns=clock)
+        wd = Watchdog(tr, stall_after_s=1.0, log=lambda _s: None,
+                      dispatch_share_warn=2.0, clock_ns=clock)
+        with tr.span("stream.dispatch", "dispatch"):
+            adv(1_000_000)
+        sp = tr.span("stream.device_wait", "device_compute")
+        sp.__enter__()
+        adv(5_000_000_000)              # deep inside the fused chunk
+        assert wd.poll_once() == [], \
+            "launch_stall false-fired on a healthy in-flight chunk"
+        sp.__exit__(None, None, None)
+        # with the launch closed and no heartbeat, real silence still
+        # warns (the fix must not blind the stall check)
+        adv(5_000_000_000)
+        assert [w["kind"] for w in wd.poll_once()] == ["launch_stall"]
+
+    def test_wedged_launch_still_warns(self):
+        # the suppression is BOUNDED: a launch the runtime wedged
+        # INSIDE (an open device_wait older than in_flight_max_s)
+        # must stop suppressing -- the wedged tunnel is the original
+        # failure mode the stall check exists for
+        clock, adv = make_clock()
+        tr = S.SpanTracer(clock_ns=clock)
+        wd = Watchdog(tr, stall_after_s=1.0, in_flight_max_s=8.0,
+                      log=lambda _s: None, dispatch_share_warn=2.0,
+                      clock_ns=clock)
+        with tr.span("stream.dispatch", "dispatch"):
+            adv(1_000_000)
+        sp = tr.span("stream.device_wait", "device_compute")
+        sp.__enter__()
+        adv(5_000_000_000)
+        assert wd.poll_once() == []          # young launch: healthy
+        adv(5_000_000_000)                   # 10s open > 8s threshold
+        assert [w["kind"] for w in wd.poll_once()] == ["launch_stall"]
+        sp.__exit__(None, None, None)
+
+    def test_dead_thread_orphan_spans_pruned(self):
+        # a thread that exits with a span still open must not report
+        # in-flight work forever (it would permanently blind the
+        # stall check); its stack prunes on the next walk and the
+        # loss is counted
+        tr = S.SpanTracer()
+
+        def leaky():
+            tr.span("w", "device_compute").__enter__()   # never exits
+
+        t = threading.Thread(target=leaky)
+        t.start()
+        t.join(5)
+        assert tr.open_categories() == {}
+        assert tr.oldest_open_ns() is None
+        assert tr.spans_leaked >= 1
+
+    def test_no_stall_with_stream_heartbeat(self):
+        # the drain-point heartbeat: the stream loop emits a
+        # drain-category instant at every chunk drain; recent drain
+        # activity proves the serve loop alive between launches
+        clock, adv = make_clock()
+        tr = S.SpanTracer(clock_ns=clock)
+        wd = Watchdog(tr, stall_after_s=1.0, log=lambda _s: None,
+                      dispatch_share_warn=2.0, clock_ns=clock)
+        with tr.span("stream.dispatch", "dispatch"):
+            adv(1_000_000)
+        adv(900_000_000)
+        tr.instant("stream.heartbeat", "drain", epoch=2)
+        adv(900_000_000)                # dispatch silent 1.8s, but the
+        assert wd.poll_once() == []     # heartbeat is 0.9s fresh
+        adv(2_000_000_000)              # heartbeat stale too: stall
+        assert [w["kind"] for w in wd.poll_once()] == ["launch_stall"]
+
+    def test_open_categories_cross_thread(self):
+        tr = S.SpanTracer()
+        assert tr.open_categories() == {}
+        entered = threading.Event()
+        release = threading.Event()
+
+        def worker():
+            with tr.span("w", "device_compute"):
+                entered.set()
+                release.wait(5)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        entered.wait(5)
+        with tr.span("d", "dispatch"):
+            opens = tr.open_categories()
+            assert opens.get("device_compute") == 1
+            assert opens.get("dispatch") == 1
+        release.set()
+        t.join(5)
+        assert tr.open_categories() == {}
 
     def test_thread_lifecycle(self):
         tr = S.SpanTracer()

@@ -1,0 +1,284 @@
+"""Always-on streaming serve loop (engine.stream /
+robust.guarded.run_stream_chunk_guarded / robust.supervisor
+``engine_loop="stream"`` / engine.queue.pull_batch_stream).
+
+The headline gate: the stream loop's decision digest, final state,
+and metric totals are BIT-IDENTICAL to the round-based engine on all
+three epoch engines and every fast-path combination (radix, tag32,
+bucketed) -- with the double-buffered superwave pregen (wave T+1
+drawn while the device runs wave T) producing the exact digest of
+sequential generation, including across a SIGKILL-mid-stream resumed
+supervised run.  Plus: the guard-trip chunk fallback, chunk_bounds
+layout, epoch-view field parity, and the queue's chunked pull."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dmclock_tpu.engine import stream as ST
+from dmclock_tpu.obs import device as obsdev
+from dmclock_tpu.robust import host_faults as HF
+from dmclock_tpu.robust import supervisor as SV
+
+BASE = dict(n=96, depth=6, ring=10, epochs=5, m=2, seed=5,
+            arrival_lam=1.0, waves=2, ckpt_every=2)
+# epochs=5 with ckpt_every=2 gives chunk layout 2+2+1: full chunks AND
+# a remainder chunk both exercised by every test below
+JOBS = {
+    "prefix-sort": SV.EpochJob(engine="prefix", k=16,
+                               select_impl="sort", **BASE),
+    "prefix-radix": SV.EpochJob(engine="prefix", k=16,
+                                select_impl="radix", **BASE),
+    "prefix-tag32": SV.EpochJob(engine="prefix", k=16, tag_width=32,
+                                **BASE),
+    "chain": SV.EpochJob(engine="chain", chain_depth=3, k=8, **BASE),
+    "calendar-minstop": SV.EpochJob(engine="calendar", k=4,
+                                    calendar_impl="minstop", **BASE),
+    "calendar-bucketed": SV.EpochJob(engine="calendar", k=4,
+                                     calendar_impl="bucketed",
+                                     ladder_levels=2, **BASE),
+}
+
+_REFS: dict = {}
+_SREFS: dict = {}
+
+
+def ref_of(name: str) -> SV.SupervisedResult:
+    """Cached round-loop reference (sequential superwave generation,
+    per-epoch launches) per engine/fast-path combination."""
+    if name not in _REFS:
+        _REFS[name] = SV.run_job(JOBS[name])
+    return _REFS[name]
+
+
+def stream_job(name: str, **over) -> SV.EpochJob:
+    return dataclasses.replace(JOBS[name], engine_loop="stream",
+                               **over)
+
+
+def stream_ref_of(name: str) -> SV.SupervisedResult:
+    """Cached bare stream run of the unmodified job -- shared between
+    the digest gate and the crash tests (deterministic, so a cached
+    run IS a fresh run)."""
+    if name not in _SREFS:
+        _SREFS[name] = SV.run_job(stream_job(name))
+    return _SREFS[name]
+
+
+def assert_stream_equals_round(s: SV.SupervisedResult,
+                               r: SV.SupervisedResult) -> None:
+    assert s.digest == r.digest, "decision digest diverged"
+    assert s.state_digest == r.state_digest, "final state diverged"
+    assert s.decisions == r.decisions
+    assert np.array_equal(np.asarray(s.metrics),
+                          np.asarray(r.metrics)), \
+        (s.metrics, r.metrics)
+
+
+class TestStreamDigestGate:
+    @pytest.mark.parametrize("name", sorted(JOBS))
+    def test_stream_bit_identical_to_round(self, name):
+        """The tentpole gate: fused ingest+serve chunks with
+        double-buffered pregen == per-epoch round launches,
+        bit-for-bit, on every engine x fast-path combination."""
+        r = ref_of(name)
+        assert r.decisions > 0
+        s = stream_ref_of(name)
+        assert_stream_equals_round(s, r)
+        # a run whose ROUND reference never tripped a guard must stay
+        # on the fused path end to end; a run that legitimately trips
+        # (this shape's tag32 job resumes on int64 in round mode too)
+        # must fall back -- slower, never divergent, and counted
+        met = np.asarray(r.metrics)
+        round_trips = int(met[obsdev.MET_REBASE_FALLBACKS]) \
+            + int(met[obsdev.MET_GUARD_TRIPS])
+        if round_trips == 0:
+            assert s.stream_fallbacks == 0, \
+                "a clean run must never leave the fused path"
+        else:
+            assert s.stream_fallbacks > 0
+
+    def test_stream_telemetry_bit_identical(self):
+        """Histograms + ledger + flight ring ride the chunk carry and
+        must match the round loop's accumulators exactly."""
+        tele = dict(with_hists=True, with_ledger=True,
+                    flight_records=16)
+        r = SV.run_job(dataclasses.replace(JOBS["calendar-bucketed"],
+                                           **tele))
+        s = SV.run_job(stream_job("calendar-bucketed", **tele))
+        assert_stream_equals_round(s, r)
+        # telemetry compared bit-for-bit by the shared gate
+        SV.assert_crash_equivalent(s, r)
+
+    def test_no_ingest_stream(self):
+        """arrival_lam=0 streams serve-only chunks (the ingest leg is
+        statically absent, not zero-count)."""
+        r = SV.run_job(dataclasses.replace(JOBS["prefix-sort"],
+                                           arrival_lam=0.0))
+        s = SV.run_job(stream_job("prefix-sort", arrival_lam=0.0))
+        assert_stream_equals_round(s, r)
+
+    def test_single_epoch_chunks(self):
+        """ckpt_every=1 degenerates to one-epoch chunks -- still the
+        fused program, still bit-identical."""
+        r = SV.run_job(dataclasses.replace(JOBS["chain"],
+                                           ckpt_every=1))
+        s = SV.run_job(stream_job("chain", ckpt_every=1))
+        assert_stream_equals_round(s, r)
+
+
+class TestChunkBounds:
+    def test_boundary_layout_matches_checkpoint_schedule(self):
+        # saves land at (e+1) % every == 0 or e+1 == epochs; chunks
+        # must end exactly there
+        assert list(ST.chunk_bounds(0, 5, 2)) == [(0, 2), (2, 4),
+                                                  (4, 5)]
+        assert list(ST.chunk_bounds(0, 4, 2)) == [(0, 2), (2, 4)]
+        assert list(ST.chunk_bounds(2, 5, 2)) == [(2, 4), (4, 5)]
+        assert list(ST.chunk_bounds(0, 3, 8)) == [(0, 3)]
+        assert list(ST.chunk_bounds(5, 5, 2)) == []
+
+    def test_resume_start_mid_layout(self):
+        # a resume landing on any snapshot epoch re-enters the same
+        # boundary grid
+        assert list(ST.chunk_bounds(4, 9, 4)) == [(4, 8), (8, 9)]
+
+
+class TestEpochViews:
+    def test_views_are_the_round_result_classes(self):
+        """The digest walks result fields via hasattr: the stream
+        views must BE the epoch-result classes with identically-typed
+        arrays, or the chain digest could silently change shape."""
+        from dmclock_tpu.engine import fastpath
+        from dmclock_tpu.robust.guarded import run_epoch_guarded, \
+            run_stream_chunk_guarded
+
+        job = JOBS["prefix-sort"]
+        state = SV._job_state(job)
+        g = run_stream_chunk_guarded(
+            state, 0, np.zeros((2, job.n), dtype=np.int32),
+            engine="prefix", epochs=2, m=job.m, k=job.k,
+            dt_epoch_ns=job.dt_epoch_ns, waves=job.waves)
+        assert g.stream_fallback == 0
+        (view,) = g.epochs[0]
+        assert isinstance(view, fastpath.PrefixEpoch)
+        ref = run_epoch_guarded(SV._job_state(job), job.dt_epoch_ns,
+                                engine="prefix", m=job.m, k=job.k,
+                                with_metrics=False)
+        (round_ep,) = ref.results
+        for field in ("count", "slot", "phase", "cost", "lb"):
+            a = np.asarray(getattr(view, field))
+            b = np.asarray(getattr(round_ep, field))
+            assert a.dtype == b.dtype, field
+            assert a.shape == b.shape, field
+
+
+class TestStreamFallback:
+    def test_tag32_window_trip_falls_back_bit_identical(self):
+        """tag_spread_ns past 2^31 trips the tag32 rebase window every
+        epoch: the fused chunk cannot run the int64 resume mid-scan,
+        so it must discard and re-run on the round path -- slower,
+        never divergent, and counted."""
+        trip = dict(tag_width=32, tag_spread_ns=1 << 33)
+        r = SV.run_job(dataclasses.replace(JOBS["prefix-sort"],
+                                           **trip))
+        s = SV.run_job(stream_job("prefix-sort", **trip))
+        assert_stream_equals_round(s, r)
+        assert s.stream_fallbacks > 0
+        assert r.stream_fallbacks == 0
+
+
+class TestStreamCrashEquivalence:
+    """SIGKILL mid-stream: the double buffer draws chunk T+1's waves
+    before boundary T's snapshot is written, so the persisted RNG
+    state MUST be the post-chunk-T snapshot, not the live generator --
+    these gates are what pin that discipline."""
+
+    @pytest.mark.parametrize("name", ["prefix-sort", "chain",
+                                      "calendar-bucketed"])
+    def test_sigkill_mid_stream_resumes_bit_identical(self, tmp_path,
+                                                      name):
+        job = stream_job(name)
+        ref = stream_ref_of(name)
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(ref.decisions // 2,))
+        out = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(out, ref)
+        assert out.restarts == 1
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("frac", [4, 3, 1])
+    def test_kill_points_across_the_chunk_grid(self, tmp_path, frac):
+        job = stream_job("prefix-sort", with_hists=True,
+                         with_ledger=True, flight_records=8)
+        ref = SV.run_job(job)
+        kill_at = max(ref.decisions // frac, 1)
+        plan = HF.HostFaultPlan(kill_at_decisions=(kill_at,))
+        out = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(out, ref)
+
+    def test_zero_host_fault_stream_gate(self, tmp_path):
+        """Supervisor-wrapped stream + empty plan == bare stream,
+        bit-identical including the metric vector and telemetry."""
+        job = stream_job("calendar-minstop", with_hists=True,
+                         with_ledger=True, flight_records=8)
+        ref = SV.run_job(job)
+        out = SV.run_supervised(job, tmp_path, HF.zero_host_plan())
+        SV.assert_crash_equivalent(out, ref)
+        assert out.restarts == 0
+        assert np.array_equal(out.metrics, ref.metrics)
+        assert out.metrics[obsdev.MET_LADDER_STEPS] == 0
+        assert out.metrics[obsdev.MET_SUPERVISOR_RESUMES] == 0
+
+    @pytest.mark.slow
+    def test_spawn_sigkill_mid_stream(self, tmp_path):
+        """Spawn mode: a REAL SIGKILL mid-stream in a child
+        interpreter, resumed from the rotation checkpoint."""
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        job = stream_job("prefix-sort")
+        ref = SV.run_job(job)
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(ref.decisions // 2,))
+        out = SV.run_supervised(job, tmp_path, plan, mode="spawn")
+        SV.assert_crash_equivalent(out, ref)
+        assert out.restarts == 1
+
+
+class TestQueueStream:
+    def test_pull_batch_stream_matches_sequential(self):
+        """chunks sequential pull_batch launches == one
+        pull_batch_stream launch, decision for decision."""
+        from dmclock_tpu.core.qos import ClientInfo
+        from dmclock_tpu.core.recs import ReqParams
+        from dmclock_tpu.engine import TpuPullPriorityQueue
+
+        infos = {1: ClientInfo(0, 1, 0), 2: ClientInfo(0, 2, 0),
+                 3: ClientInfo(5, 1, 0)}
+
+        def build():
+            q = TpuPullPriorityQueue(lambda c: infos[c], capacity=8,
+                                     ring_capacity=16)
+            for c in infos:
+                for j in range(6):
+                    q.add_request(("r", c, j), c, ReqParams(1, 1),
+                                  time_ns=1000 + j, cost=1)
+            return q
+
+        t0, dt, chunks, k = 10 ** 9, 10 ** 8, 3, 4
+        qa, qb = build(), build()
+        streamed = qa.pull_batch_stream(t0, dt, chunks, k)
+        sequential = [qb.pull_batch(t0 + c * dt, k)
+                      for c in range(chunks)]
+        assert len(streamed) == chunks
+        for got, want in zip(streamed, sequential):
+            assert [(p.type, p.client, p.request, p.phase, p.cost)
+                    for p in got] == \
+                [(p.type, p.client, p.request, p.phase, p.cost)
+                 for p in want]
+        # the host mirrors must track identically too
+        assert qa.reserv_sched_count == qb.reserv_sched_count
+        assert qa.prop_sched_count == qb.prop_sched_count
+        assert np.array_equal(qa._ledger, qb._ledger)
