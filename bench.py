@@ -1038,6 +1038,188 @@ def bench_frontier(points=((2, 64), (3, 64), (6, 64), (12, 64)), *,
     return None, rows
 
 
+def bench_churn(scenario: str = "flash_crowd", *,
+                total_ids: int = 4096, epochs: int = 64,
+                every: int = 4, engine: str = "prefix", m: int = 4,
+                k: int = 256, ring: int = 32, waves: int = 8,
+                base_lam: float = 2.0, dt_epoch_ns: int = 50_000_000,
+                seed: int = 11, boost_client: int = None,
+                boost_factor: float = 8.0, tracer=None) -> dict:
+    """Open-population churn workload (docs/LIFECYCLE.md): the
+    lifecycle plane drives a ``lifecycle.churn`` scenario -- flash
+    crowds arriving and departing, idle eviction recycling slots,
+    grow-on-demand capacity, periodic compaction -- over a sustained
+    ingest+serve epoch loop, with the admin control API mounted on a
+    live scrape endpoint.
+
+    The control-plane acceptance demo rides in: at the halfway
+    boundary the bench issues a REAL ``PUT /clients/{id}/qos`` over
+    HTTP boosting ``boost_client``'s weight by ``boost_factor``; the
+    per-client conformance table reports delivered throughput shares
+    in the windows before and after, so the live update's effect is
+    visible in the output (weight share up ~boost_factor among its
+    weight class).  Population size is dynamic, so the row records
+    peak/live client counts next to the rate (bench_guard keys the
+    series by scenario + total_ids)."""
+    import urllib.request
+
+    from dmclock_tpu.engine import stream as stream_mod
+    from dmclock_tpu.engine.state import init_state
+    from dmclock_tpu.lifecycle import churn as churn_mod
+    from dmclock_tpu.lifecycle import make_spec
+    from dmclock_tpu.lifecycle.api import mount_admin_api
+    from dmclock_tpu.lifecycle.plane import LifecyclePlane
+    from dmclock_tpu.obs import histograms as obshist
+    from dmclock_tpu.obs.registry import (MetricsHTTPServer,
+                                          MetricsRegistry)
+    from dmclock_tpu.robust.guarded import run_epoch_guarded
+
+    spec = make_spec(scenario, total_ids=total_ids, seed=seed,
+                     base_lam=base_lam, compact_every=2)
+    plane = LifecyclePlane(spec, tracer=tracer)
+    state = init_state(spec["capacity0"], ring)
+    hists = obshist.hist_zero()
+    ledger = obshist.ledger_zero(spec["capacity0"])
+    ingest = stream_mod.jit_ingest_step(dt_epoch_ns=dt_epoch_ns,
+                                        waves=waves)
+    rng = np.random.Generator(np.random.PCG64(seed))
+    boost_at = max((epochs // 2 // every) * every, every)
+
+    def ops_by_cid(led) -> np.ndarray:
+        """Cumulative delivered ops per CLIENT ID (the ledger is
+        per-slot; evicted clients are out of scope for the shares)."""
+        col = np.asarray(jax.device_get(led))[:, obshist.LED_OPS]
+        return plane.slots.scatter_by_cid(col, total_ids)
+
+    # ephemeral control endpoint for the live-PUT demo (fail-soft:
+    # a refused bind downgrades to the in-process handler -- the
+    # workload must not die on a busy box)
+    server = None
+    try:
+        server = MetricsHTTPServer(MetricsRegistry(), port=0)
+    except OSError:
+        pass
+    api = mount_admin_api(server, plane) if server is not None else None
+
+    def live_put(cid: int, r: float, w: float, l: float,
+                 apply_at: int) -> bool:
+        body = json.dumps({"reservation": r, "weight": w, "limit": l,
+                           "apply_at": apply_at}).encode()
+        if server is not None:
+            req = urllib.request.Request(
+                f"http://{server.host}:{server.port}/clients/{cid}/qos",
+                data=body, method="PUT")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 202, resp.status
+            return True
+        plane.accept({"op": "update", "cid": cid, "r": r, "w": w,
+                      "l": l, "apply_at": apply_at})
+        return False
+
+    decisions = 0
+    ops_mid = None
+    boosted = None
+    t0 = time.perf_counter()
+    try:
+        for e in range(epochs):
+            if e % every == 0:
+                if e == boost_at:
+                    if boost_client is None or \
+                            boost_client not in plane.qos:
+                        # lowest LIVE client id: churn scenarios may
+                        # have evicted any fixed pick by now
+                        boost_client = min(plane.slots.slot_of)
+                    r0, w0, l0 = plane.qos[boost_client]
+                    boosted = {"client": boost_client,
+                               "weight_before": w0,
+                               "weight_after": w0 * boost_factor,
+                               "boundary": e,
+                               "http": live_put(
+                                   boost_client, r0,
+                                   w0 * boost_factor, l0, e)}
+                    ops_mid = ops_by_cid(ledger)
+                with obsspans.span(tracer, "lifecycle.boundary",
+                                   "host_prep", epoch=e):
+                    state, ledger = plane.boundary(state, e, every,
+                                                   ledger=ledger)
+            t_base = e * dt_epoch_ns
+            raw = rng.poisson(churn_mod.lam_vector(spec, e)) \
+                .astype(np.int32)
+            with obsspans.span(tracer, "bench.round", "dispatch"):
+                state = ingest(state,
+                               jnp.asarray(plane.map_counts(raw)),
+                               jnp.int64(t_base))
+                ep = run_epoch_guarded(
+                    state, t_base + dt_epoch_ns, engine=engine, m=m,
+                    k=k, with_metrics=True, hists=hists,
+                    ledger=ledger, tracer=tracer)
+            state, hists, ledger = ep.state, ep.hists, ep.ledger
+            decisions += ep.count
+        jax.block_until_ready(state.depth)
+        wall_s = time.perf_counter() - t0
+        ops_end = ops_by_cid(ledger)
+    finally:
+        if server is not None:
+            server.close()
+
+    # conformance: delivered throughput shares in the windows before
+    # and after the live update, within the clients holding work both
+    # windows -- the visible-effect gate for PUT /clients/{id}/qos.
+    # A run too short to reach the boost boundary (epochs <= every)
+    # skips the demo instead of crashing on the never-taken branch.
+    conf = None
+    if boosted is not None:
+        before = ops_mid
+        # clamp: a client evicted after the boost has its cumulative
+        # row folded into the departed report and zeroed, so
+        # end - mid can go negative for it; its after-window share is
+        # simply zero
+        after = np.maximum(ops_end - ops_mid, 0)
+        sb, sa = max(before.sum(), 1), max(after.sum(), 1)
+        bc = boost_client
+        rows = sorted(set(range(min(6, total_ids))) | {bc})
+        conf = [{"client": c,
+                 "weight": plane.qos.get(c, (0.0, 0.0, 0.0))[1],
+                 "ops_before": int(before[c]),
+                 "ops_after": int(after[c]),
+                 "share_before": float(before[c] / sb),
+                 "share_after": float(after[c] / sa)} for c in rows]
+        boosted["share_before"] = float(before[bc] / sb)
+        boosted["share_after"] = float(after[bc] / sa)
+        boosted["share_gain"] = boosted["share_after"] \
+            / max(boosted["share_before"], 1e-12)
+
+    snap = plane.snapshot()
+    h_np = np.asarray(jax.device_get(hists), dtype=np.int64)
+    out = {"dps": decisions / max(wall_s, 1e-9),
+           "decisions": decisions, "wall_s": wall_s,
+           "scenario": scenario, "engine": engine,
+           "total_ids": total_ids, "epochs": epochs,
+           "boundary_every": every,
+           "peak_clients": snap["peak_clients"],
+           "live_clients": snap["live_clients"],
+           "capacity": snap["capacity"],
+           "registrations": snap["registrations"],
+           "evictions": snap["evictions"],
+           "compactions": snap["compactions"],
+           "qos_updates": snap["qos_updates"],
+           "slot_recycles": snap["slot_recycles"],
+           "grows": snap["grows"],
+           "boost": boosted, "conformance": conf}
+    for q, key in ((0.50, "tardiness_p50_ns"),
+                   (0.90, "tardiness_p90_ns"),
+                   (0.99, "tardiness_p99_ns")):
+        out[key] = obshist.hist_percentile(
+            h_np, obshist.HIST_RESV_TARDINESS, q)
+    out["tardiness_mean_ns"] = obshist.hist_mean(
+        h_np, obshist.HIST_RESV_TARDINESS)
+    out["tardiness_max_ns"] = float(obshist.ledger_totals(
+        np.asarray(jax.device_get(ledger),
+                   dtype=np.int64))["tardiness_max_ns"])
+    out["_hist_block"] = h_np.tolist()
+    return out
+
+
 def _with_ladder(ladder, cfg: dict, fn):
     """Run one workload under the degradation ladder
     (robust.guarded.DegradationLadder): a failed run whose config
@@ -1157,8 +1339,22 @@ def main() -> None:
     ap.add_argument("--profile", metavar="DIR", default=None)
     ap.add_argument("--mode",
                     choices=["all", "serve", "cfg3", "cfg4",
-                             "frontier"],
+                             "frontier", "churn"],
                     default="all")
+    ap.add_argument("--churn-scenario",
+                    choices=["flash_crowd", "diurnal", "churn_storm",
+                             "limit_thrash"],
+                    default="flash_crowd",
+                    help="open-population scenario for the churn "
+                    "workload (lifecycle.churn; docs/LIFECYCLE.md): "
+                    "clients register/depart through the lifecycle "
+                    "plane, slots recycle, capacity grows on demand, "
+                    "compaction repacks -- and a live PUT "
+                    "/clients/{id}/qos lands mid-run through the "
+                    "mounted admin API (its delivered-share effect "
+                    "rides the conformance table).  Runs under "
+                    "--mode churn (any backend; scaled shape on cpu) "
+                    "or --mode all (accelerator only)")
     ap.add_argument("--target-latency", type=float, default=0.0,
                     metavar="MS",
                     help="pick the fastest cfg4 operating point whose "
@@ -1438,6 +1634,20 @@ def main() -> None:
                         engine_loop=loop,
                         stream_chunk=args.stream_chunk,
                         telemetry=tele_on, tracer=tracer))
+        if args.mode == "churn" or \
+                (args.mode == "all" and backend != "cpu"):
+            # open-population churn scenario (docs/LIFECYCLE.md).  An
+            # EXPLICIT --mode churn runs a scaled shape on cpu boxes
+            # (the cfg3 convention): the lifecycle mechanics + live
+            # control-plane demo need no accelerator to be meaningful,
+            # and platform=cpu keeps the record out of accelerator
+            # medians
+            churn_shape = dict(total_ids=512, epochs=32, k=64) \
+                if backend == "cpu" \
+                else dict(total_ids=4096, epochs=64, k=256)
+            key = f"churn_{args.churn_scenario}"
+            results[key] = bench_churn(args.churn_scenario,
+                                       tracer=tracer, **churn_shape)
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster
@@ -1560,6 +1770,20 @@ def main() -> None:
             f"{r4.get('round_ms_p50', 0):.0f}ms p99 "
             f"{r4.get('round_ms_p99', 0):.0f}ms tunnel-inclusive "
             f"upper bounds)")
+    for key in sorted(results):
+        if not key.startswith("churn_"):
+            continue
+        r = results[key]
+        b = r.get("boost")
+        put = (f"; live PUT weight "
+               f"x{b['weight_after']/max(b['weight_before'], 1e-9):.0f}"
+               f" -> delivered share x{b['share_gain']:.1f}") \
+            if b else ""
+        parts.append(
+            f"churn[{r['scenario']}] {r['dps']/1e6:.2f}M over an "
+            f"open population (peak {r['peak_clients']} clients, "
+            f"{r['evictions']} evictions, {r['slot_recycles']} "
+            f"recycles, {r['compactions']} compactions{put})")
 
     # device histogram blocks feed the live scrape registry per
     # workload (proper Prometheus _bucket/_sum/_count families), then
@@ -1601,6 +1825,15 @@ def main() -> None:
     c4conf = c4.get("conformance") if c4 else None
     if c4conf:
         final["conformance"] = c4conf
+    # the churn scenario's full block (lifecycle counters, the
+    # per-client before/after shares, the live-PUT effect) rides the
+    # JSON line -- the ISSUE-9 visible-effect acceptance output
+    churn_rows = {wl: {k: v for k, v in row.items()
+                       if k != "_hist_block"}
+                  for wl, row in results.items()
+                  if wl.startswith("churn_")}
+    if churn_rows:
+        final["churn"] = churn_rows
     if wm and "device_metrics" in primary:
         final["device_metrics"] = primary["device_metrics"]
     # per-epoch XLA attribution + what bounded each sustained run ride

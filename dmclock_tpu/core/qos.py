@@ -43,6 +43,32 @@ def _validate_qos(reservation: float, weight: float, limit: float,
             "contract is unsatisfiable")
 
 
+def validate_client_info(info, name: Optional[Any] = None) -> None:
+    """Validate a QoS triple without constructing a :class:`ClientInfo`.
+
+    ``info`` is a ClientInfo, anything with reservation/weight/limit
+    attributes, or a ``(reservation, weight, limit)`` sequence.  The
+    ONE validation path shared by init-time construction and the live
+    lifecycle-update path (``lifecycle.api`` admin rejections carry
+    the same client-naming ValueErrors as init-time ones).  ``name``
+    names the owner in errors; a ClientInfo's own ``client`` is used
+    when ``name`` is not given.  Non-numeric values raise the same
+    ``ValueError`` family (a live API must not 500 on ``"abc"``)."""
+    if isinstance(info, (tuple, list)):
+        r, w, l = info
+    else:
+        r, w, l = info.reservation, info.weight, info.limit
+        if name is None:
+            name = getattr(info, "client", None)
+    try:
+        r, w, l = float(r), float(w), float(l)
+    except (TypeError, ValueError):
+        who = f" for client {name!r}" if name is not None else ""
+        raise ValueError(f"QoS triple must be numeric{who}, got "
+                         f"({r!r}, {w!r}, {l!r})")
+    _validate_qos(r, w, l, name)
+
+
 class ClientInfo:
     """QoS triple: minimum (reservation), proportional (weight), maximum
     (limit) -- with cached ns-per-unit-cost increments.
@@ -65,7 +91,8 @@ class ClientInfo:
         reservation = float(reservation)
         weight = float(weight)
         limit = float(limit)
-        _validate_qos(reservation, weight, limit, self.client)
+        validate_client_info((reservation, weight, limit),
+                             name=self.client)
         self.reservation = reservation
         self.weight = weight
         self.limit = limit

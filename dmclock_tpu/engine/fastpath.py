@@ -2150,6 +2150,20 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
 
 EPOCH_ENGINES = ("prefix", "chain", "calendar")
 
+# Decision-stream field classification for the lifecycle plane's
+# canonical client-id-space digest (lifecycle.plane.canon_results):
+# SLOT fields hold client slot indices (-1 pads) that must translate
+# through the slot map; CAPACITY fields are per-slot arrays over the
+# full [capacity] axis that must scatter to client-id space.  Every
+# other digest field is layout-invariant already -- the engines'
+# selection reductions are permutation-invariant over slots (mins /
+# sums / any) and their sorts tie-break on the per-client creation
+# ``order``, which moves with its row.
+DECISION_SLOT_FIELDS = {"prefix": ("slot",), "chain": ("slot",),
+                        "calendar": ()}
+DECISION_CAPACITY_FIELDS = {"prefix": (), "chain": (),
+                            "calendar": ("served",)}
+
 
 def epoch_scan_fn(engine: str):
     """The epoch-scan callable for ``engine`` (raises KeyError on an

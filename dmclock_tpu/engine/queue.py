@@ -47,7 +47,7 @@ from ..robust.guarded import RECOVERABLE_ERRORS, retry_with_backoff
 from . import kernels
 from .kernels import (OP_ADD, OP_CREATE, OP_NOP, FUTURE, NONE, RETURNING,
                       IngestOps)
-from .state import EngineState, init_state
+from .state import EngineState, grow_state, init_state
 
 ClientInfoFunc = Callable[[Any], Optional[ClientInfo]]
 
@@ -188,10 +188,6 @@ def _shared_jit_ingest_run(steps: int, advance_now: bool, allow: bool,
     return _JIT_CACHE[key]
 
 
-def _grow_rows(arr, new_n, fill):
-    pad = jnp.full((new_n - arr.shape[0],) + arr.shape[1:], fill,
-                   dtype=arr.dtype)
-    return jnp.concatenate([arr, pad], axis=0)
 
 
 class TpuPullPriorityQueue:
@@ -311,6 +307,13 @@ class TpuPullPriorityQueue:
         # launch-failure escalation signal
         self.launch_failures = 0
         self.invalid_cost_rejects = 0
+        # lifecycle accounting (docs/LIFECYCLE.md): erased clients free
+        # their slot for a future tenant; the final conformance-ledger
+        # row is folded into the departed-clients report BEFORE the
+        # recycle zeroes it, so a client's QoS history is never lost
+        # silently
+        self.slot_recycles = 0
+        self._departed: List[Tuple[Any, np.ndarray]] = []
 
         # speculative decision buffer (see _pull_spec)
         self._spec = int(speculative_batch)
@@ -406,34 +409,12 @@ class TpuPullPriorityQueue:
     # ------------------------------------------------------------------
     def _grow_capacity(self) -> None:
         self._settle_spec()
-        st = self.state
-        old_n, new_n = st.capacity, st.capacity * 2
-        self.state = EngineState(
-            active=_grow_rows(st.active, new_n, False),
-            idle=_grow_rows(st.idle, new_n, True),
-            order=_grow_rows(st.order, new_n, 0),
-            resv_inv=_grow_rows(st.resv_inv, new_n, 0),
-            weight_inv=_grow_rows(st.weight_inv, new_n, 0),
-            limit_inv=_grow_rows(st.limit_inv, new_n, 0),
-            prop_delta=_grow_rows(st.prop_delta, new_n, 0),
-            prev_resv=_grow_rows(st.prev_resv, new_n, 0),
-            prev_prop=_grow_rows(st.prev_prop, new_n, 0),
-            prev_limit=_grow_rows(st.prev_limit, new_n, 0),
-            prev_arrival=_grow_rows(st.prev_arrival, new_n, 0),
-            cur_rho=_grow_rows(st.cur_rho, new_n, 1),
-            cur_delta=_grow_rows(st.cur_delta, new_n, 1),
-            head_resv=_grow_rows(st.head_resv, new_n, 0),
-            head_prop=_grow_rows(st.head_prop, new_n, 0),
-            head_limit=_grow_rows(st.head_limit, new_n, 0),
-            head_arrival=_grow_rows(st.head_arrival, new_n, 0),
-            head_cost=_grow_rows(st.head_cost, new_n, 1),
-            head_rho=_grow_rows(st.head_rho, new_n, 0),
-            head_ready=_grow_rows(st.head_ready, new_n, False),
-            depth=_grow_rows(st.depth, new_n, 0),
-            q_head=_grow_rows(st.q_head, new_n, 0),
-            q_arrival=_grow_rows(st.q_arrival, new_n, 0),
-            q_cost=_grow_rows(st.q_cost, new_n, 0),
-        )
+        old_n = self.state.capacity
+        new_n = old_n * 2
+        # the exact pytree migration lives next to init_state
+        # (state.grow_state): new slots are byte-identical to
+        # freshly-initialized ones
+        self.state = grow_state(self.state, new_n)
         self._ledger = np.vstack(
             [self._ledger,
              np.zeros((new_n - old_n, 5), dtype=np.int64)])
@@ -875,6 +856,10 @@ class TpuPullPriorityQueue:
              "invalid_cost_rejects",
              "adds rejected for a non-positive cost (EINVAL, "
              "nothing committed)"),
+            ("dmclock_slot_recycles_total", "slot_recycles",
+             "client slots erased and freed for a future tenant "
+             "(do_clean erase; the final ledger row folds into the "
+             "departed-clients report before it is zeroed)"),
         )
         for name, attr, help_text in rows:
             registry.gauge(name, help_text, labels=labels).set_function(
@@ -900,6 +885,20 @@ class TpuPullPriorityQueue:
         report mutually inconsistent column totals mid-serve."""
         with self.data_mtx:
             return int(self._ledger[:, col].sum())
+
+    def departed_report(self, drain: bool = True
+                        ) -> List[Tuple[Any, np.ndarray]]:
+        """The departed-clients report: ``(client id, int64[5] final
+        ledger row)`` for every client erased since the last drain, in
+        eviction order (LED_* column layout, ``obs.histograms``).
+        ``drain=False`` peeks without clearing.  This is where the
+        conformance history of a recycled slot goes instead of being
+        zeroed silently (docs/LIFECYCLE.md)."""
+        with self.data_mtx:
+            out = list(self._departed)
+            if drain:
+                self._departed.clear()
+            return out
 
     def ledger_rows(self) -> Dict[Any, np.ndarray]:
         """Per-client conformance-ledger rows (client id -> int64[5]
@@ -1129,7 +1128,14 @@ class TpuPullPriorityQueue:
                     self._host_idle.discard(slot)
                     # recycled slots start with a fresh ledger row --
                     # a new tenant must not inherit the old one's
-                    # conformance history
+                    # conformance history.  The evicted client's FINAL
+                    # row folds into the departed-clients report
+                    # before the zero (drained via departed_report),
+                    # and the recycle is counted -- a silently zeroed
+                    # row would erase QoS history with no trace
+                    self.slot_recycles += 1
+                    self._departed.append((client,
+                                           self._ledger[slot].copy()))
                     self._ledger[slot] = 0
                     self._free.append(slot)
             if len(erase_slots) < self.erase_max:

@@ -86,6 +86,53 @@ TAG_I64_FIELDS = (
 )
 
 
+# Per-field fill values for slots that do not hold a client yet: the
+# exact values ``init_state`` writes.  Growth and slot recycling both
+# depend on a fresh slot being INDISTINGUISHABLE from an init-time one
+# (the lifecycle plane's dynamic-vs-static digest gate pins this), so
+# the fills live here, next to init_state, instead of being re-listed
+# by every grower.
+_FRESH_FILLS = {
+    "active": False, "idle": True, "order": 0,
+    "resv_inv": 0, "weight_inv": 0, "limit_inv": 0,
+    "prop_delta": 0,
+    "prev_resv": 0, "prev_prop": 0, "prev_limit": 0, "prev_arrival": 0,
+    "cur_rho": 1, "cur_delta": 1,
+    "head_resv": 0, "head_prop": 0, "head_limit": 0, "head_arrival": 0,
+    "head_cost": 1, "head_rho": 0, "head_ready": False,
+    "depth": 0, "q_head": 0, "q_arrival": 0, "q_cost": 0,
+}
+
+
+def grow_state(state: EngineState, new_capacity: int) -> EngineState:
+    """Exact pytree migration to a larger slot capacity: every [N,...]
+    leaf is extended along axis 0 with the ``init_state`` fill for its
+    field, so slots ``old_n..new_n-1`` are byte-identical to
+    freshly-initialized ones and existing slots are untouched.  The
+    grow-on-demand half of the lifecycle plane's geometric doubling
+    (docs/LIFECYCLE.md); ``TpuPullPriorityQueue`` uses the same
+    migration for its capacity doubling."""
+    import jax.numpy as _jnp
+
+    old_n = state.capacity
+    if new_capacity < old_n:
+        # ValueError, not assert: a stripped check would hand
+        # jnp.full a negative pad length deep inside the migration
+        raise ValueError(
+            f"grow_state cannot shrink: {new_capacity} < {old_n}")
+    if new_capacity == old_n:
+        return state
+
+    def pad(arr, fill):
+        ext = _jnp.full((new_capacity - old_n,) + arr.shape[1:], fill,
+                        dtype=arr.dtype)
+        return _jnp.concatenate([arr, ext], axis=0)
+
+    return EngineState(**{
+        f: pad(getattr(state, f), _FRESH_FILLS[f])
+        for f in EngineState._fields})
+
+
 def init_state(capacity: int, ring_capacity: int = 64) -> EngineState:
     """Fresh state: every slot free."""
     n = capacity

@@ -1,0 +1,78 @@
+"""Serial-engine churn runner: the lifecycle digest gate's oracle leg.
+
+The supervisor (``robust.supervisor.EpochJob(churn=spec)``) runs churn
+specs on the prefix/chain/calendar epoch engines, round and stream
+loops.  This module runs the SAME spec on the serial reference engine
+(``kernels.engine_run`` -- the oracle every epoch engine is pinned
+against), with the same boundary grid, the same RNG consumption, and
+the same canonical client-id-space digest, so the dynamic-vs-static
+gate covers serial too (ISSUE 9 acceptance: serial/prefix/chain/
+calendar x round/stream)."""
+
+from __future__ import annotations
+
+import hashlib
+from types import SimpleNamespace
+
+import numpy as np
+
+from ..engine import kernels
+from ..engine.state import init_state
+from ..engine.stream import jit_ingest_step
+from . import churn as churn_mod
+from .plane import LifecyclePlane
+
+_RUN_JIT: dict = {}
+
+
+def _jit_run(steps: int):
+    if steps not in _RUN_JIT:
+        import functools
+
+        import jax
+
+        _RUN_JIT[steps] = jax.jit(functools.partial(
+            kernels.engine_run, steps=steps, allow_limit_break=False,
+            anticipation_ns=0))
+    return _RUN_JIT[steps]
+
+
+def run_serial_churn(spec: dict, *, epochs: int, every: int = 2,
+                     steps: int = 16, ring: int = 16, waves: int = 2,
+                     dt_epoch_ns: int = 10 ** 8, seed: int = 11,
+                     plane: LifecyclePlane = None):
+    """Run ``spec`` for ``epochs`` on the serial engine; boundary grid
+    = every ``every`` epochs (the supervisor's ``ckpt_every`` grid).
+    Returns ``(digest_hex, plane, decisions)`` where the digest is the
+    canonical client-id-space chain digest -- comparable across the
+    dynamic spec and its :func:`~.churn.static_variant`, and across
+    engines only in the sense of the same canonical FORM (each engine
+    keeps its own decision layout).  ``plane`` may be passed in (e.g.
+    pre-loaded with accepted control ops)."""
+    from ..robust.supervisor import _digest_update
+
+    if plane is None:
+        plane = LifecyclePlane(spec)
+    state = init_state(spec["capacity0"], ring)
+    rng = np.random.Generator(np.random.PCG64(seed))
+    ingest = jit_ingest_step(dt_epoch_ns=dt_epoch_ns, waves=waves)
+    run = _jit_run(steps)
+    digest = b"\x00" * 32
+    decisions = 0
+    for e in range(epochs):
+        if e % every == 0:
+            state, _ = plane.boundary(state, e, every)
+        lam = churn_mod.lam_vector(spec, e)
+        raw = rng.poisson(lam).astype(np.int32)
+        t_base = e * dt_epoch_ns
+        state = ingest(state, plane.map_counts(raw), t_base)
+        state, _, decs = run(state, np.int64(t_base + dt_epoch_ns))
+        import jax
+
+        d = jax.device_get(decs)
+        dec = SimpleNamespace(type=d.type, phase=d.phase, cost=d.cost)
+        dec.slot = plane.slots.translate(np.asarray(d.slot))
+        decisions += int((np.asarray(d.type) == kernels.RETURNING)
+                         .sum())
+        digest = _digest_update(digest, (dec,))
+    return hashlib.sha256(digest).hexdigest(), plane, decisions

@@ -1,0 +1,190 @@
+"""Slot management: client id <-> dense slot index over growing HBM arrays.
+
+The epoch engines run dense passes over ``[capacity]`` state arrays, so
+an *open* client population (the reference serves one: clients register,
+idle out, get erased -- ``dmclock_server.h:913-932``, ``:1206-1255``)
+needs three mechanisms the frozen-at-init state lacked:
+
+- **allocation**: a host-side map from client id to slot index, with a
+  lowest-slot-first free list.  Lowest-first is deliberate: the free
+  order is then a pure function of the occupied-slot set, so a resume
+  can rebuild the exact allocator state from the checkpointed
+  ``cid_of_slot`` array alone (docs/LIFECYCLE.md).
+- **growth**: geometric doubling via ``engine.state.grow_state`` -- an
+  exact pytree migration whose new slots are byte-identical to
+  init-time ones, so growing mid-run cannot perturb a decision.
+- **compaction**: churn fragments the live set across the slot space,
+  and every launch pays a dense pass over ALL of it.  A compaction
+  epoch repacks live clients into a dense prefix as ONE device launch
+  (a gather by a host-computed permutation).  Every selection reduction
+  in the engines is permutation-invariant (mins/sums/any; sorts and
+  argmin tie-breaks key on the per-client ``order`` field, which moves
+  with its row), so a compacted run serves the same client-id decision
+  stream as an uncompacted one -- the digest gate in
+  tests/test_lifecycle.py and the ci.sh churn smoke pin it.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class SlotMap:
+    """Host-side client-id <-> slot-index map with slot recycling.
+
+    Client ids are non-negative ints (the lifecycle plane's id space;
+    the pull queue keeps its own hashable-id map).  ``cid_of_slot`` is
+    the canonical state: everything else (the reverse map, the free
+    heap) is derived, which is what makes the map checkpointable as a
+    single int64 array plus three scalars."""
+
+    def __init__(self, capacity: int):
+        self.cid_of_slot = np.full(capacity, -1, dtype=np.int64)
+        self.ever_used = np.zeros(capacity, dtype=bool)
+        self.slot_of: Dict[int, int] = {}
+        self._free: List[int] = list(range(capacity))
+        heapq.heapify(self._free)
+        self.next_order = 0
+
+    @property
+    def capacity(self) -> int:
+        return int(self.cid_of_slot.shape[0])
+
+    @property
+    def live_count(self) -> int:
+        return len(self.slot_of)
+
+    def allocate(self, cid: int) -> int:
+        """Bind ``cid`` to the lowest free slot; returns the slot and
+        the creation order it should carry (via ``take_order``), or -1
+        when full (caller grows and retries).  ``cid`` must not be
+        registered."""
+        cid = int(cid)
+        assert cid >= 0 and cid not in self.slot_of, cid
+        if not self._free:
+            return -1
+        slot = heapq.heappop(self._free)
+        self.cid_of_slot[slot] = cid
+        self.slot_of[cid] = slot
+        return slot
+
+    def take_order(self) -> int:
+        order = self.next_order
+        self.next_order += 1
+        return order
+
+    def was_used(self, slot: int) -> bool:
+        """True when ``slot`` held an earlier tenant (a recycle); marks
+        it used either way."""
+        prior = bool(self.ever_used[slot])
+        self.ever_used[slot] = True
+        return prior
+
+    def release(self, cid: int) -> int:
+        slot = self.slot_of.pop(int(cid))
+        self.cid_of_slot[slot] = -1
+        heapq.heappush(self._free, slot)
+        return slot
+
+    def grow(self, new_capacity: int) -> None:
+        old = self.capacity
+        assert new_capacity > old
+        self.cid_of_slot = np.concatenate(
+            [self.cid_of_slot,
+             np.full(new_capacity - old, -1, dtype=np.int64)])
+        self.ever_used = np.concatenate(
+            [self.ever_used, np.zeros(new_capacity - old, dtype=bool)])
+        for s in range(old, new_capacity):
+            heapq.heappush(self._free, s)
+
+    # -- compaction ----------------------------------------------------
+    def compaction_perm(self) -> Optional[np.ndarray]:
+        """Permutation packing live slots into a dense prefix (stable:
+        live slots keep their relative order), or None when the live
+        set is already dense -- the caller skips the launch."""
+        live = np.flatnonzero(self.cid_of_slot >= 0)
+        if live.size == 0 or int(live[-1]) == live.size - 1:
+            return None
+        free = np.flatnonzero(self.cid_of_slot < 0)
+        return np.concatenate([live, free]).astype(np.int32)
+
+    def apply_perm(self, perm: np.ndarray) -> None:
+        """Re-map after the device state was gathered by ``perm``."""
+        self.cid_of_slot = self.cid_of_slot[perm]
+        self.ever_used = self.ever_used[perm]
+        self.slot_of = {int(c): s
+                        for s, c in enumerate(self.cid_of_slot)
+                        if c >= 0}
+        self._free = [int(s) for s in
+                      np.flatnonzero(self.cid_of_slot < 0)]
+        heapq.heapify(self._free)
+
+    # -- client-id-space views -----------------------------------------
+    def translate(self, slot_arr) -> np.ndarray:
+        """Map an int slot array into client-id space (-1 and other
+        negative pads pass through) -- the canonicalization that makes
+        decision streams comparable across slot layouts (compaction,
+        recycling, growth all shuffle slots but never client ids)."""
+        a = np.asarray(slot_arr)
+        out = np.full(a.shape, -1, dtype=np.int64)
+        valid = (a >= 0) & (a < self.capacity)
+        out[valid] = self.cid_of_slot[a[valid]]
+        return out
+
+    def scatter_by_cid(self, arr, total: int) -> np.ndarray:
+        """Re-index a per-slot array (last axis = capacity) into a
+        per-client-id array of width ``total`` (unregistered ids keep
+        zero) -- the calendar engine's per-client ``served`` counts
+        canonicalize this way."""
+        a = np.asarray(arr)
+        assert a.shape[-1] == self.capacity, (a.shape, self.capacity)
+        out = np.zeros(a.shape[:-1] + (total,), dtype=a.dtype)
+        live = self.cid_of_slot >= 0
+        out[..., self.cid_of_slot[live]] = a[..., live]
+        return out
+
+    # -- checkpoint round-trip -----------------------------------------
+    def encode(self) -> dict:
+        return {"lc_cids": self.cid_of_slot.copy(),
+                "lc_ever": self.ever_used.copy(),
+                "lc_next_order": np.int64(self.next_order)}
+
+    @classmethod
+    def load(cls, payload: dict) -> "SlotMap":
+        cids = np.asarray(payload["lc_cids"], dtype=np.int64)
+        m = cls(int(cids.shape[0]))
+        m.cid_of_slot = cids.copy()
+        m.ever_used = np.asarray(payload["lc_ever"],
+                                 dtype=bool).copy()
+        m.next_order = int(payload["lc_next_order"])
+        m.slot_of = {int(c): s for s, c in enumerate(cids) if c >= 0}
+        m._free = [int(s) for s in np.flatnonzero(cids < 0)]
+        heapq.heapify(m._free)
+        return m
+
+
+# ----------------------------------------------------------------------
+# device-side compaction launch
+# ----------------------------------------------------------------------
+
+_COMPACT_JIT: dict = {}
+
+
+def compact_tree(tree, perm):
+    """Gather every leaf of a pytree of ``[capacity, ...]`` arrays by
+    ``perm`` along axis 0 in ONE jitted launch -- the compaction
+    epoch's device half.  Works for the EngineState and for the
+    per-slot telemetry ledger alike; jax retraces per new
+    shape-structure automatically."""
+    import jax
+    import jax.numpy as jnp
+
+    if "take" not in _COMPACT_JIT:
+        _COMPACT_JIT["take"] = jax.jit(
+            lambda t, p: jax.tree.map(
+                lambda a: jnp.take(a, p, axis=0), t))
+    return _COMPACT_JIT["take"](tree, jnp.asarray(perm,
+                                                  dtype=jnp.int32))

@@ -353,6 +353,14 @@ class MetricsHTTPServer:
       endpoint is actually serving (docs/ROBUSTNESS.md), and a probe
       must not pay for (or fail on) a metrics drain
 
+    ``mount(prefix, handler)`` adds a path-prefixed sub-API under the
+    same endpoint (GET/POST/PUT/DELETE): ``handler(method, path,
+    body_bytes) -> (status, content_type, body_bytes)``.  The
+    lifecycle plane's admin API (``lifecycle.api``, docs/LIFECYCLE.md)
+    mounts ``/clients`` this way, so one port serves scrape + control.
+    Mounted prefixes are consulted before the built-in GET routes; a
+    handler exception answers 500 without killing the server thread.
+
     Drains are read lazily per request (callback gauges, timer merges),
     so serving a scrape costs the hot path nothing.  ``port=0`` binds
     an ephemeral port (read it back from ``.port``); ``close()`` shuts
@@ -365,6 +373,36 @@ class MetricsHTTPServer:
 
         reg = registry if registry is not None else default_registry()
         self.registry = reg
+        # [(prefix, handler)] consulted in mount order; the list object
+        # is closed over by the Handler below, so mounts added after
+        # the server started are live immediately
+        self._mounts: List[Tuple[str, Callable]] = []
+        mounts = self._mounts
+
+        def dispatch_mounted(handler, method: str) -> bool:
+            """Route one request through the mounted sub-APIs; True
+            when a mount claimed the path (response already sent)."""
+            path = handler.path.split("?", 1)[0]
+            for prefix, fn in mounts:
+                if path == prefix or path.startswith(prefix + "/"):
+                    n = int(handler.headers.get("Content-Length", 0)
+                            or 0)
+                    body = handler.rfile.read(n) if n else b""
+                    try:
+                        status, ctype, out = fn(method, path, body)
+                    except Exception as e:   # a control-plane bug must
+                        status, ctype = 500, "application/json"
+                        out = json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}
+                        ).encode()           # not kill the endpoint
+                    handler.send_response(status)
+                    handler.send_header("Content-Type", ctype)
+                    handler.send_header("Content-Length",
+                                        str(len(out)))
+                    handler.end_headers()
+                    handler.wfile.write(out)
+                    return True
+            return False
 
         class ReuseServer(ThreadingHTTPServer):
             # SO_REUSEADDR pinned EXPLICITLY (it is also the stdlib
@@ -379,6 +417,8 @@ class MetricsHTTPServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API)
+                if dispatch_mounted(self, "GET"):
+                    return
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 if path in ("/", "/metrics"):
                     body = reg.prometheus().encode()
@@ -398,6 +438,18 @@ class MetricsHTTPServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_POST(self):  # noqa: N802
+                if not dispatch_mounted(self, "POST"):
+                    self.send_error(404)
+
+            def do_PUT(self):  # noqa: N802
+                if not dispatch_mounted(self, "PUT"):
+                    self.send_error(404)
+
+            def do_DELETE(self):  # noqa: N802
+                if not dispatch_mounted(self, "DELETE"):
+                    self.send_error(404)
+
             def log_message(self, *_args):  # scrapes are not news
                 pass
 
@@ -408,6 +460,19 @@ class MetricsHTTPServer:
             target=self._srv.serve_forever, name="metrics-http",
             daemon=True)
         self._thread.start()
+
+    def mount(self, prefix: str, handler: Callable) -> None:
+        """Mount ``handler(method, path, body) -> (status, ctype,
+        body)`` under ``prefix`` (e.g. ``"/clients"``).  Live
+        immediately; later mounts are consulted after earlier ones."""
+        if not prefix.startswith("/") or prefix.endswith("/"):
+            # ValueError, not assert: under PYTHONOPTIMIZE a stripped
+            # check would accept a prefix the dispatcher can never
+            # match -- an API that looks mounted but 404s everything
+            raise ValueError(
+                f"mount prefix must start with '/' and not end with "
+                f"one, got {prefix!r}")
+        self._mounts.append((prefix, handler))
 
     @property
     def url(self) -> str:

@@ -125,6 +125,24 @@ class EpochJob:
     # checkpointed state (crash equivalence is about decisions, not
     # about how long the host took)
     span_log: Optional[str] = None
+    # client lifecycle plane (docs/LIFECYCLE.md): a churn spec dict
+    # (lifecycle.churn.make_spec) turns the job into an OPEN-population
+    # run -- the engine state starts EMPTY at the spec's capacity0 and
+    # a lifecycle.LifecyclePlane drives registration / live QoS
+    # updates / idle eviction / compaction at the ckpt_every boundary
+    # grid (= the stream loop's chunk grid, so lifecycle ops compose
+    # with the fused chunk by construction).  Arrivals come from the
+    # spec's per-epoch lam vectors, drawn in CLIENT-ID space (identical
+    # RNG consumption in a dynamic run and its static_variant -- the
+    # digest gate's meaningfulness) and mapped onto the current slot
+    # layout at each boundary.  The chain digest hashes the CANONICAL
+    # client-id-space views (plane.canon_results), so registration
+    # timing, slot recycling, growth, and compaction are digest-
+    # neutral; the plane's state (slot map, pending-update journal,
+    # WAL cursor, counters) rides the rotation checkpoints as lc_*
+    # leaves, so churned runs stay crash-equivalent.  None = the
+    # closed-population job the PRs 1-8 gates pin.
+    churn: Optional[dict] = None
     # engine loop structure (docs/ENGINE.md "engine_loop"): "round"
     # launches the admission readback + ingest + epoch separately per
     # epoch (the PR-5 shape, ~3 tunnel round-trips/epoch); "stream"
@@ -176,6 +194,11 @@ class SupervisedResult(NamedTuple):
     # (engine_loop="stream" only; deterministic, so it replays to the
     # same value across a crash+resume)
     stream_fallbacks: int = 0
+    # lifecycle-plane summary (plane.snapshot(): live/peak clients,
+    # capacity, registration/eviction/compaction/qos-update counters)
+    # for churn jobs; None for closed-population jobs.  Deterministic,
+    # so the crash-equivalence gate compares it too.
+    lifecycle: Optional[dict] = None
 
 
 def assert_crash_equivalent(interrupted: SupervisedResult,
@@ -212,6 +235,12 @@ def assert_crash_equivalent(interrupted: SupervisedResult,
             assert np.array_equal(np.asarray(x), np.asarray(y)), \
                 f"telemetry field {field} diverged across the crash"
     assert interrupted.flight_seq == reference.flight_seq
+    # lifecycle state replays deterministically from the checkpointed
+    # slot map + WAL cursor, so the full plane summary (population,
+    # capacity, every counter) must match too
+    assert interrupted.lifecycle == reference.lifecycle, \
+        (f"lifecycle plane diverged across the crash: "
+         f"{interrupted.lifecycle} vs {reference.lifecycle}")
 
 
 # ----------------------------------------------------------------------
@@ -221,12 +250,15 @@ def assert_crash_equivalent(interrupted: SupervisedResult,
 def _job_state(job: EpochJob):
     """Deterministic preloaded engine state (the bench serve-only
     preload shape: staggered proportion tags, ``depth`` queued ops per
-    client)."""
+    client).  A churn job starts EMPTY at the spec's initial capacity
+    instead -- its population arrives through the lifecycle plane."""
     import jax.numpy as jnp
 
     from ..core.timebase import rate_to_inv_ns
     from ..engine import init_state
 
+    if job.churn is not None:
+        return init_state(int(job.churn["capacity0"]), job.ring)
     st = init_state(job.n, job.ring)
     c = np.arange(job.n)
     rinv = np.full(job.n, rate_to_inv_ns(100.0), dtype=np.int64)
@@ -309,9 +341,11 @@ def _tree_digest(tree) -> str:
 
 def _payload(job: EpochJob, state, rng, met, digest: bytes,
              epoch: int, decisions: int, ladder_vec,
-             hists=None, ledger=None, flight=None) -> dict:
+             hists=None, ledger=None, flight=None,
+             plane=None) -> dict:
     import jax
 
+    from ..lifecycle.plane import LifecyclePlane
     from ..obs import flight as obsflight
 
     # telemetry leaves are ALWAYS present (zero-size when the job runs
@@ -325,7 +359,14 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
     # must persist, or a resume would re-draw a different stream)
     rng_arr = np.asarray(rng, dtype=np.uint64) \
         if isinstance(rng, np.ndarray) else _rng_state_array(rng)
-    return {"digest": np.frombuffer(digest, dtype=np.uint8).copy(),
+    # lifecycle leaves are ALWAYS present too (empty for closed-
+    # population jobs) -- same structure-from-config convention; their
+    # capacities vary at runtime, so churn jobs restore with
+    # strict_shapes=False (utils.checkpoint)
+    lc = plane.encode() if plane is not None \
+        else LifecyclePlane.empty_leaves()
+    return {**lc,
+            "digest": np.frombuffer(digest, dtype=np.uint8).copy(),
             "decisions": np.int64(decisions),
             "engine": state,
             "epoch": np.int64(epoch),
@@ -348,18 +389,22 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
 
 
 def _tele_init(job: EpochJob):
-    """Fresh telemetry accumulators per the job's static flags."""
+    """Fresh telemetry accumulators per the job's static flags.  A
+    churn job's per-client ledger is sized to the spec's initial
+    capacity (it grows with the state arrays at boundaries)."""
     from ..obs import flight as obsflight
     from ..obs import histograms as obshist
 
+    n = int(job.churn["capacity0"]) if job.churn is not None else job.n
     hists = obshist.hist_zero() if job.with_hists else None
-    ledger = obshist.ledger_zero(job.n) if job.with_ledger else None
+    ledger = obshist.ledger_zero(n) if job.with_ledger else None
     flight = obsflight.flight_init(job.flight_records) \
         if job.flight_records else None
     return hists, ledger, flight
 
 
 def _payload_like(job: EpochJob) -> dict:
+    from ..lifecycle.plane import LifecyclePlane
     from ..obs import device as obsdev
 
     hists, ledger, flight = _tele_init(job)
@@ -368,7 +413,9 @@ def _payload_like(job: EpochJob) -> dict:
                     np.zeros(obsdev.NUM_METRICS, dtype=np.int64),
                     b"\x00" * 32, 0, 0,
                     DegradationLadder().encode(),
-                    hists=hists, ledger=ledger, flight=flight)
+                    hists=hists, ledger=ledger, flight=flight,
+                    plane=LifecyclePlane(job.churn)
+                    if job.churn is not None else None)
 
 
 class _ScrapeCtl:
@@ -379,11 +426,16 @@ class _ScrapeCtl:
     injector's port-loss points.  Host telemetry only -- deliberately
     outside the checkpointed state."""
 
-    def __init__(self, port, start_epoch: int):
+    def __init__(self, port, start_epoch: int, on_bind=None):
         self.port = port
         self.start_epoch = start_epoch
         self.scrape = None
         self.rebinds = 0
+        # called with the server after EVERY successful (re)bind --
+        # how a churn job's admin control API (lifecycle.api) rides
+        # the endpoint across port-loss faults: mounts are per-server,
+        # so a rebind must re-mount
+        self.on_bind = on_bind
 
     def tick(self, epoch: int, injector) -> None:
         from ..obs.registry import start_http_server
@@ -392,6 +444,8 @@ class _ScrapeCtl:
             self.scrape = start_http_server(port=self.port)
             if self.scrape is not None:
                 self.port = self.scrape.port   # pin ephemeral binds
+                if self.on_bind is not None:
+                    self.on_bind(self.scrape)
                 if epoch > self.start_epoch:
                     self.rebinds += 1
                     # a rebind is only a recovery if the new endpoint
@@ -489,9 +543,14 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
         try:
             with _spans.span(tracer, "supervisor.resume",
                              "checkpoint"):
+                # churn payloads hold grow-on-demand arrays (engine
+                # state, ledger, slot map, journals) whose capacities
+                # the fresh template cannot predict -- dtype+rank
+                # checked, shapes from the file (utils.checkpoint)
                 payload, resumed_from = \
                     ckpt_mod.restore_pytree_rotating(
-                        ckpt_dir, _payload_like(job))
+                        ckpt_dir, _payload_like(job),
+                        strict_shapes=job.churn is None)
         except ckpt_mod.CheckpointCorruptError:
             payload = None
     if payload is not None:
@@ -523,7 +582,29 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 payload["tele_flight_seq"],
                 payload["tele_flight_batch"])
 
-    scr = _ScrapeCtl(job.metrics_port, start_epoch)
+    plane = None
+    if job.churn is not None:
+        from ..lifecycle.plane import LifecyclePlane
+        if payload is not None:
+            plane = LifecyclePlane.load(payload, job.churn,
+                                        workdir=workdir, tracer=tracer)
+        else:
+            plane = LifecyclePlane(job.churn, workdir=workdir,
+                                   tracer=tracer)
+
+    on_bind = None
+    if plane is not None:
+        from ..lifecycle.api import mount_admin_api
+
+        def on_bind(server, _plane=plane):
+            # live control surface: the admin API (POST/PUT/DELETE
+            # /clients...) + lifecycle counters ride the supervised
+            # run's own scrape endpoint, re-mounted on every rebind.
+            # Ops accepted here are WAL-fsynced (the plane has the
+            # workdir), so a SIGKILL between accept and the epoch
+            # boundary still applies them exactly once on resume.
+            mount_admin_api(server, _plane)
+    scr = _ScrapeCtl(job.metrics_port, start_epoch, on_bind)
     base_cfg = {"select_impl": job.select_impl,
                 "tag_width": job.tag_width,
                 "calendar_impl": job.calendar_impl}
@@ -533,9 +614,19 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
         return _stream_epochs(job, injector, ckpt_dir, scr,
                               base_cfg, state, rng, met, digest,
                               start_epoch, decisions, ladder, tracer,
-                              hists, ledger, flight, resumed_from)
+                              hists, ledger, flight, resumed_from,
+                              plane)
     assert job.engine_loop == "round", job.engine_loop
-    ingest = _jit_ingest(job) if job.arrival_lam > 0 else None
+    ingest = _jit_ingest(job) \
+        if job.arrival_lam > 0 and plane is None else None
+    if plane is not None:
+        from ..engine import stream as stream_mod
+        from ..lifecycle import churn as churn_mod
+        # the stream chunk's standalone ingest leg: the admission
+        # clamp runs ON DEVICE with the identical integer math, so a
+        # churn job's round loop is bit-identical to its stream loop
+        churn_ingest = stream_mod.jit_ingest_step(
+            dt_epoch_ns=job.dt_epoch_ns, waves=job.waves)
 
     try:
         for epoch in range(start_epoch, job.epochs):
@@ -549,8 +640,27 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             _ep_span.__enter__()
             scr.tick(epoch, injector)
 
+            # lifecycle boundary: registration / QoS updates / idle
+            # eviction / compaction apply BEFORE the window they
+            # precede, on the ckpt_every grid (= the stream loop's
+            # chunk grid), so a resume replaying this epoch re-applies
+            # the identical ops from the checkpointed plane state
+            if plane is not None and epoch % job.ckpt_every == 0:
+                with _spans.span(tracer, "lifecycle.boundary",
+                                 "host_prep", epoch=epoch):
+                    state, ledger = plane.boundary(
+                        state, epoch, job.ckpt_every, ledger=ledger)
+
             t_base = jnp.int64(epoch * job.dt_epoch_ns)
-            if ingest is not None:
+            if plane is not None:
+                with _spans.span(tracer, "supervisor.ingest",
+                                 "ingest"):
+                    raw = rng.poisson(churn_mod.lam_vector(
+                        job.churn, epoch)).astype(np.int32)
+                    state = churn_ingest(
+                        state, jnp.asarray(plane.map_counts(raw)),
+                        t_base)
+            elif ingest is not None:
                 with _spans.span(tracer, "supervisor.ingest",
                                  "ingest"):
                     headroom = job.ring - np.asarray(
@@ -600,7 +710,12 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             if job.flight_records:
                 flight = ep.flight
             with _spans.span(tracer, "supervisor.digest", "drain"):
-                digest = _digest_update(digest, ep.results)
+                # churn digests hash the CANONICAL client-id-space
+                # views: slot layout (registration timing, recycling,
+                # growth, compaction) must be digest-neutral
+                digest = _digest_update(
+                    digest, plane.canon_results(ep.results)
+                    if plane is not None else ep.results)
                 for r in ep.results:
                     if hasattr(r, "metrics"):
                         met = obsdev.metrics_combine_np(
@@ -620,7 +735,8 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                     payload = _payload(job, state, rng, met, digest,
                                        epoch + 1, decisions,
                                        ladder.encode(), hists=hists,
-                                       ledger=ledger, flight=flight)
+                                       ledger=ledger, flight=flight,
+                                       plane=plane)
 
                     def save(payload=payload):
                         return ckpt_mod.save_pytree_rotating(
@@ -673,15 +789,17 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     #                                       resume span
     return _build_result(job, state, digest, decisions, met, ladder,
                          scr.rebinds, resumed_from, hists, ledger,
-                         flight, stream_fallbacks)
+                         flight, stream_fallbacks, plane)
 
 
 def _build_result(job, state, digest, decisions, met, ladder,
                   scrape_rebinds, resumed_from, hists, ledger, flight,
-                  stream_fallbacks: int) -> SupervisedResult:
+                  stream_fallbacks: int,
+                  plane=None) -> SupervisedResult:
     import jax
 
     return SupervisedResult(
+        lifecycle=plane.snapshot() if plane is not None else None,
         digest=hashlib.sha256(digest).hexdigest(),
         state_digest=_tree_digest(state),
         decisions=decisions, epochs=job.epochs,
@@ -699,11 +817,25 @@ def _build_result(job, state, digest, decisions, met, ladder,
         stream_fallbacks=stream_fallbacks)
 
 
+def _draw_counts_churn(rng: np.random.Generator, spec: dict,
+                       e0: int, e1: int) -> np.ndarray:
+    """RAW per-epoch Poisson draws for a churn spec,
+    ``int32[e1 - e0, total_ids]`` in CLIENT-ID space and epoch order
+    -- the identical consumption sequence in a dynamic run, its
+    static variant, and both engine loops (the draw stays in id
+    space; the slot mapping happens at the boundary, after the plane
+    has applied it)."""
+    from ..lifecycle import churn as churn_mod
+
+    return np.stack([rng.poisson(churn_mod.lam_vector(spec, e))
+                     .astype(np.int32) for e in range(e0, e1)])
+
+
 def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                    scr: _ScrapeCtl, base_cfg: dict, state, rng, met,
                    digest: bytes, start_epoch: int, decisions: int,
                    ladder, tracer, hists, ledger, flight,
-                   resumed_from) -> SupervisedResult:
+                   resumed_from, plane=None) -> SupervisedResult:
     """The always-on streaming serve loop (docs/ENGINE.md
     "engine_loop"): one fused device launch per stream chunk (= the
     epochs between two PR-5 checkpoint boundaries), with the host
@@ -727,7 +859,7 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
     from .guarded import run_stream_chunk_guarded
 
     stream_fallbacks = 0
-    do_ingest = job.arrival_lam > 0
+    do_ingest = job.arrival_lam > 0 or plane is not None
     try:
         counts = None
         rng_ckpt = _rng_state_array(rng)
@@ -735,7 +867,10 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
             with _spans.span(tracer, "stream.pregen", "host_prep"):
                 e1 = next(stream_mod.chunk_bounds(
                     start_epoch, job.epochs, job.ckpt_every))[1]
-                counts = _draw_counts(rng, job, e1 - start_epoch)
+                counts = _draw_counts_churn(
+                    rng, job.churn, start_epoch, e1) \
+                    if plane is not None \
+                    else _draw_counts(rng, job, e1 - start_epoch)
             rng_ckpt = _rng_state_array(rng)
         for e0, b in stream_mod.chunk_bounds(start_epoch, job.epochs,
                                              job.ckpt_every):
@@ -746,6 +881,21 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
             # points (drop_scrape fires exactly once, so this pre-tick
             # cannot double-fire them)
             scr.tick(e0, injector)
+            # lifecycle boundary at the chunk start: e0 is on the
+            # ckpt_every grid by construction (chunk_bounds), so
+            # lifecycle ops compose with the fused chunk by applying
+            # only between launches -- the chunk itself never changes.
+            # Slot mapping of the pre-generated ID-SPACE draws happens
+            # HERE, after the boundary's registrations/evictions/
+            # growth/compaction settled the layout for the chunk.
+            if plane is not None:
+                with _spans.span(tracer, "lifecycle.boundary",
+                                 "host_prep", epoch=e0):
+                    state, ledger = plane.boundary(
+                        state, e0, job.ckpt_every, ledger=ledger)
+                counts_dev = plane.map_counts(counts)
+            else:
+                counts_dev = counts
             # the double buffer: chunk T+1's draws happen between the
             # chunk launch's dispatch and its device wait (the overlap
             # seam run_stream_chunk_guarded exposes).  Idempotent: a
@@ -760,14 +910,17 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                                      "host_prep"):
                         b1 = next(stream_mod.chunk_bounds(
                             b, job.epochs, job.ckpt_every))[1]
-                        nxt["counts"] = _draw_counts(rng, job, b1 - b)
+                        nxt["counts"] = _draw_counts_churn(
+                            rng, job.churn, b, b1) \
+                            if plane is not None \
+                            else _draw_counts(rng, job, b1 - b)
                 nxt["rng"] = _rng_state_array(rng)
 
             while True:
                 cfg = ladder.apply(base_cfg)
                 try:
                     g = run_stream_chunk_guarded(
-                        state, e0, counts, engine=job.engine,
+                        state, e0, counts_dev, engine=job.engine,
                         epochs=b - e0, m=job.m, k=job.k,
                         chain_depth=job.chain_depth,
                         dt_epoch_ns=job.dt_epoch_ns, waves=job.waves,
@@ -810,7 +963,9 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                     epoch = e0 + i
                     scr.tick(epoch, injector)
                     decisions += g.counts[i]
-                    digest = _digest_update(digest, g.epochs[i])
+                    digest = _digest_update(
+                        digest, plane.canon_results(g.epochs[i])
+                        if plane is not None else g.epochs[i])
                     for r in g.epochs[i]:
                         if hasattr(r, "metrics") and \
                                 r.metrics is not None:
@@ -835,7 +990,8 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                     payload = _payload(job, state, rng_ckpt, met,
                                        digest, b, decisions,
                                        ladder.encode(), hists=hists,
-                                       ledger=ledger, flight=flight)
+                                       ledger=ledger, flight=flight,
+                                       plane=plane)
 
                     def save(payload=payload):
                         return ckpt_mod.save_pytree_rotating(
@@ -872,7 +1028,7 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
         tracer.drain_jsonl(job.span_log)
     return _build_result(job, state, digest, decisions, met, ladder,
                          scr.rebinds, resumed_from, hists, ledger,
-                         flight, stream_fallbacks)
+                         flight, stream_fallbacks, plane)
 
 
 def _healthz_ok(scrape, timeout_s: float = 2.0) -> bool:
@@ -1013,7 +1169,8 @@ def _spawn_once(job: EpochJob, workdir: str,
         hists=arr("hists"), ledger=arr("ledger"),
         flight_buf=arr("flight_buf"),
         flight_seq=int(obj.get("flight_seq", 0)),
-        stream_fallbacks=int(obj.get("stream_fallbacks", 0)))
+        stream_fallbacks=int(obj.get("stream_fallbacks", 0)),
+        lifecycle=obj.get("lifecycle"))
 
 
 def _child_main(workdir: str) -> int:
@@ -1053,7 +1210,8 @@ def _child_main(workdir: str) -> int:
                    "ledger": lst(result.ledger),
                    "flight_buf": lst(result.flight_buf),
                    "flight_seq": result.flight_seq,
-                   "stream_fallbacks": result.stream_fallbacks}, fh)
+                   "stream_fallbacks": result.stream_fallbacks,
+                   "lifecycle": result.lifecycle}, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, res_path)

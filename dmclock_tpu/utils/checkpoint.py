@@ -175,25 +175,37 @@ def save_pytree(path, tree: Any) -> None:
                 os.unlink(tmp)
 
 
-def restore_pytree(path, like: Any) -> Any:
+def restore_pytree(path, like: Any, *,
+                   strict_shapes: bool = True) -> Any:
     """Restore a checkpoint saved by ``save_pytree``; ``like`` provides
     the tree structure and array shapes/dtypes (e.g. a freshly built
     state).  Raises :class:`CheckpointCorruptError` unless the data
     loads cleanly AND matches its sidecar digest AND fits ``like``.
     When the primary pair fails verification but an intact ``.prev``
     pair exists (an in-place overwrite was interrupted mid-commit),
-    the previous snapshot is returned instead."""
+    the previous snapshot is returned instead.
+
+    ``strict_shapes=False`` relaxes the per-leaf shape check along
+    **axis 0 only** (dtype, rank, and every trailing dimension still
+    gate): grow-on-demand payloads -- the lifecycle plane's
+    geometrically-doubled client arrays, its variable-length journals
+    -- vary exactly there, while fixed-shape leaves (histogram
+    blocks, ring widths, metric vectors) keep their full check.  The
+    sidecar digest still gates integrity; only the template's axis-0
+    expectation is waived."""
     path = os.fspath(path)
     try:
-        return _restore_exact(path, like)
+        return _restore_exact(path, like, strict_shapes=strict_shapes)
     except CheckpointCorruptError:
         prev = _prev(path)
         if os.path.exists(prev) and os.path.exists(_sidecar(prev)):
-            return _restore_exact(prev, like)
+            return _restore_exact(prev, like,
+                                  strict_shapes=strict_shapes)
         raise
 
 
-def _restore_exact(path: str, like: Any) -> Any:
+def _restore_exact(path: str, like: Any, *,
+                   strict_shapes: bool = True) -> Any:
     side = _sidecar(path)
     if not os.path.exists(path):
         raise CheckpointCorruptError(f"no checkpoint at {path}")
@@ -222,7 +234,11 @@ def _restore_exact(path: str, like: Any) -> Any:
     out = []
     for arr, ref in zip(arrays, like_leaves):
         ref = np.asarray(ref)
-        if arr.shape != ref.shape or arr.dtype != ref.dtype:
+        if arr.dtype != ref.dtype or \
+                (strict_shapes and arr.shape != ref.shape) or \
+                (not strict_shapes and
+                 (arr.ndim != ref.ndim or
+                  arr.shape[1:] != ref.shape[1:])):
             raise CheckpointCorruptError(
                 f"{path}: leaf shape/dtype {arr.shape}/{arr.dtype} != "
                 f"expected {ref.shape}/{ref.dtype}")
@@ -275,17 +291,21 @@ def save_pytree_rotating(dirpath, tree: Any, keep: int = 4) -> str:
     return path
 
 
-def restore_pytree_rotating(dirpath, like: Any) -> Tuple[Any, str]:
+def restore_pytree_rotating(dirpath, like: Any, *,
+                            strict_shapes: bool = True
+                            ) -> Tuple[Any, str]:
     """Restore the newest INTACT snapshot from a rotation directory,
     walking newest to oldest past torn/corrupt entries.  Returns
     ``(tree, path)``; raises :class:`CheckpointCorruptError` when no
-    entry verifies."""
+    entry verifies.  ``strict_shapes`` as in :func:`restore_pytree`
+    (grow-on-demand payloads restore with it off)."""
     dirpath = os.fspath(dirpath)
     entries = _rotation_entries(dirpath)
     errors = []
     for _, path in reversed(entries):
         try:
-            return restore_pytree(path, like), path
+            return restore_pytree(path, like,
+                                  strict_shapes=strict_shapes), path
         except CheckpointCorruptError as e:
             errors.append(str(e))
     raise CheckpointCorruptError(

@@ -32,6 +32,13 @@ series, warn-only on >tolerance regressions -- the dispatch tax can
 regress structurally (a lost fusion, an extra sync) while dec/s holds
 because the chains amortize it, and it is the before/after currency
 of the streaming-serve-loop work (ROADMAP #1).
+
+Churn workloads (bench.py --mode churn; docs/LIFECYCLE.md) form
+their own per-workload series keyed additionally by scenario +
+scripted population size (total_ids): the population is DYNAMIC, so
+the record carries peak/live client counts next to the rate and a
+session against a different id space never enters the medians.  The
+p99-tardiness warn thresholds apply to churn series like any other.
 """
 
 from __future__ import annotations
@@ -178,10 +185,13 @@ def main() -> int:
              if r.get("device") == dev and not is_fallback(r)
              and not is_chaos(r) and not is_restarted(r)
              and not is_degraded(r)]
-    def series(wl, key, impl, cal, loop):
+    def series(wl, key, impl, cal, loop, scen=None, pop=None):
         """Prior values of one per-workload scalar column, filtered to
         the same fast-path identity (select_impl + calendar_impl +
-        engine_loop) the throughput series uses."""
+        engine_loop) the throughput series uses.  Churn workloads add
+        scenario + scripted population (total_ids) to the identity:
+        the POPULATION IS DYNAMIC, so a record against a different id
+        space is a different workload, not a comparable session."""
         return [r["workloads"][wl][key] for _, r in prior
                 if wl in r.get("workloads", {})
                 and key in r["workloads"][wl]
@@ -190,7 +200,9 @@ def main() -> int:
                 and r["workloads"][wl].get("calendar_impl",
                                            "minstop") == cal
                 and r["workloads"][wl].get("engine_loop",
-                                           "round") == loop]
+                                           "round") == loop
+                and r["workloads"][wl].get("scenario") == scen
+                and r["workloads"][wl].get("total_ids") == pop]
 
     status = 0
     for wl, row in sorted(newest.get("workloads", {}).items()):
@@ -214,12 +226,19 @@ def main() -> int:
         # and the tag filter makes it robust even if a key collides.
         # Rows without the tag predate the knob == "round".
         loop = row.get("engine_loop", "round")
+        # churn rows (open population, docs/LIFECYCLE.md) carry
+        # scenario + scripted id-space size; both join the series
+        # identity and the tag
+        scen = row.get("scenario")
+        pop = row.get("total_ids")
         tag = f"{wl}[{impl}]" if impl != "sort" else wl
         if cal != "minstop":
             tag += f"[{cal}]"
         if loop != "round" and loop not in wl:
             tag += f"[{loop}]"
-        hist = series(wl, "dps", impl, cal, loop)
+        if scen is not None:
+            tag += f"[N={pop}]"
+        hist = series(wl, "dps", impl, cal, loop, scen, pop)
         if len(hist) < args.min_records:
             print(f"bench_guard: {tag}: {dps/1e6:.1f}M "
                   f"({len(hist)} prior record(s) -- not judged)")
@@ -236,13 +255,18 @@ def main() -> int:
         # decisions-per-LAUNCH is the streaming loop's acceptance
         # currency (one stream launch covers a whole chunk of rounds)
         dpl = row.get("decisions_per_launch")
+        # churn sessions print their population next to the rate: a
+        # dynamic population's dec/s is meaningless without it
+        peak = row.get("peak_clients")
         print(f"bench_guard: {tag}: newest {dps/1e6:.1f}M vs median "
               f"{med/1e6:.1f}M over {len(hist)} sessions "
               f"(floor {floor/1e6:.1f}M at tolerance "
               f"{args.tolerance:g}x) -- {verdict}"
               + (f" [bounded by {bb}]" if bb else "")
               + (f" [{dpp:.0f} dec/pass]" if dpp else "")
-              + (f" [{dpl:.0f} dec/launch]" if dpl else ""))
+              + (f" [{dpl:.0f} dec/launch]" if dpl else "")
+              + (f" [peak {peak} / live {row.get('live_clients')} "
+                 "clients]" if peak is not None else ""))
         if dps < floor:
             status = 1
         # p99 reservation tardiness rides the same per-workload
@@ -254,7 +278,8 @@ def main() -> int:
         # shift with calibration; a hard gate would flap.
         p99 = row.get("tardiness_p99_ns")
         if p99 is not None:
-            t_hist = series(wl, "tardiness_p99_ns", impl, cal, loop)
+            t_hist = series(wl, "tardiness_p99_ns", impl, cal, loop,
+                            scen, pop)
             if len(t_hist) < args.min_records:
                 print(f"bench_guard: {tag}: p99 tardiness "
                       f"{p99/1e6:.2f}ms ({len(t_hist)} prior "
@@ -286,7 +311,7 @@ def main() -> int:
         disp = row.get("dispatch_ms_per_launch")
         if disp is not None:
             d_hist = series(wl, "dispatch_ms_per_launch", impl, cal,
-                            loop)
+                            loop, scen, pop)
             if len(d_hist) < args.min_records:
                 print(f"bench_guard: {tag}: dispatch "
                       f"{disp:.2f}ms/launch ({len(d_hist)} prior "

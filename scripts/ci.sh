@@ -500,6 +500,63 @@ print("zero-host-fault supervised stream gate ok (stream-wrapped + "
 print("streaming smoke ok")
 EOF
 
+echo "== churn smoke (dynamic == static decision-digest gate) =="
+# the client lifecycle plane (docs/LIFECYCLE.md): a seeded
+# register/evict/update/compact churn run -- clients arriving through
+# the lifecycle plane, idle slots recycled, capacity geometrically
+# doubled, live clients repacked by compaction epochs -- must produce
+# a BIT-IDENTICAL canonical (client-id-space) decision stream to a
+# statically pre-registered population serving the same arrival
+# trace, on the serial oracle and on all three epoch engines under
+# both the round and the stream loop.
+timeout -k 30 900 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+from dmclock_tpu.lifecycle import (make_spec, run_serial_churn,
+                                   static_variant)
+from dmclock_tpu.robust import supervisor as SV
+
+# growth (capacity0=4) + eviction (2-epoch generations) + recycling
+# (gen2 lands on gen0's freed slots) + compaction (every boundary)
+spec = make_spec("churn_storm", total_ids=16, base_lam=1.5,
+                 compact_every=1, gens=4, stride=4, life=2,
+                 capacity0=4)
+static = static_variant(spec)
+
+d_dyn, plane, n_dyn = run_serial_churn(spec, epochs=16, every=2)
+d_st, _, n_st = run_serial_churn(static, epochs=16, every=2)
+assert d_dyn == d_st, "serial: dynamic digest diverged from static"
+assert n_dyn == n_st > 0
+snap = plane.snapshot()
+for key in ("grows", "evictions", "slot_recycles", "compactions"):
+    assert snap[key] > 0, f"churn mechanics never fired: {key}"
+print(f"serial: dynamic == static ({n_dyn} decisions, "
+      f"{snap['evictions']} evictions, {snap['slot_recycles']} "
+      f"recycles, {snap['compactions']} compactions, "
+      f"{snap['grows']} grows)")
+
+for engine in ("prefix", "chain", "calendar"):
+    jobs = {(tag, loop): SV.EpochJob(
+                engine=engine, churn=sp, epochs=12, m=2, k=8,
+                ring=16, waves=4, ckpt_every=2, seed=11,
+                engine_loop=loop)
+            for tag, sp in (("dyn", spec), ("static", static))
+            for loop in ("round", "stream")}
+    res = {key: SV.run_job(job) for key, job in jobs.items()}
+    ref = res[("static", "round")]
+    assert ref.decisions > 0, engine
+    for key, r in res.items():
+        assert r.digest == ref.digest, \
+            f"{engine}/{key}: digest diverged from static/round"
+        assert r.decisions == ref.decisions, f"{engine}/{key}"
+    dyn = res[("dyn", "round")].lifecycle
+    assert dyn["compactions"] > 0 and dyn["grows"] > 0, engine
+    print(f"{engine}: dyn == static on round + stream "
+          f"({ref.decisions} decisions, digest {ref.digest[:16]})")
+print("churn smoke ok")
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

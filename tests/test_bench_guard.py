@@ -284,3 +284,69 @@ def test_decisions_per_launch_printed(monkeypatch, capsys, tmp_path):
     rc, out = run_guard(monkeypatch, capsys, hist)
     assert rc == 0
     assert "dec/launch" in out
+
+
+# ----------------------------------------------------------------------
+# churn (open-population) series -- docs/LIFECYCLE.md
+# ----------------------------------------------------------------------
+
+def _churn_row(dps, total_ids=4096, peak=4096, p99=None):
+    row = {"dps": dps, "scenario": "flash_crowd",
+           "total_ids": total_ids, "peak_clients": peak,
+           "live_clients": peak // 2}
+    if p99 is not None:
+        row["tardiness_p99_ns"] = p99
+    return row
+
+
+def write_history_churn(tmp_path, rows):
+    h = tmp_path / "history"
+    h.mkdir()
+    for i, row in enumerate(rows):
+        (h / f"bench_{1000 + i}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": "tpu0",
+             "workloads": {"churn_flash_crowd": row}}))
+    return h
+
+
+def test_churn_series_judged_with_population_tag(monkeypatch, capsys,
+                                                 tmp_path):
+    hist = write_history_churn(tmp_path, [
+        _churn_row(4e6), _churn_row(5e6), _churn_row(4.5e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "churn_flash_crowd[N=4096]" in out
+    assert "peak 4096 / live 2048 clients" in out
+    assert "OK" in out
+
+
+def test_churn_regression_fails(monkeypatch, capsys, tmp_path):
+    hist = write_history_churn(tmp_path, [
+        _churn_row(4e6), _churn_row(5e6), _churn_row(1e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 1 and "REGRESSION" in out
+
+
+def test_churn_population_splits_the_series(monkeypatch, capsys,
+                                            tmp_path):
+    # a 100k-id session must NOT be median-compared against 4096-id
+    # records even under the same workload key
+    hist = write_history_churn(tmp_path, [
+        _churn_row(40e6), _churn_row(45e6),
+        _churn_row(4e6, total_ids=100_000, peak=100_000)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "not judged" in out
+
+
+def test_churn_tardiness_warns_like_cfg4(monkeypatch, capsys,
+                                         tmp_path):
+    hist = write_history_churn(tmp_path, [
+        _churn_row(4e6, p99=2e6), _churn_row(4e6, p99=2e6),
+        _churn_row(4e6, p99=50e6)])
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    monkeypatch.setattr(bg, "HISTORY", hist)
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0                       # warn-only
+    assert "WARNING p99 tardiness" in cap.err

@@ -1,0 +1,821 @@
+"""Client lifecycle plane (dmclock_tpu.lifecycle; docs/LIFECYCLE.md).
+
+The headline gate: a run that registers clients dynamically -- with
+idle eviction, slot recycling, grow-on-demand capacity, and at least
+one compaction epoch -- produces a BIT-IDENTICAL canonical decision
+stream to a statically pre-registered run over the same arrival
+trace, on the serial engine and on prefix/chain/calendar under both
+the round and the stream loop.  Plus the slot-map/op-vector unit
+contracts, the admin control API (one validation path with init-time
+construction), the WAL acceptance journal, the queue's
+departed-clients report, and the grow-on-demand checkpoint shapes.
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dmclock_tpu.core.qos import ClientInfo, validate_client_info
+from dmclock_tpu.engine.state import (_FRESH_FILLS, EngineState,
+                                      grow_state, init_state)
+from dmclock_tpu.lifecycle import (SCENARIOS, LifecyclePlane, SlotMap,
+                                   make_spec, run_serial_churn,
+                                   static_variant, wal_append)
+from dmclock_tpu.lifecycle import churn as churn_mod
+from dmclock_tpu.lifecycle.api import AdminAPI, mount_admin_api
+from dmclock_tpu.lifecycle.plane import (LC_EVICT, LC_IDLE, LC_NOP,
+                                         LC_REGISTER, LC_UPDATE,
+                                         apply_op_vector)
+from dmclock_tpu.lifecycle.slots import compact_tree
+from dmclock_tpu.robust import supervisor as SV
+
+
+def np_state(st: EngineState) -> dict:
+    return {f: np.asarray(jax.device_get(getattr(st, f)))
+            for f in EngineState._fields}
+
+
+# ----------------------------------------------------------------------
+# slot map
+# ----------------------------------------------------------------------
+
+class TestSlotMap:
+    def test_allocate_lowest_first_and_recycle(self):
+        m = SlotMap(4)
+        assert [m.allocate(c) for c in (10, 11, 12)] == [0, 1, 2]
+        for s in range(3):
+            m.was_used(s)                 # mark, as the plane does
+        assert m.release(11) == 1
+        # the freed slot is the LOWEST free one -> reused next
+        assert m.allocate(13) == 1
+        assert m.was_used(1) is True      # second tenant = a recycle
+        assert m.allocate(14) == 3
+        assert m.was_used(3) is False
+        assert m.allocate(15) == -1       # full -> caller grows
+        assert m.live_count == 4
+
+    def test_grow_extends_free_list(self):
+        m = SlotMap(2)
+        m.allocate(0), m.allocate(1)
+        m.grow(4)
+        assert m.capacity == 4
+        assert m.allocate(2) == 2
+        assert np.array_equal(m.cid_of_slot, [0, 1, 2, -1])
+
+    def test_compaction_perm_none_when_dense(self):
+        m = SlotMap(4)
+        m.allocate(0), m.allocate(1)
+        assert m.compaction_perm() is None     # already a dense prefix
+        m.release(0)
+        perm = m.compaction_perm()
+        assert perm is not None
+        assert perm.tolist() == [1, 0, 2, 3]   # stable: live first
+
+    def test_apply_perm_remaps_everything(self):
+        m = SlotMap(4)
+        for c in (7, 8, 9):
+            m.allocate(c)
+        m.release(8)
+        perm = m.compaction_perm()
+        m.apply_perm(perm)
+        assert np.array_equal(m.cid_of_slot, [7, 9, -1, -1])
+        assert m.slot_of == {7: 0, 9: 1}
+        assert m.allocate(20) == 2             # free list rebuilt
+
+    def test_translate_and_scatter(self):
+        m = SlotMap(3)
+        m.allocate(5), m.allocate(6)
+        out = m.translate(np.asarray([[1, 0], [-1, 2]]))
+        # -1 pads pass through; slot 2 is free -> -1
+        assert out.tolist() == [[6, 5], [-1, -1]]
+        sc = m.scatter_by_cid(np.asarray([10, 20, 30]), total=8)
+        assert sc.tolist() == [0, 0, 0, 0, 0, 10, 20, 0]
+
+    def test_encode_load_round_trip(self):
+        m = SlotMap(4)
+        for c in (3, 1, 2):
+            m.allocate(c)
+        m.take_order(), m.take_order()
+        m.release(1)
+        m2 = SlotMap.load(m.encode())
+        assert np.array_equal(m2.cid_of_slot, m.cid_of_slot)
+        assert m2.slot_of == m.slot_of
+        assert m2.next_order == m.next_order
+        # derived free list rebuilt lowest-first (the resume contract)
+        assert m2.allocate(99) == 1
+
+
+# ----------------------------------------------------------------------
+# validation: ONE path shared by init-time and live updates
+# ----------------------------------------------------------------------
+
+class TestValidation:
+    def test_same_error_as_init_time_construction(self):
+        with pytest.raises(ValueError) as init_err:
+            ClientInfo(-1.0, 1.0, 0.0, client="g0")
+        with pytest.raises(ValueError) as live_err:
+            validate_client_info((-1.0, 1.0, 0.0), name="g0")
+        assert str(init_err.value) == str(live_err.value)
+        assert "g0" in str(live_err.value)
+
+    def test_limit_below_reservation_matches_too(self):
+        with pytest.raises(ValueError) as init_err:
+            ClientInfo(100.0, 1.0, 50.0, client=7)
+        with pytest.raises(ValueError) as live_err:
+            validate_client_info((100.0, 1.0, 50.0), name=7)
+        assert str(init_err.value) == str(live_err.value)
+
+    def test_object_form_uses_own_client_name(self):
+        info = ClientInfo(0.0, 1.0, 0.0, client="ok")
+        validate_client_info(info)            # valid passes
+        bad = ClientInfo(0.0, 1.0, 0.0, client="bad")
+        bad.weight = float("nan")
+        with pytest.raises(ValueError, match="bad"):
+            validate_client_info(bad)
+
+    def test_non_numeric_is_a_valueerror_not_a_crash(self):
+        with pytest.raises(ValueError, match="numeric"):
+            validate_client_info(("abc", 1.0, 0.0), name=3)
+
+
+# ----------------------------------------------------------------------
+# the device op vector
+# ----------------------------------------------------------------------
+
+class TestApplyOpVector:
+    def _dirty_state(self, n=4, ring=4):
+        """A state whose slot 1 carries junk in every field."""
+        st = init_state(n, ring)
+        return st._replace(
+            active=st.active.at[1].set(True),
+            idle=st.idle.at[1].set(False),
+            order=st.order.at[1].set(9),
+            resv_inv=st.resv_inv.at[1].set(11),
+            weight_inv=st.weight_inv.at[1].set(12),
+            limit_inv=st.limit_inv.at[1].set(13),
+            prev_prop=st.prev_prop.at[1].set(14),
+            head_prop=st.head_prop.at[1].set(15),
+            head_cost=st.head_cost.at[1].set(16),
+            head_ready=st.head_ready.at[1].set(True),
+            depth=st.depth.at[1].set(2),
+            q_head=st.q_head.at[1].set(1),
+            q_arrival=st.q_arrival.at[1].set(17),
+            q_cost=st.q_cost.at[1].set(18),
+        )
+
+    def _apply(self, st, rows):
+        arr = np.asarray(rows, dtype=np.int64)
+        return apply_op_vector(st, arr[:, 0], arr[:, 1], arr[:, 2],
+                               arr[:, 3], arr[:, 4], arr[:, 5])
+
+    def test_evicted_slot_is_byte_identical_to_fresh(self):
+        st = self._apply(self._dirty_state(),
+                         [(LC_EVICT, 1, 0, 0, 0, 0)])
+        fresh = np_state(init_state(4, 4))
+        got = np_state(st)
+        for f in EngineState._fields:
+            assert np.array_equal(got[f], fresh[f]), f
+
+    def test_register_installs_exactly_create_fields(self):
+        st = self._apply(self._dirty_state(),
+                         [(LC_REGISTER, 1, 100, 200, 300, 5)])
+        got = np_state(st)
+        fresh = np_state(init_state(4, 4))
+        assert got["active"][1] and got["idle"][1]
+        assert got["order"][1] == 5
+        assert (got["resv_inv"][1], got["weight_inv"][1],
+                got["limit_inv"][1]) == (100, 200, 300)
+        # every OTHER field of the row reset to the init fill
+        for f in EngineState._fields:
+            if f in ("active", "order", "resv_inv", "weight_inv",
+                     "limit_inv"):
+                continue
+            assert np.array_equal(got[f][1], fresh[f][1]), f
+
+    def test_update_touches_only_the_three_inverses(self):
+        dirty = self._dirty_state()
+        st = self._apply(dirty, [(LC_UPDATE, 1, 7, 8, 9, 0)])
+        got, before = np_state(st), np_state(dirty)
+        assert (got["resv_inv"][1], got["weight_inv"][1],
+                got["limit_inv"][1]) == (7, 8, 9)
+        for f in EngineState._fields:
+            if f in ("resv_inv", "weight_inv", "limit_inv"):
+                continue
+            assert np.array_equal(got[f], before[f]), f
+
+    def test_idle_mark_touches_only_idle(self):
+        dirty = self._dirty_state()
+        st = self._apply(dirty, [(LC_IDLE, 1, 0, 0, 0, 0)])
+        got, before = np_state(st), np_state(dirty)
+        assert got["idle"][1]
+        for f in EngineState._fields:
+            if f == "idle":
+                continue
+            assert np.array_equal(got[f], before[f]), f
+
+    def test_rows_compose_in_order_and_nops_pad(self):
+        st = self._apply(init_state(4, 4), [
+            (LC_REGISTER, 2, 1, 2, 3, 0),
+            (LC_UPDATE, 2, 4, 5, 6, 0),      # same boundary, later row
+            (LC_NOP, 0, 0, 0, 0, 0),
+        ])
+        got = np_state(st)
+        assert got["active"][2]
+        assert (got["resv_inv"][2], got["weight_inv"][2],
+                got["limit_inv"][2]) == (4, 5, 6)
+        assert not got["active"][0]          # NOP touched nothing
+
+    def test_grow_state_new_rows_match_fresh(self):
+        st = grow_state(self._dirty_state(), 8)
+        fresh = np_state(init_state(8, 4))
+        got = np_state(st)
+        for f in EngineState._fields:
+            assert np.array_equal(got[f][4:], fresh[f][4:]), f
+        assert got["order"][1] == 9          # old rows untouched
+
+    def test_compact_tree_gathers_every_leaf(self):
+        st = self._dirty_state()
+        led = jnp.arange(8, dtype=jnp.int64).reshape(4, 2)
+        perm = np.asarray([1, 0, 2, 3], dtype=np.int32)
+        st2, led2 = compact_tree((st, led), perm)
+        assert np.asarray(st2.order).tolist() == [9, 0, 0, 0]
+        assert np.asarray(led2).tolist() == [[2, 3], [0, 1],
+                                             [4, 5], [6, 7]]
+
+
+# ----------------------------------------------------------------------
+# the digest gates
+# ----------------------------------------------------------------------
+
+# generations live 2 epochs and start 4 apart: gen0 is evicted (quiet
+# streak 2 at boundary 6) before gen2 registers at boundary 8, so
+# registrations land on RECYCLED slots; capacity0=4 forces a grow at
+# boundary 4; the eviction holes make compaction (every boundary) fire
+SPEC = make_spec("churn_storm", total_ids=16, base_lam=1.5,
+                 compact_every=1, gens=4, stride=4, life=2,
+                 capacity0=4)
+
+
+class TestSerialDigestGate:
+    @pytest.mark.parametrize("scenario", SCENARIOS)
+    def test_dynamic_equals_static(self, scenario):
+        spec = make_spec(scenario, total_ids=16, base_lam=1.5,
+                         compact_every=2)
+        d_dyn, plane, n_dyn = run_serial_churn(spec, epochs=16,
+                                               every=2)
+        d_st, _, n_st = run_serial_churn(static_variant(spec),
+                                         epochs=16, every=2)
+        assert d_dyn == d_st
+        assert n_dyn == n_st > 0
+        snap = plane.snapshot()
+        if scenario == "churn_storm":
+            # later generations start past this short run's horizon;
+            # the open-population mechanics still fired
+            assert snap["registrations"] >= 8
+            assert snap["evictions"] > 0
+        else:
+            assert snap["registrations"] == 16
+
+    def test_churn_storm_recycles_and_compacts(self):
+        d_dyn, plane, _ = run_serial_churn(SPEC, epochs=20, every=2)
+        d_st, _, _ = run_serial_churn(static_variant(SPEC),
+                                      epochs=20, every=2)
+        assert d_dyn == d_st
+        snap = plane.snapshot()
+        assert snap["evictions"] > 0
+        assert snap["slot_recycles"] > 0
+        assert snap["compactions"] > 0
+        # departed report: one final ledger row per evicted client
+        dep = plane.departed_report()
+        assert len(dep) == snap["evictions"]
+        assert all(row.shape == (5,) for _, row in dep)
+        assert plane.departed_report() == []   # drained
+
+
+_STATIC_REFS: dict = {}
+
+
+def _churn_job(engine: str, spec: dict, loop: str) -> SV.EpochJob:
+    return SV.EpochJob(engine=engine, churn=spec, epochs=12, m=2,
+                       k=8, ring=16, waves=4, ckpt_every=2, seed=11,
+                       engine_loop=loop)
+
+
+def _static_ref(engine: str) -> SV.SupervisedResult:
+    if engine not in _STATIC_REFS:
+        _STATIC_REFS[engine] = SV.run_job(
+            _churn_job(engine, static_variant(SPEC), "round"))
+    return _STATIC_REFS[engine]
+
+
+class TestEngineDigestGate:
+    @pytest.mark.parametrize("engine", ("prefix", "chain", "calendar"))
+    @pytest.mark.parametrize("loop", ("round", "stream"))
+    def test_dynamic_equals_static(self, engine, loop):
+        """The acceptance gate: dynamic registration + recycling +
+        growth + compaction is decision-stream-neutral on every epoch
+        engine, round and stream loops."""
+        res = SV.run_job(_churn_job(engine, SPEC, loop))
+        ref = _static_ref(engine)
+        assert res.digest == ref.digest
+        assert res.decisions == ref.decisions > 0
+        assert res.lifecycle["grows"] >= 1
+        assert res.lifecycle["compactions"] >= 1
+        assert res.lifecycle["evictions"] >= 1
+
+    def test_static_stream_equals_static_round(self):
+        res = SV.run_job(
+            _churn_job("prefix", static_variant(SPEC), "stream"))
+        assert res.digest == _static_ref("prefix").digest
+
+
+# ----------------------------------------------------------------------
+# admin control API
+# ----------------------------------------------------------------------
+
+def _plane(**kw) -> LifecyclePlane:
+    spec = make_spec("flash_crowd", total_ids=8, base_lam=1.0, **kw)
+    return LifecyclePlane(spec)
+
+
+class TestAdminAPI:
+    def _api(self, plane=None, ledger_rows=None):
+        return AdminAPI(plane or _plane(), ledger_rows=ledger_rows)
+
+    def _call(self, api, method, path, body=None):
+        status, ctype, out = api.handler(
+            method, path,
+            json.dumps(body).encode() if body is not None else b"")
+        assert ctype == "application/json"
+        return status, json.loads(out.decode())
+
+    def test_register_update_get_delete_cycle(self):
+        # client 6 is in the flash_crowd cohort scripted for boundary
+        # 8 -- these boundaries stop at 2, so every op below is ours;
+        # the base cohort (ids 0-3) registers by script at boundary 0
+        api = self._api()
+        st, obj = self._call(api, "POST", "/clients",
+                             {"id": 6, "reservation": 0.0,
+                              "weight": 2.0, "limit": 0.0})
+        assert st == 202 and obj["accepted"] and obj["seq"] == 0
+        # visible as pending before its boundary
+        st, obj = self._call(api, "GET", "/clients/6")
+        assert st == 200 and obj["pending"] == ["register"]
+        assert not obj["registered"]
+        st, obj = self._call(api, "PUT", "/clients/6/qos",
+                             {"weight": 8.0})
+        assert st == 202 and obj["seq"] == 1
+        # apply at a boundary, then the slot is live
+        plane = api.plane
+        state = init_state(plane.spec["capacity0"], 8)
+        state, _ = plane.boundary(state, 0, 2)
+        st, obj = self._call(api, "GET", "/clients/6")
+        assert st == 200 and obj["registered"]
+        assert obj["qos"]["weight"] == 8.0
+        st, obj = self._call(api, "DELETE", "/clients/6")
+        assert st == 202
+        state, _ = plane.boundary(state, 2, 2)
+        st, obj = self._call(api, "GET", "/clients/6")
+        assert st == 404
+        snap = plane.snapshot()
+        assert snap["registrations"] == 5    # 4 scripted + ours
+        assert snap["qos_updates"] == 1
+        assert snap["evictions"] == 1
+
+    def test_invalid_qos_is_400_with_init_time_message(self):
+        api = self._api()
+        st, obj = self._call(api, "POST", "/clients",
+                             {"id": 1, "reservation": -5.0})
+        assert st == 400
+        with pytest.raises(ValueError) as err:
+            ClientInfo(-5.0, 1.0, 0.0, client=1)
+        assert obj["error"] == str(err.value)
+
+    def test_conflict_unknown_and_method_errors(self):
+        api = self._api()
+        self._call(api, "POST", "/clients", {"id": 1})
+        st, _ = self._call(api, "POST", "/clients", {"id": 1})
+        assert st == 409
+        st, _ = self._call(api, "PUT", "/clients/9/qos",
+                           {"weight": 1.0})
+        assert st == 404
+        st, _ = self._call(api, "DELETE", "/clients/9")
+        assert st == 404
+        st, _ = self._call(api, "GET", "/clients/xyz")
+        assert st == 404
+        st, _ = self._call(api, "PUT", "/clients")
+        assert st == 405
+        st, obj = self._call(api, "POST", "/clients", "not a dict")
+        assert st == 400
+
+    def test_population_summary(self):
+        api = self._api()
+        st, obj = self._call(api, "GET", "/clients")
+        assert st == 200
+        assert obj["live_clients"] == 0
+        assert obj["pending_ops"] == 0
+        assert "registrations" in obj
+
+    def test_ledger_rows_surface_in_get(self):
+        plane = _plane()
+        api = self._api(plane,
+                        ledger_rows=lambda: {2: np.arange(5)})
+        self._call(api, "POST", "/clients", {"id": 2})
+        state = init_state(plane.spec["capacity0"], 8)
+        plane.boundary(state, 0, 2)
+        st, obj = self._call(api, "GET", "/clients/2")
+        assert st == 200 and obj["ledger"] == [0, 1, 2, 3, 4]
+
+    def test_mounted_over_http(self):
+        """End to end through the scrape endpoint: ONE port serves
+        Prometheus scrape + lifecycle control."""
+        from dmclock_tpu.obs.registry import (MetricsHTTPServer,
+                                              MetricsRegistry)
+
+        plane = _plane()
+        with MetricsHTTPServer(MetricsRegistry(), port=0) as srv:
+            mount_admin_api(srv, plane)
+            base = f"http://{srv.host}:{srv.port}"
+
+            def req(method, path, body=None):
+                data = json.dumps(body).encode() \
+                    if body is not None else None
+                r = urllib.request.Request(base + path, data=data,
+                                           method=method)
+                try:
+                    with urllib.request.urlopen(r, timeout=5) as resp:
+                        return resp.status, json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            st, obj = req("POST", "/clients", {"id": 5, "weight": 3.0})
+            assert st == 202 and obj["accepted"]
+            st, obj = req("GET", "/clients/5")
+            assert st == 200 and obj["pending"] == ["register"]
+            st, obj = req("POST", "/clients",
+                          {"id": 6, "reservation": -1.0})
+            assert st == 400 and "client 6" in obj["error"]
+            st, obj = req("GET", "/clients")
+            assert st == 200 and obj["pending_ops"] == 1
+            # the scrape side still serves, counters published
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=5) as resp:
+                text = resp.read().decode()
+            assert "dmclock_lc_live_clients" in text
+
+    def test_supervised_scrape_remounts_across_rebinds(self):
+        """A churn job's scrape endpoint carries the admin control
+        API (supervisor wires it through _ScrapeCtl.on_bind), and a
+        port-loss rebind re-mounts it -- mounts are per-server, so
+        without the re-mount a recovered endpoint would serve scrape
+        but 404 the control plane."""
+        plane = _plane()
+        scr = SV._ScrapeCtl(
+            0, 0, lambda srv: mount_admin_api(srv, plane))
+
+        def get_clients():
+            url = f"http://127.0.0.1:{scr.port}/clients"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+
+        try:
+            scr.tick(0, None)
+            assert scr.scrape is not None
+            st, obj = get_clients()
+            assert st == 200 and obj["live_clients"] == 0
+            # the injector's drop_scrape path: port yanked, next tick
+            # rebinds on the pinned port
+            scr.scrape.close()
+            scr.scrape = None
+            scr.tick(1, None)
+            assert scr.scrape is not None and scr.rebinds == 1
+            st, obj = get_clients()
+            assert st == 200 and "registrations" in obj
+        finally:
+            scr.close()
+
+
+# ----------------------------------------------------------------------
+# WAL acceptance journal
+# ----------------------------------------------------------------------
+
+class TestAdminWAL:
+    def test_accept_fsyncs_then_boundary_applies_once(self, tmp_path):
+        spec = make_spec("flash_crowd", total_ids=8, base_lam=1.0)
+        plane = LifecyclePlane(spec, workdir=str(tmp_path))
+        seq = plane.accept({"op": "register", "cid": 2, "r": 0.0,
+                            "w": 2.0, "l": 0.0, "apply_at": None})
+        assert seq == 0
+        assert (tmp_path / "admin.wal").exists()
+        # accepted ops live in the WAL, not in memory, until a
+        # boundary ingests them (crash between accept and apply loses
+        # nothing)
+        assert plane.pending == []
+        state = init_state(spec["capacity0"], 8)
+        plane.boundary(state, 0, 2)
+        assert plane.wal_seen == 1
+        assert 2 in plane.slots.slot_of
+        # a resumed plane with the cursor PAST the line replays nothing
+        plane2 = LifecyclePlane.load(plane.encode(), spec,
+                                     workdir=str(tmp_path))
+        plane2._wal_ingest()
+        assert plane2.pending == []
+
+    def test_resume_before_ingest_replays_exactly_once(self, tmp_path):
+        spec = make_spec("flash_crowd", total_ids=8, base_lam=1.0)
+        wal_append(tmp_path, {"op": "update", "cid": 0, "r": 0.0,
+                              "w": 9.0, "l": 0.0, "apply_at": 2})
+        plane = LifecyclePlane(spec, workdir=str(tmp_path))
+        state = init_state(spec["capacity0"], 8)
+        state, _ = plane.boundary(state, 0, 2)     # ingests, not due
+        assert plane.wal_seen == 1
+        assert len(plane.pending) == 1
+        # crash here: reload from the encoded snapshot -- the pending
+        # op rides it, the WAL line is NOT re-ingested
+        plane2 = LifecyclePlane.load(plane.encode(), spec,
+                                     workdir=str(tmp_path))
+        state, _ = plane2.boundary(state, 2, 2)
+        assert plane2.snapshot()["qos_updates"] == 1
+        assert plane2.pending == []
+
+    def test_wal_append_validates_like_the_live_path(self, tmp_path):
+        with pytest.raises(ValueError, match="client 4"):
+            wal_append(tmp_path, {"op": "register", "cid": 4,
+                                  "r": -1.0, "w": 1.0, "l": 0.0})
+        assert not (tmp_path / "admin.wal").exists()
+
+    def test_out_of_id_space_cid_rejected_at_accept(self):
+        """The id space is spec-bounded: arrival draws and the
+        canonical digest views are [total_ids]-wide, so an
+        out-of-space registration must 400 at accept, not IndexError
+        the serving loop at the next ingest."""
+        plane = _plane()                      # total_ids=8
+        with pytest.raises(ValueError, match=r"outside.*\[0, 8\)"):
+            plane.accept({"op": "register", "cid": 8, "r": 0.0,
+                          "w": 1.0, "l": 0.0, "apply_at": None})
+        api = AdminAPI(plane)
+        st, _, out = api.handler("POST", "/clients",
+                                 json.dumps({"id": 99}).encode())
+        assert st == 400
+        assert "outside" in json.loads(out.decode())["error"]
+
+    def test_poisoned_wal_line_dropped_not_fatal(self, tmp_path,
+                                                 capsys):
+        """A hand-written WAL bypasses accept(); an out-of-space line
+        must be dropped deterministically at ingest (every
+        incarnation drops the same line), not crash every resume."""
+        spec = make_spec("flash_crowd", total_ids=8, base_lam=1.0)
+        wal_append(tmp_path, {"op": "register", "cid": 500,
+                              "r": 0.0, "w": 1.0, "l": 0.0,
+                              "apply_at": None})
+        plane = LifecyclePlane(spec, workdir=str(tmp_path))
+        state = init_state(spec["capacity0"], 8)
+        state, _ = plane.boundary(state, 0, 2)
+        assert 500 not in plane.slots.slot_of
+        assert plane.wal_seen == 1            # cursor still advances
+        assert "dropping WAL line" in capsys.readouterr().err
+        # and the serving-loop mapping stays intact
+        plane.map_counts(np.zeros(8, dtype=np.int32))
+
+    def test_wal_seq_is_cheap_and_monotone(self, tmp_path):
+        """Sequence numbers come from the cached line count (one file
+        scan total), not a per-accept re-read of the journal."""
+        spec = make_spec("flash_crowd", total_ids=8, base_lam=1.0)
+        plane = LifecyclePlane(spec, workdir=str(tmp_path))
+        seqs = [plane.accept({"op": "update", "cid": 0, "r": 0.0,
+                              "w": float(w), "l": 0.0,
+                              "apply_at": None})
+                for w in range(1, 5)]
+        assert seqs == [0, 1, 2, 3]
+        # a fresh plane over the same workdir continues the numbering
+        plane2 = LifecyclePlane(spec, workdir=str(tmp_path))
+        assert plane2.accept({"op": "update", "cid": 0, "r": 0.0,
+                              "w": 9.0, "l": 0.0,
+                              "apply_at": None}) == 4
+
+    def test_wal_mode_pending_visible_to_api_checks(self, tmp_path):
+        """In WAL mode an accepted op lives only in the file until
+        the next boundary -- the API's existence/duplicate checks
+        must still see it: POST then PUT is 202/202 (not 404), and a
+        duplicate POST is 409 (not a second 202)."""
+        spec = make_spec("flash_crowd", total_ids=8, base_lam=1.0)
+        plane = LifecyclePlane(spec, workdir=str(tmp_path))
+        api = AdminAPI(plane)
+
+        def call(method, path, body):
+            st, _, out = api.handler(method, path,
+                                     json.dumps(body).encode())
+            return st, json.loads(out.decode())
+
+        st, _ = call("POST", "/clients", {"id": 5, "weight": 2.0})
+        assert st == 202
+        assert plane.pending == []            # journaled, not staged
+        st, _ = call("PUT", "/clients/5/qos", {"weight": 8.0})
+        assert st == 202
+        st, _ = call("POST", "/clients", {"id": 5})
+        assert st == 409
+        st, _, out = api.handler("GET", "/clients/5", b"")
+        obj = json.loads(out.decode())
+        assert st == 200 and "register" in obj["pending"]
+        # both ops apply exactly once at the boundary
+        state = init_state(spec["capacity0"], 8)
+        plane.boundary(state, 0, 2)
+        assert 5 in plane.slots.slot_of
+        assert plane.qos[5][1] == 8.0
+        assert plane.snapshot()["qos_updates"] == 1
+
+
+# ----------------------------------------------------------------------
+# queue departed-clients report
+# ----------------------------------------------------------------------
+
+class TestQueueDepartedReport:
+    def test_erase_folds_final_ledger_row_before_zeroing(self):
+        from dmclock_tpu.core import ClientInfo as CI
+        from dmclock_tpu.core.recs import ReqParams
+        from dmclock_tpu.engine import TpuPullPriorityQueue
+
+        clock = [0.0]
+        infos = {c: CI(0.0, 1.0, 0.0, client=c) for c in range(3)}
+        q = TpuPullPriorityQueue(lambda c: infos[c], capacity=4,
+                                 ring_capacity=8, idle_age_s=5.0,
+                                 erase_age_s=10.0,
+                                 monotonic_clock=lambda: clock[0])
+        t = 10 ** 9
+        for i in range(4):
+            q.add_request(("r", i), i % 2, ReqParams(1, 1),
+                          time_ns=t, cost=1)
+        served = 0
+        for _ in range(4):
+            if q.pull_request(now_ns=t + served * 10).is_retn():
+                served += 1
+        assert served == 4
+        rows_before = q.ledger_rows()
+        q.do_clean()                       # mark point at t=0
+        clock[0] = 11.0
+        q.do_clean()                       # past erase_age -> erase
+        assert q.slot_recycles == 2
+        dep = dict(q.departed_report(drain=False))
+        assert set(dep) == {0, 1}
+        for cid, row in dep.items():
+            assert np.array_equal(row, rows_before[cid])
+            assert int(row[0]) == 2        # LED_OPS: 2 ops each
+        # ledger rows zeroed AFTER the fold
+        assert all(int(r.sum()) == 0
+                   for r in q.ledger_rows().values())
+        assert len(q.departed_report()) == 2   # drain clears
+        assert q.departed_report() == []
+
+    def test_recycle_counter_is_published(self):
+        from dmclock_tpu.core import ClientInfo as CI
+        from dmclock_tpu.engine import TpuPullPriorityQueue
+        from dmclock_tpu.obs.registry import MetricsRegistry
+
+        q = TpuPullPriorityQueue(
+            lambda c: CI(0.0, 1.0, 0.0, client=c), capacity=4,
+            ring_capacity=8)
+        reg = MetricsRegistry()
+        q.register_metrics(reg)
+        text = reg.prometheus()
+        assert "dmclock_slot_recycles_total" in text
+
+
+# ----------------------------------------------------------------------
+# grow-on-demand checkpoint shapes
+# ----------------------------------------------------------------------
+
+class TestGrowableCheckpoints:
+    def test_strict_shapes_off_restores_grown_payload(self, tmp_path):
+        from dmclock_tpu.utils import checkpoint as ckpt_mod
+
+        small = {"a": np.zeros((2, 3), dtype=np.int64),
+                 "n": np.int64(0)}
+        grown = {"a": np.arange(12, dtype=np.int64).reshape(4, 3),
+                 "n": np.int64(7)}
+        path = str(tmp_path / "ck.npz")
+        ckpt_mod.save_pytree(path, grown)
+        with pytest.raises(ckpt_mod.CheckpointCorruptError):
+            ckpt_mod.restore_pytree(path, small)
+        out = ckpt_mod.restore_pytree(path, small,
+                                      strict_shapes=False)
+        assert np.array_equal(out["a"], grown["a"])
+        assert int(out["n"]) == 7
+
+    def test_rank_and_dtype_still_gate(self, tmp_path):
+        from dmclock_tpu.utils import checkpoint as ckpt_mod
+
+        path = str(tmp_path / "ck.npz")
+        ckpt_mod.save_pytree(path, {"a": np.zeros(4, dtype=np.int64)})
+        with pytest.raises(ckpt_mod.CheckpointCorruptError):
+            ckpt_mod.restore_pytree(
+                path, {"a": np.zeros((1, 1), dtype=np.int64)},
+                strict_shapes=False)
+        with pytest.raises(ckpt_mod.CheckpointCorruptError):
+            ckpt_mod.restore_pytree(
+                path, {"a": np.zeros(1, dtype=np.float64)},
+                strict_shapes=False)
+
+    def test_trailing_dims_still_gate(self, tmp_path):
+        """The relaxation is AXIS-0 ONLY: growth and the journals
+        vary exactly there, so a fixed trailing width (ring columns,
+        histogram buckets, journal row layout) changing between runs
+        must still raise, not restore silently wrong-shaped."""
+        from dmclock_tpu.utils import checkpoint as ckpt_mod
+
+        path = str(tmp_path / "ck.npz")
+        ckpt_mod.save_pytree(
+            path, {"q": np.zeros((4, 16), dtype=np.int64)})
+        # grown axis 0, same ring width: restores
+        out = ckpt_mod.restore_pytree(
+            path, {"q": np.zeros((2, 16), dtype=np.int64)},
+            strict_shapes=False)
+        assert out["q"].shape == (4, 16)
+        # same rank, different ring width: still corrupt
+        with pytest.raises(ckpt_mod.CheckpointCorruptError):
+            ckpt_mod.restore_pytree(
+                path, {"q": np.zeros((4, 8), dtype=np.int64)},
+                strict_shapes=False)
+
+    def test_plane_encode_load_round_trip(self):
+        spec = make_spec("churn_storm", total_ids=8, base_lam=1.0)
+        plane = LifecyclePlane(spec)
+        state = init_state(spec["capacity0"], 8)
+        for b in (0, 2, 4):
+            state, _ = plane.boundary(state, b, 2)
+        plane.accept({"op": "update", "cid": 0, "r": 0.0, "w": 2.0,
+                      "l": 0.0, "apply_at": 99})
+        enc = plane.encode()
+        plane2 = LifecyclePlane.load(
+            {k: np.asarray(v) for k, v in enc.items()}, spec)
+        assert plane2.snapshot() == plane.snapshot()
+        assert plane2.pending == plane.pending
+        assert np.array_equal(plane2.streak, plane.streak)
+        assert plane2.qos == plane.qos
+
+    def test_empty_leaves_structure_matches_encode(self):
+        empty = LifecyclePlane.empty_leaves()
+        enc = _plane().encode()
+        assert set(empty) == set(enc)
+        for k in empty:
+            assert np.asarray(empty[k]).dtype == \
+                np.asarray(enc[k]).dtype, k
+            assert np.asarray(empty[k]).ndim == \
+                np.asarray(enc[k]).ndim, k
+
+
+# ----------------------------------------------------------------------
+# churn spec scripts
+# ----------------------------------------------------------------------
+
+class TestChurnSpecs:
+    def test_unknown_scenario_and_params_raise(self):
+        with pytest.raises(ValueError, match="unknown churn"):
+            make_spec("nope", total_ids=4)
+        with pytest.raises(ValueError, match="params"):
+            make_spec("diurnal", total_ids=4, crowd_at=3)
+
+    def test_lam_shared_between_dynamic_and_static(self):
+        spec = make_spec("flash_crowd", total_ids=12, seed=3)
+        st = static_variant(spec)
+        for e in (0, 7, 8, 15, 16, 30):
+            assert np.array_equal(churn_mod.lam_vector(spec, e),
+                                  churn_mod.lam_vector(st, e))
+
+    def test_flash_crowd_rates_follow_the_script(self):
+        spec = make_spec("flash_crowd", total_ids=12, base_lam=1.0,
+                         crowd_at=8, crowd_len=4, crowd_lam_x=4.0)
+        lam0 = churn_mod.lam_vector(spec, 0)
+        assert lam0[:6].tolist() == [1.0] * 6    # base cohort on
+        assert lam0[6:].tolist() == [0.0] * 6    # crowd not started
+        lam8 = churn_mod.lam_vector(spec, 8)
+        assert lam8[6:].tolist() == [4.0] * 6
+        assert churn_mod.lam_vector(spec, 12)[6:].tolist() == [0.0] * 6
+
+    def test_peak_ids(self):
+        spec = make_spec("churn_storm", total_ids=12, gens=3,
+                         stride=2, life=3)
+        assert churn_mod.peak_ids(spec) == 8     # 2 gens overlap
+        assert churn_mod.peak_ids(
+            make_spec("diurnal", total_ids=12)) == 12
+
+    def test_events_register_in_ascending_cid_order(self):
+        spec = make_spec("churn_storm", total_ids=12, gens=3,
+                         stride=2, life=4)
+        regs = [e["cid"] for e in churn_mod.events(spec, 0, 2)
+                if e["op"] == "register"]
+        assert regs == sorted(regs) == [0, 1, 2, 3]
+
+    def test_limit_thrash_flips_the_victim_limit(self):
+        spec = make_spec("limit_thrash", total_ids=8, victim_frac=0.5,
+                         tight_limit=40.0)
+        ups = [e for e in churn_mod.events(spec, 2, 2)
+               if e["op"] == "update"]
+        assert {e["cid"] for e in ups} == {4, 5, 6, 7}
+        assert all(e["l"] == 40.0 for e in ups)
+        ups2 = [e for e in churn_mod.events(spec, 4, 2)
+                if e["op"] == "update"]
+        assert all(e["l"] == 0.0 for e in ups2)

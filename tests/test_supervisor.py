@@ -341,3 +341,182 @@ class TestSpawnMode:
         res = SV.run_supervised(job, tmp_path, plan, mode="spawn")
         SV.assert_crash_equivalent(res, ref)
         assert res.restarts == 1
+
+
+# ----------------------------------------------------------------------
+# churn (client lifecycle plane) crash equivalence -- docs/LIFECYCLE.md
+# ----------------------------------------------------------------------
+
+CHURN_SPEC = None
+
+
+def _churn_spec() -> dict:
+    """Heavy-mechanics churn spec: growth (capacity0=4), eviction
+    (life=2 generations), slot recycling (gen2 lands on gen0's
+    slots), compaction at every boundary."""
+    global CHURN_SPEC
+    if CHURN_SPEC is None:
+        from dmclock_tpu.lifecycle import make_spec
+        CHURN_SPEC = make_spec("churn_storm", total_ids=16,
+                               base_lam=1.5, compact_every=1, gens=4,
+                               stride=4, life=2, capacity0=4)
+    return CHURN_SPEC
+
+
+def _churn_job(engine: str, loop: str = "round") -> SV.EpochJob:
+    return SV.EpochJob(engine=engine, churn=_churn_spec(), epochs=12,
+                       m=2, k=8, ring=16, waves=4, ckpt_every=2,
+                       seed=11, engine_loop=loop)
+
+
+def churn_ref(engine: str, loop: str = "round") -> SV.SupervisedResult:
+    key = f"churn-{engine}-{loop}"
+    if key not in _REFS:
+        _REFS[key] = SV.run_job(_churn_job(engine, loop))
+    return _REFS[key]
+
+
+class TestChurnCrashEquivalence:
+    """ISSUE-9 acceptance: crash equivalence extends to lifecycle
+    state -- SIGKILL mid-churn (including between an admin accept and
+    its epoch-boundary application, and mid-compaction) resumes
+    bit-identical to the uninterrupted run, slot map + pending-update
+    journal + counters included."""
+
+    @pytest.mark.parametrize("engine",
+                             ("prefix", "chain", "calendar"))
+    @pytest.mark.parametrize("loop", ("round", "stream"))
+    def test_kill_mid_churn_resumes_bit_identical(self, tmp_path,
+                                                  engine, loop):
+        job, ref = _churn_job(engine, loop), churn_ref(engine, loop)
+        assert ref.decisions > 0
+        # the run's own mechanics all fired before/after kill points
+        assert ref.lifecycle["grows"] >= 1
+        assert ref.lifecycle["compactions"] >= 1
+        assert ref.lifecycle["slot_recycles"] >= 1
+        plan = HF.HostFaultPlan(kill_at_decisions=(
+            max(ref.decisions // 3, 1),
+            max(2 * ref.decisions // 3, 2)))
+        res = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)   # incl. lifecycle
+        assert res.restarts == 2
+
+    def test_kill_between_admin_accept_and_apply(self, tmp_path):
+        """An op accepted through the control API (WAL-fsynced) whose
+        boundary has not come yet must survive the SIGKILL and apply
+        EXACTLY once on resume."""
+        from dmclock_tpu.lifecycle import wal_append
+
+        job = _churn_job("prefix")
+        # client 8 (gen2) registers at boundary 8 -- the same
+        # boundary the pinned update applies at (registers are
+        # processed before pending control ops within a boundary)
+        op = {"op": "update", "cid": 8, "r": 0.0, "w": 8.0, "l": 0.0,
+              "apply_at": 8}
+        wd_ref = tmp_path / "ref"
+        wd_kill = tmp_path / "kill"
+        wd_ref.mkdir(), wd_kill.mkdir()
+        wal_append(wd_ref, op)
+        wal_append(wd_kill, op)
+        ref = SV.run_supervised(job, wd_ref, HF.zero_host_plan())
+        assert ref.lifecycle["qos_updates"] == 1
+        # the uninterrupted CHURN reference without the op diverges:
+        # the update visibly changed the decision stream
+        assert ref.digest != churn_ref("prefix").digest
+        # kill strictly before boundary 8 can have applied the op
+        kill_at = max(ref.decisions // 4, 1)
+        res = SV.run_supervised(
+            job, wd_kill,
+            HF.HostFaultPlan(kill_at_decisions=(kill_at,)))
+        SV.assert_crash_equivalent(res, ref)
+        assert res.lifecycle["qos_updates"] == 1
+        assert res.restarts == 1
+
+    def test_kill_mid_compaction(self, tmp_path):
+        """SIGKILL between the compaction gather launch and the
+        host-side slot-map re-map (the _compact_hook seam): the
+        discarded gather must replay cleanly on resume."""
+        from dmclock_tpu.lifecycle import plane as plane_mod
+
+        job, ref = _churn_job("prefix"), churn_ref("prefix")
+        fired = []
+
+        def hook():
+            if not fired:
+                fired.append(1)
+                raise HF.HostKill("mid-compaction")
+
+        old = plane_mod._compact_hook
+        plane_mod._compact_hook = hook
+        try:
+            res = SV.run_supervised(job, tmp_path,
+                                    HF.zero_host_plan())
+        finally:
+            plane_mod._compact_hook = old
+        assert fired, "compaction hook never reached"
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 1
+
+    def test_churn_zero_host_fault_gate(self, tmp_path):
+        """Supervisor-wrapped churn run with an empty plan == bare
+        churn runner, bit-identical including the metric vector and
+        the full lifecycle snapshot."""
+        job, ref = _churn_job("prefix"), churn_ref("prefix")
+        res = SV.run_supervised(job, tmp_path, HF.zero_host_plan())
+        SV.assert_crash_equivalent(res, ref)
+        assert np.array_equal(res.metrics, ref.metrics)
+        assert res.lifecycle == ref.lifecycle
+
+    def test_lifecycle_mismatch_is_caught(self):
+        """The extended gate actually bites on lifecycle state."""
+        ref = churn_ref("prefix")
+        bad = dict(ref.lifecycle)
+        bad["evictions"] += 1
+        with pytest.raises(AssertionError, match="lifecycle"):
+            SV.assert_crash_equivalent(ref._replace(lifecycle=bad),
+                                       ref)
+
+    def test_churn_telemetry_rides_the_crash(self, tmp_path):
+        """Churn + telemetry: the growing/compacting per-slot ledger
+        and the histograms stay bit-identical across a kill."""
+        job = dataclasses.replace(_churn_job("prefix"),
+                                  with_hists=True, with_ledger=True)
+        ref = SV.run_job(job)
+        assert ref.ledger is not None
+        # the ledger grew with the state arrays (capacity0=4 -> >4)
+        assert ref.ledger.shape[0] > 4
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(ref.decisions // 2, 1),))
+        res = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)
+
+
+@pytest.mark.slow
+class TestChurnSpawnMode:
+    def test_real_sigkill_mid_churn_resumes_bit_identical(
+            self, tmp_path, monkeypatch):
+        """Spawn mode: the churn job JSON-round-trips into a child
+        interpreter, the kill is a REAL SIGKILL, and the resumed run
+        (slot map + WAL + journal restored from the rotation
+        checkpoint) stays bit-identical."""
+        from dmclock_tpu.lifecycle import wal_append
+
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        job, ref0 = _churn_job("prefix"), churn_ref("prefix")
+        # client 8 (gen2) registers at boundary 8 -- the same
+        # boundary the pinned update applies at (registers are
+        # processed before pending control ops within a boundary)
+        op = {"op": "update", "cid": 8, "r": 0.0, "w": 8.0, "l": 0.0,
+              "apply_at": 8}
+        wd_ref = tmp_path / "ref"
+        wd_kill = tmp_path / "kill"
+        wd_ref.mkdir(), wd_kill.mkdir()
+        wal_append(wd_ref, op)
+        wal_append(wd_kill, op)
+        ref = SV.run_supervised(job, wd_ref, HF.zero_host_plan())
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(ref0.decisions // 2, 1),))
+        res = SV.run_supervised(job, wd_kill, plan, mode="spawn")
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 1
+        assert res.lifecycle["qos_updates"] == 1
