@@ -275,6 +275,19 @@ def obsdev_np_combine(acc, *vecs):
     return obsdev.metrics_combine_np(acc, *vecs)
 
 
+def _slo_result_block(out: dict, slo_eval) -> None:
+    """Fold the burn-rate evaluator's verdict into a workload row:
+    the readable 'slo' block plus the flat scalars bench_guard tracks
+    as its own warn-only series -- ONE implementation for the
+    sustained and churn workloads."""
+    s = slo_eval.summary()
+    out["slo"] = s
+    out["slo_violations_total"] = s["violations_total"]
+    out["slo_worst_share_err"] = s["worst_window_share_err"]
+    out["slo_window_tardiness_p99_ns"] = s["window_tardiness_p99_ns"]
+    out["slo_windows_closed"] = s["windows_closed"]
+
+
 def _per_pass_cap(n: int, k: int, calendar_steps: int,
                   calendar_impl: str, ladder_levels: int) -> int:
     """Max decisions one batch/pass can commit -- the fill metric's
@@ -363,7 +376,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     ladder_levels: int = 8,
                     engine_loop: str = "round",
                     stream_chunk: int = 8,
-                    telemetry: bool = True, tracer=None):
+                    telemetry: bool = True, slo: bool = False,
+                    tracer=None):
     """Closed loop: Poisson superwave ingest + prefix serve epoch per
     round, chained async on device; ingest IS inside the timed region.
 
@@ -438,14 +452,48 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     # the flight recorder uses.  The accumulation itself runs inside
     # the timed kernels (telemetry in the data path is the point);
     # --telemetry off A/Bs that cost, decisions bit-identical.
+    # the SLO window block (obs.slo) rides the same donated carry:
+    # windows roll between timed chains (one chain = one window,
+    # fetched + re-zeroed untimed), and the burn-rate evaluator judges
+    # each roll against the workload's reservation/weight contracts
+    from dmclock_tpu.obs import slo as obsslo
+    from dmclock_tpu.obs.alerts import SloEvaluator
+
+    slo_plane = slo_eval = None
+    if slo:
+        slo_plane = obsslo.SloPlane(n, dt_epoch_ns=dt_round_ns,
+                                    ring_depth=32)
+        # initial contracts from the configured rates; calibration
+        # rewrites resv_inv below, and the post-calibration
+        # register_from_inv re-registers everyone from the DEVICE
+        # arrays (a fresh contract epoch: the timed windows must be
+        # judged against the floors the engine actually enforces,
+        # not the pre-calibration guess)
+        for c in range(n):
+            slo_plane.register(c, float(resv_rates[c]),
+                               float(weights[c]), 0.0)
+        slo_eval = SloEvaluator(slo_plane, log=lambda _line: None)
+
     def tele_zero():
-        return (obshist.hist_zero(), obshist.ledger_zero(n)) \
+        out = (obshist.hist_zero(), obshist.ledger_zero(n)) \
             if telemetry else ()
+        if slo:
+            out = out + (slo_plane.stamp(obsslo.window_zero(n)),)
+        return out
+
+    def tele_unpack(tele):
+        if telemetry and slo:
+            return tele
+        if telemetry:
+            return tele + (None,)
+        if slo:
+            return (None, None) + tele
+        return (None, None, None)
 
     tele = tele_zero()
 
     def round_fn(st, counts, t_base, tele):
-        th, tl = tele if telemetry else (None, None)
+        th, tl, ts = tele_unpack(tele)
         headroom = jnp.maximum(
             st.ring_capacity - st.depth, 0).astype(jnp.int32)
         # admission clamp (the AtLimit Reject/EAGAIN analog); the drop
@@ -459,6 +507,10 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         now = t_base + dt_round_ns
         drop_met = obsdev.metrics_delta(ingest_drops=dropped) \
             if with_metrics else obsdev.metrics_zero()
+
+        def tele_pack(ep):
+            out = (ep.hists, ep.ledger) if telemetry else ()
+            return out + (ep.slo,) if slo else out
         # returns (state, count[m], guards[m], resv_decisions[m],
         # slot[m,k], length[m,k], metrics): the phase split reduces ON
         # DEVICE so per-round readbacks stay O(m) scalars; slot/length
@@ -475,19 +527,19 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                                      with_metrics=with_metrics,
                                      calendar_impl=calendar_impl,
                                      ladder_levels=ladder_levels,
-                                     hists=th, ledger=tl)
+                                     hists=th, ledger=tl, slo=ts)
             return (ep.state, ep.count, ep.progress_ok,
                     ep.resv_count, ep.served,
                     jnp.ones_like(ep.served),
                     obsdev.metrics_combine(ep.metrics, drop_met),
-                    (ep.hists, ep.ledger) if telemetry else ())
+                    tele_pack(ep))
         if chain_depth > 1:
             ep = scan_chain_epoch(st, now, m, k,
                                   chain_depth=chain_depth,
                                   anticipation_ns=0,
                                   with_metrics=with_metrics,
                                   select_impl=select_impl,
-                                  hists=th, ledger=tl)
+                                  hists=th, ledger=tl, slo=ts)
             units = ep.slot >= 0
             lens = ep.length.astype(jnp.int32)
             # a unit's entry serve is weight-phase iff class >= 1;
@@ -499,14 +551,14 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
             ep = scan_prefix_epoch(st, now, m, k, anticipation_ns=0,
                                    with_metrics=with_metrics,
                                    select_impl=select_impl,
-                                   hists=th, ledger=tl)
+                                   hists=th, ledger=tl, slo=ts)
             srv_pos = ep.slot >= 0
             resv = jnp.sum(srv_pos & (ep.phase == 0),
                            axis=1).astype(jnp.int32)
             lens = srv_pos.astype(jnp.int32)
         return (ep.state, ep.count, ep.guards_ok, resv, ep.slot, lens,
                 obsdev.metrics_combine(ep.metrics, drop_met),
-                (ep.hists, ep.ledger) if telemetry else ())
+                tele_pack(ep))
 
     # AOT lower+compile with a zero-arrivals sample (same avals as the
     # real draws, and the Poisson stream stays byte-identical to prior
@@ -680,6 +732,13 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
             chunk_run(c)
 
     met_acc = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
+    if slo:
+        # calibration rescaled the reservation floors on device:
+        # re-register every contract from the device-truth inverse
+        # arrays (the supervisor's register_from_inv discipline), so
+        # the timed windows judge delivered-vs-ENFORCED contract
+        slo_plane.register_from_inv(state.resv_inv, state.weight_inv,
+                                    state.limit_inv)
     # calibration's warm-up serves pollute the distribution: reset the
     # telemetry accumulators so the reported percentiles cover the
     # measured steady state only
@@ -690,9 +749,12 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     span_win = _span_window(tracer)
     chain_walls = []
     chain_launches = [0]
+    slo_round0 = [0]
 
     def chain(idx):
         nonlocal state, t_base, met_acc, tele
+        idx = list(idx)
+        n_rounds = len(idx)
         t0 = time.perf_counter()
         counts_out, resv_out, guards, mets = [], [], [], []
         launches = 0
@@ -700,7 +762,6 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
             # one launch per stream chunk of rounds; idx is always a
             # contiguous range here, so the pre-stacked draw block
             # slices straight onto the device
-            idx = list(idx)
             pos = 0
             while pos < len(idx):
                 c = min(stream_chunk, len(idx) - pos)
@@ -746,6 +807,16 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     for row in np.atleast_2d(np.asarray(
                         jax.device_get(mv), dtype=np.int64))]
         met_acc = obsdev_np_combine(met_acc, *met_rows)
+        if slo:
+            # one timed chain = one conformance window: roll the block
+            # UNTIMED (wall is already banked above), judge it, and
+            # re-arm a fresh stamped block as the next chain's carry
+            fresh, closed = slo_plane.roll(
+                tele[-1], slo_round0[0], slo_round0[0] + n_rounds,
+                skip_idle=True)
+            slo_round0[0] += n_rounds
+            slo_eval.observe_roll(closed)
+            tele = tele[:-1] + (fresh,)
         return int(cnts.sum()), wall, cnts, rs
 
     if rlo:
@@ -960,6 +1031,12 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         out["round_ms_p99"] = float(np.percentile(samples_ms, 99))
         out["round_ms_mean"] = round_est * 1e3
 
+    if slo:
+        # the windowed-conformance verdict of the timed chains: a
+        # chain-per-window series judged by the burn-rate evaluator
+        # (docs/OBSERVABILITY.md "SLO plane")
+        _slo_result_block(out, slo_eval)
+
     if telemetry:
         # ONE untimed fetch of the device accumulators (steady-state
         # rounds only; calibration was excluded by the reset above).
@@ -1044,7 +1121,8 @@ def bench_churn(scenario: str = "flash_crowd", *,
                 k: int = 256, ring: int = 32, waves: int = 8,
                 base_lam: float = 2.0, dt_epoch_ns: int = 50_000_000,
                 seed: int = 11, boost_client: int = None,
-                boost_factor: float = 8.0, tracer=None) -> dict:
+                boost_factor: float = 8.0, slo: bool = False,
+                tracer=None) -> dict:
     """Open-population churn workload (docs/LIFECYCLE.md): the
     lifecycle plane drives a ``lifecycle.churn`` scenario -- flash
     crowds arriving and departing, idle eviction recycling slots,
@@ -1080,6 +1158,21 @@ def bench_churn(scenario: str = "flash_crowd", *,
     state = init_state(spec["capacity0"], ring)
     hists = obshist.hist_zero()
     ledger = obshist.ledger_zero(spec["capacity0"])
+    # the SLO plane rides the churn loop exactly as in the supervisor:
+    # window rolls on the lifecycle boundary grid, contract epochs
+    # bumped by the plane's REGISTER/UPDATE/EVICT -- the live-PUT demo
+    # below lands in a FRESH contract epoch's windows (no smearing)
+    slo_block = slo_plane = slo_eval = None
+    slo_w0 = 0
+    if slo:
+        from dmclock_tpu.obs import slo as obsslo
+        from dmclock_tpu.obs.alerts import SloEvaluator
+        slo_plane = obsslo.SloPlane(spec["capacity0"],
+                                    dt_epoch_ns=dt_epoch_ns,
+                                    ring_depth=max(epochs // every, 8))
+        slo_eval = SloEvaluator(slo_plane, log=lambda _line: None)
+        slo_block = obsslo.window_zero(spec["capacity0"])
+        plane.attach_slo(slo_plane)
     ingest = stream_mod.jit_ingest_step(dt_epoch_ns=dt_epoch_ns,
                                         waves=waves)
     rng = np.random.Generator(np.random.PCG64(seed))
@@ -1099,7 +1192,8 @@ def bench_churn(scenario: str = "flash_crowd", *,
         server = MetricsHTTPServer(MetricsRegistry(), port=0)
     except OSError:
         pass
-    api = mount_admin_api(server, plane) if server is not None else None
+    api = mount_admin_api(server, plane, slo=slo_plane) \
+        if server is not None else None
 
     def live_put(cid: int, r: float, w: float, l: float,
                  apply_at: int) -> bool:
@@ -1123,6 +1217,13 @@ def bench_churn(scenario: str = "flash_crowd", *,
     try:
         for e in range(epochs):
             if e % every == 0:
+                if slo_plane is not None and e > 0:
+                    slo_block, closed = slo_plane.roll(
+                        slo_block, slo_w0, e,
+                        cid_of_slot=plane.slots.cid_of_slot,
+                        depth=state.depth)
+                    slo_w0 = e
+                    slo_eval.observe_roll(closed)
                 if e == boost_at:
                     if boost_client is None or \
                             boost_client not in plane.qos:
@@ -1140,8 +1241,13 @@ def bench_churn(scenario: str = "flash_crowd", *,
                     ops_mid = ops_by_cid(ledger)
                 with obsspans.span(tracer, "lifecycle.boundary",
                                    "host_prep", epoch=e):
-                    state, ledger = plane.boundary(state, e, every,
-                                                   ledger=ledger)
+                    if slo_block is not None:
+                        state, ledger, slo_block = plane.boundary(
+                            state, e, every, ledger=ledger,
+                            slo_block=slo_block)
+                    else:
+                        state, ledger = plane.boundary(
+                            state, e, every, ledger=ledger)
             t_base = e * dt_epoch_ns
             raw = rng.poisson(churn_mod.lam_vector(spec, e)) \
                 .astype(np.int32)
@@ -1152,11 +1258,19 @@ def bench_churn(scenario: str = "flash_crowd", *,
                 ep = run_epoch_guarded(
                     state, t_base + dt_epoch_ns, engine=engine, m=m,
                     k=k, with_metrics=True, hists=hists,
-                    ledger=ledger, tracer=tracer)
+                    ledger=ledger, slo=slo_block, tracer=tracer)
             state, hists, ledger = ep.state, ep.hists, ep.ledger
+            if slo_block is not None:
+                slo_block = ep.slo
             decisions += ep.count
         jax.block_until_ready(state.depth)
         wall_s = time.perf_counter() - t0
+        if slo_plane is not None:
+            slo_block, closed = slo_plane.roll(
+                slo_block, slo_w0, epochs,
+                cid_of_slot=plane.slots.cid_of_slot,
+                depth=state.depth)
+            slo_eval.observe_roll(closed)
         ops_end = ops_by_cid(ledger)
     finally:
         if server is not None:
@@ -1216,6 +1330,16 @@ def bench_churn(scenario: str = "flash_crowd", *,
     out["tardiness_max_ns"] = float(obshist.ledger_totals(
         np.asarray(jax.device_get(ledger),
                    dtype=np.int64))["tardiness_max_ns"])
+    if slo_plane is not None:
+        _slo_result_block(out, slo_eval)
+        if boosted is not None:
+            # the no-smearing demo: the boosted client's closed
+            # windows report against their OWN contract versions --
+            # the live PUT lands in a fresh contract epoch
+            out["slo_boost_windows"] = [
+                {"window": [w.e0, w.e1],
+                 "contract_epoch": w.cepoch, "ops": w.ops}
+                for w in slo_plane.ring_rows(boost_client)]
     out["_hist_block"] = h_np.tolist()
     return out
 
@@ -1412,6 +1536,16 @@ def main() -> None:
                     "either way, and the JSON line carries "
                     "p50/p90/p99 reservation tardiness from the "
                     "device ledger ('off' measures the overhead)")
+    ap.add_argument("--slo", choices=["on", "off"], default="on",
+                    help="accumulate the device-resident SLO window "
+                    "block (obs.slo) inside the timed sustained "
+                    "rounds (donated carry, one window per timed "
+                    "chain, fetched untimed) and judge it with the "
+                    "burn-rate evaluator (obs.alerts); decisions are "
+                    "bit-identical either way, and the JSON line "
+                    "carries a per-workload 'slo' block (violation "
+                    "counts, worst-window share error, p99 window "
+                    "tardiness).  'off' measures the overhead")
     ap.add_argument("--conformance-out", metavar="FILE", default=None,
                     help="write the cfg4 per-client conformance table "
                     "as JSONL")
@@ -1474,6 +1608,7 @@ def main() -> None:
     backend_fallback = None   # "dispatch" after a launch-time switch
     wm = args.device_metrics == "on"
     tele_on = args.telemetry == "on"
+    slo_on = args.slo == "on"
     if args.trace_out:
         args.spans = True
     tracer = obsspans.SpanTracer() if args.spans else None
@@ -1633,7 +1768,8 @@ def main() -> None:
                         select_impl=select_impl,
                         engine_loop=loop,
                         stream_chunk=args.stream_chunk,
-                        telemetry=tele_on, tracer=tracer))
+                        telemetry=tele_on, slo=slo_on,
+                        tracer=tracer))
         if args.mode == "churn" or \
                 (args.mode == "all" and backend != "cpu"):
             # open-population churn scenario (docs/LIFECYCLE.md).  An
@@ -1647,7 +1783,8 @@ def main() -> None:
                 else dict(total_ids=4096, epochs=64, k=256)
             key = f"churn_{args.churn_scenario}"
             results[key] = bench_churn(args.churn_scenario,
-                                       tracer=tracer, **churn_shape)
+                                       slo=slo_on, tracer=tracer,
+                                       **churn_shape)
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster
@@ -1682,7 +1819,8 @@ def main() -> None:
                             engine_loop=loop,
                             stream_chunk=args.stream_chunk,
                             conformance_out=args.conformance_out,
-                            telemetry=tele_on, tracer=tracer))
+                            telemetry=tele_on, slo=slo_on,
+                            tracer=tracer))
                     key = "cfg4" if eff["calendar_impl"] == "minstop" \
                         else "cfg4_bucketed"
                     if loop == "stream":
@@ -1804,6 +1942,24 @@ def main() -> None:
                                          publish_span_gauges)
             publish_span_gauges(default_registry(), row["spans"],
                                 labels={"workload": wl})
+        if "slo" in row:
+            # per-workload SLO verdicts as labelled gauges on the
+            # same scrape endpoint (dmclock_slo_* family names)
+            from dmclock_tpu.obs import default_registry
+            reg = default_registry()
+            for key, name in (
+                    ("violations_total",
+                     "dmclock_slo_violations_total"),
+                    ("worst_window_share_err",
+                     "dmclock_slo_worst_window_share_err"),
+                    ("window_tardiness_p99_ns",
+                     "dmclock_slo_window_tardiness_p99_ns"),
+                    ("windows_closed",
+                     "dmclock_slo_windows_closed_total")):
+                reg.gauge(name, "per-workload SLO plane verdict "
+                          "(docs/OBSERVABILITY.md SLO plane)",
+                          labels={"workload": wl}) \
+                    .set(float(row["slo"].get(key, 0)))
 
     try:
         _record_history(results, fault_plan=args.fault_plan,
@@ -1857,6 +2013,10 @@ def main() -> None:
                  if "spans" in row}
     if span_rows:
         final["spans"] = span_rows
+    slo_rows = {wl: row["slo"] for wl, row in results.items()
+                if "slo" in row}
+    if slo_rows:
+        final["slo"] = slo_rows
     tard = {wl: {"p50": row["tardiness_p50_ns"],
                  "p90": row["tardiness_p90_ns"],
                  "p99": row["tardiness_p99_ns"],

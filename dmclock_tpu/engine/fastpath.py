@@ -79,6 +79,7 @@ from ..core.timebase import MAX_TAG, MIN_TAG
 from ..obs import device as obsdev
 from ..obs import flight as obsflight
 from ..obs import histograms as obshist
+from ..obs import slo as obsslo
 from . import kernels
 from .kernels import (KEY_INF, NONE, RETURNING, Decision, _make_tag,
                       _fold_prev)
@@ -351,6 +352,10 @@ class ChainServe(NamedTuple):
     prev_arrival: jnp.ndarray
     exit_cls: jnp.ndarray     # int32[N] unified class after the chain
     exit_key: jnp.ndarray     # int64[N] unified key after the chain
+    cost_acc: jnp.ndarray     # int64[N] summed cost of the chain's
+    #                           serves (garbage outside the committed
+    #                           set, masked at commit like every other
+    #                           dense chain field)
 
 
 def _chain_serve(state: EngineState, now, arr_rows, cost_rows,
@@ -384,6 +389,7 @@ def _chain_serve(state: EngineState, now, arr_rows, cost_rows,
     depth = state.depth
     qadv = jnp.zeros_like(state.q_head)
     length = jnp.zeros_like(state.q_head)
+    cost_acc = jnp.zeros_like(h_resv)
     cont = is_cand
 
     for j in range(depth_cap):
@@ -404,6 +410,9 @@ def _chain_serve(state: EngineState, now, arr_rows, cost_rows,
         has_more = new_depth > 0
         upd = cont
         updh = cont & has_more
+        # delivered-cost accumulation (the SLO window block's cost
+        # column): the head served at this step is the CURRENT h_cost
+        cost_acc = cost_acc + jnp.where(upd, h_cost, jnp.int64(0))
 
         new_h_resv = nr - off
         pr = jnp.where(has_more, _fold_prev(p_resv, nr), p_resv) - off
@@ -447,7 +456,8 @@ def _chain_serve(state: EngineState, now, arr_rows, cost_rows,
         head_arrival=h_arr, head_cost=h_cost, head_rho=h_rho,
         prev_resv=p_resv, prev_prop=p_prop, prev_limit=p_limit,
         prev_arrival=p_arr,
-        exit_cls=exit_cls.astype(jnp.int32), exit_key=exit_key)
+        exit_cls=exit_cls.astype(jnp.int32), exit_key=exit_key,
+        cost_acc=cost_acc)
 
 
 def _commit_chains(state: EngineState, sel,
@@ -567,6 +577,8 @@ class _Selection(NamedTuple):
     guards_ok: jnp.ndarray   # bool
     state: EngineState       # after the committed prefix
     last_client: jnp.ndarray  # int32 slot of the final committed unit
+    cost_pc: jnp.ndarray     # int64[N] delivered cost per client over
+    #                          the committed prefix (0 off-prefix)
 
 
 def _unified_prefix(state: EngineState, now, k: int, *,
@@ -721,7 +733,9 @@ def _unified_prefix(state: EngineState, now, k: int, *,
     return _Selection(idxs=idxs, cls_s=cls_s, cost_s=costs, len_s=lens,
                       count_units=count_units, count=count,
                       guards_ok=guards_ok, state=new_state,
-                      last_client=last_client)
+                      last_client=last_client,
+                      cost_pc=jnp.where(sel, chain.cost_acc,
+                                        jnp.int64(0)))
 
 
 # ----------------------------------------------------------------------
@@ -738,6 +752,8 @@ class PrefixBatch(NamedTuple):
     #                         False count is 0 and the caller must use
     #                         the serial engine for this batch
     decisions: Decision    # [k]; slots -1 / type NONE past `count`
+    cost_pc: object = None  # int64[N] delivered cost per client (the
+    #                         SLO window block's cost column feed)
 
 
 def speculate_prefix_batch(state: EngineState, now, k: int, *,
@@ -773,7 +789,8 @@ def speculate_prefix_batch(state: EngineState, now, k: int, *,
         limit_break=served & (s.cls_s >= CLS_LB),
     )
     return PrefixBatch(state=s.state, count=s.count,
-                       guards_ok=s.guards_ok, decisions=decisions)
+                       guards_ok=s.guards_ok, decisions=decisions,
+                       cost_pc=s.cost_pc)
 
 
 # ----------------------------------------------------------------------
@@ -795,6 +812,7 @@ class ChainBatch(NamedTuple):
     slot: jnp.ndarray        # int32[k] unit client (-1 pad)
     cls: jnp.ndarray         # int32[k] unit entry class
     length: jnp.ndarray      # int32[k] unit decisions
+    cost_pc: object = None   # int64[N] delivered cost per client
 
 
 def speculate_chain_batch(state: EngineState, now, k: int, *,
@@ -819,7 +837,8 @@ def speculate_chain_batch(state: EngineState, now, k: int, *,
         guards_ok=s.guards_ok,
         slot=jnp.where(served, s.idxs, -1).astype(jnp.int32),
         cls=jnp.where(served, s.cls_s, CLS_NONE).astype(jnp.int32),
-        length=jnp.where(served, s.len_s, 0).astype(jnp.int32))
+        length=jnp.where(served, s.len_s, 0).astype(jnp.int32),
+        cost_pc=s.cost_pc)
 
 
 def expand_units(slot, cls, length, pre_state, *,
@@ -993,6 +1012,7 @@ class PrefixEpoch(NamedTuple):
     hists: object = None   # int64[NUM_HISTS, NUM_BUCKETS+1]
     ledger: object = None  # int64[N, LED_COLS]
     flight: object = None  # obs.flight.FlightState
+    slo: object = None     # int64[N, W_FIELDS] window block (obs.slo)
 
 
 def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
@@ -1029,26 +1049,31 @@ def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
 
 def _telemetry_delta(st_post: EngineState, now, cls, key, served_pc,
                      resv_pc, lb_pc, count, with_hists: bool,
-                     with_ledger: bool):
-    """One batch/level's telemetry contribution (``obs.histograms``):
-    pure reductions over the entry classification the batch already
-    computed and the pre/post depth delta, so the decision stream
-    cannot be perturbed.  Returns ``(hist_delta | None,
-    ledger_delta | None)``; the caller folds them gated on batch
-    liveness (the tag32 dead-batch rule, exactly like
-    ``_batch_metrics``).
+                     with_ledger: bool, cost_pc=None,
+                     with_slo: bool = False):
+    """One batch/level's telemetry contribution (``obs.histograms`` /
+    ``obs.slo``): pure reductions over the entry classification the
+    batch already computed and the pre/post depth delta, so the
+    decision stream cannot be perturbed.  Returns ``(hist_delta |
+    None, ledger_delta | None, slo_delta | None)``; the caller folds
+    them gated on batch liveness (the tag32 dead-batch rule, exactly
+    like ``_batch_metrics``).
 
     Tardiness/latency are ENTRY-HEAD observations: ``max(now - key,
     0)`` against the committed unit's unified entry key -- the
     reservation deadline for class-0 entries, the effective proportion
     tag for class-1/2 entries (0 = served at/ahead of its virtual
     tag).  The stall observation is the time until the earliest queued
-    head becomes eligible, read from the post-batch state."""
+    head becomes eligible, read from the post-batch state.
+    ``cost_pc`` (required with ``with_slo``) is the per-client
+    delivered cost the batch committed -- the window block's cost
+    column shares the ledger's entry-head tardiness semantics, so the
+    windowed-vs-cumulative cross-check can hold exactly."""
     m = served_pc > 0
     tard = jnp.maximum(jnp.asarray(now, jnp.int64) - key, 0)
     resv_entry = m & (cls == CLS_RESV)
     w_entry = m & (cls >= CLS_WEIGHT) & (cls < CLS_NONE)
-    hd = ld = None
+    hd = ld = sd = None
     if with_hists:
         hd = obshist.hist_zero()
         hd = obshist.hist_observe(hd, obshist.HIST_DECISION_LATENCY,
@@ -1065,16 +1090,24 @@ def _telemetry_delta(st_post: EngineState, now, cls, key, served_pc,
             jnp.maximum(next_elig - now, 0), stalled)
         hd = obshist.hist_observe_scalar(
             hd, obshist.HIST_COMMIT_SIZE, count.astype(jnp.int64), 1)
-    if with_ledger:
+    if with_ledger or with_slo:
         t = jnp.where(resv_entry, tard, 0)
+    if with_ledger:
         ld = jnp.stack([served_pc.astype(jnp.int64),
                         resv_pc.astype(jnp.int64),
                         lb_pc.astype(jnp.int64), t, t], axis=1)
-    return hd, ld
+    if with_slo:
+        assert cost_pc is not None, \
+            "the SLO window block needs the per-client delivered cost"
+        tardy = (resv_entry & (tard > 0)).astype(jnp.int64)
+        sd = obsslo.window_delta(served_pc, cost_pc, resv_pc, tardy,
+                                 lb_pc, t)
+    return hd, ld, sd
 
 
-def _tele_init(state: EngineState, hists, ledger, flight) -> dict:
-    """Normalize the three optional telemetry accumulators into the
+def _tele_init(state: EngineState, hists, ledger, flight,
+               slo=None) -> dict:
+    """Normalize the four optional telemetry accumulators into the
     tele carry dict (presence of a key IS the static on-flag)."""
     tele = {}
     if hists is not None:
@@ -1087,37 +1120,47 @@ def _tele_init(state: EngineState, hists, ledger, flight) -> dict:
         tele["l"] = ledger
     if flight is not None:
         tele["f"] = flight
+    if slo is not None:
+        slo = jnp.asarray(slo, dtype=jnp.int64)
+        assert slo.shape == (state.capacity, obsslo.W_FIELDS), \
+            f"slo window shape {slo.shape} != " \
+            f"({state.capacity}, {obsslo.W_FIELDS})"
+        tele["s"] = slo
     return tele
 
 
-def _tele_fold(tele: dict, hd, ld, live) -> dict:
-    """Fold one batch's histogram/ledger deltas, gated on liveness."""
+def _tele_fold(tele: dict, hd, ld, live, sd=None) -> dict:
+    """Fold one batch's histogram/ledger/window deltas, gated on
+    liveness."""
     out = dict(tele)
     if "h" in tele:
         out["h"] = obshist.hist_fold(tele["h"], hd, live)
     if "l" in tele:
         out["l"] = obshist.ledger_fold(tele["l"], ld, live)
+    if "s" in tele:
+        out["s"] = obsslo.window_fold(tele["s"], sd, live)
     return out
 
 
 def _tele_entry_fold(tele: dict, st: EngineState, post_state,
-                     now, allow: bool, count, live):
+                     now, allow: bool, count, live, cost_pc=None):
     """The shared prefix/chain telemetry fold: batch-entry
     classification, depth-delta served counts, the entry-head
-    resv/limit-break derivation, and the gated histogram/ledger fold
-    -- ONE implementation so the two sorted engines' entry-head
+    resv/limit-break derivation, and the gated histogram/ledger/window
+    fold -- ONE implementation so the two sorted engines' entry-head
     semantics cannot drift.  Returns ``(tele, key_e)`` (the entry
     keys feed each engine's own flight record)."""
     cls_e, key_e = _classify(st, now, allow)
     served_pc = (st.depth - post_state.depth).astype(jnp.int32)
     srv = served_pc > 0
     w_entry = srv & (cls_e >= CLS_WEIGHT) & (cls_e < CLS_NONE)
-    hd, ld = _telemetry_delta(
+    hd, ld, sd = _telemetry_delta(
         post_state, now, cls_e, key_e, served_pc,
         served_pc - w_entry.astype(jnp.int32),
         (srv & (cls_e == CLS_LB)).astype(jnp.int32),
-        count, "h" in tele, "l" in tele)
-    return _tele_fold(tele, hd, ld, live), key_e
+        count, "h" in tele, "l" in tele,
+        cost_pc=cost_pc, with_slo="s" in tele)
+    return _tele_fold(tele, hd, ld, live, sd), key_e
 
 
 def _tele_flight(tele: dict, slot, cls, tag, cost, live) -> dict:
@@ -1137,7 +1180,7 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
                       tag_width: int = 64,
                       window_m: int | None = None,
                       hists=None, ledger=None,
-                      flight=None) -> PrefixEpoch:
+                      flight=None, slo=None) -> PrefixEpoch:
     """Run m flat prefix-commit batches of up to k decisions on device.
 
     EVERY batch commits its own exact prefix, so the concatenated
@@ -1171,15 +1214,16 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
     (the chain's cost scales with the window width -- PROFILE.md).
     Must divide m; None = one m-row window (the original layout).
 
-    ``hists`` / ``ledger`` / ``flight`` (each None = off; presence is
-    the static flag) are INITIAL telemetry accumulators
+    ``hists`` / ``ledger`` / ``flight`` / ``slo`` (each None = off;
+    presence is the static flag) are INITIAL telemetry accumulators
     (``obs.histograms.hist_zero()`` / ``ledger_zero(N)`` /
-    ``obs.flight.flight_init(R)`` or the previous epoch's outputs, so
-    chained epochs accumulate on device with one final fetch).  They
-    ride the scan carry next to the metrics vector and come back as
-    the epoch result's ``hists``/``ledger``/``flight`` fields; the
-    decision stream and final state are bit-identical with telemetry
-    on or off (tests/test_telemetry.py).
+    ``obs.flight.flight_init(R)`` / ``obs.slo.window_zero(N)`` or the
+    previous epoch's outputs, so chained epochs accumulate on device
+    with one final fetch).  They ride the scan carry next to the
+    metrics vector and come back as the epoch result's
+    ``hists``/``ledger``/``flight``/``slo`` fields; the decision
+    stream and final state are bit-identical with telemetry on or off
+    (tests/test_telemetry.py, tests/test_slo.py).
     """
     assert tag_width in (32, 64), tag_width
     w = m if window_m is None else min(int(window_m), m)
@@ -1188,7 +1232,7 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     met0 = obsdev.metrics_zero()
-    tele0 = _tele_init(state, hists, ledger, flight)
+    tele0 = _tele_init(state, hists, ledger, flight, slo)
     need_class = bool(tele0)
     if narrow32:
         tc = _TagCarry32(state)
@@ -1243,7 +1287,7 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
             # cheap dense pass; the decision stream is untouched)
             tele, key_e = _tele_entry_fold(
                 tele, st, batch.state, now, allow_limit_break,
-                batch.count, good)
+                batch.count, good, cost_pc=batch.cost_pc)
             tele = _tele_flight(
                 tele, slot,
                 phase.astype(jnp.int64) + lb.astype(jnp.int64),
@@ -1275,7 +1319,8 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
     return PrefixEpoch(state=state, count=count, guards_ok=guards,
                        slot=slot, phase=phase, cost=cost, lb=lb,
                        metrics=metrics, hists=tele.get("h"),
-                       ledger=tele.get("l"), flight=tele.get("f"))
+                       ledger=tele.get("l"), flight=tele.get("f"),
+                       slo=tele.get("s"))
 
 
 class ChainEpoch(NamedTuple):
@@ -1294,6 +1339,7 @@ class ChainEpoch(NamedTuple):
     hists: object = None
     ledger: object = None
     flight: object = None
+    slo: object = None
 
 
 def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
@@ -1304,7 +1350,7 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
                      select_impl: str = "sort",
                      tag_width: int = 64,
                      hists=None, ledger=None,
-                     flight=None) -> ChainEpoch:
+                     flight=None, slo=None) -> ChainEpoch:
     """Run m chained prefix batches on device.  Each batch prefetches
     its own ``chain_depth``-row ring window (one barrel-shift ring
     pass per batch; a shared per-epoch window would need m *
@@ -1319,7 +1365,7 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     met0 = obsdev.metrics_zero()
-    tele0 = _tele_init(state, hists, ledger, flight)
+    tele0 = _tele_init(state, hists, ledger, flight, slo)
     need_class = bool(tele0)
     if narrow32:
         tc = _TagCarry32(state)
@@ -1378,7 +1424,7 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
         if need_class:
             tele, key_e = _tele_entry_fold(
                 tele, st, batch.state, now, allow_limit_break,
-                batch.count, good)
+                batch.count, good, cost_pc=batch.cost_pc)
             tele = _tele_flight(
                 tele, slot, cls.astype(jnp.int64),
                 jnp.take(key_e, jnp.maximum(slot, 0)),
@@ -1399,7 +1445,7 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
                       guards_ok=guards, slot=slot, cls=cls,
                       length=length, metrics=metrics,
                       hists=tele.get("h"), ledger=tele.get("l"),
-                      flight=tele.get("f"))
+                      flight=tele.get("f"), slo=tele.get("s"))
 
 
 def make_prefix_runner(k: int, *, anticipation_ns: int = 0,
@@ -1479,6 +1525,7 @@ class CalendarBatch(NamedTuple):
     served_resv: jnp.ndarray  # int32[N] constraint decisions
     lb: jnp.ndarray           # int32[N] limit-break entries (Allow)
     progress_ok: jnp.ndarray  # bool: count>0 or no candidate existed
+    served_cost: object = None  # int64[N] delivered cost per client
 
 
 def _cal_pack(cls, key, kresv, kprop1, kprop2):
@@ -1516,6 +1563,7 @@ def _calendar_pass(state: EngineState, now, arr_rows, cost_rows,
         p_limit=state.prev_limit, p_arr=state.prev_arrival,
         depth=state.depth,
         qadv=jnp.zeros_like(state.q_head),
+        cost=jnp.zeros_like(state.head_cost),
         alive=jnp.ones((n,), dtype=bool),
         in_unit=jnp.zeros((n,), dtype=bool),
         stop_pk=jnp.full((n,), jnp.int64(KEY_INF)),
@@ -1590,6 +1638,10 @@ def _calendar_pass(state: EngineState, now, arr_rows, cost_rows,
             depth=jnp.where(upd, new_depth,
                             c["depth"]).astype(jnp.int32),
             qadv=(c["qadv"] + updh).astype(jnp.int32),
+            # delivered cost: the head served at this step is the
+            # CURRENT h_cost (the SLO window block's cost column)
+            cost=c["cost"] + jnp.where(serve, c["h_cost"],
+                                       jnp.int64(0)),
             alive=alive,
             in_unit=serve & cont_cls & has_more & (new_h_resv <= now),
             stop_pk=stop_pk,
@@ -1634,7 +1686,8 @@ def _calendar_pass(state: EngineState, now, arr_rows, cost_rows,
                   prev_limit=c["p_limit"], prev_arrival=c["p_arr"],
                   depth=c["depth"])
     return (fields, c["qadv"], c["units"], c["served"],
-            c["served_resv"], c["lb"], c["prev_pk"], c["unit_cls"])
+            c["served_resv"], c["lb"], c["prev_pk"], c["unit_cls"],
+            c["cost"])
 
 
 def _calendar_batch_core(state: EngineState, now, arr_rows, cost_rows,
@@ -1666,9 +1719,9 @@ def _calendar_batch_core(state: EngineState, now, arr_rows, cost_rows,
                              kresv, kprop1, kprop2, None)
     b_eff = jnp.min(stop_pk)
     (fields, qadv, units, served, served_resv, lb, last_pk,
-     last_cls) = _calendar_pass(state, now, arr_rows, cost_rows,
-                                allow_limit_break, anticipation_ns,
-                                kresv, kprop1, kprop2, b_eff)
+     last_cls, cost_pc) = _calendar_pass(
+         state, now, arr_rows, cost_rows, allow_limit_break,
+         anticipation_ns, kresv, kprop1, kprop2, b_eff)
 
     did = served > 0
     popped = did & (qadv > 0)
@@ -1722,7 +1775,8 @@ def _calendar_batch_core(state: EngineState, now, arr_rows, cost_rows,
         state=new_state, count=count,
         resv_count=jnp.sum(served_resv).astype(jnp.int32),
         units=units, served=served, served_resv=served_resv, lb=lb,
-        progress_ok=(count > 0) | ~any_cand)
+        progress_ok=(count > 0) | ~any_cand,
+        served_cost=jnp.where(served > 0, cost_pc, jnp.int64(0)))
     return batch, b_eff, stop_pk
 
 
@@ -1813,13 +1867,15 @@ class CalendarLadderBatch(NamedTuple):
     #                           present (a mid-ladder stall wastes the
     #                           remaining levels; metric row
     #                           calendar_ladder_fallbacks)
+    served_cost: object = None  # int64[N] delivered cost (all levels)
 
 
 def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
                           steps: int, levels: int,
                           anticipation_ns: int, allow: bool,
                           use_pallas, with_hists: bool = False,
-                          with_ledger: bool = False):
+                          with_ledger: bool = False,
+                          with_slo: bool = False):
     """The fused ladder: a lax.scan over L levels, each a full
     window-prefetch + measure + histogram boundary + commit from the
     previous level's committed state.  Carries only the mutable epoch
@@ -1835,12 +1891,15 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
     acc0 = dict(units=jnp.zeros((n,), jnp.int32),
                 served=jnp.zeros((n,), jnp.int32),
                 served_resv=jnp.zeros((n,), jnp.int32),
-                lb=jnp.zeros((n,), jnp.int32))
+                lb=jnp.zeros((n,), jnp.int32),
+                cost=jnp.zeros((n,), jnp.int64))
     tacc0 = {}
     if with_hists:
         tacc0["h"] = obshist.hist_zero()
     if with_ledger:
         tacc0["l"] = obshist.ledger_zero(n)
+    if with_slo:
+        tacc0["s"] = obsslo.window_zero(n)
 
     def level(carry, _):
         mut, acc, tacc = carry
@@ -1854,22 +1913,26 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
         acc = dict(units=acc["units"] + batch.units,
                    served=acc["served"] + batch.served,
                    served_resv=acc["served_resv"] + batch.served_resv,
-                   lb=acc["lb"] + batch.lb)
-        if with_hists or with_ledger:
+                   lb=acc["lb"] + batch.lb,
+                   cost=acc["cost"] + batch.served_cost)
+        if with_hists or with_ledger or with_slo:
             # per-LEVEL entry classification: level i starts from the
             # exact serial state at boundary i-1, so these are the
             # same observations L sequential minstop batches would
             # record
             cls_e, key_e = _classify(st, now, allow)
-            hd, ld = _telemetry_delta(
+            hd, ld, sd = _telemetry_delta(
                 batch.state, now, cls_e, key_e, batch.served,
                 batch.served_resv, batch.lb, batch.count,
-                with_hists, with_ledger)
+                with_hists, with_ledger,
+                cost_pc=batch.served_cost, with_slo=with_slo)
             tacc = dict(tacc)
             if with_hists:
                 tacc["h"] = obshist.hist_combine(tacc["h"], hd)
             if with_ledger:
                 tacc["l"] = obshist.ledger_combine(tacc["l"], ld)
+            if with_slo:
+                tacc["s"] = obsslo.window_combine(tacc["s"], sd)
         # a level that commits nothing WITH candidates present is a
         # ladder stall: progress_ok's per-level analog (later levels
         # deterministically repeat it -- same state, same boundary)
@@ -1912,7 +1975,8 @@ def calendar_batch_bucketed(state: EngineState, now, *, steps: int,
         units=acc["units"], served=acc["served"],
         served_resv=acc["served_resv"], lb=acc["lb"],
         progress_ok=~stall[0],
-        level_count=count, level_bound=bound, level_stall=stall)
+        level_count=count, level_bound=bound, level_stall=stall,
+        served_cost=acc["cost"])
 
 
 def calendar_stop_ladder(state: EngineState, now, *, steps: int,
@@ -1963,6 +2027,7 @@ class CalendarEpoch(NamedTuple):
     hists: object = None
     ledger: object = None
     flight: object = None
+    slo: object = None
 
 
 def scan_calendar_epoch(state: EngineState, now, m: int, *,
@@ -1974,7 +2039,7 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                         calendar_impl: str = "minstop",
                         ladder_levels: int = 8,
                         hists=None, ledger=None,
-                        flight=None) -> CalendarEpoch:
+                        flight=None, slo=None) -> CalendarEpoch:
     """Run m calendar batches on device (each prefetches its own
     ``steps``-row ring window).  ``tag_width`` as in
     :func:`scan_prefix_epoch` (a window trip reports
@@ -2006,7 +2071,7 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     served0 = jnp.zeros((state.capacity,), dtype=jnp.int32)
     met0 = obsdev.metrics_zero()
-    tele0 = _tele_init(state, hists, ledger, flight)
+    tele0 = _tele_init(state, hists, ledger, flight, slo)
     need_tele = bool(tele0)
     if narrow32:
         tc = _TagCarry32(state)
@@ -2025,7 +2090,7 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
         else:
             mut, acc, met, tele = carry
             st = EngineState(**invariant, **mut)
-        hd = ld = None
+        hd = ld = sd = None
         if need_tele:
             # batch-entry classification, shared by the minstop
             # telemetry delta and the flight records (ONE definition,
@@ -2041,8 +2106,10 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                     invariant, mut_in, now, steps=steps,
                     levels=levels, anticipation_ns=anticipation_ns,
                     allow=allow_limit_break, use_pallas=use_pallas,
-                    with_hists="h" in tele, with_ledger="l" in tele)
-            hd, ld = tdelta.get("h"), tdelta.get("l")
+                    with_hists="h" in tele, with_ledger="l" in tele,
+                    with_slo="s" in tele)
+            hd, ld, sd = (tdelta.get("h"), tdelta.get("l"),
+                          tdelta.get("s"))
             batch_state = EngineState(**invariant, **new_mut)
             count = jnp.sum(lvl_count).astype(jnp.int32)
             resv_count = jnp.sum(lvl_resv).astype(jnp.int32)
@@ -2071,11 +2138,12 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
             base_decs = count.astype(jnp.int64)
             new_mut = {f: getattr(batch.state, f)
                        for f in _EPOCH_MUTABLE}
-            if "h" in tele or "l" in tele:
-                hd, ld = _telemetry_delta(
+            if "h" in tele or "l" in tele or "s" in tele:
+                hd, ld, sd = _telemetry_delta(
                     batch.state, now, cls_e, key_e, batch.served,
                     batch.served_resv, batch.lb, batch.count,
-                    "h" in tele, "l" in tele)
+                    "h" in tele, "l" in tele,
+                    cost_pc=batch.served_cost, with_slo="s" in tele)
         trip = jnp.bool_(False)
         good = jnp.bool_(True)
         if narrow32:
@@ -2106,7 +2174,7 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                 ladder_base_decisions=base_decs,
                 ladder_fallbacks=ladder_fb)
         if need_tele:
-            tele = _tele_fold(tele, hd, ld, good)
+            tele = _tele_fold(tele, hd, ld, good, sd)
             if "f" in tele:
                 # per-client-per-batch records (the calendar engine
                 # emits counts, not a stream); GATED served, so a
@@ -2133,7 +2201,7 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                          progress_ok=ok, served=served,
                          metrics=metrics, level_count=lvls,
                          hists=tele.get("h"), ledger=tele.get("l"),
-                         flight=tele.get("f"))
+                         flight=tele.get("f"), slo=tele.get("s"))
 
 
 # ----------------------------------------------------------------------

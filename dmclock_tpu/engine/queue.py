@@ -293,6 +293,27 @@ class TpuPullPriorityQueue:
         # exact, and the sims cross-check them against their own
         # host-recomputed conformance tables (docs/OBSERVABILITY.md)
         self._ledger = np.zeros((capacity, 5), dtype=np.int64)
+        # host-side SLO window mirror (obs.slo W_* layout; docs/
+        # OBSERVABILITY.md "SLO plane"): the push/pull queue's
+        # windowed analog of the epoch engines' device block.  The
+        # countable columns (ops / cost / resv / limit-break) are
+        # exact; the tardiness columns stay 0 for the same reason the
+        # ledger's do.  ``update_client_info`` and slot creation bump
+        # the per-slot contract-epoch counter, so rolled windows
+        # attribute to exactly one contract version; embedders roll
+        # via roll_slo_windows() on whatever cadence they serve.
+        from ..obs import slo as _obsslo
+        self._W = _obsslo
+        self._slo_win = np.zeros((capacity, _obsslo.W_FIELDS),
+                                 dtype=np.int64)
+        self._slo_cepoch = np.zeros(capacity, dtype=np.int64)
+        self.slo_window_rolls = 0
+        # last-applied QoS inverses per slot: the contract-epoch bump
+        # must fire on a REAL ClientInfo change, not on every
+        # update_client_infos() refresh sweep (an unchanged-triple
+        # bump would fragment the (client, contract_version) series
+        # the epoch counter exists to keep whole)
+        self._qos_inv: Dict[int, Tuple[int, int, int]] = {}
 
         # guarded-commit telemetry (docs/ROBUSTNESS.md): launches
         # retried after a transient device error, and adds rejected
@@ -418,6 +439,13 @@ class TpuPullPriorityQueue:
         self._ledger = np.vstack(
             [self._ledger,
              np.zeros((new_n - old_n, 5), dtype=np.int64)])
+        self._slo_win = np.vstack(
+            [self._slo_win,
+             np.zeros((new_n - old_n, self._W.W_FIELDS),
+                      dtype=np.int64)])
+        self._slo_cepoch = np.concatenate(
+            [self._slo_cepoch,
+             np.zeros(new_n - old_n, dtype=np.int64)])
         self._free.extend(range(new_n - 1, old_n - 1, -1))
 
     def _grow_ring(self) -> None:
@@ -505,6 +533,16 @@ class TpuPullPriorityQueue:
                 self._lim_inv[slot] = info.limit_inv_ns
                 self._lim_prev[slot] = 0
                 self._lim_prev_arr[slot] = 0
+                # a fresh tenancy is a fresh contract version; the
+                # per-slot counter is monotone across recycling so
+                # versions never repeat (obs.slo discipline)
+                self._slo_cepoch[slot] += 1
+                self._slo_win[slot] = 0
+                self._slo_win[slot, self._W.W_CEPOCH] = \
+                    self._slo_cepoch[slot]
+                self._qos_inv[slot] = (info.reservation_inv_ns,
+                                       info.weight_inv_ns,
+                                       info.limit_inv_ns)
             if self.at_limit is AtLimit.REJECT:
                 # host immediate-mode limit mirror (module docstring):
                 # the axis recurrence depends only on add-time inputs,
@@ -554,10 +592,14 @@ class TpuPullPriorityQueue:
             client = self._client_of[dslot]
             request, _arr, _cost = self._payloads[dslot].popleft()
             led = self._ledger[dslot]
+            win = self._slo_win[dslot]
             led[0] += 1                      # LED_OPS
+            win[self._W.W_OPS] += 1
+            win[self._W.W_COST] += int(dcost)
             if dphase == 0:
                 self.reserv_sched_count += 1
                 led[1] += 1                  # LED_RESV_OPS
+                win[self._W.W_RESV_OPS] += 1
                 phase = Phase.RESERVATION
             else:
                 self.prop_sched_count += 1
@@ -565,6 +607,7 @@ class TpuPullPriorityQueue:
             if dlimit_break:
                 self.limit_break_sched_count += 1
                 led[2] += 1                  # LED_LIMIT_BREAKS
+                win[self._W.W_LB_OPS] += 1
             self._last_tick[dslot] = self.tick
             return PullReq(NextReqType.RETURNING, client=client,
                            request=request, phase=phase, cost=int(dcost))
@@ -911,6 +954,41 @@ class TpuPullPriorityQueue:
             return {cid: self._ledger[slot].copy()
                     for cid, slot in self._slot_of.items()}
 
+    def slo_window_rows(self) -> Dict[Any, np.ndarray]:
+        """The OPEN window per live client (client id -> int64
+        ``obs.slo`` W_* row): the push/pull queue's host mirror of the
+        device window block -- countable columns exact, tardiness
+        columns 0 (the ledger_rows caveat applies)."""
+        with self.data_mtx:
+            return {cid: self._slo_win[slot].copy()
+                    for cid, slot in self._slot_of.items()}
+
+    def roll_slo_windows(self) -> List[dict]:
+        """Close the open window of every live client with activity:
+        returns ``[{client, contract_epoch, ops, cost, resv_ops,
+        lb_ops}]`` rows and zeroes the counters (the contract-epoch
+        stamp survives).  Embedders call this on their own serving
+        cadence; a client updated mid-window reports its whole window
+        against the version live at close (the epoch engines avoid
+        even that by pinning rolls to the lifecycle boundary grid)."""
+        W = self._W
+        with self.data_mtx:
+            out = []
+            for cid, slot in sorted(self._slot_of.items(),
+                                    key=lambda kv: kv[1]):
+                row = self._slo_win[slot]
+                if not row[:W.W_CEPOCH].any():
+                    continue
+                out.append({"client": cid,
+                            "contract_epoch": int(row[W.W_CEPOCH]),
+                            "ops": int(row[W.W_OPS]),
+                            "cost": int(row[W.W_COST]),
+                            "resv_ops": int(row[W.W_RESV_OPS]),
+                            "lb_ops": int(row[W.W_LB_OPS])})
+                row[:W.W_CEPOCH] = 0
+            self.slo_window_rolls += 1
+            return out
+
     # ------------------------------------------------------------------
     # inspection (host mirrors; reference :545-564)
     # ------------------------------------------------------------------
@@ -994,6 +1072,21 @@ class TpuPullPriorityQueue:
                 resv_inv=st.resv_inv.at[slot].set(info.reservation_inv_ns),
                 weight_inv=st.weight_inv.at[slot].set(info.weight_inv_ns),
                 limit_inv=st.limit_inv.at[slot].set(info.limit_inv_ns))
+            # a live ClientInfo replacement is a new contract version
+            # -- but only a REAL one: refresh sweeps
+            # (update_client_infos) re-apply unchanged triples, and
+            # bumping on those would fragment the version series.
+            # The open window keeps accumulating (it spans the
+            # update; the NEXT roll attributes it to the stamped
+            # epoch, which is the version live at close -- embedders
+            # that need clean attribution roll right before updating)
+            triple = (info.reservation_inv_ns, info.weight_inv_ns,
+                      info.limit_inv_ns)
+            if self._qos_inv.get(slot) != triple:
+                self._qos_inv[slot] = triple
+                self._slo_cepoch[slot] += 1
+                self._slo_win[slot, self._W.W_CEPOCH] = \
+                    self._slo_cepoch[slot]
 
     def update_client_infos(self) -> None:
         for client_id in list(self._slot_of):
@@ -1137,6 +1230,11 @@ class TpuPullPriorityQueue:
                     self._departed.append((client,
                                            self._ledger[slot].copy()))
                     self._ledger[slot] = 0
+                    # the open SLO window goes with the tenancy (its
+                    # cumulative history is the ledger row above); the
+                    # contract-epoch counter stays monotone so the
+                    # next tenant gets a fresh version
+                    self._slo_win[slot] = 0
                     self._free.append(slot)
             if len(erase_slots) < self.erase_max:
                 self._last_erase_point = 0

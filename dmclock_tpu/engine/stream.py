@@ -68,6 +68,7 @@ class StreamChunk(NamedTuple):
     hists: object = None
     ledger: object = None
     flight: object = None
+    slo: object = None
 
 
 # per-engine stacked output fields, in the epoch-result class's field
@@ -145,21 +146,22 @@ def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
     fields = STREAM_OUT_FIELDS[engine]
 
     def chunk(state: EngineState, epoch0, counts, hists=None,
-              ledger=None, flight=None) -> StreamChunk:
+              ledger=None, flight=None, slo=None) -> StreamChunk:
         epoch0 = jnp.asarray(epoch0, dtype=jnp.int64)
 
         def body(carry, xs):
-            st, h, l, f = carry
+            st, h, l, f, s = carry
             counts_e, i = xs
             t_base = (epoch0 + i) * dt
             if ingest:
                 st = clamped_ingest(st, counts_e, t_base,
                                     waves=waves, dt_wave=dt_wave)
             ep = fn(st, t_base + dt, m=m, **kw,
-                    hists=h, ledger=l, flight=f)
+                    hists=h, ledger=l, flight=f, slo=s)
             outs = {name: getattr(ep, name) for name in fields}
             outs["metrics"] = ep.metrics
-            return (ep.state, ep.hists, ep.ledger, ep.flight), outs
+            return (ep.state, ep.hists, ep.ledger, ep.flight,
+                    ep.slo), outs
 
         idx = jnp.arange(epochs, dtype=jnp.int64)
         if ingest:
@@ -167,10 +169,10 @@ def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
             xs = (counts, idx)
         else:
             xs = (jnp.zeros((epochs, 0), dtype=jnp.int32), idx)
-        (state, hists, ledger, flight), outs = lax.scan(
-            body, (state, hists, ledger, flight), xs)
+        (state, hists, ledger, flight, slo), outs = lax.scan(
+            body, (state, hists, ledger, flight, slo), xs)
         return StreamChunk(state=state, outs=outs, hists=hists,
-                           ledger=ledger, flight=flight)
+                           ledger=ledger, flight=flight, slo=slo)
 
     return chunk
 
@@ -189,7 +191,7 @@ def jit_stream_chunk(*, donate: bool = False, **cfg):
     key = (donate,) + tuple(sorted(cfg.items()))
     if key not in _STREAM_JIT_CACHE:
         fn = build_stream_chunk(**cfg)
-        donate_argnums = (0, 3, 4, 5) if donate else ()
+        donate_argnums = (0, 3, 4, 5, 6) if donate else ()
         _STREAM_JIT_CACHE[key] = jax.jit(
             fn, donate_argnums=donate_argnums)
     return _STREAM_JIT_CACHE[key]

@@ -32,7 +32,7 @@ import numpy as np
 
 from .plane import LifecyclePlane
 
-_ID_RE = re.compile(r"^/clients/(\d+)(/qos)?$")
+_ID_RE = re.compile(r"^/clients/(\d+)(/qos|/conformance)?$")
 _JSON = "application/json"
 
 
@@ -44,11 +44,15 @@ class AdminAPI:
     """``handler(method, path, body)`` for ``MetricsHTTPServer.mount``
     over one :class:`~.plane.LifecyclePlane`."""
 
-    def __init__(self, plane: LifecyclePlane, *, ledger_rows=None):
+    def __init__(self, plane: LifecyclePlane, *, ledger_rows=None,
+                 slo=None):
         self.plane = plane
         # optional callable () -> {cid: int64[5] LED_* row} supplying
         # live conformance rows for GET /clients/{id}
         self.ledger_rows = ledger_rows
+        # optional obs.slo.SloPlane: serves the windowed per-contract-
+        # epoch conformance view (GET /clients/{id}/conformance)
+        self.slo = slo
 
     # -- mountable entry point ----------------------------------------
     def handler(self, method: str, path: str,
@@ -71,6 +75,10 @@ class AdminAPI:
         if not m:
             return _resp(404, {"error": f"no route {path!r}"})
         cid = int(m.group(1))
+        if m.group(2) == "/conformance":
+            if method != "GET":
+                return _resp(405, {"error": f"{method} not allowed"})
+            return self._conformance(cid)
         if m.group(2):                       # /clients/{id}/qos
             if method != "PUT":
                 return _resp(405, {"error": f"{method} not allowed"})
@@ -122,6 +130,23 @@ class AdminAPI:
                                      "apply_at": None})
         return _resp(202, {"accepted": True, "seq": seq})
 
+    def _conformance(self, cid: int):
+        """The windowed conformance view (obs.slo): the client's
+        closed-window ring judged per window against its OWN contract
+        version, plus the live contract epoch.  404s without an
+        attached SLO plane (the run was started with it off)."""
+        if self.slo is None:
+            return _resp(404, {"error": "SLO plane not enabled "
+                                        "(run with with_slo/--slo)"})
+        with self.plane.lock:
+            known = cid in self.plane.slots.slot_of or \
+                cid in self.plane.qos
+        view = self.slo.client_view(cid)
+        if not known and not view["windows"] \
+                and view["contract_epoch"] == 0:
+            return _resp(404, {"error": f"no client {cid}"})
+        return _resp(200, view)
+
     def _get(self, cid: int):
         with self.plane.lock:
             slot = self.plane.slots.slot_of.get(cid)
@@ -157,14 +182,15 @@ def _body_json(body: bytes) -> dict:
 
 
 def mount_admin_api(server, plane: LifecyclePlane, *,
-                    ledger_rows=None) -> Optional[AdminAPI]:
+                    ledger_rows=None, slo=None) -> Optional[AdminAPI]:
     """Mount the control API on a (possibly None, fail-soft)
     ``MetricsHTTPServer`` and publish the lifecycle counters into its
-    registry.  Returns the API object, or None when there is no
-    server."""
+    registry.  ``slo`` (an ``obs.slo.SloPlane``) additionally serves
+    ``GET /clients/{id}/conformance``.  Returns the API object, or
+    None when there is no server."""
     if server is None:
         return None
-    api = AdminAPI(plane, ledger_rows=ledger_rows)
+    api = AdminAPI(plane, ledger_rows=ledger_rows, slo=slo)
     server.mount("/clients", api.handler)
     plane.publish(server.registry)
     return api

@@ -205,6 +205,14 @@ class LifecyclePlane:
         self.lock = threading.RLock()
         self.workdir = workdir
         self.tracer = tracer
+        # optional obs.slo.SloPlane: every applied REGISTER/UPDATE/
+        # EVICT bumps the client's contract-epoch counter there, so
+        # closed conformance windows attribute to exactly one
+        # (client, contract_version) pair (docs/OBSERVABILITY.md)
+        self._slo = None
+
+    def attach_slo(self, slo) -> None:
+        self._slo = slo
 
     # -- control-plane ingress (HTTP thread) ---------------------------
     @property
@@ -338,7 +346,7 @@ class LifecyclePlane:
 
     # -- the boundary --------------------------------------------------
     def boundary(self, state: EngineState, b: int, every: int, *,
-                 ledger=None):
+                 ledger=None, slo_block=None):
         """Apply everything due at boundary ``b`` (the epoch index the
         next window starts at): WAL ingest, scripted registrations and
         QoS updates, pending control ops with ``apply_at <= b`` (None
@@ -346,10 +354,20 @@ class LifecyclePlane:
         compaction epoch when due.  Returns the possibly grown /
         compacted ``(state, ledger)``; ``ledger=None`` passes through.
         Deterministic: a resumed incarnation replaying this boundary
-        from the same checkpoint applies the identical ops."""
+        from the same checkpoint applies the identical ops.
+
+        ``slo_block`` (the obs.slo window block; pass only with an
+        attached SloPlane) makes the return a 3-tuple: the block grows
+        with capacity, permutes with compaction, zeroes with eviction,
+        and leaves re-stamped with the post-boundary contract epochs.
+        Boundaries sit exactly on the window-roll grid, so the block's
+        counters are zero here and only the contract-epoch column is
+        live -- a lifecycle op can never smear into a closed window."""
         import jax
 
         from ..obs import spans as _spans
+
+        slo_wanted = slo_block is not None
 
         with self.lock:
             self._wal_ingest()
@@ -376,7 +394,8 @@ class LifecyclePlane:
 
             # growth happens inside _register_row via self._grow_to;
             # the grown state is staged on the instance
-            state, ledger = self._take_growth(state, ledger)
+            state, ledger, slo_block = self._take_growth(
+                state, ledger, slo_block)
 
             # idle evictions: scripted policy (zero-arrival streak,
             # drained queue) + control-plane DELETEs (drained only;
@@ -421,6 +440,10 @@ class LifecyclePlane:
             if evict_slots and ledger is not None:
                 import jax.numpy as jnp
                 ledger = ledger.at[jnp.asarray(evict_slots)].set(0)
+            if evict_slots and slo_block is not None:
+                import jax.numpy as jnp
+                slo_block = slo_block.at[jnp.asarray(evict_slots)] \
+                    .set(0)
 
             # streaks for the upcoming window [b, b+every): counted
             # BEFORE serving it, so boundary b+every evicts on
@@ -441,9 +464,14 @@ class LifecyclePlane:
                         reg[cid] = True
                 self.streak = np.where(reg & quiet, self.streak + 1, 0)
 
-            state, ledger = self._maybe_compact(state, ledger, b,
-                                                every, _spans)
+            state, ledger, slo_block = self._maybe_compact(
+                state, ledger, slo_block, b, every, _spans)
             self.peak_live = max(self.peak_live, self.slots.live_count)
+            if slo_wanted:
+                if self._slo is not None:
+                    slo_block = self._slo.stamp(
+                        slo_block, self.slots.cid_of_slot)
+                return state, ledger, slo_block
             return state, ledger
 
     # -- boundary internals --------------------------------------------
@@ -465,6 +493,8 @@ class LifecyclePlane:
         if cid < self.total:
             self.streak[cid] = 0
         self.counters["registrations"] += 1
+        if self._slo is not None:
+            self._slo.register(cid, op["r"], op["w"], op["l"])
         return [(LC_REGISTER, slot,
                  rate_to_inv_ns(op["r"]), rate_to_inv_ns(op["w"]),
                  rate_to_inv_ns(op["l"]), order)]
@@ -476,22 +506,29 @@ class LifecyclePlane:
             return []                     # departed before its boundary
         self.qos[cid] = (op["r"], op["w"], op["l"])
         self.counters["qos_updates"] += 1
+        if self._slo is not None:
+            self._slo.update(cid, op["r"], op["w"], op["l"])
         return [(LC_UPDATE, slot,
                  rate_to_inv_ns(op["r"]), rate_to_inv_ns(op["w"]),
                  rate_to_inv_ns(op["l"]), 0)]
 
-    def _take_growth(self, state, ledger):
+    def _take_growth(self, state, ledger, slo_block=None):
         new_n = getattr(self, "_grow_pending", 0)
         if new_n > state.capacity:
+            import jax.numpy as jnp
             state = grow_state(state, new_n)
             if ledger is not None:
-                import jax.numpy as jnp
                 pad = jnp.zeros((new_n - ledger.shape[0],
                                  ledger.shape[1]), dtype=ledger.dtype)
                 ledger = jnp.concatenate([ledger, pad], axis=0)
+            if slo_block is not None:
+                pad = jnp.zeros((new_n - slo_block.shape[0],
+                                 slo_block.shape[1]),
+                                dtype=slo_block.dtype)
+                slo_block = jnp.concatenate([slo_block, pad], axis=0)
             self.counters["grows"] += 1
         self._grow_pending = 0
-        return state, ledger
+        return state, ledger, slo_block
 
     def _evict_candidates(self, b: int, evict_api: List[dict]):
         out = list(evict_api)
@@ -521,27 +558,34 @@ class LifecyclePlane:
         if cid < self.total:
             self.streak[cid] = 0
         self.counters["evictions"] += 1
+        if self._slo is not None:
+            self._slo.evict(cid)
 
-    def _maybe_compact(self, state, ledger, b: int, every: int,
-                       _spans):
+    def _maybe_compact(self, state, ledger, slo_block, b: int,
+                       every: int, _spans):
         ce = self.spec["compact_every"]
         if self.static or not ce or b == 0 or (b // every) % ce != 0:
-            return state, ledger
+            return state, ledger, slo_block
         perm = self.slots.compaction_perm()
         if perm is None:
-            return state, ledger
+            return state, ledger, slo_block
         with _spans.span(self.tracer, "lifecycle.compact", "dispatch",
                          boundary=b, live=self.slots.live_count):
+            extras = tuple(x for x in (ledger, slo_block)
+                           if x is not None)
+            out = compact_tree((state,) + extras, perm)
+            state = out[0]
+            it = iter(out[1:])
             if ledger is not None:
-                state, ledger = compact_tree((state, ledger), perm)
-            else:
-                state = compact_tree(state, perm)
+                ledger = next(it)
+            if slo_block is not None:
+                slo_block = next(it)
         if _compact_hook is not None:
             _compact_hook()      # crash seam: device gather done,
         #                          host map not yet re-mapped
         self.slots.apply_perm(perm)
         self.counters["compactions"] += 1
-        return state, ledger
+        return state, ledger, slo_block
 
     # -- arrival-count mapping -----------------------------------------
     def map_counts(self, raw) -> np.ndarray:

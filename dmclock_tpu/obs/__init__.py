@@ -43,7 +43,10 @@ from .trace import DecisionTrace, validate_trace_file
 from .spans import SpanTracer
 from .trace_export import export_chrome_trace, validate_chrome_trace
 from .watchdog import Watchdog
-from . import device, flight, histograms, spans, trace_export
+from .slo import SloPlane
+from .alerts import SloEvaluator, mount_slo_api
+from . import alerts, device, flight, histograms, slo, spans, \
+    trace_export
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "TimerMetric",
@@ -51,6 +54,7 @@ __all__ = [
     "publish_span_gauges",
     "DecisionTrace", "validate_trace_file",
     "SpanTracer", "export_chrome_trace", "validate_chrome_trace",
-    "Watchdog",
-    "device", "flight", "histograms", "spans", "trace_export",
+    "Watchdog", "SloPlane", "SloEvaluator", "mount_slo_api",
+    "alerts", "device", "flight", "histograms", "slo", "spans",
+    "trace_export",
 ]

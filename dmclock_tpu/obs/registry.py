@@ -472,6 +472,12 @@ class MetricsHTTPServer:
             raise ValueError(
                 f"mount prefix must start with '/' and not end with "
                 f"one, got {prefix!r}")
+        if any(p == prefix for p, _ in self._mounts):
+            # first-mount-wins dispatch would silently shadow the
+            # second handler forever -- reject the collision instead
+            # (re-mount-after-rebind creates a FRESH server, so a
+            # legitimate caller never hits this)
+            raise ValueError(f"prefix {prefix!r} already mounted")
         self._mounts.append((prefix, handler))
 
     @property

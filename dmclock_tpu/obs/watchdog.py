@@ -188,6 +188,18 @@ class Watchdog:
                       json.dumps(w, separators=(",", ":")))
         return out
 
+    def external_warning(self, obj: dict) -> None:
+        """Route a structured warning from another monitor (the SLO
+        burn-rate evaluator, ``obs.alerts``) through this watchdog's
+        stream: appended to :attr:`warnings`, counted in the registry
+        counter, logged in the same one-JSON-line format -- one
+        warning stream (and one counter) for the whole run."""
+        self.warnings.append(obj)
+        if self._counter is not None:
+            self._counter.inc()
+        self._log("# watchdog: " +
+                  json.dumps(obj, separators=(",", ":")))
+
     # -- the thread ----------------------------------------------------
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):

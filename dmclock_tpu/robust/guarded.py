@@ -97,6 +97,7 @@ class GuardedEpoch(NamedTuple):
     hists: object = None
     ledger: object = None
     flight: object = None
+    slo: object = None
 
 
 # Module-level jit cache keyed by the static epoch configuration (the
@@ -168,7 +169,7 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                       calendar_impl: str = "minstop",
                       ladder_levels: int = 8,
                       skew_ns: int = 0,
-                      hists=None, ledger=None, flight=None,
+                      hists=None, ledger=None, flight=None, slo=None,
                       retries: int = 3, base_s: float = 0.05,
                       sleep: Callable[[float], None] = _time.sleep,
                       on_retry=None, tracer=None) -> GuardedEpoch:
@@ -231,6 +232,8 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
         tele["ledger"] = ledger
     if flight is not None:
         tele["flight"] = flight
+    if slo is not None:
+        tele["slo"] = slo
     tele_sig = tuple(sorted(tele))
 
     def attempt(st, t, m_run, width):
@@ -316,7 +319,8 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                         retries=retry_count[0],
                         hists=tele.get("hists"),
                         ledger=tele.get("ledger"),
-                        flight=tele.get("flight"))
+                        flight=tele.get("flight"),
+                        slo=tele.get("slo"))
 
 
 class StreamGuarded(NamedTuple):
@@ -339,6 +343,7 @@ class StreamGuarded(NamedTuple):
     hists: object = None     # telemetry accumulators after the chunk
     ledger: object = None
     flight: object = None
+    slo: object = None
 
 
 def run_stream_chunk_guarded(state, epoch0: int, counts, *,
@@ -354,6 +359,7 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
                              calendar_impl: str = "minstop",
                              ladder_levels: int = 8,
                              hists=None, ledger=None, flight=None,
+                             slo=None,
                              retries: int = 3, base_s: float = 0.05,
                              sleep: Callable[[float], None] =
                              _time.sleep,
@@ -416,7 +422,7 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
         with _spans.span(tracer, "stream.dispatch", "dispatch",
                          engine=engine, epochs=epochs):
             out = fn(state, jnp.int64(epoch0), counts_dev,
-                     hists, ledger, flight)
+                     hists, ledger, flight, slo)
         if overlap is not None:
             overlap()     # host pregen rides the device's chunk time
         with _spans.span(tracer, "stream.device_wait",
@@ -438,7 +444,7 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
                          for i in range(epochs)),
             guard_trips=(0,) * epochs, stream_fallback=0,
             retries=retry_count[0], hists=out.hists,
-            ledger=out.ledger, flight=out.flight)
+            ledger=out.ledger, flight=out.flight, slo=out.slo)
 
     # a guard tripped somewhere in the chunk: the fused program cannot
     # run the tag32/serial resumes mid-scan, so the whole chunk is
@@ -452,7 +458,8 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
     ingest_step = stream_mod.jit_ingest_step(
         dt_epoch_ns=dt_epoch_ns, waves=waves) if do_ingest else None
     st = state
-    cur = {"hists": hists, "ledger": ledger, "flight": flight}
+    cur = {"hists": hists, "ledger": ledger, "flight": flight,
+           "slo": slo}
     ep_rows, count_rows, trip_rows = [], [], []
     for i in range(epochs):
         t_base = (int(epoch0) + i) * int(dt_epoch_ns)
@@ -466,7 +473,8 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
             tag_width=tag_width, window_m=window_m,
             calendar_impl=calendar_impl, ladder_levels=ladder_levels,
             hists=cur["hists"], ledger=cur["ledger"],
-            flight=cur["flight"], retries=retries, base_s=base_s,
+            flight=cur["flight"], slo=cur["slo"],
+            retries=retries, base_s=base_s,
             sleep=sleep, on_retry=on_retry, tracer=tracer)
         st = ep.state
         if cur["hists"] is not None:
@@ -475,6 +483,8 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
             cur["ledger"] = ep.ledger
         if cur["flight"] is not None:
             cur["flight"] = ep.flight
+        if cur["slo"] is not None:
+            cur["slo"] = ep.slo
         retry_count[0] += ep.retries
         ep_rows.append(ep.results)
         count_rows.append(ep.count)
@@ -483,7 +493,7 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
         state=st, epochs=tuple(ep_rows), counts=tuple(count_rows),
         guard_trips=tuple(trip_rows), stream_fallback=1,
         retries=retry_count[0], hists=cur["hists"],
-        ledger=cur["ledger"], flight=cur["flight"])
+        ledger=cur["ledger"], flight=cur["flight"], slo=cur["slo"])
 
 
 # ----------------------------------------------------------------------

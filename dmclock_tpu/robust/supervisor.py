@@ -143,6 +143,22 @@ class EpochJob:
     # leaves, so churned runs stay crash-equivalent.  None = the
     # closed-population job the PRs 1-8 gates pin.
     churn: Optional[dict] = None
+    # SLO plane (obs.slo / obs.alerts; docs/OBSERVABILITY.md "SLO
+    # plane"): a per-client windowed-conformance block rides the epoch
+    # scans like the PR-6 telemetry, with window rolls pinned to the
+    # ckpt_every boundary grid (= the stream loop's chunk grid, so
+    # both loops roll identically).  The closed-window ring, the
+    # contract-epoch counters, and the burn-rate evaluator state ride
+    # the rotation checkpoints as slo_* leaves -- crash equivalence
+    # extends to all of them (a killed-and-resumed run's windows,
+    # attribution, and fired episodes == the uninterrupted run's).
+    with_slo: bool = False
+    slo_ring: int = 64              # closed-window ring depth/client
+    # judged closed windows as JSONL (scripts/slo_report.py's feed),
+    # APPENDED right after each checkpoint commits -- the span_log
+    # durability discipline: what is flushed is exactly what a resume
+    # will never re-close
+    slo_log: Optional[str] = None
     # engine loop structure (docs/ENGINE.md "engine_loop"): "round"
     # launches the admission readback + ingest + epoch separately per
     # epoch (the PR-5 shape, ~3 tunnel round-trips/epoch); "stream"
@@ -199,6 +215,15 @@ class SupervisedResult(NamedTuple):
     # for churn jobs; None for closed-population jobs.  Deterministic,
     # so the crash-equivalence gate compares it too.
     lifecycle: Optional[dict] = None
+    # SLO plane outputs (None when the job ran with it off): the final
+    # open window block, the closed-window ring (flat RING_COLS rows in
+    # close order), the contract-epoch counters ([K, 2] cid/epoch
+    # pairs), and the burn-rate evaluator summary -- all deterministic,
+    # all compared by the crash-equivalence gate
+    slo_window: Optional[np.ndarray] = None
+    slo_ring: Optional[np.ndarray] = None
+    slo_cepoch: Optional[np.ndarray] = None
+    slo: Optional[dict] = None
 
 
 def assert_crash_equivalent(interrupted: SupervisedResult,
@@ -241,6 +266,21 @@ def assert_crash_equivalent(interrupted: SupervisedResult,
     assert interrupted.lifecycle == reference.lifecycle, \
         (f"lifecycle plane diverged across the crash: "
          f"{interrupted.lifecycle} vs {reference.lifecycle}")
+    # the SLO plane's window block, closed-window ring, and
+    # contract-epoch counters ride the rotation checkpoints and the
+    # rolls are pinned to the checkpoint grid, so all three -- and the
+    # burn-rate evaluator's episode accounting -- must be bit-identical
+    for field in ("slo_window", "slo_ring", "slo_cepoch"):
+        x = getattr(interrupted, field)
+        y = getattr(reference, field)
+        assert (x is None) == (y is None), \
+            f"SLO field {field} enabled on only one side"
+        if x is not None:
+            assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                f"SLO field {field} diverged across the crash"
+    assert interrupted.slo == reference.slo, \
+        (f"SLO evaluator diverged across the crash: "
+         f"{interrupted.slo} vs {reference.slo}")
 
 
 # ----------------------------------------------------------------------
@@ -342,11 +382,13 @@ def _tree_digest(tree) -> str:
 def _payload(job: EpochJob, state, rng, met, digest: bytes,
              epoch: int, decisions: int, ladder_vec,
              hists=None, ledger=None, flight=None,
-             plane=None) -> dict:
+             plane=None, slo=None) -> dict:
     import jax
 
     from ..lifecycle.plane import LifecyclePlane
     from ..obs import flight as obsflight
+    from ..obs import slo as obsslo
+    from ..obs.alerts import SloEvaluator
 
     # telemetry leaves are ALWAYS present (zero-size when the job runs
     # with that accumulator off) so the restore template's structure
@@ -365,7 +407,21 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
     # strict_shapes=False (utils.checkpoint)
     lc = plane.encode() if plane is not None \
         else LifecyclePlane.empty_leaves()
-    return {**lc,
+    # SLO leaves follow the same always-present convention: the block,
+    # the plane's ring/contract-epoch state, and the evaluator's
+    # episode accounting (slo = (block, SloPlane, SloEvaluator) or
+    # None); rolls are pinned to the checkpoint grid, so the saved
+    # block is always a freshly-opened window
+    if slo is not None:
+        sl = {"slo_window": np.asarray(jax.device_get(slo[0]),
+                                       dtype=np.int64),
+              **slo[1].encode(), **slo[2].encode()}
+    else:
+        sl = {"slo_window": np.zeros((0, obsslo.W_FIELDS),
+                                     dtype=np.int64),
+              **obsslo.SloPlane.empty_leaves(),
+              **SloEvaluator.empty_leaves()}
+    return {**lc, **sl,
             "digest": np.frombuffer(digest, dtype=np.uint8).copy(),
             "decisions": np.int64(decisions),
             "engine": state,
@@ -408,6 +464,10 @@ def _payload_like(job: EpochJob) -> dict:
     from ..obs import device as obsdev
 
     hists, ledger, flight = _tele_init(job)
+    # the SLO leaves' template stays the empty-leaf shape even for
+    # with_slo jobs: their axis-0 sizes are runtime state (ring fill,
+    # contract count), so such jobs restore with the axis-0-only
+    # relaxation (trailing dims still gate) -- see _job_loop
     return _payload(job, _job_state(job),
                     np.random.Generator(np.random.PCG64(job.seed)),
                     np.zeros(obsdev.NUM_METRICS, dtype=np.int64),
@@ -416,6 +476,21 @@ def _payload_like(job: EpochJob) -> dict:
                     hists=hists, ledger=ledger, flight=flight,
                     plane=LifecyclePlane(job.churn)
                     if job.churn is not None else None)
+
+
+def _slo_log_flush(slo_plane, slo_log, closed) -> None:
+    """Append one roll's judged closed windows to the slo_log JSONL
+    (fail-soft: telemetry must never kill the run) -- the ONE
+    implementation both the round and the stream loop call right
+    after their checkpoint commits, so the two durability
+    disciplines cannot drift."""
+    if not closed or not slo_log or slo_plane is None:
+        return
+    try:
+        slo_plane.export_jsonl(slo_log, closed)
+    except OSError as e:
+        print(f"# supervisor: slo_log write failed: {e}",
+              file=sys.stderr)
 
 
 class _ScrapeCtl:
@@ -546,11 +621,15 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 # churn payloads hold grow-on-demand arrays (engine
                 # state, ledger, slot map, journals) whose capacities
                 # the fresh template cannot predict -- dtype+rank
-                # checked, shapes from the file (utils.checkpoint)
+                # checked, shapes from the file (utils.checkpoint).
+                # SLO payloads relax the same way: the ring fill and
+                # contract count are runtime state (axis 0 only;
+                # trailing dims -- RING_COLS, W_FIELDS -- still gate)
                 payload, resumed_from = \
                     ckpt_mod.restore_pytree_rotating(
                         ckpt_dir, _payload_like(job),
-                        strict_shapes=job.churn is None)
+                        strict_shapes=job.churn is None
+                        and not job.with_slo)
         except ckpt_mod.CheckpointCorruptError:
             payload = None
     if payload is not None:
@@ -592,10 +671,61 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             plane = LifecyclePlane(job.churn, workdir=workdir,
                                    tracer=tracer)
 
-    on_bind = None
-    if plane is not None:
-        from ..lifecycle.api import mount_admin_api
+    # the SLO plane (obs.slo): window block + contract-epoch/ring host
+    # state + burn-rate evaluator.  Window rolls happen ONLY at the
+    # ckpt_every boundary grid below, in bare and supervised runs
+    # alike -- the zero-host-fault gate compares their rings.
+    slo_block = slo_plane = slo_eval = None
+    slo_w0 = start_epoch
+    if job.with_slo:
+        import jax.numpy as _jnp
 
+        from ..obs import slo as obsslo
+        from ..obs.alerts import SloEvaluator
+
+        if payload is not None:
+            slo_block = _jnp.asarray(payload["slo_window"])
+            slo_plane = obsslo.SloPlane.load(
+                payload, capacity=int(slo_block.shape[0]),
+                dt_epoch_ns=job.dt_epoch_ns,
+                ring_depth=max(job.slo_ring, 1))
+            slo_eval = SloEvaluator(slo_plane)
+            slo_eval.load(payload)
+        else:
+            n0 = int(job.churn["capacity0"]) if job.churn is not None \
+                else job.n
+            slo_plane = obsslo.SloPlane(n0,
+                                        dt_epoch_ns=job.dt_epoch_ns,
+                                        ring_depth=job.slo_ring)
+            slo_block = obsslo.window_zero(n0)
+            if job.churn is None:
+                # closed population: every slot is a client with a
+                # fixed contract, registered once from the device
+                # truth (the inverse-rate arrays)
+                slo_plane.register_from_inv(
+                    state.resv_inv, state.weight_inv, state.limit_inv)
+                slo_block = slo_plane.stamp(slo_block)
+            slo_eval = SloEvaluator(slo_plane)
+        if plane is not None:
+            # lifecycle REGISTER/UPDATE/EVICT bump contract epochs
+            # through the plane's boundary (docs/LIFECYCLE.md)
+            plane.attach_slo(slo_plane)
+
+    def _slo_roll(state_now, e1: int):
+        """Close the window ending at boundary ``e1`` and judge it;
+        returns the rows to flush AFTER the checkpoint commits."""
+        nonlocal slo_block, slo_w0
+        cid_of_slot = plane.slots.cid_of_slot if plane is not None \
+            else None
+        slo_block, closed = slo_plane.roll(
+            slo_block, slo_w0, e1, cid_of_slot=cid_of_slot,
+            depth=state_now.depth)
+        slo_w0 = e1
+        slo_eval.observe_roll(closed)
+        return closed
+
+    on_bind = None
+    if plane is not None or slo_eval is not None:
         def on_bind(server, _plane=plane):
             # live control surface: the admin API (POST/PUT/DELETE
             # /clients...) + lifecycle counters ride the supervised
@@ -603,7 +733,12 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             # Ops accepted here are WAL-fsynced (the plane has the
             # workdir), so a SIGKILL between accept and the epoch
             # boundary still applies them exactly once on resume.
-            mount_admin_api(server, _plane)
+            if _plane is not None:
+                from ..lifecycle.api import mount_admin_api
+                mount_admin_api(server, _plane, slo=slo_plane)
+            if slo_eval is not None:
+                from ..obs.alerts import mount_slo_api
+                mount_slo_api(server, slo_eval)
     scr = _ScrapeCtl(job.metrics_port, start_epoch, on_bind)
     base_cfg = {"select_impl": job.select_impl,
                 "tag_width": job.tag_width,
@@ -615,7 +750,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                               base_cfg, state, rng, met, digest,
                               start_epoch, decisions, ladder, tracer,
                               hists, ledger, flight, resumed_from,
-                              plane)
+                              plane, slo_block, slo_plane, slo_eval)
     assert job.engine_loop == "round", job.engine_loop
     ingest = _jit_ingest(job) \
         if job.arrival_lam > 0 and plane is None else None
@@ -648,8 +783,14 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             if plane is not None and epoch % job.ckpt_every == 0:
                 with _spans.span(tracer, "lifecycle.boundary",
                                  "host_prep", epoch=epoch):
-                    state, ledger = plane.boundary(
-                        state, epoch, job.ckpt_every, ledger=ledger)
+                    if slo_block is not None:
+                        state, ledger, slo_block = plane.boundary(
+                            state, epoch, job.ckpt_every,
+                            ledger=ledger, slo_block=slo_block)
+                    else:
+                        state, ledger = plane.boundary(
+                            state, epoch, job.ckpt_every,
+                            ledger=ledger)
 
             t_base = jnp.int64(epoch * job.dt_epoch_ns)
             if plane is not None:
@@ -683,7 +824,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                         calendar_impl=cfg["calendar_impl"],
                         ladder_levels=job.ladder_levels,
                         hists=hists, ledger=ledger, flight=flight,
-                        tracer=tracer)
+                        slo=slo_block, tracer=tracer)
                     break
                 except RECOVERABLE_ERRORS:
                     # bounded retries EXHAUSTED inside the guarded
@@ -709,6 +850,8 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 ledger = ep.ledger
             if job.flight_records:
                 flight = ep.flight
+            if job.with_slo:
+                slo_block = ep.slo
             with _spans.span(tracer, "supervisor.digest", "drain"):
                 # churn digests hash the CANONICAL client-id-space
                 # views: slot layout (registration timing, recycling,
@@ -727,16 +870,26 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
 
             if injector is not None:
                 injector.after_decisions(decisions)
-            if ckpt_dir is not None and \
-                    ((epoch + 1) % job.ckpt_every == 0
-                     or epoch + 1 == job.epochs):
+            at_boundary = ((epoch + 1) % job.ckpt_every == 0
+                           or epoch + 1 == job.epochs)
+            closed = None
+            if slo_plane is not None and at_boundary:
+                # the window roll happens in BARE and supervised runs
+                # alike (same grid), BEFORE the snapshot: the saved
+                # block is a freshly-opened window and the ring
+                # already holds what this boundary closed
+                closed = _slo_roll(state, epoch + 1)
+            if ckpt_dir is not None and at_boundary:
                 with _spans.span(tracer, "supervisor.checkpoint_save",
                                  "checkpoint", epoch=epoch + 1):
                     payload = _payload(job, state, rng, met, digest,
                                        epoch + 1, decisions,
                                        ladder.encode(), hists=hists,
                                        ledger=ledger, flight=flight,
-                                       plane=plane)
+                                       plane=plane,
+                                       slo=None if slo_plane is None
+                                       else (slo_block, slo_plane,
+                                             slo_eval))
 
                     def save(payload=payload):
                         return ckpt_mod.save_pytree_rotating(
@@ -754,11 +907,16 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 # crash+resume (replayed epochs re-record).  Spans and
                 # checkpoints share one durability window by
                 # construction: what is flushed is exactly what will
-                # never be replayed.
+                # never be replayed.  The slo_log flush follows the
+                # same discipline: windows flushed after the save are
+                # exactly the ones a resume will never re-close.
                 if tracer is not None:
                     tracer.drain_jsonl(job.span_log)
+                _slo_log_flush(slo_plane, job.slo_log, closed)
             else:
                 _ep_span.__exit__(None, None, None)
+                if ckpt_dir is None:
+                    _slo_log_flush(slo_plane, job.slo_log, closed)
                 if tracer is not None and ckpt_dir is None:
                     # bare/unsupervised runner: nothing ever replays,
                     # per-epoch flushes are safe
@@ -789,16 +947,28 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     #                                       resume span
     return _build_result(job, state, digest, decisions, met, ladder,
                          scr.rebinds, resumed_from, hists, ledger,
-                         flight, stream_fallbacks, plane)
+                         flight, stream_fallbacks, plane,
+                         slo_block, slo_plane, slo_eval)
 
 
 def _build_result(job, state, digest, decisions, met, ladder,
                   scrape_rebinds, resumed_from, hists, ledger, flight,
-                  stream_fallbacks: int,
-                  plane=None) -> SupervisedResult:
+                  stream_fallbacks: int, plane=None,
+                  slo_block=None, slo_plane=None,
+                  slo_eval=None) -> SupervisedResult:
     import jax
 
+    slo_kw = {}
+    if slo_plane is not None:
+        enc = slo_plane.encode()
+        slo_kw = dict(
+            slo_window=np.asarray(jax.device_get(slo_block),
+                                  dtype=np.int64),
+            slo_ring=enc["slo_ring"],
+            slo_cepoch=enc["slo_cepoch"],
+            slo=slo_eval.summary())
     return SupervisedResult(
+        **slo_kw,
         lifecycle=plane.snapshot() if plane is not None else None,
         digest=hashlib.sha256(digest).hexdigest(),
         state_digest=_tree_digest(state),
@@ -835,7 +1005,8 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                    scr: _ScrapeCtl, base_cfg: dict, state, rng, met,
                    digest: bytes, start_epoch: int, decisions: int,
                    ladder, tracer, hists, ledger, flight,
-                   resumed_from, plane=None) -> SupervisedResult:
+                   resumed_from, plane=None, slo_block=None,
+                   slo_plane=None, slo_eval=None) -> SupervisedResult:
     """The always-on streaming serve loop (docs/ENGINE.md
     "engine_loop"): one fused device launch per stream chunk (= the
     epochs between two PR-5 checkpoint boundaries), with the host
@@ -860,6 +1031,7 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
 
     stream_fallbacks = 0
     do_ingest = job.arrival_lam > 0 or plane is not None
+    slo_w0 = start_epoch
     try:
         counts = None
         rng_ckpt = _rng_state_array(rng)
@@ -891,8 +1063,13 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
             if plane is not None:
                 with _spans.span(tracer, "lifecycle.boundary",
                                  "host_prep", epoch=e0):
-                    state, ledger = plane.boundary(
-                        state, e0, job.ckpt_every, ledger=ledger)
+                    if slo_block is not None:
+                        state, ledger, slo_block = plane.boundary(
+                            state, e0, job.ckpt_every, ledger=ledger,
+                            slo_block=slo_block)
+                    else:
+                        state, ledger = plane.boundary(
+                            state, e0, job.ckpt_every, ledger=ledger)
                 counts_dev = plane.map_counts(counts)
             else:
                 counts_dev = counts
@@ -930,7 +1107,7 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                         calendar_impl=cfg["calendar_impl"],
                         ladder_levels=job.ladder_levels,
                         hists=hists, ledger=ledger, flight=flight,
-                        tracer=tracer, overlap=overlap)
+                        slo=slo_block, tracer=tracer, overlap=overlap)
                     break
                 except RECOVERABLE_ERRORS:
                     # retries exhausted at stream-chunk granularity:
@@ -952,6 +1129,8 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                 ledger = g.ledger
             if job.flight_records:
                 flight = g.flight
+            if job.with_slo:
+                slo_block = g.slo
             stream_fallbacks += g.stream_fallback
             # the drain: per-epoch bookkeeping in epoch order, exactly
             # the round loop's sequence (digest -> metric fold ->
@@ -981,6 +1160,18 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
             # completing -- docs/OBSERVABILITY.md)
             _spans.instant(tracer, "stream.heartbeat", "drain",
                            epoch=b)
+            closed = None
+            if slo_plane is not None:
+                # b is a window boundary by construction: every chunk
+                # ends on the ckpt_every grid (chunk_bounds), so the
+                # stream loop rolls at exactly the round loop's points
+                cid_of_slot = plane.slots.cid_of_slot \
+                    if plane is not None else None
+                slo_block, closed = slo_plane.roll(
+                    slo_block, slo_w0, b, cid_of_slot=cid_of_slot,
+                    depth=state.depth)
+                slo_w0 = b
+                slo_eval.observe_roll(closed)
             if ckpt_dir is not None:
                 # b is a checkpoint boundary by construction
                 # (chunk_bounds); the persisted RNG state is rng_ckpt
@@ -991,7 +1182,10 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                                        digest, b, decisions,
                                        ladder.encode(), hists=hists,
                                        ledger=ledger, flight=flight,
-                                       plane=plane)
+                                       plane=plane,
+                                       slo=None if slo_plane is None
+                                       else (slo_block, slo_plane,
+                                             slo_eval))
 
                     def save(payload=payload):
                         return ckpt_mod.save_pytree_rotating(
@@ -1003,10 +1197,13 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                         save()
                 if tracer is not None:
                     tracer.drain_jsonl(job.span_log)
-            elif tracer is not None:
+                _slo_log_flush(slo_plane, job.slo_log, closed)
+            else:
                 # bare/unsupervised runner: nothing ever replays,
                 # per-chunk flushes are safe
-                tracer.drain_jsonl(job.span_log)
+                _slo_log_flush(slo_plane, job.slo_log, closed)
+                if tracer is not None:
+                    tracer.drain_jsonl(job.span_log)
             counts = nxt.get("counts")
             rng_ckpt = nxt["rng"]
     except BaseException:
@@ -1028,7 +1225,8 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
         tracer.drain_jsonl(job.span_log)
     return _build_result(job, state, digest, decisions, met, ladder,
                          scr.rebinds, resumed_from, hists, ledger,
-                         flight, stream_fallbacks, plane)
+                         flight, stream_fallbacks, plane,
+                         slo_block, slo_plane, slo_eval)
 
 
 def _healthz_ok(scrape, timeout_s: float = 2.0) -> bool:
@@ -1159,6 +1357,13 @@ def _spawn_once(job: EpochJob, workdir: str,
         v = obj.get(key)
         return None if v is None else np.asarray(v, dtype=np.int64)
 
+    def arr2(key, cols):
+        v = obj.get(key)
+        return None if v is None else \
+            np.asarray(v, dtype=np.int64).reshape(-1, cols)
+
+    from ..obs import slo as obsslo
+
     return SupervisedResult(
         digest=obj["digest"], state_digest=obj["state_digest"],
         decisions=int(obj["decisions"]), epochs=int(obj["epochs"]),
@@ -1170,7 +1375,11 @@ def _spawn_once(job: EpochJob, workdir: str,
         flight_buf=arr("flight_buf"),
         flight_seq=int(obj.get("flight_seq", 0)),
         stream_fallbacks=int(obj.get("stream_fallbacks", 0)),
-        lifecycle=obj.get("lifecycle"))
+        lifecycle=obj.get("lifecycle"),
+        slo_window=arr2("slo_window", obsslo.W_FIELDS),
+        slo_ring=arr2("slo_ring", obsslo.RING_COLS),
+        slo_cepoch=arr2("slo_cepoch", 2),
+        slo=obj.get("slo"))
 
 
 def _child_main(workdir: str) -> int:
@@ -1211,7 +1420,11 @@ def _child_main(workdir: str) -> int:
                    "flight_buf": lst(result.flight_buf),
                    "flight_seq": result.flight_seq,
                    "stream_fallbacks": result.stream_fallbacks,
-                   "lifecycle": result.lifecycle}, fh)
+                   "lifecycle": result.lifecycle,
+                   "slo_window": lst(result.slo_window),
+                   "slo_ring": lst(result.slo_ring),
+                   "slo_cepoch": lst(result.slo_cepoch),
+                   "slo": result.slo}, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, res_path)

@@ -70,6 +70,13 @@ def main(argv=None) -> int:
                    "(delivered rate vs reservation/weight/limit), "
                    "plus reservation-tardiness percentiles when the "
                    "backend materializes tags")
+    p.add_argument("--slo-check", action="store_true",
+                   help="cross-check the queue backends' SLO window "
+                   "mirror (obs.slo; open window == cumulative "
+                   "ledger on every countable column, contract "
+                   "epochs stamped) and exit nonzero on mismatch; "
+                   "passes with a note when no backend exposes the "
+                   "mirror")
     p.add_argument("--ledger-check", action="store_true",
                    help="cross-check backend conformance ledgers "
                    "(device-truth per-client served/reservation "
@@ -175,6 +182,20 @@ def main(argv=None) -> int:
             print(f"# ledger-check: ok ({chk['clients']} clients, "
                   f"{chk['ops']} ops; backend ledger == host "
                   "recount)")
+    if args.slo_check:
+        chk = report.slo_window_check()
+        if chk is None:
+            print("# slo-check: no backend exposes the SLO window "
+                  "mirror; pass")
+        elif chk["mismatches"]:
+            print(f"# slo-check: FAILED -- "
+                  f"{len(chk['mismatches'])} client(s) diverge "
+                  f"between the window mirror and the ledger: "
+                  f"{chk['mismatches'][:5]}")
+            return 1
+        else:
+            print(f"# slo-check: ok ({chk['clients']} clients, "
+                  f"{chk['windows_ops']} windowed ops == ledger)")
     if trace is not None and trace.rows_dropped:
         print(f"# trace: {trace.rows_written} rows written, "
               f"{trace.rows_dropped} dropped past --trace-limit")

@@ -728,6 +728,46 @@ class SimReport:
                 "recycled_clients": len(set(host) - set(ledgers)),
                 "mismatches": mismatches}
 
+    def slo_window_check(self) -> Optional[dict]:
+        """The queue backends' SLO window mirror vs their own ledger
+        (docs/OBSERVABILITY.md "SLO plane"): a sim never rolls the
+        mirror, so every client's OPEN window must equal its
+        cumulative ledger row on the countable columns (ops /
+        resv-ops / limit-breaks) and carry a nonzero contract epoch.
+        Returns ``{"clients", "windows_ops", "mismatches": [...]}`` or
+        None when no backend exposes the mirror."""
+        from ..obs import slo as obsslo
+
+        found = False
+        mismatches = []
+        clients = 0
+        ops = 0
+        for s in self.sim.servers.values():
+            queue = getattr(s, "queue", None)
+            if queue is None or not hasattr(queue,
+                                            "slo_window_rows"):
+                continue
+            found = True
+            leds = queue.ledger_rows()
+            for cid, win in queue.slo_window_rows().items():
+                clients += 1
+                ops += int(win[obsslo.W_OPS])
+                led = leds[cid]
+                bad = (int(win[obsslo.W_OPS]) != int(led[0])
+                       or int(win[obsslo.W_RESV_OPS]) != int(led[1])
+                       or int(win[obsslo.W_LB_OPS]) != int(led[2])
+                       or (int(win[obsslo.W_OPS]) > 0
+                           and int(win[obsslo.W_CEPOCH]) < 1))
+                if bad:
+                    mismatches.append({
+                        "client": cid,
+                        "window": [int(x) for x in win],
+                        "ledger": [int(x) for x in led]})
+        if not found:
+            return None
+        return {"clients": clients, "windows_ops": ops,
+                "mismatches": mismatches}
+
     def format_conformance(self, tol: float = 0.05) -> str:
         rows = self.conformance(tol=tol)
         lines = ["-- per-client QoS conformance --",

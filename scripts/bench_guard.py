@@ -335,6 +335,61 @@ def main() -> int:
                     print(f"bench_guard: {tag}: dispatch "
                           f"{disp:.2f}ms/launch vs median "
                           f"{d_med:.2f}ms -- OK")
+        # SLO plane verdicts (bench.py --slo; docs/OBSERVABILITY.md
+        # "SLO plane") as their own per-workload warn-only series:
+        # burn-rate episodes and the worst-window share error measure
+        # delivered-vs-contract QoS, which can regress while dec/s
+        # holds -- and, like tardiness, their equilibria shift with
+        # calibration, so a hard gate would flap.
+        viol = row.get("slo_violations_total")
+        if viol is not None:
+            v_hist = series(wl, "slo_violations_total", impl, cal,
+                            loop, scen, pop)
+            if len(v_hist) < args.min_records:
+                print(f"bench_guard: {tag}: slo violations {viol} "
+                      f"({len(v_hist)} prior record(s) -- not "
+                      "judged)")
+            else:
+                v_med = median(v_hist)
+                # floor the median at 1: a historically-clean series
+                # must not warn on the first stray episode
+                ceil = max(v_med, 1.0) * args.tolerance
+                if viol > ceil:
+                    print(f"bench_guard: {tag}: WARNING slo "
+                          f"violations {viol} vs median {v_med:g} "
+                          f"over {len(v_hist)} sessions "
+                          f"(> {args.tolerance:g}x) -- burn-rate "
+                          "episodes up; the QoS contract regressed "
+                          "even if throughput held; investigate",
+                          file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: slo violations "
+                          f"{viol} vs median {v_med:g} -- OK")
+        serr = row.get("slo_worst_share_err")
+        if serr is not None:
+            s_hist = series(wl, "slo_worst_share_err", impl, cal,
+                            loop, scen, pop)
+            if len(s_hist) < args.min_records:
+                print(f"bench_guard: {tag}: worst-window share err "
+                      f"{serr:.3f} ({len(s_hist)} prior record(s) "
+                      "-- not judged)")
+            else:
+                s_med = median(s_hist)
+                # floor at 0.05: a 5% relative share error is inside
+                # windowing noise on any population
+                ceil = max(s_med, 0.05) * args.tolerance
+                if serr > ceil:
+                    print(f"bench_guard: {tag}: WARNING worst-window "
+                          f"share error {serr:.3f} vs median "
+                          f"{s_med:.3f} over {len(s_hist)} sessions "
+                          f"(> {args.tolerance:g}x) -- proportional "
+                          "share drifted from the weight "
+                          "entitlement; investigate",
+                          file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: worst-window share "
+                          f"err {serr:.3f} vs median {s_med:.3f} "
+                          "-- OK")
     if status:
         print(f"bench_guard: FAILED on {newest_name} -- a >"
               f"{args.tolerance:g}x drop survived the drift margin; "

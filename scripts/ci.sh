@@ -557,6 +557,89 @@ for engine in ("prefix", "chain", "calendar"):
 print("churn smoke ok")
 EOF
 
+echo "== slo smoke (window digest gate + windowed==cumulative + scrape) =="
+# the SLO plane (docs/OBSERVABILITY.md "SLO plane"): (1) the windowed
+# conformance block must leave decisions BIT-IDENTICAL with --slo
+# on/off on all three epoch engines under BOTH the round and the
+# stream loop; (2) over a contract-stable run, the closed windows plus
+# the open block must sum to the cumulative ledger exactly (windowed
+# totals == cumulative totals); (3) a dmclock_slo_* family must scrape
+# from the HTTP endpoint and GET /slo must answer live.
+timeout -k 30 900 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import dataclasses, json, urllib.request
+import numpy as np
+from dmclock_tpu.obs import MetricsHTTPServer, MetricsRegistry
+from dmclock_tpu.obs import slo as obsslo, histograms as obshist
+from dmclock_tpu.obs.alerts import SloEvaluator, mount_slo_api
+from dmclock_tpu.robust import supervisor as SV
+
+base = dict(n=128, depth=6, ring=12, epochs=6, m=2, seed=9,
+            arrival_lam=1.5, waves=3, ckpt_every=2, with_ledger=True)
+matrix = {
+    "prefix": SV.EpochJob(engine="prefix", k=16, **base),
+    "chain": SV.EpochJob(engine="chain", chain_depth=3, k=8, **base),
+    "calendar": SV.EpochJob(engine="calendar", k=4,
+                            calendar_impl="bucketed",
+                            ladder_levels=2, **base),
+}
+for name, j_off in matrix.items():
+    refs = {}
+    for loop in ("round", "stream"):
+        r_off = SV.run_job(dataclasses.replace(j_off,
+                                               engine_loop=loop))
+        r_on = SV.run_job(dataclasses.replace(j_off, with_slo=True,
+                                              engine_loop=loop))
+        assert r_on.digest == r_off.digest, f"{name}/{loop}"
+        assert r_on.state_digest == r_off.state_digest, f"{name}/{loop}"
+        assert np.array_equal(r_on.metrics, r_off.metrics)
+        refs[loop] = r_on
+        # windowed == cumulative: ring + open block vs the ledger
+        ring = np.asarray(r_on.slo_ring)
+        win = np.asarray(r_on.slo_window)
+        led = np.asarray(r_on.ledger)
+        for wcol, lcol in ((5, obshist.LED_OPS),
+                           (7, obshist.LED_RESV_OPS),
+                           (9, obshist.LED_LIMIT_BREAKS),
+                           (10, obshist.LED_TARD_SUM)):
+            got = ring[:, wcol].sum() + win[:, wcol - 5].sum()
+            assert got == led[:, lcol].sum(), (name, loop, wcol)
+        # delivered COST: these jobs ingest unit costs, so the
+        # windowed cost total must equal the ops total exactly
+        # (per-client non-unit-cost exactness is pinned per engine
+        # in tests/test_slo.py)
+        got_cost = ring[:, 6].sum() + win[:, 1].sum()
+        assert got_cost == led[:, obshist.LED_OPS].sum(), (name, loop)
+    assert refs["round"].slo == refs["stream"].slo, name
+    assert np.array_equal(np.asarray(refs["round"].slo_ring),
+                          np.asarray(refs["stream"].slo_ring)), name
+    print(f"{name}: slo on/off digest gate + windowed==cumulative ok "
+          f"(round & stream, {refs['round'].slo['windows_closed']} "
+          "windows)")
+
+# scrape: dmclock_slo_* family + live GET /slo
+plane = obsslo.SloPlane(4, dt_epoch_ns=10**8)
+plane.register(0, 100.0, 1.0, 0.0)
+ev = SloEvaluator(plane, log=lambda _l: None)
+reg = MetricsRegistry()
+with MetricsHTTPServer(reg, port=0) as srv:
+    mount_slo_api(srv, ev)
+    blk, closed = plane.roll(obsslo.window_zero(4), 0, 2)
+    ev.observe_roll(closed)
+    with urllib.request.urlopen(srv.url, timeout=10) as resp:
+        text = resp.read().decode()
+    assert "dmclock_slo_violations_total" in text, text[:400]
+    assert "dmclock_slo_windows_closed_total" in text
+    with urllib.request.urlopen(srv.url.replace("/metrics", "/slo"),
+                                timeout=10) as resp:
+        out = json.loads(resp.read())
+    assert out["windows_closed"] == len(closed), out
+print("slo smoke ok (digest gates green; dmclock_slo_* scrapes; "
+      "GET /slo live)")
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

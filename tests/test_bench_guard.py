@@ -350,3 +350,82 @@ def test_churn_tardiness_warns_like_cfg4(monkeypatch, capsys,
     cap = capsys.readouterr()
     assert rc == 0                       # warn-only
     assert "WARNING p99 tardiness" in cap.err
+
+
+def write_history_slo(tmp_path, rows):
+    """rows = [(dps, violations, share_err)] -- the bench.py --slo
+    scalars ride the workload row like tardiness does."""
+    h = tmp_path / "history"
+    h.mkdir()
+    for i, (dps, viol, serr) in enumerate(rows):
+        (h / f"bench_{4000 + i}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": "tpu0",
+             "workloads": {"cfg4": {
+                 "dps": dps, "slo_violations_total": viol,
+                 "slo_worst_share_err": serr}}}))
+    return h
+
+
+def test_slo_series_ok_when_stable(monkeypatch, capsys, tmp_path):
+    hist = write_history_slo(tmp_path, [(40e6, 3, 0.2),
+                                        (42e6, 4, 0.25),
+                                        (41e6, 3, 0.22)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "slo violations" in out and "OK" in out
+    assert "worst-window share err" in out
+
+
+def test_slo_violation_burst_warns_but_passes(monkeypatch, capsys,
+                                              tmp_path):
+    # burn-rate episodes 10x the median while throughput held: the
+    # QoS contract regressed -- warn-only, same policy as tardiness
+    monkeypatch.setattr(bg, "HISTORY",
+                        write_history_slo(tmp_path,
+                                          [(40e6, 3, 0.2),
+                                           (42e6, 4, 0.2),
+                                           (41e6, 40, 0.2)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING slo violations" in cap.err
+    assert "burn-rate episodes up" in cap.err
+
+
+def test_slo_share_err_warns_but_passes(monkeypatch, capsys,
+                                        tmp_path):
+    monkeypatch.setattr(bg, "HISTORY",
+                        write_history_slo(tmp_path,
+                                          [(40e6, 3, 0.2),
+                                           (42e6, 3, 0.25),
+                                           (41e6, 3, 1.8)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING worst-window share error" in cap.err
+
+
+def test_slo_clean_history_floored(monkeypatch, capsys, tmp_path):
+    # a historically-clean series (median 0 violations, ~0 share err)
+    # must not warn on one stray episode / 5% windowing noise
+    monkeypatch.setattr(bg, "HISTORY",
+                        write_history_slo(tmp_path,
+                                          [(40e6, 0, 0.0),
+                                           (42e6, 0, 0.01),
+                                           (41e6, 1, 0.04)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING slo" not in cap.err
+    assert "WARNING worst-window" not in cap.err
+
+
+def test_slo_not_judged_without_history(monkeypatch, capsys,
+                                        tmp_path):
+    hist = write_history_slo(tmp_path, [(40e6, 3, 0.2)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "not judged" in out

@@ -77,6 +77,7 @@ def drive_calendar(state, now, steps, *, allow=False,
     return st, counts
 
 
+@pytest.mark.slow
 def test_calendar_weight_steady_state():
     """Pure weight workload: every client commits up to `steps`
     decisions per batch (the sort-based batch is capped at one serve
@@ -88,6 +89,7 @@ def test_calendar_weight_steady_state():
     assert max(counts) > 20, f"calendar never batched deep: {counts}"
 
 
+@pytest.mark.slow
 def test_calendar_heavy_weight_skew():
     """The cfg4 cutter shape: one weight-64 client among weight-1
     clients.  A sort batch commits only the entries inside the heavy
@@ -105,6 +107,7 @@ def test_calendar_heavy_weight_skew():
     check_calendar_vs_serial(state, 500 * S, 16)
 
 
+@pytest.mark.slow
 def test_calendar_mixed_regimes():
     state, now = mixed_qos_state(n=8, depth=12)
     st, counts = drive_calendar(state, now, 8)
@@ -137,6 +140,11 @@ def test_calendar_nothing_eligible():
     check_calendar_vs_serial(state, 1, 4)
 
 
+# the fuzz families are slow (scripts/run_tests.sh still runs them):
+# each seed costs ~90s on the CPU box and the suite outgrew the
+# tier-1 wall budget at PR-9; the named differential tests above keep
+# the quick sweep's calendar-vs-serial coverage
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [61, 62, 63, 64, 65])
 def test_fuzz_calendar_matches_serial(seed):
     """Random QoS mixes / costs / arrivals: calendar batches replay
@@ -175,6 +183,7 @@ def test_fuzz_calendar_matches_serial(seed):
             now += rng.randint(1, 5) * S
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [71, 72, 73])
 def test_fuzz_calendar_allow(seed):
     """Allow mode (weights > 0 everywhere): calendar batches replay
@@ -195,6 +204,7 @@ def test_fuzz_calendar_allow(seed):
             now += rng.randint(1, 4) * S
 
 
+@pytest.mark.slow
 def test_calendar_anticipation():
     rng = random.Random(23)
     ant = S // 2
@@ -212,6 +222,7 @@ def test_calendar_anticipation():
     assert sum(counts) == 80
 
 
+@pytest.mark.slow
 def test_calendar_epoch_matches_batches():
     from dmclock_tpu.engine.fastpath import (calendar_batch,
                                              scan_calendar_epoch)

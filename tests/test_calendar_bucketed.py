@@ -165,6 +165,7 @@ def test_ladder_budget_exhaustion_mid_ladder():
     check_ladder_vs_serial(state, 100 * S, 4, 3)
 
 
+@pytest.mark.slow
 def test_ladder_l1_bit_identical_to_minstop():
     """levels=1 must reproduce calendar_batch bit for bit: same
     committed counts, same final state -- the digest-gate contract."""

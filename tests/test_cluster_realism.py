@@ -152,6 +152,7 @@ def test_per_client_costs_parity(mesh8):
                cost_of=lambda c: 1 + (c % 3))
 
 
+@pytest.mark.slow
 def test_midrun_client_creation_parity(mesh8):
     """Clients appear mid-run (rounds 1 and 2) via the sharded
     OP_CREATE ingest; the decision streams must still match the host
@@ -161,6 +162,7 @@ def test_midrun_client_creation_parity(mesh8):
                create_at={1: [8, 9], 2: [10, 11]})
 
 
+@pytest.mark.slow
 def test_midrun_creation_borrowing(mesh8):
     run_parity(mesh8, n_servers=8, n_clients=9, rounds=3, k=20,
                max_arr=2, tracker_kind="borrowing", seed=41,

@@ -54,6 +54,7 @@ def group_slices(groups):
     return out
 
 
+@pytest.mark.slow
 def test_weight_shares_under_saturation(mesh8):
     """Backlogged weight-1 vs weight-2 clients split capacity ~1:2
     (reference pull_weight behavior at sim scale)."""
@@ -153,6 +154,7 @@ def test_cli_runs(mesh8, capsys):
     assert "total ops: 1600" in report
 
 
+@pytest.mark.slow
 def test_random_server_selection(mesh8):
     """v2: device-side counter-RNG selection (reference random policy,
     simulate.h:401-444) -- load spreads over every server and weight
@@ -179,6 +181,7 @@ def test_random_server_selection(mesh8):
     assert 1.6 < ratio < 2.4, f"weight 1:2 ratio {ratio:.2f}"
 
 
+@pytest.mark.slow
 def test_multi_thread_servers(mesh8):
     """v2: threads > 1 keeps the aggregate iops model (op_time =
     threads/iops): total throughput matches the single-thread run."""
@@ -237,6 +240,7 @@ def _prefix_vs_scan(cfg, mesh8, q):
         "radix selection diverges from sort in the device sim"
 
 
+@pytest.mark.slow
 def test_prefix_serve_mode_matches_scan(mesh8):
     """Throughput shapes (q >= 256) serve via prefix-commit batches;
     the outcome must exactly match the q-step serial scan."""
@@ -250,6 +254,7 @@ def test_prefix_serve_mode_matches_scan(mesh8):
     _prefix_vs_scan(make_cfg(groups, iops=200000.0), mesh8, 256)
 
 
+@pytest.mark.slow
 def test_prefix_serve_skewed_population_matches_scan(mesh8):
     """Eligible population far below q (select_range=1 pins each
     client to ONE server: 8 reachable clients per server vs q=256): a
@@ -301,6 +306,7 @@ def test_guard_trips_checked(mesh8):
         DS.check_guard_trips(sim)
 
 
+@pytest.mark.slow
 def test_prefix_serve_allow_soft_limit_matches_scan(mesh8):
     """AtLimit::Allow (soft limit) on the prefix path: the reference's
     own stress shape (dmc_sim_100th.conf sets server_soft_limit=true,

@@ -82,6 +82,7 @@ def drive_to_exhaustion(state, now, k, *, max_batches=200,
 # the former fallback cliffs
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_single_client_deep_queue_progresses():
     """One client with many requests: all-or-nothing speculation always
     failed here (one-serve-per-client); prefix commit must serve one
@@ -105,6 +106,7 @@ def test_underfull_commits_remaining():
     check_prefix_vs_serial(st, 1000 * S, 8, expect_count=0)
 
 
+@pytest.mark.slow
 def test_regime_flip_resv_to_weight_mid_batch():
     """Reservation backlog drains mid-batch: the prefix stops exactly
     at the transition; the next batch serves the weight regime."""
@@ -118,6 +120,7 @@ def test_regime_flip_resv_to_weight_mid_batch():
     assert int(jnp.max(st.depth)) == 0
 
 
+@pytest.mark.slow
 def test_weight_to_resv_blocker():
     """A weight serve whose reservation tag becomes eligible (via the
     weight-debt reduction keeping resv near now) must stop the prefix
@@ -133,6 +136,7 @@ def test_weight_to_resv_blocker():
     assert int(jnp.max(st.depth)) == 0
 
 
+@pytest.mark.slow
 def test_ties_at_every_boundary():
     """Equal weights + equal arrivals: every batch boundary is a pure
     creation-order tie group."""
@@ -148,6 +152,7 @@ def test_ties_at_every_boundary():
     assert total == 12 * 6
 
 
+@pytest.mark.slow
 def test_k_larger_than_population():
     """k far beyond the candidate count (the old k-cliff shape): the
     prefix commits what exists, repeatedly, with no cliff."""
@@ -159,6 +164,7 @@ def test_k_larger_than_population():
     assert max(counts) <= 8
 
 
+@pytest.mark.slow
 def test_limited_clients_excluded_from_weight_prefix():
     infos = {}
     for c in range(12):
@@ -220,6 +226,7 @@ def test_prefix_epoch_concatenation_is_serial_stream():
     assert_states_equal(ep.state, st)
 
 
+@pytest.mark.slow
 def test_prefix_epoch_regime_transition():
     """An epoch spanning a resv->weight transition: the unified order
     commits across the boundary and the per-position phases match the
@@ -253,6 +260,7 @@ def test_prefix_epoch_regime_transition():
 # runner + randomized differential fuzz
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_prefix_runner_matches_serial_stream():
     infos = {c: ClientInfo(0, 1 + c % 3, 0) for c in range(10)}
     state = deep_state(infos, depth=6)
@@ -273,6 +281,7 @@ def test_prefix_runner_matches_serial_stream():
     assert total == 10 * 6
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [31, 32, 33, 34, 35, 36])
 def test_fuzz_prefix_matches_serial(seed):
     """Random QoS mixes, arrival histories, ks and nows: every batch's
@@ -317,6 +326,7 @@ def test_fuzz_prefix_matches_serial(seed):
     assert int(jnp.min(st.depth)) >= 0
 
 
+@pytest.mark.slow
 def test_fuzz_epoch_vs_batches():
     """The epoch scan must produce exactly the same stream as repeated
     single prefix batches."""
@@ -432,6 +442,7 @@ def mixed_qos_state(n=8, depth=12, resv=2.0, seed=3):
     return build_state(infos, adds, capacity=max(8, n)), now
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("chain_depth", [1, 2, 4])
 def test_chain_balanced_mix_exact(chain_depth):
     """Balanced mixed-QoS stream (phase flips every few decisions):
@@ -515,6 +526,7 @@ def test_fuzz_chains_actually_fire():
     assert max_len > 1, "chains never fired on a variable-cost stream"
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [41, 42, 43, 44])
 def test_fuzz_chain_matches_serial(seed):
     """Random QoS mixes and chain depths: every chained batch's
@@ -622,6 +634,7 @@ def limited_state(depth=6, n=8):
     return deep_state(infos, depth=depth)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("chain_depth", [1, 3])
 def test_allow_limit_break_exact(chain_depth):
     """Allow mode: the committed stream (including limit_break flags
@@ -673,6 +686,7 @@ def test_allow_flat_batch_flags_match_serial():
     assert any_lb, "Allow drive never limit-broke"
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [51, 52, 53])
 def test_fuzz_allow_matches_serial(seed):
     """Random limited populations (weight > 0 everywhere, the Allow
@@ -694,6 +708,7 @@ def test_fuzz_allow_matches_serial(seed):
             now += rng.randint(1, 4) * S
 
 
+@pytest.mark.slow
 def test_anticipation_prefix_differential():
     rng = random.Random(19)
     ant = S // 2

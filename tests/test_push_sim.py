@@ -59,6 +59,7 @@ def test_push_sched_ahead_wakeup_fires():
     assert st.finish_time_ns >= int(1.8e9)
 
 
+@pytest.mark.slow
 def test_tpu_push_trace_matches_pull():
     """The TPU engine behind the push surface, in virtual time: the
     push-mode sim trace must equal the pull-mode TPU sim trace (scaled

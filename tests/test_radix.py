@@ -93,6 +93,7 @@ def drive_both_to_exhaustion(state, now, k, *, max_batches=100, **kw):
 # radix vs sort: the differential shapes
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_radix_uniform_weights():
     infos = {c: ClientInfo(0, 1 + (c % 4), 0) for c in range(16)}
     state = deep_state(infos, depth=4)
@@ -100,6 +101,7 @@ def test_radix_uniform_weights():
     assert total == 16 * 4
 
 
+@pytest.mark.slow
 def test_radix_zipf_weights():
     """Zipf-skewed weights: the packed keys spread over decades, so
     every histogram round sees non-trivial digit distributions."""
@@ -112,6 +114,7 @@ def test_radix_zipf_weights():
     assert total == 24 * 3
 
 
+@pytest.mark.slow
 def test_radix_all_ties():
     """Equal weights + equal arrivals: every selection boundary is a
     pure creation-order tie group -- the low 28 order bits decide."""
@@ -121,6 +124,7 @@ def test_radix_all_ties():
     assert total == 12 * 6
 
 
+@pytest.mark.slow
 def test_radix_single_client():
     infos = {0: ClientInfo(0, 1, 0)}
     adds = [(0, 1 * S, 1, 1, 1) for _ in range(10)]
@@ -140,6 +144,7 @@ def test_radix_k_past_live_count():
     both_impls(batch.state, 1000 * S, 64)   # empty follow-up
 
 
+@pytest.mark.slow
 def test_radix_both_regimes():
     """Reservation backlog drains mid-run: batches cross the
     constraint->weight boundary; classes 0 and 1 both populated."""
@@ -149,6 +154,7 @@ def test_radix_both_regimes():
     assert total == 8 * 8
 
 
+@pytest.mark.slow
 def test_radix_limit_break_class():
     """AtLimit::Allow adds class 2: limit-capped clients selected by
     effective proportion with the limit_break flag."""
@@ -177,6 +183,7 @@ def test_radix_chain_batch():
     assert_states_equal(a.state, b.state)
 
 
+@pytest.mark.slow
 def test_radix_epoch_stream_identical():
     """Whole epochs under both backends: decision stream, guards, and
     final state bit-identical (the A/B contract benches rely on)."""
@@ -259,6 +266,7 @@ def _low_rate_state(n=12, depth=6):
 
 
 @pytest.mark.parametrize("select_impl", ["sort", "radix"])
+@pytest.mark.slow
 def test_tag32_epoch_bit_identical_in_window(select_impl):
     state = _high_rate_state()
     now = jnp.int64(4 * S)
@@ -273,6 +281,7 @@ def test_tag32_epoch_bit_identical_in_window(select_impl):
     assert_states_equal(e64.state, e32.state)
 
 
+@pytest.mark.slow
 def test_tag32_chain_and_calendar_epochs():
     state = _high_rate_state()
     now = jnp.int64(4 * S)
@@ -295,6 +304,7 @@ def test_tag32_chain_and_calendar_epochs():
     assert_states_equal(k64.state, k32.state)
 
 
+@pytest.mark.slow
 def test_tag32_window_trip_falls_back_exactly():
     """The fallback contract: a mid-epoch window trip zeroes that batch
     and every later one, keeps the carry at the last good state, and
@@ -331,6 +341,7 @@ def test_tag32_window_trip_falls_back_exactly():
     assert_states_equal(st_resume.state, e64_ref.state)
 
 
+@pytest.mark.slow
 def test_tag32_ignores_stale_inactive_lanes():
     """A stale lane (inactive, or active but empty) whose ancient tag
     sits far outside any window must NOT trip the int32 carry: it

@@ -152,6 +152,7 @@ def _arrivals():
 
 
 class TestChaosDifferential:
+    @pytest.mark.slow
     def test_zero_plan_bit_identical_to_no_plumbing(self, mesh4):
         rc, seq_none = RC.run_with_plan(
             _fresh_rc(mesh4), _arrivals(), 1, mesh4, None,
@@ -167,7 +168,8 @@ class TestChaosDifferential:
                         jax.tree.leaves(rc2.cluster)):
             assert np.array_equal(np.asarray(a), np.asarray(b))
 
-    @pytest.mark.parametrize("tracker_kind", ["orig", "borrowing"])
+    @pytest.mark.parametrize("tracker_kind", [
+        "orig", pytest.param("borrowing", marks=pytest.mark.slow)])
     def test_zero_plan_identity_both_trackers(self, mesh4,
                                               tracker_kind):
         _, seq_none = RC.run_with_plan(
@@ -266,6 +268,7 @@ def _low_rate_state():
 
 
 class TestGuardedEpoch:
+    @pytest.mark.slow
     def test_prefix_identity(self):
         now = jnp.int64(4 * S)
         ep = scan_prefix_epoch(_mid_rate_state(), now, 4, 8,
@@ -280,6 +283,7 @@ class TestGuardedEpoch:
                                                      f))), f
         assert_states_equal(ep.state, ge.state)
 
+    @pytest.mark.slow
     def test_chain_identity(self):
         now = jnp.int64(4 * S)
         ep = scan_chain_epoch(_mid_rate_state(), now, 3, 8,
@@ -304,6 +308,7 @@ class TestGuardedEpoch:
                               np.asarray(ge.results[0].served))
         assert_states_equal(ep.state, ge.state)
 
+    @pytest.mark.slow
     def test_tag32_trip_resumes_on_int64_exactly(self):
         now = jnp.int64(4 * S)
         e64 = scan_prefix_epoch(_low_rate_state(), now, 4, 8,

@@ -37,6 +37,7 @@ def assert_fullscale_parity(conf_name, seed=12345):
             (cb.reservation_ops, cb.priority_ops)
 
 
+@pytest.mark.slow
 def test_fullscale_example():
     """configs/dmc_sim_example.conf (1 srv x 4 cli, 8000 ops): exact
     trace parity at full scale (~25s on CPU jax)."""

@@ -8,6 +8,8 @@ versions of the acceptance configs for test-time reasons; ``bench.py``
 and the full configs cover scale.
 """
 
+import pytest
+
 from dmclock_tpu.sim import ClientGroup, ServerGroup, SimConfig
 from dmclock_tpu.sim.dmc_sim import run_sim
 
@@ -35,6 +37,7 @@ def assert_traces_equal(cfg, seed=7):
             (cb.reservation_ops, cb.priority_ops)
 
 
+@pytest.mark.slow
 def test_trace_parity_example_shape():
     """Scaled-down dmc_sim_example.conf: 4 QoS groups incl. limited and
     weighted clients, one 160-iops server, hard limit."""
@@ -63,6 +66,7 @@ def test_trace_parity_example_shape():
                                  server_soft_limit=False))
 
 
+@pytest.mark.slow
 def test_trace_parity_100th_shape():
     """Scaled-down dmc_sim_100th.conf: reservation-heavy mix with a
     cost-3 client on one server, soft limit (AtLimit.ALLOW)."""

@@ -79,13 +79,19 @@ def run_interleaving(seed, spec, n_clients=8, steps=300,
     return out, counters, counts
 
 
-@pytest.mark.parametrize("seed", [41, 42, 43, 44, 45, 46, 47, 48])
+# seeds 41/44 draw the deep-backlog interleavings (~20-40s each on
+# the CPU box): slow-marked for the tier-1 wall budget, still run by
+# scripts/run_tests.sh; the other six seeds keep the quick coverage
+@pytest.mark.parametrize("seed", [
+    pytest.param(41, marks=pytest.mark.slow), 42, 43,
+    pytest.param(44, marks=pytest.mark.slow), 45, 46, 47, 48])
 def test_spec_buffer_stream_matches_unbuffered(seed):
     a = run_interleaving(seed, spec=0)
     b = run_interleaving(seed, spec=8)
     assert a == b, f"seed {seed}: buffered stream diverges"
 
 
+@pytest.mark.slow
 def test_spec_buffer_heavy_single_client():
     """Single deep client: every buffered serve retags the same client,
     so the one-client interleavings stress consumed-prefix settling."""
@@ -112,6 +118,7 @@ def test_spec_buffer_heavy_single_client():
     assert runs[0] == runs[1]
 
 
+@pytest.mark.slow
 def test_spec_buffer_idle_reactivation():
     """do_clean idle-marks a client; its next add reactivates with a
     prop_delta shift -- the buffer must not serve stale decisions."""

@@ -69,7 +69,10 @@ def apply_sequential(state, counts, wave_times, cost, rho, delta):
     return st
 
 
-@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5, 6])
+@pytest.mark.parametrize("seed", [
+    pytest.param(1, marks=pytest.mark.slow), 2,
+    pytest.param(3, marks=pytest.mark.slow), 4, 5,
+    pytest.param(6, marks=pytest.mark.slow)])
 def test_superwave_equals_sequential_waves(seed):
     rng = random.Random(seed)
     n = rng.randint(3, 24)
@@ -118,6 +121,7 @@ def test_superwave_then_serve_matches_serial():
     assert int(np.asarray(st2.depth).sum()) == 0
 
 
+@pytest.mark.slow
 def test_superwave_zero_counts_is_identity():
     rng = random.Random(7)
     state, t = random_state(rng, 6, ring=8)
