@@ -138,17 +138,98 @@ def epoch_cost_analysis(compiled) -> dict:
     -- so PROFILE.md-style breakdowns regenerate from every bench JSON
     line instead of by hand.  Backends that cannot attribute (or old
     jax) degrade to an ``error`` note, never a crash."""
+    from dmclock_tpu.obs import compile_plane as _cp
+
     try:
         ca = compiled.cost_analysis()
     except Exception as e:      # per-backend support varies
         return {"error": f"{type(e).__name__}: {e}"}
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0] if ca else {}
-    out = {}
-    for key in ("flops", "bytes accessed", "transcendentals"):
-        if key in (ca or {}):
-            out[key.replace(" ", "_")] = float(ca[key])
+    # ONE normalization shared with the compile plane's per-entry
+    # records, so the bench row and the record cannot disagree
+    return _cp.normalize_cost_analysis(ca)
+
+
+def _capacity_row(out: dict, cap_cfg: dict, cp0: dict) -> dict:
+    """Fold the capacity plane's per-workload record into a result
+    row (docs/OBSERVABILITY.md "Capacity plane"): the compile wall +
+    retraces this workload added (compile-plane totals delta), the
+    projected resident HBM for its knob setting, and the roofline
+    verdict joining cost_analysis flops/bytes with the span tracer's
+    measured dispatch/device self-time.  Telemetry must never eat the
+    measurement -- every leg degrades, none raises."""
+    from dmclock_tpu.obs import capacity as obscap
+    from dmclock_tpu.obs import compile_plane as _cplane
+
+    t1 = _cplane.plane().totals()
+    out["compile_ms_total"] = round(
+        t1["compile_ms_total"] - cp0.get("compile_ms_total", 0.0), 3)
+    out["retraces"] = int(t1["retraces"] - cp0.get("retraces", 0))
+    try:
+        cfg = dict(cap_cfg)
+        out["projected_hbm_bytes"] = obscap.projected_hbm(
+            cfg.pop("n"), **cfg)
+    except Exception as e:
+        out["projected_hbm_error"] = f"{type(e).__name__}: {e}"
+    try:
+        rl = obscap.classify_bench_row(out)
+        out["roofline"] = rl
+        out["bound_class"] = rl["bound_class"]
+    except Exception:
+        out["bound_class"] = "unknown"
     return out
+
+
+def _capacity_gate(cap_cfg: dict, *, select_impl: str = "sort",
+                   calendar_impl: str = "minstop",
+                   engine_loop: str = "round"):
+    """Pre-launch projected-HBM check (``--capacity``): when the
+    projection exceeds the detected device budget the workload is
+    DOWNGRADED -- a stderr warning and a tagged skip row, never a
+    crash (the BENCH_r05 unkillable-bench discipline).  Returns None
+    when the workload fits or nothing is known (cpu boxes report no
+    budget)."""
+    import sys
+
+    from dmclock_tpu.obs import capacity as obscap
+
+    try:
+        cfg = dict(cap_cfg)
+        n = cfg.pop("n")
+        budget = obscap.device_hbm_budget()
+        if budget is None:
+            return None
+        projected = obscap.projected_hbm(n, **cfg)
+        ok = obscap.fits(n, budget, **cfg)
+    except Exception as e:   # the gate must never kill the bench
+        print(f"# capacity: projection failed "
+              f"({type(e).__name__}: {e}); workload not gated",
+              file=sys.stderr)
+        return None
+    if ok:
+        return None
+
+    def gib(v):
+        return f"{v / 2**30:.2f} GiB" if v >= (1 << 28) \
+            else f"{v / 2**20:.1f} MiB"
+
+    usable = int(budget * 0.9)   # fits()'s default slack_frac
+    print(f"# capacity: projected {gib(projected)} exceeds the "
+          f"usable budget {gib(usable)} (device {gib(budget)} minus "
+          f"10% slack) -- workload SKIPPED, not crashed (n={n}; "
+          f"plan_capacity() for the fitting shape)", file=sys.stderr)
+    # the skip row keeps the standard scalar keys so the metric
+    # string / history plumbing never KeyErrors; bench_guard excludes
+    # capacity_skipped rows from the medians and never judges them
+    return {"dps": 0.0, "decisions": 0, "fill": 0.0,
+            "resv_phase_frac": 0.0, "mean_depth": 0.0,
+            "decisions_per_launch": 0.0,
+            "select_impl": select_impl,
+            "calendar_impl": calendar_impl,
+            "engine_loop": engine_loop,
+            "capacity_skipped": True,
+            "projected_hbm_bytes": int(projected),
+            "hbm_budget_bytes": int(budget),
+            "cost_analysis": {}}
 
 
 def _feed_cost_registry(workload: str, cost: dict) -> None:
@@ -214,12 +295,22 @@ def bench_serve_only(k: int = 65536, m: int = 32, *,
         f"backlog {n * depth} cannot feed {need} decisions " \
         "with heavy-class margin"
     # AOT lower+compile: the Compiled handle both runs the chains and
-    # carries the cost_analysis attribution (one compilation, not two)
-    run = jax.jit(functools.partial(
-        scan_prefix_epoch, m=m, k=k, anticipation_ns=0,
-        with_metrics=with_metrics, select_impl=select_impl,
-        tag_width=tag_width, window_m=window_m),
-        donate_argnums=(0,)).lower(state, jnp.int64(0)).compile()
+    # carries the cost_analysis attribution (one compilation, not
+    # two); routed through the compile plane so the JSON line's
+    # compile_ms_total / retraces cover the bench's own programs
+    from dmclock_tpu.obs import compile_plane as _cplane
+
+    cp0 = _cplane.plane().totals()
+    run = _cplane.aot_record(
+        "bench.serve",
+        (n, k, m, depth, select_impl, tag_width, window_m,
+         with_metrics),
+        jax.jit(functools.partial(
+            scan_prefix_epoch, m=m, k=k, anticipation_ns=0,
+            with_metrics=with_metrics, select_impl=select_impl,
+            tag_width=tag_width, window_m=window_m),
+            donate_argnums=(0,)),
+        state, jnp.int64(0))
     cost = epoch_cost_analysis(run)
     # a single differenced pair still carries tunnel jitter of the
     # chains' own order; the MEDIAN over fresh-state reps is stable
@@ -264,6 +355,10 @@ def bench_serve_only(k: int = 65536, m: int = 32, *,
         out["host_overhead_frac"] = sp["host_overhead_frac"]
     if with_metrics:
         out["device_metrics"] = obsdev.metrics_dict(met)
+    _capacity_row(out, dict(n=n, ring=depth, engine="prefix", m=m,
+                            k=k, select_impl=select_impl,
+                            tag_width=tag_width,
+                            window_m=window_m), cp0)
     return out
 
 
@@ -377,6 +472,7 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     engine_loop: str = "round",
                     stream_chunk: int = 8,
                     telemetry: bool = True, slo: bool = False,
+                    capacity_check: bool = True,
                     tracer=None):
     """Closed loop: Poisson superwave ingest + prefix serve epoch per
     round, chained async on device; ingest IS inside the timed region.
@@ -400,9 +496,30 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     from dmclock_tpu.engine.fastpath import (scan_calendar_epoch,
                                              scan_chain_epoch,
                                              scan_prefix_epoch)
+    from dmclock_tpu.obs import compile_plane as _cplane
     from dmclock_tpu.obs import device as obsdev
     from dmclock_tpu.obs import histograms as obshist
     from profile_util import scalar_latency, state_digest
+
+    # capacity plane (docs/OBSERVABILITY.md): the knob setting's
+    # resident-HBM shape, for the pre-launch projected-HBM gate and
+    # the JSON line's projected_hbm_bytes
+    cap_engine = "calendar" if calendar_steps else \
+        ("chain" if chain_depth > 1 else "prefix")
+    cap_cfg = dict(
+        n=n, ring=ring, engine=cap_engine, m=m,
+        k=(calendar_steps if calendar_steps else k),
+        chain_depth=chain_depth, select_impl=select_impl,
+        calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        telemetry=telemetry, slo=slo,
+        stream_chunk=(stream_chunk if engine_loop == "stream" else 0))
+    if capacity_check:
+        skip = _capacity_gate(cap_cfg, select_impl=select_impl,
+                              calendar_impl=calendar_impl,
+                              engine_loop=engine_loop)
+        if skip is not None:
+            return skip
+    cp0 = _cplane.plane().totals()
 
     # ``split_resv`` > 0 models split-population multi-tenancy: that
     # fraction of clients are reservation-ONLY floor tenants (w=0) and
@@ -567,9 +684,13 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     # the telemetry accumulators are donated alongside the state: they
     # are pure carried state, and an un-donated [N, 5] ledger would
     # pay a fresh HBM allocation every round
-    run = jax.jit(round_fn, donate_argnums=(0, 3)).lower(
-        state, jnp.zeros((n,), jnp.int32), jnp.int64(0),
-        tele).compile()
+    run = _cplane.aot_record(
+        "bench.round",
+        (n, k, m, ring, cap_engine, select_impl, calendar_impl,
+         calendar_steps, ladder_levels, chain_depth, telemetry, slo,
+         with_metrics),
+        jax.jit(round_fn, donate_argnums=(0, 3)),
+        state, jnp.zeros((n,), jnp.int32), jnp.int64(0), tele)
     # NOT named `cost`: round_fn closes over the per-client cost
     # vector of that name, and the stream chunk re-traces round_fn
     # lazily -- shadowing it with this dict would poison the trace
@@ -607,10 +728,14 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     (counts_c, jnp.arange(c, dtype=jnp.int64)))
                 return st, outs, tele
 
-            _chunk_jits[c] = jax.jit(
-                chunk_fn, donate_argnums=(0, 3)).lower(
+            _chunk_jits[c] = _cplane.aot_record(
+                "bench.chunk",
+                (n, k, m, ring, cap_engine, select_impl,
+                 calendar_impl, calendar_steps, telemetry, slo,
+                 with_metrics, c),
+                jax.jit(chunk_fn, donate_argnums=(0, 3)),
                 state, jnp.zeros((c, n), jnp.int32), jnp.int64(0),
-                tele).compile()
+                tele)
         return _chunk_jits[c]
 
     def draw():
@@ -1059,6 +1184,7 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                             "ledger_totals": lt}
         out["_hist_block"] = h_np.tolist()   # registry feed; stripped
         #                                      by main before emit
+    _capacity_row(out, cap_cfg, cp0)
     return out
 
 
@@ -1154,6 +1280,9 @@ def bench_churn(scenario: str = "flash_crowd", *,
 
     spec = make_spec(scenario, total_ids=total_ids, seed=seed,
                      base_lam=base_lam, compact_every=2)
+    from dmclock_tpu.obs import compile_plane as _cplane
+
+    cp0 = _cplane.plane().totals()
     plane = LifecyclePlane(spec, tracer=tracer)
     state = init_state(spec["capacity0"], ring)
     hists = obshist.hist_zero()
@@ -1341,6 +1470,12 @@ def bench_churn(scenario: str = "flash_crowd", *,
                  "contract_epoch": w.cepoch, "ops": w.ops}
                 for w in slo_plane.ring_rows(boost_client)]
     out["_hist_block"] = h_np.tolist()
+    # capacity record: the open population's projection is sized for
+    # the full scripted id space landing at once (the conservative
+    # per-shard planning number), lifecycle slot map included
+    _capacity_row(out, dict(n=total_ids, ring=ring, engine=engine,
+                            m=m, k=k, telemetry=True, slo=slo,
+                            lifecycle=True), cp0)
     return out
 
 
@@ -1402,6 +1537,14 @@ def _switch_to_cpu_backend() -> None:
     jax.config.update("jax_platforms", "cpu")
     try:
         jax.clear_caches()
+    except Exception:
+        pass
+    try:
+        # the compile plane's instrumented caches hold AOT executables
+        # bound to the dead backend -- drop them or the re-entered run
+        # would dispatch into the corpse
+        from dmclock_tpu.obs import compile_plane as _cp
+        _cp.clear_compiled()
     except Exception:
         pass
     try:
@@ -1546,6 +1689,15 @@ def main() -> None:
                     "carries a per-workload 'slo' block (violation "
                     "counts, worst-window share error, p99 window "
                     "tardiness).  'off' measures the overhead")
+    ap.add_argument("--capacity", choices=["on", "off"], default="on",
+                    help="capacity plane (docs/OBSERVABILITY.md): "
+                    "pre-launch projected-HBM check per sustained "
+                    "workload (projection over budget -> warn + skip "
+                    "the workload, never crash) and the "
+                    "compile_ms_total / retraces / "
+                    "projected_hbm_bytes / bound_class record in the "
+                    "JSON line + history ('off' disables the gate; "
+                    "the record always rides)")
     ap.add_argument("--conformance-out", metavar="FILE", default=None,
                     help="write the cfg4 per-client conformance table "
                     "as JSONL")
@@ -1613,15 +1765,21 @@ def main() -> None:
         args.spans = True
     tracer = obsspans.SpanTracer() if args.spans else None
     watchdog = None
+    from dmclock_tpu.obs import compile_plane as _cplane
     if tracer is not None:
+        # compile records ride the same span stream as the launches
+        # they delay (category "compile"; docs/OBSERVABILITY.md
+        # capacity plane)
+        _cplane.plane().set_tracer(tracer)
         # steady-state watchdog: warns live when the launch cadence
-        # stalls or the dispatch share breaches its threshold
-        # (docs/OBSERVABILITY.md tracing plane)
+        # stalls, the dispatch share breaches its threshold, or a jit
+        # cache entry retraces storm-fast (docs/OBSERVABILITY.md)
         from dmclock_tpu.obs import default_registry
         from dmclock_tpu.obs.watchdog import Watchdog
         watchdog = Watchdog(tracer, interval_s=2.0,
                             stall_after_s=60.0,
-                            registry=default_registry()).start()
+                            registry=default_registry(),
+                            compile_plane=_cplane.plane()).start()
     from dmclock_tpu.robust.guarded import DegradationLadder
     ladder = DegradationLadder(enabled=not args.no_ladder,
                                threshold=1, tracer=tracer)
@@ -1769,6 +1927,7 @@ def main() -> None:
                         engine_loop=loop,
                         stream_chunk=args.stream_chunk,
                         telemetry=tele_on, slo=slo_on,
+                        capacity_check=args.capacity == "on",
                         tracer=tracer))
         if args.mode == "churn" or \
                 (args.mode == "all" and backend != "cpu"):
@@ -1820,6 +1979,7 @@ def main() -> None:
                             stream_chunk=args.stream_chunk,
                             conformance_out=args.conformance_out,
                             telemetry=tele_on, slo=slo_on,
+                            capacity_check=args.capacity == "on",
                             tracer=tracer))
                     key = "cfg4" if eff["calendar_impl"] == "minstop" \
                         else "cfg4_bucketed"
@@ -2026,6 +2186,43 @@ def main() -> None:
             if "tardiness_p99_ns" in row}
     if tard:
         final["tardiness_ns"] = tard
+    # capacity plane session record (docs/OBSERVABILITY.md "Capacity
+    # plane"): compile/retrace totals over every instrumented jit
+    # cache, per-workload projections + roofline verdicts, and the
+    # detected device budget -- the full capacity record the next
+    # silicon session captures with zero extra flags
+    try:
+        from dmclock_tpu.obs import (capacity as obscap,
+                                     default_registry,
+                                     publish_compile_metrics)
+        from dmclock_tpu.obs.capacity import publish_capacity_metrics
+        cp = _cplane.plane()
+        final["compile"] = cp.totals()
+        publish_compile_metrics(default_registry())
+        budget = obscap.device_hbm_budget()
+        cap_block = {}
+        if budget is not None:
+            cap_block["budget_bytes"] = int(budget)
+        for wl, row in results.items():
+            if "projected_hbm_bytes" in row:
+                cap_block.setdefault("projected_hbm_bytes", {})[wl] = \
+                    row["projected_hbm_bytes"]
+                publish_capacity_metrics(
+                    default_registry(),
+                    projected_bytes=row["projected_hbm_bytes"],
+                    budget_bytes=budget, workload=wl)
+            if "bound_class" in row:
+                cap_block.setdefault("bound_class", {})[wl] = \
+                    row["bound_class"]
+            if "compile_ms_total" in row:
+                cap_block.setdefault("compile_ms_total", {})[wl] = \
+                    row["compile_ms_total"]
+                cap_block.setdefault("retraces", {})[wl] = \
+                    row.get("retraces", 0)
+        if cap_block:
+            final["capacity"] = cap_block
+    except Exception as e:   # the capacity record must never eat the
+        final["capacity_error"] = f"{type(e).__name__}: {e}"  # line
     emit(final)
 
 

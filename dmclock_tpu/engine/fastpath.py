@@ -1448,6 +1448,21 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
                       flight=tele.get("f"), slo=tele.get("s"))
 
 
+# Module-level jit cache for the host-orchestrated prefix runner (the
+# engine/queue.py convention, compile-plane-instrumented): repeated
+# make_prefix_runner calls at one static config share one compiled
+# attempt/exact pair instead of re-tracing per runner.
+_RUNNER_JIT_CACHE: dict = {}
+
+
+def _runner_jit(key: tuple, make):
+    if key not in _RUNNER_JIT_CACHE:
+        from ..obs import compile_plane as _cplane
+        _RUNNER_JIT_CACHE[key] = _cplane.instrumented_jit(
+            make(), cache="fastpath.runner", entry=key)
+    return _RUNNER_JIT_CACHE[key]
+
+
 def make_prefix_runner(k: int, *, anticipation_ns: int = 0,
                        allow_limit_break: bool = False,
                        select_impl: str = "sort"):
@@ -1457,13 +1472,19 @@ def make_prefix_runner(k: int, *, anticipation_ns: int = 0,
     past 2^31 -- never observed in practice); a zero count with guards
     intact means nothing is eligible at ``now`` (serial FUTURE/NONE).
     """
-    attempt = jax.jit(functools.partial(
-        speculate_prefix_batch, k=k, anticipation_ns=anticipation_ns,
-        allow_limit_break=allow_limit_break,
-        select_impl=select_impl))
-    exact = jax.jit(lambda s, t: kernels.engine_run(
-        s, t, k, allow_limit_break=allow_limit_break,
-        anticipation_ns=anticipation_ns, advance_now=False))
+    attempt = _runner_jit(
+        ("attempt", k, anticipation_ns, allow_limit_break,
+         select_impl),
+        lambda: functools.partial(
+            speculate_prefix_batch, k=k,
+            anticipation_ns=anticipation_ns,
+            allow_limit_break=allow_limit_break,
+            select_impl=select_impl))
+    exact = _runner_jit(
+        ("exact", k, anticipation_ns, allow_limit_break),
+        lambda: lambda s, t: kernels.engine_run(
+            s, t, k, allow_limit_break=allow_limit_break,
+            anticipation_ns=anticipation_ns, advance_now=False))
 
     def run(state: EngineState, now):
         batch = attempt(state, now)

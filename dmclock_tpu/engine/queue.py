@@ -42,6 +42,7 @@ from ..core.recs import Phase, ReqParams
 from ..core.scheduler import AtLimit, NextReqType, PullReq
 from ..core.tags import tag_calc
 from ..core.timebase import MAX_TAG, MIN_TAG, sec_to_ns
+from ..obs import compile_plane as _cplane
 from ..obs import spans as _spans
 from ..robust.guarded import RECOVERABLE_ERRORS, retry_with_backoff
 from . import kernels
@@ -56,7 +57,17 @@ ClientInfoFunc = Callable[[Any], Optional[ClientInfo]]
 # sim builds 100 queues, and per-instance jits would re-TRACE the
 # engine for every one of them (tracing a long engine_run scan costs
 # seconds; XLA's compile cache only deduplicates after tracing).
+# Entries are compile-plane-instrumented (obs.compile_plane): every
+# lower+compile is timed and recorded per entry, and a re-trace is
+# attributed to the arg-signature diff that caused it.
 _JIT_CACHE: Dict[Tuple, Callable] = {}
+
+
+def _jit_cached(key: Tuple, fn) -> Callable:
+    if key not in _JIT_CACHE:
+        _JIT_CACHE[key] = _cplane.instrumented_jit(
+            fn, cache="queue", entry=key)
+    return _JIT_CACHE[key]
 
 
 def _unpack_ops(packed) -> IngestOps:
@@ -73,13 +84,10 @@ def _unpack_ops(packed) -> IngestOps:
 
 
 def _shared_jit_ingest(anticipation_ns: int):
-    key = ("ingest", anticipation_ns)
-    if key not in _JIT_CACHE:
-        def ingest_packed(s, packed):
-            return kernels.ingest(s, _unpack_ops(packed),
-                                  anticipation_ns=anticipation_ns)
-        _JIT_CACHE[key] = jax.jit(ingest_packed)
-    return _JIT_CACHE[key]
+    def ingest_packed(s, packed):
+        return kernels.ingest(s, _unpack_ops(packed),
+                              anticipation_ns=anticipation_ns)
+    return _jit_cached(("ingest", anticipation_ns), ingest_packed)
 
 
 def _pack_decisions(dec) -> jnp.ndarray:
@@ -94,30 +102,25 @@ def _pack_decisions(dec) -> jnp.ndarray:
 
 def _shared_jit_run(steps: int, advance_now: bool, allow: bool,
                     anticipation_ns: int):
-    key = ("run", steps, advance_now, allow, anticipation_ns)
-    if key not in _JIT_CACHE:
-        def run(s, t):
-            s, _, dec = kernels.engine_run(
-                s, t, steps, allow_limit_break=allow,
-                anticipation_ns=anticipation_ns,
-                advance_now=advance_now)
-            return s, _pack_decisions(dec)
-        _JIT_CACHE[key] = jax.jit(run)
-    return _JIT_CACHE[key]
+    def run(s, t):
+        s, _, dec = kernels.engine_run(
+            s, t, steps, allow_limit_break=allow,
+            anticipation_ns=anticipation_ns,
+            advance_now=advance_now)
+        return s, _pack_decisions(dec)
+    return _jit_cached(("run", steps, advance_now, allow,
+                        anticipation_ns), run)
 
 
 def _shared_jit_run_horizon(steps: int, allow: bool,
                             anticipation_ns: int):
-    key = ("run_h", steps, allow, anticipation_ns)
-    if key not in _JIT_CACHE:
-        def run(s, t):
-            s, _, dec, hz = kernels.engine_run(
-                s, t, steps, allow_limit_break=allow,
-                anticipation_ns=anticipation_ns,
-                advance_now=False, with_horizon=True)
-            return s, _pack_decisions(dec), hz
-        _JIT_CACHE[key] = jax.jit(run)
-    return _JIT_CACHE[key]
+    def run(s, t):
+        s, _, dec, hz = kernels.engine_run(
+            s, t, steps, allow_limit_break=allow,
+            anticipation_ns=anticipation_ns,
+            advance_now=False, with_horizon=True)
+        return s, _pack_decisions(dec), hz
+    return _jit_cached(("run_h", steps, allow, anticipation_ns), run)
 
 
 def _stream_windows(s, t0, dt, *, steps: int, chunks: int, allow: bool,
@@ -142,14 +145,12 @@ def _shared_jit_run_stream(steps: int, chunks: int, allow: bool,
     """The pull queue's streaming dispatch (docs/ENGINE.md
     "engine_loop"): the :func:`_stream_windows` scan as ONE launch,
     all packed decision blocks stacking in HBM and draining once."""
-    key = ("run_stream", steps, chunks, allow, anticipation_ns)
-    if key not in _JIT_CACHE:
-        def run(s, t0, dt):
-            return _stream_windows(
-                s, t0, dt, steps=steps, chunks=chunks, allow=allow,
-                anticipation_ns=anticipation_ns)
-        _JIT_CACHE[key] = jax.jit(run)
-    return _JIT_CACHE[key]
+    def run(s, t0, dt):
+        return _stream_windows(
+            s, t0, dt, steps=steps, chunks=chunks, allow=allow,
+            anticipation_ns=anticipation_ns)
+    return _jit_cached(("run_stream", steps, chunks, allow,
+                        anticipation_ns), run)
 
 
 def _shared_jit_ingest_run_stream(steps: int, chunks: int, allow: bool,
@@ -157,35 +158,31 @@ def _shared_jit_ingest_run_stream(steps: int, chunks: int, allow: bool,
     """Fused flush + streaming serve: pending op rows ingest once at
     window 0, then the chunked serve scan -- one launch where the
     sequential form pays ``1 + chunks``."""
-    key = ("ingest_run_stream", steps, chunks, allow, anticipation_ns)
-    if key not in _JIT_CACHE:
-        ant = anticipation_ns
+    ant = anticipation_ns
 
-        def fused(s, packed, t0, dt):
-            s = kernels.ingest(s, _unpack_ops(packed),
-                               anticipation_ns=ant)
-            return _stream_windows(
-                s, t0, dt, steps=steps, chunks=chunks, allow=allow,
-                anticipation_ns=ant)
-        _JIT_CACHE[key] = jax.jit(fused)
-    return _JIT_CACHE[key]
+    def fused(s, packed, t0, dt):
+        s = kernels.ingest(s, _unpack_ops(packed),
+                           anticipation_ns=ant)
+        return _stream_windows(
+            s, t0, dt, steps=steps, chunks=chunks, allow=allow,
+            anticipation_ns=ant)
+    return _jit_cached(("ingest_run_stream", steps, chunks, allow,
+                        anticipation_ns), fused)
 
 
 def _shared_jit_ingest_run(steps: int, advance_now: bool, allow: bool,
                            anticipation_ns: int):
-    key = ("ingest_run", steps, advance_now, allow, anticipation_ns)
-    if key not in _JIT_CACHE:
-        ant = anticipation_ns
+    ant = anticipation_ns
 
-        def fused(s, packed, t):
-            s = kernels.ingest(s, _unpack_ops(packed),
-                               anticipation_ns=ant)
-            s, _, dec = kernels.engine_run(
-                s, t, steps, allow_limit_break=allow,
-                anticipation_ns=ant, advance_now=advance_now)
-            return s, _pack_decisions(dec)
-        _JIT_CACHE[key] = jax.jit(fused)
-    return _JIT_CACHE[key]
+    def fused(s, packed, t):
+        s = kernels.ingest(s, _unpack_ops(packed),
+                           anticipation_ns=ant)
+        s, _, dec = kernels.engine_run(
+            s, t, steps, allow_limit_break=allow,
+            anticipation_ns=ant, advance_now=advance_now)
+        return s, _pack_decisions(dec)
+    return _jit_cached(("ingest_run", steps, advance_now, allow,
+                        anticipation_ns), fused)
 
 
 

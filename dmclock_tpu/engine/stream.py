@@ -179,7 +179,11 @@ def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
 
 # module-level jit cache keyed by the full static configuration (the
 # engine/queue.py convention): a fresh jax.jit per chunk would
-# recompile the whole fused program on every launch
+# recompile the whole fused program on every launch.  Entries are
+# compile-plane-instrumented (obs.compile_plane): the fused chunk is
+# the most expensive program in the repo to compile, so its
+# lower+compile wall and retraces are exactly what the capacity plane
+# must see.
 _STREAM_JIT_CACHE: dict = {}
 
 
@@ -188,12 +192,15 @@ def jit_stream_chunk(*, donate: bool = False, **cfg):
     donates the state + telemetry accumulators (carried HBM state, the
     bench discipline); the guarded runner keeps them alive instead so
     a tripped chunk can be discarded and re-run from its entry state."""
+    from ..obs import compile_plane as _cplane
+
     key = (donate,) + tuple(sorted(cfg.items()))
     if key not in _STREAM_JIT_CACHE:
         fn = build_stream_chunk(**cfg)
         donate_argnums = (0, 3, 4, 5, 6) if donate else ()
-        _STREAM_JIT_CACHE[key] = jax.jit(
-            fn, donate_argnums=donate_argnums)
+        _STREAM_JIT_CACHE[key] = _cplane.instrumented_jit(
+            fn, cache="stream.chunk", entry=key,
+            donate_argnums=donate_argnums)
     return _STREAM_JIT_CACHE[key]
 
 
@@ -206,6 +213,8 @@ def jit_ingest_step(*, dt_epoch_ns: int, waves: int):
     alone, for the guarded runner's round-path fallback (identical
     clamp math, so the fallback ingests exactly what the chunk would
     have)."""
+    from ..obs import compile_plane as _cplane
+
     key = (int(dt_epoch_ns), int(waves))
     if key not in _INGEST_STEP_CACHE:
         dt_wave = int(dt_epoch_ns) // int(waves)
@@ -214,7 +223,8 @@ def jit_ingest_step(*, dt_epoch_ns: int, waves: int):
             return clamped_ingest(state, counts, t_base,
                                   waves=waves, dt_wave=dt_wave)
 
-        _INGEST_STEP_CACHE[key] = jax.jit(step)
+        _INGEST_STEP_CACHE[key] = _cplane.instrumented_jit(
+            step, cache="stream.ingest", entry=key)
     return _INGEST_STEP_CACHE[key]
 
 

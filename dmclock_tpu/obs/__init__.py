@@ -33,6 +33,16 @@ that warns on launch-cadence stalls and dispatch-share breaches.
 Spans are host-side only, never in-graph -- decisions are
 bit-identical with tracing on or off.
 
+And the capacity plane (``obs.compile_plane``, ``obs.capacity``): an
+instrumented jit-cache wrapper adopted by every module-level jit cache
+(per-entry lower+compile wall, retraces with the arg-signature diff
+that caused them, ``cost_analysis`` flops/bytes, ``memory_analysis``
+HBM breakdown -- exported as ``dmclock_compile_*`` families and as
+``compile``-category spans), a static HBM footprint ledger over the
+live state pytrees with a ``plan_capacity()`` inverse (max clients
+per chip for a budget and knob setting), and a roofline attributor
+classifying workloads compute-/memory-/dispatch-bound.
+
 See ``docs/OBSERVABILITY.md`` for metric names and schemas.
 """
 
@@ -45,8 +55,11 @@ from .trace_export import export_chrome_trace, validate_chrome_trace
 from .watchdog import Watchdog
 from .slo import SloPlane
 from .alerts import SloEvaluator, mount_slo_api
-from . import alerts, device, flight, histograms, slo, spans, \
-    trace_export
+from .compile_plane import (CompilePlane, instrumented_jit,
+                            publish_compile_metrics)
+from .compile_plane import plane as compile_plane_singleton
+from . import alerts, capacity, compile_plane, device, flight, \
+    histograms, slo, spans, trace_export
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "TimerMetric",
@@ -55,6 +68,8 @@ __all__ = [
     "DecisionTrace", "validate_trace_file",
     "SpanTracer", "export_chrome_trace", "validate_chrome_trace",
     "Watchdog", "SloPlane", "SloEvaluator", "mount_slo_api",
-    "alerts", "device", "flight", "histograms", "slo", "spans",
-    "trace_export",
+    "CompilePlane", "instrumented_jit", "publish_compile_metrics",
+    "compile_plane_singleton",
+    "alerts", "capacity", "compile_plane", "device", "flight",
+    "histograms", "slo", "spans", "trace_export",
 ]

@@ -41,9 +41,11 @@ from typing import Callable, Dict, List, Optional, Tuple
 # The fixed category taxonomy (docs/OBSERVABILITY.md).  Every span
 # carries exactly one; the exporter validates against this set so a
 # typo'd category fails in CI instead of silently fragmenting the
-# attribution tables.
+# attribution tables.  "compile" is the capacity plane's time axis
+# (obs.compile_plane): one span per jit-cache lower+compile, so
+# compile storms land on the same timeline as the launches they delay.
 CATEGORIES = ("ingest", "host_prep", "dispatch", "device_compute",
-              "fetch", "drain", "checkpoint", "retry")
+              "fetch", "drain", "checkpoint", "retry", "compile")
 
 # JSONL row schema (docs/OBSERVABILITY.md): ts/dur/self in ns from
 # perf_counter_ns (monotonic within a process -- NOT comparable across

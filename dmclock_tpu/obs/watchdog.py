@@ -19,7 +19,15 @@ structured health warnings while a run is still going -- the
   self-time exceeds ``dispatch_share_warn`` of the
   dispatch+device_compute total -- the run is paying more to LAUNCH
   work than to DO it, the exact pathology the ROADMAP's streaming
-  serve loop exists to kill (PROFILE.md findings 17-18).
+  serve loop exists to kill (PROFILE.md findings 17-18);
+- **retrace storm** (when a ``compile_plane`` is attached): one jit
+  cache entry re-traced >= ``retrace_storm_k`` times inside
+  ``retrace_window_s`` -- an argument signature is churning (a shape
+  bug, an un-padded dynamic dimension) and every churn pays a full
+  XLA compile, the >15-minute-on-Mosaic failure mode PROFILE.md
+  records.  First compiles are NOT retraces, so the PR-8 AOT
+  pre-compile loop (one fresh entry per chunk length) can never fire
+  this.
 
 Warnings are structured: one JSON line on ``log`` (default stderr,
 prefixed ``# watchdog:``), a bump of the
@@ -64,6 +72,9 @@ class Watchdog:
                  min_window_ns: int = 1_000_000,
                  in_flight_max_s: Optional[float] = None,
                  registry=None,
+                 compile_plane=None,
+                 retrace_storm_k: int = 4,
+                 retrace_window_s: float = 120.0,
                  log: Callable[[str], None] = _stderr_log,
                  clock_ns: Callable[[], int] =
                  _walltime.perf_counter_ns):
@@ -99,8 +110,15 @@ class Watchdog:
         # not vanish from it
         self._share_prev = tracer.category_totals()
         self._share_prev_count = dict(self._prev_count)
+        # retrace-storm check (obs.compile_plane): the plane's event
+        # clock must share this watchdog's clock domain (both default
+        # perf_counter_ns; tests inject one fake into both)
+        self._cplane = compile_plane
+        self.retrace_storm_k = int(retrace_storm_k)
+        self.retrace_window_ns = int(retrace_window_s * 1e9)
         self._stall_warned = False
         self._share_warned = False
+        self._retrace_warned = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -180,6 +198,32 @@ class Watchdog:
             self._share_prev = totals
             self._share_prev_count = counts
         self._prev_count = counts
+
+        # retrace storm: the SAME cache entry re-traced >= K times in
+        # the window.  First compiles never count (a retrace is the
+        # 2nd+ signature on one entry -- obs.compile_plane), so the
+        # legitimate first-compile of each chunk length in an AOT
+        # pre-compile loop is invisible here by construction.  Once
+        # per episode; a window with no entry at storm level re-arms.
+        if self._cplane is not None and self.retrace_storm_k > 0:
+            lo = now_ns - self.retrace_window_ns
+            per: dict = {}
+            for t_ns, entry in self._cplane.retrace_events():
+                if t_ns >= lo:
+                    per[entry] = per.get(entry, 0) + 1
+            worst = max(per.items(), key=lambda kv: kv[1],
+                        default=(None, 0))
+            if worst[1] >= self.retrace_storm_k:
+                if not self._retrace_warned:
+                    out.append({"kind": "retrace_storm",
+                                "entry": worst[0],
+                                "retraces": worst[1],
+                                "window_s":
+                                    self.retrace_window_ns / 1e9})
+                self._retrace_warned = True
+            else:
+                self._retrace_warned = False
+
         for w in out:
             self.warnings.append(w)
             if self._counter is not None:

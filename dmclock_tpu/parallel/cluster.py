@@ -297,6 +297,8 @@ def mesh_step_jit(cache: dict, step_fn, mesh: Mesh, cfg: tuple):
     (decisions_per_step, max_arrivals, anticipation_ns,
     allow_limit_break, advance_ns).  The unhashable-mesh id() fallback
     lives HERE so a jax-version fix lands in one place."""
+    from ..obs import compile_plane as _cplane
+
     try:
         key = (mesh,) + cfg
         hash(key)
@@ -305,13 +307,23 @@ def mesh_step_jit(cache: dict, step_fn, mesh: Mesh, cfg: tuple):
     if key not in cache:
         (decisions_per_step, max_arrivals, anticipation_ns,
          allow_limit_break, advance_ns) = cfg
-        cache[key] = jax.jit(functools.partial(
-            step_fn, mesh=mesh,
-            decisions_per_step=decisions_per_step,
-            max_arrivals=max_arrivals,
-            anticipation_ns=anticipation_ns,
-            allow_limit_break=allow_limit_break,
-            advance_ns=advance_ns))
+        # compile-plane-instrumented (obs.compile_plane): the mesh
+        # step is the program the multichip item compiles per (mesh,
+        # config) pair; entry is keyed WITHOUT the mesh repr (the
+        # object id is meaningless across runs), but WITH the mesh
+        # shape -- distinct meshes at one cfg are distinct programs,
+        # and colliding them would record phantom retraces
+        mesh_shape = tuple(np.shape(getattr(mesh, "devices", ())))
+        cache[key] = _cplane.instrumented_jit(
+            functools.partial(
+                step_fn, mesh=mesh,
+                decisions_per_step=decisions_per_step,
+                max_arrivals=max_arrivals,
+                anticipation_ns=anticipation_ns,
+                allow_limit_break=allow_limit_break,
+                advance_ns=advance_ns),
+            cache=f"cluster.{getattr(step_fn, '__name__', 'step')}",
+            entry=cfg + (mesh_shape,))
     return cache[key]
 
 

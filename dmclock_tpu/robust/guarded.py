@@ -104,6 +104,7 @@ class GuardedEpoch(NamedTuple):
 # engine/queue.py _JIT_CACHE convention): a fresh jax.jit(partial(...))
 # per call would retrace + recompile the whole epoch program on EVERY
 # guarded run, and the compile dwarfs the epoch at bench shapes.
+# Entries are compile-plane-instrumented (obs.compile_plane).
 _EPOCH_JIT_CACHE: dict = {}
 
 
@@ -116,17 +117,18 @@ def _jit_epoch(engine: str, m_run: int, kw: dict, tele_sig=()):
     if key not in _EPOCH_JIT_CACHE:
         import functools
 
-        import jax
-
         from ..engine import fastpath
+        from ..obs import compile_plane as _cplane
         fn = fastpath.epoch_scan_fn(engine)
         if tele_sig:
             def run(st, t, tele):
                 return fn(st, t, m=m_run, **kw, **tele)
-            _EPOCH_JIT_CACHE[key] = jax.jit(run)
+            _EPOCH_JIT_CACHE[key] = _cplane.instrumented_jit(
+                run, cache="guarded.epoch", entry=key)
         else:
-            _EPOCH_JIT_CACHE[key] = jax.jit(
-                functools.partial(fn, m=m_run, **kw))
+            _EPOCH_JIT_CACHE[key] = _cplane.instrumented_jit(
+                functools.partial(fn, m=m_run, **kw),
+                cache="guarded.epoch", entry=key)
     return _EPOCH_JIT_CACHE[key]
 
 
@@ -136,13 +138,14 @@ def _jit_serial(steps: int, allow_limit_break: bool,
     if key not in _EPOCH_JIT_CACHE:
         import functools
 
-        import jax
-
         from ..engine import kernels
-        _EPOCH_JIT_CACHE[key] = jax.jit(functools.partial(
-            kernels.engine_run, steps=steps,
-            allow_limit_break=allow_limit_break,
-            anticipation_ns=anticipation_ns, advance_now=False))
+        from ..obs import compile_plane as _cplane
+        _EPOCH_JIT_CACHE[key] = _cplane.instrumented_jit(
+            functools.partial(
+                kernels.engine_run, steps=steps,
+                allow_limit_break=allow_limit_break,
+                anticipation_ns=anticipation_ns, advance_now=False),
+            cache="guarded.serial", entry=key)
     return _EPOCH_JIT_CACHE[key]
 
 

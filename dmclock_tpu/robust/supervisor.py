@@ -559,10 +559,10 @@ def _jit_ingest(job: EpochJob):
     engine/queue.py module-cache convention)."""
     key = (job.n, job.ring, job.waves, job.dt_epoch_ns)
     if key not in _INGEST_JIT_CACHE:
-        import jax
         import jax.numpy as jnp
 
         from ..engine import kernels
+        from ..obs import compile_plane as _cplane
 
         waves, dt_wave = job.waves, job.dt_epoch_ns // job.waves
         cost = jnp.ones((job.n,), dtype=jnp.int64)
@@ -574,7 +574,8 @@ def _jit_ingest(job: EpochJob):
                                             cost, cost, cost,
                                             anticipation_ns=0)
 
-        _INGEST_JIT_CACHE[key] = jax.jit(ingest)
+        _INGEST_JIT_CACHE[key] = _cplane.instrumented_jit(
+            ingest, cache="supervisor.ingest", entry=key)
     return _INGEST_JIT_CACHE[key]
 
 
@@ -600,6 +601,16 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     start_epoch = 0
     decisions = 0
     tracer = _spans.SpanTracer() if job.span_log else None
+    if tracer is not None:
+        # compile records ride the SAME per-incarnation span stream
+        # (category "compile"), so they flush with the span_log at
+        # checkpoint boundaries -- the rotation checkpoints'
+        # durability window (docs/OBSERVABILITY.md capacity plane).
+        # Compile walls are host-side per-incarnation facts, like
+        # every other span: deliberately outside the checkpointed
+        # state and the crash-equivalence comparison.
+        from ..obs import compile_plane as _cplane
+        _cplane.plane().set_tracer(tracer)
     ladder = DegradationLadder(enabled=job.ladder,
                                threshold=job.ladder_threshold,
                                tracer=tracer)

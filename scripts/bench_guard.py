@@ -39,6 +39,17 @@ scripted population size (total_ids): the population is DYNAMIC, so
 the record carries peak/live client counts next to the rate and a
 session against a different id space never enters the medians.  The
 p99-tardiness warn thresholds apply to churn series like any other.
+
+compile_ms_total and retraces (the capacity plane's per-workload
+compile record, docs/OBSERVABILITY.md "Capacity plane") are tracked
+the same warn-only way: a compile-time regression or a retrace storm
+can eat a whole silicon session (PROFILE.md records a >15-minute
+Mosaic compile) while dec/s of the epochs that DID run holds.  Both
+medians are floored (100ms / 1 retrace) so clean histories never flap
+on jitter or a first stray retrace.  Workload rows the capacity gate
+skipped (projected HBM over budget; "capacity_skipped": true) are
+excluded from every median and never judged -- a skip is a capacity
+verdict, not a rate.
 """
 
 from __future__ import annotations
@@ -195,6 +206,7 @@ def main() -> int:
         return [r["workloads"][wl][key] for _, r in prior
                 if wl in r.get("workloads", {})
                 and key in r["workloads"][wl]
+                and not r["workloads"][wl].get("capacity_skipped")
                 and r["workloads"][wl].get("select_impl",
                                            "sort") == impl
                 and r["workloads"][wl].get("calendar_impl",
@@ -208,6 +220,17 @@ def main() -> int:
     for wl, row in sorted(newest.get("workloads", {}).items()):
         dps = row.get("dps")
         if dps is None:
+            continue
+        if row.get("capacity_skipped"):
+            # the capacity gate downgraded this workload before launch
+            # (projected HBM over the device budget): a deliberate
+            # skip, not a rate -- never judged, never in the medians
+            print(f"bench_guard: {wl}: SKIPPED by the capacity gate "
+                  f"(projected "
+                  f"{row.get('projected_hbm_bytes', 0)/2**30:.2f} GiB"
+                  f" vs budget "
+                  f"{row.get('hbm_budget_bytes', 0)/2**30:.2f} GiB) "
+                  "-- not judged")
             continue
         # the selection backend is part of the series identity: sort
         # and radix epochs are bit-identical in DECISIONS but not in
@@ -390,6 +413,65 @@ def main() -> int:
                     print(f"bench_guard: {tag}: worst-window share "
                           f"err {serr:.3f} vs median {s_med:.3f} "
                           "-- OK")
+        # compile wall per workload (the capacity plane's compile
+        # record) as its own warn-only series: a compile-time
+        # regression (a fusion pass giving up, a program blowup)
+        # lands BEFORE the timed chains, so dec/s holds while the
+        # session's setup cost explodes -- the >15-min-Mosaic-compile
+        # failure mode.  Warn-only: compile time on the shared tunnel
+        # drifts like everything else.
+        cms = row.get("compile_ms_total")
+        if cms is not None:
+            c_hist = series(wl, "compile_ms_total", impl, cal, loop,
+                            scen, pop)
+            if len(c_hist) < args.min_records:
+                print(f"bench_guard: {tag}: compile {cms:.0f}ms "
+                      f"({len(c_hist)} prior record(s) -- not "
+                      "judged)")
+            else:
+                c_med = median(c_hist)
+                # floor the median at 100ms: sub-100ms compiles are
+                # cache-hit noise, not a regression signal
+                ceil = max(c_med, 100.0) * args.tolerance
+                if cms > ceil:
+                    print(f"bench_guard: {tag}: WARNING compile "
+                          f"{cms:.0f}ms vs median {c_med:.0f}ms "
+                          f"over {len(c_hist)} sessions "
+                          f"(> {args.tolerance:g}x) -- the workload's "
+                          "compile wall regressed; a retrace storm "
+                          "or program blowup can eat a whole "
+                          "silicon session; investigate",
+                          file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: compile {cms:.0f}ms "
+                          f"vs median {c_med:.0f}ms -- OK")
+        # retraces as their own series, floored at 1: a clean history
+        # (median 0) must not flap on one stray retrace, but a
+        # retrace count past tolerance x max(median, 1) means an
+        # argument signature is churning (the watchdog's
+        # retrace_storm warning is the live view of the same signal)
+        rt = row.get("retraces")
+        if rt is not None:
+            r_hist = series(wl, "retraces", impl, cal, loop, scen,
+                            pop)
+            if len(r_hist) < args.min_records:
+                print(f"bench_guard: {tag}: retraces {rt} "
+                      f"({len(r_hist)} prior record(s) -- not "
+                      "judged)")
+            else:
+                r_med = median(r_hist)
+                ceil = max(r_med, 1.0) * args.tolerance
+                if rt > ceil:
+                    print(f"bench_guard: {tag}: WARNING retraces "
+                          f"{rt} vs median {r_med:g} over "
+                          f"{len(r_hist)} sessions "
+                          f"(> {args.tolerance:g}x) -- an argument "
+                          "signature is churning; every retrace "
+                          "pays a full XLA compile; investigate",
+                          file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: retraces {rt} vs "
+                          f"median {r_med:g} -- OK")
     if status:
         print(f"bench_guard: FAILED on {newest_name} -- a >"
               f"{args.tolerance:g}x drop survived the drift margin; "

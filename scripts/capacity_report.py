@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Capacity report: the bench JSON line's capacity record as a table.
+
+Renders the capacity plane's per-workload record (docs/OBSERVABILITY.md
+"Capacity plane") from either input shape:
+
+- a **bench output file** (the line ``bench.py`` prints; stderr noise
+  and non-JSON lines are skipped, the last JSON object wins) -- reads
+  the ``capacity`` / ``compile`` / ``cost_analysis`` / ``spans``
+  blocks;
+- a **benchmark/history record** (``benchmark/history/bench_*.json``)
+  -- reads the per-workload scalars directly.
+
+Columns: compile wall + retraces the workload added, projected
+resident HBM, cost_analysis flops / bytes accessed, arithmetic
+intensity, measured dispatch share (when spans ran), and the roofline
+``bound_class``.  ``--diff BASELINE`` prints per-workload deltas --
+the before/after instrument for a compile-time or footprint
+regression, same contract as ``trace_report.py --diff``.
+
+Usage:
+    python scripts/capacity_report.py BENCH.json [--diff BASELINE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional
+
+
+def load_line(path: str) -> dict:
+    """Last parseable JSON object in the file (the bench emits one
+    line, but logs may surround it; history records are one
+    pretty-printed object, so the whole-file parse is tried first)."""
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        whole = json.loads(text)
+        if isinstance(whole, dict):
+            return whole
+    except json.JSONDecodeError:
+        pass
+    obj = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            cand = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(cand, dict):
+            obj = cand
+    if obj is None:
+        raise ValueError(f"{path}: no JSON object found")
+    return obj
+
+
+def workload_rows(obj: dict) -> Dict[str, dict]:
+    """Normalize either input shape to {workload: scalars}."""
+    if "workloads" in obj:          # a history record
+        return {wl: dict(row) for wl, row in obj["workloads"].items()}
+    rows: Dict[str, dict] = {}
+    cap = obj.get("capacity") or {}
+    for field in ("projected_hbm_bytes", "bound_class",
+                  "compile_ms_total", "retraces"):
+        for wl, v in (cap.get(field) or {}).items():
+            rows.setdefault(wl, {})[field] = v
+    for wl, ca in (obj.get("cost_analysis") or {}).items():
+        if isinstance(ca, dict):
+            rows.setdefault(wl, {}).update(
+                {k: v for k, v in ca.items()
+                 if k in ("flops", "bytes_accessed")})
+    for wl, sp in (obj.get("spans") or {}).items():
+        if isinstance(sp, dict):
+            row = rows.setdefault(wl, {})
+            d = sp.get("dispatch_ms_per_launch")
+            dev = sp.get("device_ms_per_launch")
+            if d is not None and dev is not None and (d + dev) > 0:
+                row["dispatch_share"] = d / (d + dev)
+    return rows
+
+
+def _mib(v) -> str:
+    return f"{v / 2**20:.1f}M" if v is not None else "-"
+
+
+def _num(v, fmt="{:.0f}") -> str:
+    return fmt.format(v) if v is not None else "-"
+
+
+def render(rows: Dict[str, dict], totals: Optional[dict],
+           budget: Optional[int], out=sys.stdout) -> None:
+    hdr = (f"{'workload':<24} {'compile_ms':>10} {'retrace':>7} "
+           f"{'proj_hbm':>9} {'flops':>10} {'bytes':>10} "
+           f"{'AI':>6} {'disp%':>6}  bound_class")
+    print(hdr, file=out)
+    print("-" * len(hdr), file=out)
+    for wl in sorted(rows):
+        r = rows[wl]
+        flops = r.get("flops")
+        byts = r.get("bytes_accessed")
+        ai = (flops / byts) if flops and byts else None
+        share = r.get("dispatch_share")
+        print(f"{wl:<24} "
+              f"{_num(r.get('compile_ms_total')):>10} "
+              f"{_num(r.get('retraces')):>7} "
+              f"{_mib(r.get('projected_hbm_bytes')):>9} "
+              f"{_num(flops, '{:.2e}'):>10} "
+              f"{_num(byts, '{:.2e}'):>10} "
+              f"{_num(ai, '{:.2f}'):>6} "
+              f"{_num(share * 100 if share is not None else None):>6} "
+              f" {r.get('bound_class', '-')}"
+              + ("  [CAPACITY-SKIPPED]"
+                 if r.get("capacity_skipped") else ""),
+              file=out)
+    if totals:
+        print(f"\ncompile totals: {totals.get('entries', 0)} cache "
+              f"entries, {totals.get('compiles', 0)} compiles "
+              f"({totals.get('retraces', 0)} retraces), "
+              f"{totals.get('compile_ms_total', 0):.0f}ms compile + "
+              f"{totals.get('lower_ms_total', 0):.0f}ms lower, "
+              f"{totals.get('dispatch_fallbacks', 0)} dispatch "
+              "fallbacks", file=out)
+    if budget is not None:
+        print(f"device HBM budget: {budget / 2**30:.2f} GiB",
+              file=out)
+
+
+def render_diff(rows: Dict[str, dict], base: Dict[str, dict],
+                out=sys.stdout) -> None:
+    hdr = (f"{'workload':<24} {'d compile_ms':>12} {'d retrace':>9} "
+           f"{'d proj_hbm':>11}  bound_class")
+    print("\n-- diff vs baseline --", file=out)
+    print(hdr, file=out)
+    print("-" * len(hdr), file=out)
+    for wl in sorted(set(rows) | set(base)):
+        a, b = rows.get(wl), base.get(wl)
+        if a is None or b is None:
+            print(f"{wl:<24} {'(only in ' + ('new' if b is None else 'baseline') + ')':>12}",
+                  file=out)
+            continue
+
+        def delta(key):
+            x, y = a.get(key), b.get(key)
+            if x is None or y is None:
+                return None
+            return x - y
+
+        dc = delta("compile_ms_total")
+        dr = delta("retraces")
+        dh = delta("projected_hbm_bytes")
+        bc_a = a.get("bound_class", "-")
+        bc_b = b.get("bound_class", "-")
+        bc = bc_a if bc_a == bc_b else f"{bc_b} -> {bc_a}"
+        print(f"{wl:<24} "
+              f"{_num(dc, '{:+.0f}'):>12} "
+              f"{_num(dr, '{:+.0f}'):>9} "
+              f"{(_num(dh / 2**20, '{:+.1f}M') if dh is not None else '-'):>11}"
+              f"  {bc}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="capacity-plane report from a bench JSON line or "
+                    "history record")
+    ap.add_argument("bench", help="bench output file or "
+                    "benchmark/history record")
+    ap.add_argument("--diff", metavar="BASELINE", default=None,
+                    help="baseline file to diff against")
+    args = ap.parse_args(argv)
+
+    obj = load_line(args.bench)
+    rows = workload_rows(obj)
+    if not rows:
+        print(f"{args.bench}: no capacity record (run bench.py with "
+              "the capacity plane on)", file=sys.stderr)
+        return 1
+    render(rows, obj.get("compile"),
+           (obj.get("capacity") or {}).get("budget_bytes"))
+    if args.diff:
+        render_diff(rows, workload_rows(load_line(args.diff)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

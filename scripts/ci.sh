@@ -640,6 +640,147 @@ print("slo smoke ok (digest gates green; dmclock_slo_* scrapes; "
       "GET /slo live)")
 EOF
 
+echo "== capacity smoke (plane on/off digest gate + planner round-trip + 10% projection gate) =="
+# the capacity plane (docs/OBSERVABILITY.md "Capacity plane"): (1) the
+# compile/retrace observatory must leave decisions BIT-IDENTICAL with
+# the plane on or off, on the serial engine and on all three epoch
+# engines under BOTH the round and the stream loop (the wrapper
+# dispatches the exact program jax.jit would); (2) plan_capacity()
+# must invert the HBM ledger exactly -- the planned N fits the budget
+# and N+eps refuses; (3) the ledger's projection for the cfg4 STATE
+# shape (100k clients, ring 128, calendar m=3 steps=64, telemetry+slo
+# on) must be within 10% of the real compiled program's
+# memory_analysis() argument bytes on the CPU backend.
+timeout -k 30 1200 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import dataclasses, functools, hashlib
+import numpy as np, jax.numpy as jnp
+from dmclock_tpu.obs import capacity as CAP, compile_plane as CP
+from dmclock_tpu.robust import supervisor as SV
+from dmclock_tpu.robust.guarded import _jit_serial
+from __graft_entry__ import _preloaded_state
+from profile_util import state_digest
+
+# (1a) serial engine: instrumented jit on/off, byte-identical
+def serial_digest():
+    st = _preloaded_state(512, 6, ring=8)
+    run = _jit_serial(64, False, 0)
+    s, _, dec = run(st, jnp.int64(10 ** 9))
+    h = hashlib.sha256()
+    for arr in jax.tree_util.tree_leaves(dec):
+        h.update(np.asarray(jax.device_get(arr)).tobytes())
+    h.update(np.asarray(jax.device_get(state_digest(s))).tobytes())
+    return h.hexdigest()
+
+digs = {}
+for on in (True, False):
+    CP.plane().enable(on)
+    digs[on] = serial_digest()
+assert digs[True] == digs[False], "serial digest diverged with the plane"
+print(f"serial: capacity plane on/off digest gate ok ({digs[True][:16]})")
+
+# (1b) three epoch engines x round/stream
+base = dict(n=160, depth=6, ring=12, epochs=4, m=2, seed=9,
+            arrival_lam=1.5, waves=3, ckpt_every=2)
+matrix = {
+    "prefix": SV.EpochJob(engine="prefix", k=16, **base),
+    "chain": SV.EpochJob(engine="chain", chain_depth=3, k=8, **base),
+    "calendar": SV.EpochJob(engine="calendar", k=4,
+                            calendar_impl="bucketed",
+                            ladder_levels=2, **base),
+}
+for name, job in matrix.items():
+    for loop in ("round", "stream"):
+        j = dataclasses.replace(job, engine_loop=loop)
+        res = {}
+        for on in (True, False):
+            CP.plane().enable(on)
+            res[on] = SV.run_job(j)
+        assert res[True].decisions > 0, (name, loop)
+        assert res[True].digest == res[False].digest, (name, loop)
+        assert res[True].state_digest == res[False].state_digest
+        assert np.array_equal(res[True].metrics, res[False].metrics)
+    print(f"{name}: plane on/off digest gate ok on round + stream "
+          f"({res[True].decisions} decisions, "
+          f"digest {res[True].digest[:16]})")
+CP.plane().enable(True)
+t = CP.plane().totals()
+assert t["compiles"] > 0, "the plane recorded no compiles"
+print(f"compile plane: {t['entries']} entries, {t['compiles']} "
+      f"compiles, {t['retraces']} retraces, "
+      f"{t['compile_ms_total']:.0f}ms compile wall")
+
+# (2) plan_capacity round-trip: planned N fits, N+eps refuses
+cfg = dict(ring=128, engine="calendar", m=3, k=64, telemetry=True,
+           slo=True)
+budget = 16 << 30    # a v5e-sized 16 GiB budget
+plan = CAP.plan_capacity(budget, **cfg)
+n_max = plan["max_clients"]
+assert n_max > 0
+assert CAP.fits(n_max, budget, **cfg)
+assert not CAP.fits(n_max + 1024, budget, **cfg)
+print(f"plan_capacity round-trip ok: {n_max} clients fit a 16 GiB "
+      f"budget at the cfg4 knobs ({plan['bytes_per_client']:.0f} "
+      f"B/client); N+1024 refuses")
+
+# (3) projected vs measured at the cfg4 STATE shape (abstract
+# lowering -- no 100k-client buffers are allocated)
+from dmclock_tpu.engine import fastpath
+from dmclock_tpu.obs import histograms as obshist, slo as obsslo
+n, ring, m, steps = 100_000, 128, 3, 64
+st = CAP.abstract_state(n, ring)
+comp = jax.jit(functools.partial(
+    fastpath.scan_calendar_epoch, m=m, steps=steps,
+    anticipation_ns=0, with_metrics=True,
+    calendar_impl="minstop")).lower(
+        st, jax.ShapeDtypeStruct((), np.dtype(np.int64)),
+        hists=jax.eval_shape(obshist.hist_zero),
+        ledger=jax.eval_shape(functools.partial(obshist.ledger_zero,
+                                                n)),
+        slo=jax.eval_shape(functools.partial(obsslo.window_zero,
+                                             n))).compile()
+mem = CP.memory_analysis_dict(comp)
+proj = sum(CAP.hbm_ledger(n, ring=ring, telemetry=True,
+                          slo=True).values())
+measured = mem["argument_bytes"]
+rel = abs(proj - measured) / measured
+assert rel <= 0.10, (proj, measured, rel)
+print(f"cfg4-shape projection ok: projected {proj/2**20:.1f} MiB vs "
+      f"memory_analysis {measured/2**20:.1f} MiB "
+      f"(rel err {rel:.2e}, gate 10%; XLA:CPU advisory -- PROFILE.md)")
+print("capacity smoke ok")
+EOF
+
+echo "== capacity report reproduction (real bench line) =="
+# scripts/capacity_report.py must reproduce the capacity table from a
+# real recorded bench line (benchmark/history carries the capacity
+# scalars since the capacity plane landed) and --diff must render
+timeout -k 30 300 python - <<'EOF'
+import json, subprocess, sys
+from pathlib import Path
+hist = sorted(Path("benchmark/history").glob("bench_*.json"))
+rec = None
+for p in reversed(hist):
+    wl = json.loads(p.read_text()).get("workloads", {})
+    if any("compile_ms_total" in row for row in wl.values()):
+        rec = p
+        break
+if rec is None:
+    print("no capacity-bearing history record yet -- skip "
+          "(bench.py records one per session)")
+    sys.exit(0)
+out = subprocess.run(
+    [sys.executable, "scripts/capacity_report.py", str(rec),
+     "--diff", str(rec)], capture_output=True, text=True)
+assert out.returncode == 0, out.stderr
+assert "bound_class" in out.stdout and "compile_ms" in out.stdout
+assert "diff vs baseline" in out.stdout
+print(f"capacity_report ok on {rec.name}:")
+print("\n".join(out.stdout.splitlines()[:3]))
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

@@ -35,4 +35,9 @@ def _clear_jax_caches_between_modules():
     stall."""
     yield
     jax.clear_caches()
+    # the compile plane's instrumented caches hold AOT executables
+    # OUTSIDE jax's own caches -- drop those too, or the relief this
+    # fixture exists for never reaches the module jit caches
+    from dmclock_tpu.obs import compile_plane
+    compile_plane.clear_compiled()
     gc.collect()

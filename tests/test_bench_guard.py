@@ -429,3 +429,100 @@ def test_slo_not_judged_without_history(monkeypatch, capsys,
     rc, out = run_guard(monkeypatch, capsys, hist)
     assert rc == 0
     assert "not judged" in out
+
+
+def write_history_capacity(tmp_path, rows):
+    """rows = [(dps, compile_ms, retraces)] or a dict row -- the
+    capacity plane's per-workload compile record (bench.py; docs/
+    OBSERVABILITY.md "Capacity plane")."""
+    h = tmp_path / "history"
+    h.mkdir(parents=True)
+    for i, row in enumerate(rows):
+        if isinstance(row, tuple):
+            dps, cms, rt = row
+            row = {"dps": dps, "compile_ms_total": cms,
+                   "retraces": rt}
+        (h / f"bench_{5000 + i}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": "tpu0",
+             "workloads": {"cfg4": row}}))
+    return h
+
+
+def test_compile_series_ok_when_stable(monkeypatch, capsys, tmp_path):
+    hist = write_history_capacity(tmp_path, [(40e6, 900.0, 0),
+                                             (42e6, 1100.0, 0),
+                                             (41e6, 1000.0, 0)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "compile 1000ms vs median" in out and "OK" in out
+    assert "retraces 0 vs median" in out
+
+
+def test_compile_blowup_warns_but_passes(monkeypatch, capsys,
+                                         tmp_path):
+    # a >tolerance compile-wall regression (the >15-min-Mosaic shape)
+    # while dec/s held: warn-only, like the dispatch-tax series
+    monkeypatch.setattr(bg, "HISTORY",
+                        write_history_capacity(tmp_path,
+                                               [(40e6, 900.0, 0),
+                                                (42e6, 1100.0, 0),
+                                                (41e6, 9000.0, 0)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING compile" in cap.err
+    assert "compile wall regressed" in cap.err
+
+
+def test_retrace_churn_warns_but_passes(monkeypatch, capsys,
+                                        tmp_path):
+    monkeypatch.setattr(bg, "HISTORY",
+                        write_history_capacity(tmp_path,
+                                               [(40e6, 900.0, 0),
+                                                (42e6, 950.0, 1),
+                                                (41e6, 980.0, 9)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING retraces 9" in cap.err
+    assert "argument signature is churning" in cap.err
+
+
+def test_compile_clean_history_floored(monkeypatch, capsys, tmp_path):
+    # floors: sub-100ms compile medians and a first stray retrace are
+    # cache-hit noise, not regressions -- a clean history never flaps
+    monkeypatch.setattr(bg, "HISTORY",
+                        write_history_capacity(tmp_path,
+                                               [(40e6, 20.0, 0),
+                                                (42e6, 30.0, 0),
+                                                (41e6, 150.0, 1)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING compile" not in cap.err
+    assert "WARNING retraces" not in cap.err
+
+
+def test_capacity_skipped_rows_excluded_and_not_judged(monkeypatch,
+                                                       capsys,
+                                                       tmp_path):
+    # a capacity-gate skip row (projected HBM over budget) neither
+    # enters the medians nor gets judged as a 0-dps regression
+    skip = {"dps": 0.0, "capacity_skipped": True,
+            "projected_hbm_bytes": 32 << 30,
+            "hbm_budget_bytes": 16 << 30}
+    hist = write_history_capacity(
+        tmp_path, [(40e6, 900.0, 0), (42e6, 950.0, 0), skip])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "SKIPPED by the capacity gate" in out
+    # and a skip row in the PRIOR history must not drag the median
+    hist2 = write_history_capacity(
+        tmp_path / "h2",
+        [(40e6, 900.0, 0), skip, (42e6, 950.0, 0), (41e6, 940.0, 0)])
+    rc2, out2 = run_guard(monkeypatch, capsys, hist2)
+    assert rc2 == 0
+    assert "REGRESSION" not in out2
