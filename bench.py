@@ -472,8 +472,9 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     engine_loop: str = "round",
                     stream_chunk: int = 8,
                     telemetry: bool = True, slo: bool = False,
+                    provenance: bool = True,
                     capacity_check: bool = True,
-                    tracer=None):
+                    tracer=None, watchdog=None):
     """Closed loop: Poisson superwave ingest + prefix serve epoch per
     round, chained async on device; ingest IS inside the timed region.
 
@@ -591,26 +592,39 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                                float(weights[c]), 0.0)
         slo_eval = SloEvaluator(slo_plane, log=lambda _line: None)
 
-    def tele_zero():
+    from dmclock_tpu.obs import provenance as obsprov
+
+    def tele_zero(t0=0):
         out = (obshist.hist_zero(), obshist.ledger_zero(n)) \
             if telemetry else ()
+        if provenance:
+            # t0 = the measurement baseline: the post-calibration
+            # reset must not read continuously-served clients as
+            # starved since virtual t=0
+            out = out + (obsprov.prov_init(n, now_ns=t0),)
         if slo:
+            # the SLO block stays LAST: the per-chain roll reads and
+            # replaces tele[-1]
             out = out + (slo_plane.stamp(obsslo.window_zero(n)),)
         return out
 
     def tele_unpack(tele):
-        if telemetry and slo:
-            return tele
+        i = 0
+        th = tl = tp = ts = None
         if telemetry:
-            return tele + (None,)
+            th, tl = tele[0], tele[1]
+            i = 2
+        if provenance:
+            tp = tele[i]
+            i += 1
         if slo:
-            return (None, None) + tele
-        return (None, None, None)
+            ts = tele[i]
+        return th, tl, tp, ts
 
     tele = tele_zero()
 
     def round_fn(st, counts, t_base, tele):
-        th, tl, ts = tele_unpack(tele)
+        th, tl, tp, ts = tele_unpack(tele)
         headroom = jnp.maximum(
             st.ring_capacity - st.depth, 0).astype(jnp.int32)
         # admission clamp (the AtLimit Reject/EAGAIN analog); the drop
@@ -627,6 +641,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
 
         def tele_pack(ep):
             out = (ep.hists, ep.ledger) if telemetry else ()
+            if provenance:
+                out = out + (ep.prov,)
             return out + (ep.slo,) if slo else out
         # returns (state, count[m], guards[m], resv_decisions[m],
         # slot[m,k], length[m,k], metrics): the phase split reduces ON
@@ -644,7 +660,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                                      with_metrics=with_metrics,
                                      calendar_impl=calendar_impl,
                                      ladder_levels=ladder_levels,
-                                     hists=th, ledger=tl, slo=ts)
+                                     hists=th, ledger=tl, slo=ts,
+                                    prov=tp)
             return (ep.state, ep.count, ep.progress_ok,
                     ep.resv_count, ep.served,
                     jnp.ones_like(ep.served),
@@ -656,7 +673,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                                   anticipation_ns=0,
                                   with_metrics=with_metrics,
                                   select_impl=select_impl,
-                                  hists=th, ledger=tl, slo=ts)
+                                  hists=th, ledger=tl, slo=ts,
+                                    prov=tp)
             units = ep.slot >= 0
             lens = ep.length.astype(jnp.int32)
             # a unit's entry serve is weight-phase iff class >= 1;
@@ -668,7 +686,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
             ep = scan_prefix_epoch(st, now, m, k, anticipation_ns=0,
                                    with_metrics=with_metrics,
                                    select_impl=select_impl,
-                                   hists=th, ledger=tl, slo=ts)
+                                   hists=th, ledger=tl, slo=ts,
+                                    prov=tp)
             srv_pos = ep.slot >= 0
             resv = jnp.sum(srv_pos & (ep.phase == 0),
                            axis=1).astype(jnp.int32)
@@ -866,8 +885,9 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                                     state.limit_inv)
     # calibration's warm-up serves pollute the distribution: reset the
     # telemetry accumulators so the reported percentiles cover the
-    # measured steady state only
-    tele = tele_zero()
+    # measured steady state only (the provenance watermark re-arms at
+    # the current virtual time)
+    tele = tele_zero(int(t_base))
     # span window opens HERE: the summary covers the timed chains
     # only (calibration spans stay in the timeline but out of the
     # dispatch-tax decomposition)
@@ -985,6 +1005,10 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
            "mean_depth": mean_depth,
            "select_impl": select_impl,
            "engine_loop": engine_loop,
+           # part of the bench_guard series identity: a
+           # provenance-off session's rates must never enter (or be
+           # judged against) provenance-on medians
+           "provenance_on": bool(provenance),
            "cost_analysis": cost_attr}
     # launches-per-decision is the streaming loop's acceptance
     # currency (ROADMAP #1): decisions_per_launch counts the TIMED
@@ -1184,6 +1208,27 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                             "ledger_totals": lt}
         out["_hist_block"] = h_np.tolist()   # registry feed; stripped
         #                                      by main before emit
+    if provenance:
+        # ONE untimed fetch of the provenance block (the telemetry
+        # drain discipline): margin percentiles from the on-device
+        # log2 histogram, the limit-gate share, the starvation
+        # watermark -- the "why" scalars next to the "what" ones
+        prov_f = tele[2 if telemetry else 0]
+        pd = obsprov.prov_dict(prov_f)
+        out["provenance"] = pd
+        out["margin_p50_ns"] = pd["margin_p50_ns"]
+        out["margin_p99_ns"] = pd["margin_p99_ns"]
+        out["starvation_max_ns"] = pd["starvation_max_ns"]
+        out["limit_gate_share"] = round(pd["limit_gate_share"], 4)
+        # once-per-episode client_starved warnings through the PR-7
+        # watchdog external-warning hook (or stderr): a backlogged
+        # client unserved for > 8 rounds of virtual time at the end
+        # of the measured region is starving RIGHT NOW
+        mon = obsprov.StarvationMonitor(8 * dt_round_ns,
+                                        watchdog=watchdog)
+        mon.observe(prov_f, int(t_base), backlog=state.depth)
+        if mon.fired:
+            out["starved_clients"] = mon.fired[:8]
     _capacity_row(out, cap_cfg, cp0)
     return out
 
@@ -1689,6 +1734,19 @@ def main() -> None:
                     "carries a per-workload 'slo' block (violation "
                     "counts, worst-window share error, p99 window "
                     "tardiness).  'off' measures the overhead")
+    ap.add_argument("--provenance", choices=["on", "off"],
+                    default="on",
+                    help="accumulate the decision provenance plane "
+                    "(obs.provenance) inside the timed sustained "
+                    "rounds: per-decision winner margins, the "
+                    "limit-gate state, eligible-set depth, winning "
+                    "phase, and the per-client last-served "
+                    "starvation watermark; decisions are "
+                    "bit-identical either way, and the JSON line "
+                    "carries margin_p50/p99_ns, limit_gate_share, "
+                    "and starvation_max_ns ('off' measures the "
+                    "overhead; provenance-off rows form their own "
+                    "bench_guard series)")
     ap.add_argument("--capacity", choices=["on", "off"], default="on",
                     help="capacity plane (docs/OBSERVABILITY.md): "
                     "pre-launch projected-HBM check per sustained "
@@ -1761,6 +1819,7 @@ def main() -> None:
     wm = args.device_metrics == "on"
     tele_on = args.telemetry == "on"
     slo_on = args.slo == "on"
+    prov_on = args.provenance == "on"
     if args.trace_out:
         args.spans = True
     tracer = obsspans.SpanTracer() if args.spans else None
@@ -1927,8 +1986,9 @@ def main() -> None:
                         engine_loop=loop,
                         stream_chunk=args.stream_chunk,
                         telemetry=tele_on, slo=slo_on,
+                        provenance=prov_on,
                         capacity_check=args.capacity == "on",
-                        tracer=tracer))
+                        tracer=tracer, watchdog=watchdog))
         if args.mode == "churn" or \
                 (args.mode == "all" and backend != "cpu"):
             # open-population churn scenario (docs/LIFECYCLE.md).  An
@@ -1979,8 +2039,9 @@ def main() -> None:
                             stream_chunk=args.stream_chunk,
                             conformance_out=args.conformance_out,
                             telemetry=tele_on, slo=slo_on,
+                            provenance=prov_on,
                             capacity_check=args.capacity == "on",
-                            tracer=tracer))
+                            tracer=tracer, watchdog=watchdog))
                     key = "cfg4" if eff["calendar_impl"] == "minstop" \
                         else "cfg4_bucketed"
                     if loop == "stream":
@@ -2102,6 +2163,26 @@ def main() -> None:
                                          publish_span_gauges)
             publish_span_gauges(default_registry(), row["spans"],
                                 labels={"workload": wl})
+        if "provenance" in row:
+            # per-workload provenance verdicts as labelled gauges on
+            # the same scrape endpoint (dmclock_provenance_* /
+            # dmclock_starvation_* family names)
+            from dmclock_tpu.obs import default_registry
+            reg = default_registry()
+            pd = row["provenance"]
+            for key in ("margin_p50_ns", "margin_p99_ns",
+                        "limit_gate_share", "eligible_depth_mean",
+                        "eligible_depth_max"):
+                reg.gauge(f"dmclock_provenance_{key}",
+                          "per-workload decision provenance scalar "
+                          "(docs/OBSERVABILITY.md Provenance plane)",
+                          labels={"workload": wl}) \
+                    .set(float(pd[key]))
+            reg.gauge("dmclock_starvation_max_ns",
+                      "per-workload starvation watermark "
+                      "(provenance plane)",
+                      labels={"workload": wl}) \
+                .set(float(pd["starvation_max_ns"]))
         if "slo" in row:
             # per-workload SLO verdicts as labelled gauges on the
             # same scrape endpoint (dmclock_slo_* family names)
@@ -2177,6 +2258,10 @@ def main() -> None:
                 if "slo" in row}
     if slo_rows:
         final["slo"] = slo_rows
+    prov_rows = {wl: row["provenance"] for wl, row in results.items()
+                 if "provenance" in row}
+    if prov_rows:
+        final["provenance"] = prov_rows
     tard = {wl: {"p50": row["tardiness_p50_ns"],
                  "p90": row["tardiness_p90_ns"],
                  "p99": row["tardiness_p99_ns"],

@@ -79,6 +79,7 @@ from ..core.timebase import MAX_TAG, MIN_TAG
 from ..obs import device as obsdev
 from ..obs import flight as obsflight
 from ..obs import histograms as obshist
+from ..obs import provenance as obsprov
 from ..obs import slo as obsslo
 from . import kernels
 from .kernels import (KEY_INF, NONE, RETURNING, Decision, _make_tag,
@@ -579,6 +580,9 @@ class _Selection(NamedTuple):
     last_client: jnp.ndarray  # int32 slot of the final committed unit
     cost_pc: jnp.ndarray     # int64[N] delivered cost per client over
     #                          the committed prefix (0 off-prefix)
+    margin_s: jnp.ndarray    # int64[k] winner margin over the exact
+    #                          runner-up per committed unit, ns
+    #                          (-1 = no runner-up; obs.provenance)
 
 
 def _unified_prefix(state: EngineState, now, k: int, *,
@@ -696,6 +700,22 @@ def _unified_prefix(state: EngineState, now, k: int, *,
     j = jnp.arange(k, dtype=jnp.int32)
     served = j < count_units
     cls_s = (pks >> 60).astype(jnp.int32)   # >= CLS_NONE on sentinels
+
+    # provenance margins (obs.provenance): at the instant unit j
+    # commits, the candidate set is {entries j+1..} plus the re-entry
+    # exit keys of the already-served prefix p < j -- so the EXACT
+    # runner-up is min(pks[j+1], cm_excl[j]), both already
+    # materialized.  The >> 28 strips the order bits (packed key =
+    # cls<<60 | rebased-ns<<28 | order): a same-class margin is the
+    # tag distance in ns; a cross-class one carries the class step
+    # (>= 2^32 ns -- "the phase ladder, not the tag, decided").  -1 =
+    # no runner-up existed (sole candidate).  Dead code unless a
+    # provenance/flight consumer reads it (XLA DCE).
+    nxt = jnp.concatenate(
+        [pks[1:], jnp.full((1,), jnp.int64(KEY_INF))])
+    runner = jnp.minimum(nxt, cm_excl)
+    margin_s = jnp.where(served & (runner < jnp.int64(KEY_INF)),
+                         (runner - pks) >> 28, jnp.int64(-1))
     if chain_depth == 1:
         count = count_units
     else:
@@ -735,7 +755,8 @@ def _unified_prefix(state: EngineState, now, k: int, *,
                       guards_ok=guards_ok, state=new_state,
                       last_client=last_client,
                       cost_pc=jnp.where(sel, chain.cost_acc,
-                                        jnp.int64(0)))
+                                        jnp.int64(0)),
+                      margin_s=margin_s)
 
 
 # ----------------------------------------------------------------------
@@ -754,6 +775,8 @@ class PrefixBatch(NamedTuple):
     decisions: Decision    # [k]; slots -1 / type NONE past `count`
     cost_pc: object = None  # int64[N] delivered cost per client (the
     #                         SLO window block's cost column feed)
+    margins: object = None  # int64[k] per-decision winner margin, ns
+    #                         (-1 = no runner-up; obs.provenance)
 
 
 def speculate_prefix_batch(state: EngineState, now, k: int, *,
@@ -790,7 +813,7 @@ def speculate_prefix_batch(state: EngineState, now, k: int, *,
     )
     return PrefixBatch(state=s.state, count=s.count,
                        guards_ok=s.guards_ok, decisions=decisions,
-                       cost_pc=s.cost_pc)
+                       cost_pc=s.cost_pc, margins=s.margin_s)
 
 
 # ----------------------------------------------------------------------
@@ -813,6 +836,7 @@ class ChainBatch(NamedTuple):
     cls: jnp.ndarray         # int32[k] unit entry class
     length: jnp.ndarray      # int32[k] unit decisions
     cost_pc: object = None   # int64[N] delivered cost per client
+    margins: object = None   # int64[k] per-unit winner margin, ns
 
 
 def speculate_chain_batch(state: EngineState, now, k: int, *,
@@ -838,7 +862,7 @@ def speculate_chain_batch(state: EngineState, now, k: int, *,
         slot=jnp.where(served, s.idxs, -1).astype(jnp.int32),
         cls=jnp.where(served, s.cls_s, CLS_NONE).astype(jnp.int32),
         length=jnp.where(served, s.len_s, 0).astype(jnp.int32),
-        cost_pc=s.cost_pc)
+        cost_pc=s.cost_pc, margins=s.margin_s)
 
 
 def expand_units(slot, cls, length, pre_state, *,
@@ -1013,6 +1037,7 @@ class PrefixEpoch(NamedTuple):
     ledger: object = None  # int64[N, LED_COLS]
     flight: object = None  # obs.flight.FlightState
     slo: object = None     # int64[N, W_FIELDS] window block (obs.slo)
+    prov: object = None    # obs.provenance.ProvBlock
 
 
 def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
@@ -1106,9 +1131,9 @@ def _telemetry_delta(st_post: EngineState, now, cls, key, served_pc,
 
 
 def _tele_init(state: EngineState, hists, ledger, flight,
-               slo=None) -> dict:
-    """Normalize the four optional telemetry accumulators into the
-    tele carry dict (presence of a key IS the static on-flag)."""
+               slo=None, prov=None) -> dict:
+    """Normalize the optional telemetry accumulators into the tele
+    carry dict (presence of a key IS the static on-flag)."""
     tele = {}
     if hists is not None:
         tele["h"] = jnp.asarray(hists, dtype=jnp.int64)
@@ -1126,6 +1151,11 @@ def _tele_init(state: EngineState, hists, ledger, flight,
             f"slo window shape {slo.shape} != " \
             f"({state.capacity}, {obsslo.W_FIELDS})"
         tele["s"] = slo
+    if prov is not None:
+        assert prov.last_served.shape == (state.capacity,), \
+            f"prov last_served shape {prov.last_served.shape} != " \
+            f"({state.capacity},)"
+        tele["p"] = prov
     return tele
 
 
@@ -1143,13 +1173,16 @@ def _tele_fold(tele: dict, hd, ld, live, sd=None) -> dict:
 
 
 def _tele_entry_fold(tele: dict, st: EngineState, post_state,
-                     now, allow: bool, count, live, cost_pc=None):
+                     now, allow: bool, count, live, cost_pc=None,
+                     margins=None):
     """The shared prefix/chain telemetry fold: batch-entry
     classification, depth-delta served counts, the entry-head
     resv/limit-break derivation, and the gated histogram/ledger/window
     fold -- ONE implementation so the two sorted engines' entry-head
-    semantics cannot drift.  Returns ``(tele, key_e)`` (the entry
-    keys feed each engine's own flight record)."""
+    semantics cannot drift.  ``margins`` is the batch's per-record
+    winner-margin array (the provenance plane's histogram feed).
+    Returns ``(tele, key_e, gate_n)`` -- the entry keys and the
+    limit-gated client count feed each engine's own flight record."""
     cls_e, key_e = _classify(st, now, allow)
     served_pc = (st.depth - post_state.depth).astype(jnp.int32)
     srv = served_pc > 0
@@ -1160,15 +1193,27 @@ def _tele_entry_fold(tele: dict, st: EngineState, post_state,
         (srv & (cls_e == CLS_LB)).astype(jnp.int32),
         count, "h" in tele, "l" in tele,
         cost_pc=cost_pc, with_slo="s" in tele)
-    return _tele_fold(tele, hd, ld, live, sd), key_e
+    has_req = st.active & (st.depth > 0)
+    elig = cls_e != CLS_NONE
+    gate_n = jnp.sum(has_req & ~elig).astype(jnp.int64)
+    out = _tele_fold(tele, hd, ld, live, sd)
+    if "p" in tele:
+        newp = obsprov.prov_observe(
+            tele["p"], now=now, elig=elig, gated=has_req & ~elig,
+            win_cls=jnp.min(jnp.where(elig, cls_e, CLS_NONE)),
+            served_pc=served_pc, margins=margins)
+        out["p"] = obsprov.prov_select(live, newp, tele["p"])
+    return out, key_e, gate_n
 
 
-def _tele_flight(tele: dict, slot, cls, tag, cost, live) -> dict:
+def _tele_flight(tele: dict, slot, cls, tag, cost, live,
+                 margin=None, gate=None) -> dict:
     if "f" not in tele:
         return tele
     out = dict(tele)
     out["f"] = obsflight.flight_record(tele["f"], slot, cls, tag,
-                                       cost, live=live)
+                                       cost, live=live,
+                                       margin=margin, gate=gate)
     return out
 
 
@@ -1180,7 +1225,8 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
                       tag_width: int = 64,
                       window_m: int | None = None,
                       hists=None, ledger=None,
-                      flight=None, slo=None) -> PrefixEpoch:
+                      flight=None, slo=None,
+                      prov=None) -> PrefixEpoch:
     """Run m flat prefix-commit batches of up to k decisions on device.
 
     EVERY batch commits its own exact prefix, so the concatenated
@@ -1214,16 +1260,17 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
     (the chain's cost scales with the window width -- PROFILE.md).
     Must divide m; None = one m-row window (the original layout).
 
-    ``hists`` / ``ledger`` / ``flight`` / ``slo`` (each None = off;
-    presence is the static flag) are INITIAL telemetry accumulators
-    (``obs.histograms.hist_zero()`` / ``ledger_zero(N)`` /
-    ``obs.flight.flight_init(R)`` / ``obs.slo.window_zero(N)`` or the
-    previous epoch's outputs, so chained epochs accumulate on device
-    with one final fetch).  They ride the scan carry next to the
-    metrics vector and come back as the epoch result's
-    ``hists``/``ledger``/``flight``/``slo`` fields; the decision
-    stream and final state are bit-identical with telemetry on or off
-    (tests/test_telemetry.py, tests/test_slo.py).
+    ``hists`` / ``ledger`` / ``flight`` / ``slo`` / ``prov`` (each
+    None = off; presence is the static flag) are INITIAL telemetry
+    accumulators (``obs.histograms.hist_zero()`` / ``ledger_zero(N)``
+    / ``obs.flight.flight_init(R)`` / ``obs.slo.window_zero(N)`` /
+    ``obs.provenance.prov_init(N)`` or the previous epoch's outputs,
+    so chained epochs accumulate on device with one final fetch).
+    They ride the scan carry next to the metrics vector and come back
+    as the epoch result's ``hists``/``ledger``/``flight``/``slo``/
+    ``prov`` fields; the decision stream and final state are
+    bit-identical with telemetry on or off (tests/test_telemetry.py,
+    tests/test_slo.py, tests/test_provenance.py).
     """
     assert tag_width in (32, 64), tag_width
     w = m if window_m is None else min(int(window_m), m)
@@ -1232,7 +1279,7 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     met0 = obsdev.metrics_zero()
-    tele0 = _tele_init(state, hists, ledger, flight, slo)
+    tele0 = _tele_init(state, hists, ledger, flight, slo, prov)
     need_class = bool(tele0)
     if narrow32:
         tc = _TagCarry32(state)
@@ -1285,13 +1332,15 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
         if need_class:
             # entry classification recomputed for telemetry only (a
             # cheap dense pass; the decision stream is untouched)
-            tele, key_e = _tele_entry_fold(
+            tele, key_e, gate_n = _tele_entry_fold(
                 tele, st, batch.state, now, allow_limit_break,
-                batch.count, good, cost_pc=batch.cost_pc)
+                batch.count, good, cost_pc=batch.cost_pc,
+                margins=batch.margins)
             tele = _tele_flight(
                 tele, slot,
                 phase.astype(jnp.int64) + lb.astype(jnp.int64),
-                jnp.take(key_e, jnp.maximum(slot, 0)), cost, good)
+                jnp.take(key_e, jnp.maximum(slot, 0)), cost, good,
+                margin=batch.margins, gate=gate_n)
         carry = (mut, met, tele, dead) if narrow32 \
             else (mut, met, tele)
         return carry, out
@@ -1320,7 +1369,7 @@ def scan_prefix_epoch(state: EngineState, now, m: int, k: int, *,
                        slot=slot, phase=phase, cost=cost, lb=lb,
                        metrics=metrics, hists=tele.get("h"),
                        ledger=tele.get("l"), flight=tele.get("f"),
-                       slo=tele.get("s"))
+                       slo=tele.get("s"), prov=tele.get("p"))
 
 
 class ChainEpoch(NamedTuple):
@@ -1340,6 +1389,7 @@ class ChainEpoch(NamedTuple):
     ledger: object = None
     flight: object = None
     slo: object = None
+    prov: object = None
 
 
 def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
@@ -1350,7 +1400,8 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
                      select_impl: str = "sort",
                      tag_width: int = 64,
                      hists=None, ledger=None,
-                     flight=None, slo=None) -> ChainEpoch:
+                     flight=None, slo=None,
+                     prov=None) -> ChainEpoch:
     """Run m chained prefix batches on device.  Each batch prefetches
     its own ``chain_depth``-row ring window (one barrel-shift ring
     pass per batch; a shared per-epoch window would need m *
@@ -1365,7 +1416,7 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     met0 = obsdev.metrics_zero()
-    tele0 = _tele_init(state, hists, ledger, flight, slo)
+    tele0 = _tele_init(state, hists, ledger, flight, slo, prov)
     need_class = bool(tele0)
     if narrow32:
         tc = _TagCarry32(state)
@@ -1422,13 +1473,15 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
                 guards_ok=batch.guards_ok, rebase_fallback=trip,
                 live=good)
         if need_class:
-            tele, key_e = _tele_entry_fold(
+            tele, key_e, gate_n = _tele_entry_fold(
                 tele, st, batch.state, now, allow_limit_break,
-                batch.count, good, cost_pc=batch.cost_pc)
+                batch.count, good, cost_pc=batch.cost_pc,
+                margins=batch.margins)
             tele = _tele_flight(
                 tele, slot, cls.astype(jnp.int64),
                 jnp.take(key_e, jnp.maximum(slot, 0)),
-                length.astype(jnp.int64), good)
+                length.astype(jnp.int64), good,
+                margin=batch.margins, gate=gate_n)
         carry = (mut, met, tele, dead) if narrow32 \
             else (mut, met, tele)
         return carry, out
@@ -1445,7 +1498,8 @@ def scan_chain_epoch(state: EngineState, now, m: int, k: int, *,
                       guards_ok=guards, slot=slot, cls=cls,
                       length=length, metrics=metrics,
                       hists=tele.get("h"), ledger=tele.get("l"),
-                      flight=tele.get("f"), slo=tele.get("s"))
+                      flight=tele.get("f"), slo=tele.get("s"),
+                      prov=tele.get("p"))
 
 
 # Module-level jit cache for the host-orchestrated prefix runner (the
@@ -1547,6 +1601,11 @@ class CalendarBatch(NamedTuple):
     lb: jnp.ndarray           # int32[N] limit-break entries (Allow)
     progress_ok: jnp.ndarray  # bool: count>0 or no candidate existed
     served_cost: object = None  # int64[N] delivered cost per client
+    margin: object = None     # int64[N] boundary-distance margin per
+    #                           served client: B_eff minus the
+    #                           client's LAST unit-entry pack, ns for
+    #                           same-class keys (-1 = not served or
+    #                           no finite boundary; obs.provenance)
 
 
 def _cal_pack(cls, key, kresv, kprop1, kprop2):
@@ -1792,12 +1851,20 @@ def _calendar_batch_core(state: EngineState, now, arr_rows, cost_rows,
         do_promote, promoted, new_state.head_ready))
 
     count = jnp.sum(served).astype(jnp.int32)
+    # boundary-distance margin (obs.provenance): how much headroom
+    # B_eff left each served client's LAST unit entry -- the calendar
+    # analog of the sorted engines' runner-up margin (the boundary IS
+    # the first unfollowable competitor).  Dead code unless a
+    # provenance/flight consumer reads it (XLA DCE).
+    margin = jnp.where((served > 0) & (b_eff < jnp.int64(KEY_INF)),
+                       b_eff - last_pk, jnp.int64(-1))
     batch = CalendarBatch(
         state=new_state, count=count,
         resv_count=jnp.sum(served_resv).astype(jnp.int32),
         units=units, served=served, served_resv=served_resv, lb=lb,
         progress_ok=(count > 0) | ~any_cand,
-        served_cost=jnp.where(served > 0, cost_pc, jnp.int64(0)))
+        served_cost=jnp.where(served > 0, cost_pc, jnp.int64(0)),
+        margin=margin)
     return batch, b_eff, stop_pk
 
 
@@ -1896,7 +1963,8 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
                           anticipation_ns: int, allow: bool,
                           use_pallas, with_hists: bool = False,
                           with_ledger: bool = False,
-                          with_slo: bool = False):
+                          with_slo: bool = False,
+                          prov0=None):
     """The fused ladder: a lax.scan over L levels, each a full
     window-prefetch + measure + histogram boundary + commit from the
     previous level's committed state.  Carries only the mutable epoch
@@ -1907,13 +1975,22 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
     accumulated per LEVEL (so a level equals one minstop batch and
     bucketed-L telemetry equals the L-batch composition exactly; the
     caller folds the deltas gated on batch liveness), and ``outs`` the
-    per-level (count, resv_count, bound, stall) stacks."""
+    per-level (count, resv_count, bound, stall) stacks.  ``prov0``
+    (an ``obs.provenance.ProvBlock``) threads the provenance block
+    through the levels as FULL STATE (not a delta): each level
+    observes its own entry classification and boundary margins, and
+    the caller selects the returned block against the entry block on
+    batch liveness."""
     n = invariant["active"].shape[-1]
     acc0 = dict(units=jnp.zeros((n,), jnp.int32),
                 served=jnp.zeros((n,), jnp.int32),
                 served_resv=jnp.zeros((n,), jnp.int32),
                 lb=jnp.zeros((n,), jnp.int32),
-                cost=jnp.zeros((n,), jnp.int64))
+                cost=jnp.zeros((n,), jnp.int64),
+                # newest boundary-distance margin per client across
+                # levels (-1 = never observed): the flight record's
+                # margin column for the whole bucketed batch
+                margin=jnp.full((n,), jnp.int64(-1)))
     tacc0 = {}
     if with_hists:
         tacc0["h"] = obshist.hist_zero()
@@ -1921,6 +1998,8 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
         tacc0["l"] = obshist.ledger_zero(n)
     if with_slo:
         tacc0["s"] = obsslo.window_zero(n)
+    if prov0 is not None:
+        tacc0["p"] = prov0
 
     def level(carry, _):
         mut, acc, tacc = carry
@@ -1935,8 +2014,10 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
                    served=acc["served"] + batch.served,
                    served_resv=acc["served_resv"] + batch.served_resv,
                    lb=acc["lb"] + batch.lb,
-                   cost=acc["cost"] + batch.served_cost)
-        if with_hists or with_ledger or with_slo:
+                   cost=acc["cost"] + batch.served_cost,
+                   margin=jnp.where(batch.margin >= 0, batch.margin,
+                                    acc["margin"]))
+        if with_hists or with_ledger or with_slo or prov0 is not None:
             # per-LEVEL entry classification: level i starts from the
             # exact serial state at boundary i-1, so these are the
             # same observations L sequential minstop batches would
@@ -1954,6 +2035,14 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
                 tacc["l"] = obshist.ledger_combine(tacc["l"], ld)
             if with_slo:
                 tacc["s"] = obsslo.window_combine(tacc["s"], sd)
+            if prov0 is not None:
+                has_req = st.active & (st.depth > 0)
+                elig = cls_e != CLS_NONE
+                tacc["p"] = obsprov.prov_observe(
+                    tacc["p"], now=now, elig=elig,
+                    gated=has_req & ~elig,
+                    win_cls=jnp.min(jnp.where(elig, cls_e, CLS_NONE)),
+                    served_pc=batch.served, margins=batch.margin)
         # a level that commits nothing WITH candidates present is a
         # ladder stall: progress_ok's per-level analog (later levels
         # deterministically repeat it -- same state, same boundary)
@@ -2049,6 +2138,7 @@ class CalendarEpoch(NamedTuple):
     ledger: object = None
     flight: object = None
     slo: object = None
+    prov: object = None
 
 
 def scan_calendar_epoch(state: EngineState, now, m: int, *,
@@ -2060,7 +2150,8 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                         calendar_impl: str = "minstop",
                         ladder_levels: int = 8,
                         hists=None, ledger=None,
-                        flight=None, slo=None) -> CalendarEpoch:
+                        flight=None, slo=None,
+                        prov=None) -> CalendarEpoch:
     """Run m calendar batches on device (each prefetches its own
     ``steps``-row ring window).  ``tag_width`` as in
     :func:`scan_prefix_epoch` (a window trip reports
@@ -2092,7 +2183,7 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
     served0 = jnp.zeros((state.capacity,), dtype=jnp.int32)
     met0 = obsdev.metrics_zero()
-    tele0 = _tele_init(state, hists, ledger, flight, slo)
+    tele0 = _tele_init(state, hists, ledger, flight, slo, prov)
     need_tele = bool(tele0)
     if narrow32:
         tc = _TagCarry32(state)
@@ -2111,7 +2202,7 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
         else:
             mut, acc, met, tele = carry
             st = EngineState(**invariant, **mut)
-        hd = ld = sd = None
+        hd = ld = sd = p_new = margin_pc = None
         if need_tele:
             # batch-entry classification, shared by the minstop
             # telemetry delta and the flight records (ONE definition,
@@ -2128,9 +2219,11 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                     levels=levels, anticipation_ns=anticipation_ns,
                     allow=allow_limit_break, use_pallas=use_pallas,
                     with_hists="h" in tele, with_ledger="l" in tele,
-                    with_slo="s" in tele)
+                    with_slo="s" in tele, prov0=tele.get("p"))
             hd, ld, sd = (tdelta.get("h"), tdelta.get("l"),
                           tdelta.get("s"))
+            p_new = tdelta.get("p")
+            margin_pc = lacc["margin"]
             batch_state = EngineState(**invariant, **new_mut)
             count = jnp.sum(lvl_count).astype(jnp.int32)
             resv_count = jnp.sum(lvl_resv).astype(jnp.int32)
@@ -2159,12 +2252,22 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
             base_decs = count.astype(jnp.int64)
             new_mut = {f: getattr(batch.state, f)
                        for f in _EPOCH_MUTABLE}
+            margin_pc = batch.margin
             if "h" in tele or "l" in tele or "s" in tele:
                 hd, ld, sd = _telemetry_delta(
                     batch.state, now, cls_e, key_e, batch.served,
                     batch.served_resv, batch.lb, batch.count,
                     "h" in tele, "l" in tele,
                     cost_pc=batch.served_cost, with_slo="s" in tele)
+            if "p" in tele:
+                has_req = st.active & (st.depth > 0)
+                elig = cls_e != CLS_NONE
+                p_new = obsprov.prov_observe(
+                    tele["p"], now=now, elig=elig,
+                    gated=has_req & ~elig,
+                    win_cls=jnp.min(jnp.where(elig, cls_e,
+                                              CLS_NONE)),
+                    served_pc=batch.served, margins=batch.margin)
         trip = jnp.bool_(False)
         good = jnp.bool_(True)
         if narrow32:
@@ -2196,15 +2299,22 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                 ladder_fallbacks=ladder_fb)
         if need_tele:
             tele = _tele_fold(tele, hd, ld, good, sd)
+            if "p" in tele:
+                tele["p"] = obsprov.prov_select(good, p_new,
+                                                tele["p"])
             if "f" in tele:
                 # per-client-per-batch records (the calendar engine
                 # emits counts, not a stream); GATED served, so a
                 # dead batch records nothing
+                has_req = st.active & (st.depth > 0)
+                gate_n = jnp.sum(has_req & (cls_e == CLS_NONE)) \
+                    .astype(jnp.int64)
                 iota = jnp.arange(st.capacity, dtype=jnp.int32)
                 tele = _tele_flight(
                     tele, jnp.where(served > 0, iota, -1),
                     cls_e.astype(jnp.int64), key_e,
-                    served.astype(jnp.int64), good)
+                    served.astype(jnp.int64), good,
+                    margin=margin_pc, gate=gate_n)
         carry = (mut, acc + served, met, tele, dead) if narrow32 \
             else (mut, acc + served, met, tele)
         return carry, out
@@ -2222,7 +2332,8 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                          progress_ok=ok, served=served,
                          metrics=metrics, level_count=lvls,
                          hists=tele.get("h"), ledger=tele.get("l"),
-                         flight=tele.get("f"), slo=tele.get("s"))
+                         flight=tele.get("f"), slo=tele.get("s"),
+                         prov=tele.get("p"))
 
 
 # ----------------------------------------------------------------------

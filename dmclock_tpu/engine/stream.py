@@ -69,6 +69,7 @@ class StreamChunk(NamedTuple):
     ledger: object = None
     flight: object = None
     slo: object = None
+    prov: object = None
 
 
 # per-engine stacked output fields, in the epoch-result class's field
@@ -146,22 +147,23 @@ def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
     fields = STREAM_OUT_FIELDS[engine]
 
     def chunk(state: EngineState, epoch0, counts, hists=None,
-              ledger=None, flight=None, slo=None) -> StreamChunk:
+              ledger=None, flight=None, slo=None,
+              prov=None) -> StreamChunk:
         epoch0 = jnp.asarray(epoch0, dtype=jnp.int64)
 
         def body(carry, xs):
-            st, h, l, f, s = carry
+            st, h, l, f, s, p = carry
             counts_e, i = xs
             t_base = (epoch0 + i) * dt
             if ingest:
                 st = clamped_ingest(st, counts_e, t_base,
                                     waves=waves, dt_wave=dt_wave)
             ep = fn(st, t_base + dt, m=m, **kw,
-                    hists=h, ledger=l, flight=f, slo=s)
+                    hists=h, ledger=l, flight=f, slo=s, prov=p)
             outs = {name: getattr(ep, name) for name in fields}
             outs["metrics"] = ep.metrics
             return (ep.state, ep.hists, ep.ledger, ep.flight,
-                    ep.slo), outs
+                    ep.slo, ep.prov), outs
 
         idx = jnp.arange(epochs, dtype=jnp.int64)
         if ingest:
@@ -169,10 +171,11 @@ def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
             xs = (counts, idx)
         else:
             xs = (jnp.zeros((epochs, 0), dtype=jnp.int32), idx)
-        (state, hists, ledger, flight, slo), outs = lax.scan(
-            body, (state, hists, ledger, flight, slo), xs)
+        (state, hists, ledger, flight, slo, prov), outs = lax.scan(
+            body, (state, hists, ledger, flight, slo, prov), xs)
         return StreamChunk(state=state, outs=outs, hists=hists,
-                           ledger=ledger, flight=flight, slo=slo)
+                           ledger=ledger, flight=flight, slo=slo,
+                           prov=prov)
 
     return chunk
 
@@ -197,7 +200,7 @@ def jit_stream_chunk(*, donate: bool = False, **cfg):
     key = (donate,) + tuple(sorted(cfg.items()))
     if key not in _STREAM_JIT_CACHE:
         fn = build_stream_chunk(**cfg)
-        donate_argnums = (0, 3, 4, 5, 6) if donate else ()
+        donate_argnums = (0, 3, 4, 5, 6, 7) if donate else ()
         _STREAM_JIT_CACHE[key] = _cplane.instrumented_jit(
             fn, cache="stream.chunk", entry=key,
             donate_argnums=donate_argnums)

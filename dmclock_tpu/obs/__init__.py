@@ -59,7 +59,7 @@ from .compile_plane import (CompilePlane, instrumented_jit,
                             publish_compile_metrics)
 from .compile_plane import plane as compile_plane_singleton
 from . import alerts, capacity, compile_plane, device, flight, \
-    histograms, slo, spans, trace_export
+    histograms, provenance, slo, spans, trace_export
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "TimerMetric",
@@ -71,5 +71,5 @@ __all__ = [
     "CompilePlane", "instrumented_jit", "publish_compile_metrics",
     "compile_plane_singleton",
     "alerts", "capacity", "compile_plane", "device", "flight",
-    "histograms", "slo", "spans", "trace_export",
+    "histograms", "provenance", "slo", "spans", "trace_export",
 ]

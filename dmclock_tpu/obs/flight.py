@@ -26,7 +26,14 @@ Columns (int64): ``seq`` (monotone global record number -- drain
 orders by it and wraparound is visible as a seq gap), ``batch`` (the
 recording batch's global index), ``client`` (slot), ``cls`` (unified
 class: 0 reservation / 1 weight / 2 limit-break), ``tag`` (unified
-entry key), ``cost``.  Unwritten rows carry seq -1.
+entry key), ``cost``, and -- since the provenance plane
+(``obs.provenance``) -- ``margin`` (the record's winner margin over
+the runner-up candidate, ns; -1 = no runner-up existed) and ``gate``
+(how many clients sat queued but limit-blocked at the recording
+batch's entry).  Unwritten rows carry seq -1.  With the three
+provenance columns the ring is a true black box: each drained record
+says not just WHAT committed but how contested the choice was and how
+much demand the limit gate was holding back at that instant.
 """
 
 from __future__ import annotations
@@ -36,7 +43,8 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
-FLIGHT_FIELDS = ("seq", "batch", "client", "cls", "tag", "cost")
+FLIGHT_FIELDS = ("seq", "batch", "client", "cls", "tag", "cost",
+                 "margin", "gate")
 FLIGHT_COLS = len(FLIGHT_FIELDS)
 
 
@@ -60,7 +68,7 @@ def flight_init(records: int) -> FlightState:
 
 
 def flight_record(fl: FlightState, slot, cls, tag, cost,
-                  live=True) -> FlightState:
+                  live=True, margin=None, gate=None) -> FlightState:
     """Append one batch's commit records in-graph.
 
     ``slot`` (int32[k], -1 = no record) selects the valid rows --
@@ -71,7 +79,11 @@ def flight_record(fl: FlightState, slot, cls, tag, cost,
     When one batch carries more than R records only the NEWEST R are
     materialized (deterministically -- duplicate ring indices never
     reach the scatter), but ``seq`` still advances by the full count,
-    so the drop is visible as a seq gap."""
+    so the drop is visible as a seq gap.
+
+    ``margin`` (int64[k]; -1 = no runner-up) and ``gate`` (scalar:
+    limit-gated client count at batch entry) are the provenance
+    columns (``obs.provenance``); callers without them write -1 / 0."""
     r = fl.buf.shape[0]
     slot = jnp.asarray(slot)
     live = jnp.asarray(live, dtype=bool)
@@ -80,6 +92,10 @@ def flight_record(fl: FlightState, slot, cls, tag, cost,
     total = jnp.sum(mask.astype(jnp.int64))
     keep = mask & (rank >= total - r)
     idx = jnp.where(keep, (fl.seq + rank) % r, r).astype(jnp.int32)
+    margin = jnp.full(slot.shape, jnp.int64(-1)) if margin is None \
+        else jnp.asarray(margin, dtype=jnp.int64)
+    gate = jnp.int64(0) if gate is None \
+        else jnp.asarray(gate, dtype=jnp.int64)
     rows = jnp.stack([
         fl.seq + rank,
         jnp.broadcast_to(fl.batch, slot.shape),
@@ -87,6 +103,8 @@ def flight_record(fl: FlightState, slot, cls, tag, cost,
         jnp.asarray(cls, dtype=jnp.int64),
         jnp.asarray(tag, dtype=jnp.int64),
         jnp.asarray(cost, dtype=jnp.int64),
+        jnp.broadcast_to(margin, slot.shape),
+        jnp.broadcast_to(gate, slot.shape),
     ], axis=1)
     buf = fl.buf.at[idx].set(rows, mode="drop")
     return FlightState(buf=buf, seq=fl.seq + total,

@@ -216,7 +216,8 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
                  anticipation_ns: int = 0,
                  allow_limit_break: bool = False,
                  advance_ns: int = 0,
-                 with_metrics: bool = False):
+                 with_metrics: bool = False,
+                 with_pressure: bool = False):
     """Advance the whole cluster: ``arrivals`` is int32[S, C] request
     counts (honored up to the static ``max_arrivals`` per client per
     round, wave-major order -- see _one_server_step), sharded over
@@ -240,7 +241,19 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
     hwm rows pmax -- ``obs.device.metrics_mesh_reduce``), so cluster
     totals need no host-side gather.  Decisions are bit-identical with
     the flag on or off (tests/test_obs.py pins the engine; the merged
-    == host-summed pin lives in tests/test_cluster_realism.py)."""
+    == host-summed pin lives in tests/test_cluster_realism.py).
+
+    ``with_pressure`` (STATIC) additionally returns ``(per_shard
+    int64[S, PRESS_FIELDS], merged int64[PRESS_FIELDS])``: each
+    server's post-round scheduling-pressure vector (live eligible-set
+    depth, backlog, peak, head-wait watermark --
+    ``obs.provenance.pressure_vec``) and the cluster total through the
+    same psum/pmax collective (``pressure_mesh_reduce``) -- the
+    placement signal the ROADMAP rack-scheduling item routes on,
+    published as ``dmclock_shard_pressure_*``
+    (``obs.provenance.publish_shard_pressure``)."""
+    from ..obs import provenance as obsprov
+
     cost = jnp.asarray(cost, dtype=jnp.int64)
 
     def shard_fn(engine, tracker, now, arr):
@@ -261,26 +274,31 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
             # merged vector is replicated (P() out-spec)
             merged = obsdev.metrics_mesh_reduce(
                 obsdev.metrics_combine_axis(met), SERVER_AXIS)
-            return engine, tracker, now, decs, met, merged
-        engine, tracker, now, decs = out
-        return engine, tracker, now, decs
+            out = (engine, tracker, now, decs, met, merged)
+        if with_pressure:
+            engine, tracker, now = out[0], out[1], out[2]
+            press = jax.vmap(obsprov.pressure_vec)(engine, now)
+            press_merged = obsprov.pressure_mesh_reduce(
+                obsprov.pressure_combine_axis(press), SERVER_AXIS)
+            out = out + (press, press_merged)
+        return out
 
     spec = P(SERVER_AXIS)
-    n_out = 6 if with_metrics else 4
+    out_specs = (spec,) * 4
+    if with_metrics:
+        out_specs += (spec, P())
+    if with_pressure:
+        out_specs += (spec, P())
     fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(spec, spec, spec, spec),
-        out_specs=(spec,) * (n_out - 1) + (P(),) if with_metrics
-        else (spec,) * n_out,
+        out_specs=out_specs,
         check_vma=False)
     now0 = cluster.now + jnp.int64(advance_ns)
     out = fn(cluster.engine, cluster.tracker, now0, arrivals)
-    if with_metrics:
-        engine, tracker, now, decs, shard_met, merged = out
-        return (ClusterState(engine=engine, tracker=tracker, now=now),
-                decs, shard_met, merged)
-    engine, tracker, now, decs = out
-    return ClusterState(engine=engine, tracker=tracker, now=now), decs
+    engine, tracker, now, decs = out[:4]
+    return (ClusterState(engine=engine, tracker=tracker, now=now),
+            decs) + tuple(out[4:])
 
 
 # Module-level jit cache for the healthy-path round driver (the

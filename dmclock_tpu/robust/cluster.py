@@ -199,7 +199,8 @@ def robust_cluster_step(rc: RobustClusterState, arrivals: jnp.ndarray,
                         anticipation_ns: int = 0,
                         allow_limit_break: bool = False,
                         advance_ns: int = 0,
-                        with_merged: bool = False):
+                        with_merged: bool = False,
+                        with_pressure: bool = False):
     """One cluster step under an optional :class:`FaultStep`.
 
     ``fault=None`` (STATIC) delegates to the plain ``cluster_step`` --
@@ -215,20 +216,33 @@ def robust_cluster_step(rc: RobustClusterState, arrivals: jnp.ndarray,
     across the mesh, so cluster fault totals need no host gather even
     mid-chaos.  Pinned merged == host-summed under a nonzero plan in
     ``tests/test_cluster_realism.py``.
+
+    ``with_pressure`` (STATIC) additionally returns ``(per_shard
+    int64[S, PRESS_FIELDS], merged int64[PRESS_FIELDS])`` post-round
+    scheduling-pressure vectors (``obs.provenance.pressure_vec`` --
+    eligible depth / backlog / peak / head-wait watermark) through the
+    same psum/pmax collective: the degraded-mode twin of the healthy
+    path's gauges, so the rack-scheduling placement signal stays
+    published even mid-chaos (a down shard reports its FROZEN state:
+    its backlog keeps aging, which is exactly what a router must see).
     """
     if fault is None:
-        cluster, decs = CL.cluster_step(
+        out = CL.cluster_step(
             rc.cluster, arrivals, cost, mesh,
             decisions_per_step=decisions_per_step,
             max_arrivals=max_arrivals, anticipation_ns=anticipation_ns,
-            allow_limit_break=allow_limit_break, advance_ns=advance_ns)
+            allow_limit_break=allow_limit_break, advance_ns=advance_ns,
+            with_pressure=with_pressure)
+        cluster, decs = out[0], out[1]
         rc = rc._replace(cluster=cluster)
-        if not with_merged:
-            return rc, decs
-        # no fault plumbing ran, but the caller still wants the
-        # merged view of the HELD metrics (frozen this step)
-        merged = _merge_held_metrics(rc.metrics, mesh)
-        return rc, decs, merged
+        res = (rc, decs)
+        if with_merged:
+            # no fault plumbing ran, but the caller still wants the
+            # merged view of the HELD metrics (frozen this step)
+            res = res + (_merge_held_metrics(rc.metrics, mesh),)
+        if with_pressure:
+            res = res + tuple(out[2:])
+        return res
 
     cost = jnp.asarray(cost, dtype=jnp.int64)
     f_up = jnp.asarray(fault.up, dtype=bool)
@@ -247,17 +261,27 @@ def robust_cluster_step(rc: RobustClusterState, arrivals: jnp.ndarray,
         out = jax.vmap(step)(engine, tracker, now, arr, view_d,
                              view_r, up_prev, met, up, skew, delay,
                              dup)
-        if not with_merged:
-            return out
-        # local reduce over this shard's servers, then the mesh
-        # collective: counters psum, hwm pmax (associative +
-        # commutative, so mesh order cannot matter)
-        merged = obsdev.metrics_mesh_reduce(
-            obsdev.metrics_combine_axis(out[6]), SERVER_AXIS)
-        return out + (merged,)
+        if with_merged:
+            # local reduce over this shard's servers, then the mesh
+            # collective: counters psum, hwm pmax (associative +
+            # commutative, so mesh order cannot matter)
+            merged = obsdev.metrics_mesh_reduce(
+                obsdev.metrics_combine_axis(out[6]), SERVER_AXIS)
+            out = out + (merged,)
+        if with_pressure:
+            from ..obs import provenance as obsprov
+            # post-round engine state at the UNSKEWED clock (out[2]):
+            # a down shard's frozen backlog keeps aging against the
+            # cluster clock, exactly what a router must see
+            press = jax.vmap(obsprov.pressure_vec)(out[0], out[2])
+            out = out + (press, obsprov.pressure_mesh_reduce(
+                obsprov.pressure_combine_axis(press), SERVER_AXIS))
+        return out
 
     spec = P(SERVER_AXIS)
     out_specs = (spec,) * 8 + ((P(),) if with_merged else ())
+    if with_pressure:
+        out_specs += (spec, P())
     fn = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(spec,) * 12, out_specs=out_specs,
@@ -273,9 +297,7 @@ def robust_cluster_step(rc: RobustClusterState, arrivals: jnp.ndarray,
         cluster=ClusterState(engine=engine, tracker=tracker, now=now),
         view_delta=view_d, view_rho=view_r, up_prev=up_prev,
         metrics=met)
-    if with_merged:
-        return rc, decs, outs[8]
-    return rc, decs
+    return (rc, decs) + tuple(outs[8:])
 
 
 # Module-level jit cache (the engine/queue.py _JIT_CACHE convention):

@@ -98,6 +98,7 @@ class GuardedEpoch(NamedTuple):
     ledger: object = None
     flight: object = None
     slo: object = None
+    prov: object = None
 
 
 # Module-level jit cache keyed by the static epoch configuration (the
@@ -173,6 +174,7 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                       ladder_levels: int = 8,
                       skew_ns: int = 0,
                       hists=None, ledger=None, flight=None, slo=None,
+                      prov=None,
                       retries: int = 3, base_s: float = 0.05,
                       sleep: Callable[[float], None] = _time.sleep,
                       on_retry=None, tracer=None) -> GuardedEpoch:
@@ -237,6 +239,8 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
         tele["flight"] = flight
     if slo is not None:
         tele["slo"] = slo
+    if prov is not None:
+        tele["prov"] = prov
     tele_sig = tuple(sorted(tele))
 
     def attempt(st, t, m_run, width):
@@ -323,7 +327,8 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                         hists=tele.get("hists"),
                         ledger=tele.get("ledger"),
                         flight=tele.get("flight"),
-                        slo=tele.get("slo"))
+                        slo=tele.get("slo"),
+                        prov=tele.get("prov"))
 
 
 class StreamGuarded(NamedTuple):
@@ -347,6 +352,7 @@ class StreamGuarded(NamedTuple):
     ledger: object = None
     flight: object = None
     slo: object = None
+    prov: object = None
 
 
 def run_stream_chunk_guarded(state, epoch0: int, counts, *,
@@ -362,7 +368,7 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
                              calendar_impl: str = "minstop",
                              ladder_levels: int = 8,
                              hists=None, ledger=None, flight=None,
-                             slo=None,
+                             slo=None, prov=None,
                              retries: int = 3, base_s: float = 0.05,
                              sleep: Callable[[float], None] =
                              _time.sleep,
@@ -425,7 +431,7 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
         with _spans.span(tracer, "stream.dispatch", "dispatch",
                          engine=engine, epochs=epochs):
             out = fn(state, jnp.int64(epoch0), counts_dev,
-                     hists, ledger, flight, slo)
+                     hists, ledger, flight, slo, prov)
         if overlap is not None:
             overlap()     # host pregen rides the device's chunk time
         with _spans.span(tracer, "stream.device_wait",
@@ -447,7 +453,8 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
                          for i in range(epochs)),
             guard_trips=(0,) * epochs, stream_fallback=0,
             retries=retry_count[0], hists=out.hists,
-            ledger=out.ledger, flight=out.flight, slo=out.slo)
+            ledger=out.ledger, flight=out.flight, slo=out.slo,
+            prov=out.prov)
 
     # a guard tripped somewhere in the chunk: the fused program cannot
     # run the tag32/serial resumes mid-scan, so the whole chunk is
@@ -462,7 +469,7 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
         dt_epoch_ns=dt_epoch_ns, waves=waves) if do_ingest else None
     st = state
     cur = {"hists": hists, "ledger": ledger, "flight": flight,
-           "slo": slo}
+           "slo": slo, "prov": prov}
     ep_rows, count_rows, trip_rows = [], [], []
     for i in range(epochs):
         t_base = (int(epoch0) + i) * int(dt_epoch_ns)
@@ -476,7 +483,7 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
             tag_width=tag_width, window_m=window_m,
             calendar_impl=calendar_impl, ladder_levels=ladder_levels,
             hists=cur["hists"], ledger=cur["ledger"],
-            flight=cur["flight"], slo=cur["slo"],
+            flight=cur["flight"], slo=cur["slo"], prov=cur["prov"],
             retries=retries, base_s=base_s,
             sleep=sleep, on_retry=on_retry, tracer=tracer)
         st = ep.state
@@ -488,6 +495,8 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
             cur["flight"] = ep.flight
         if cur["slo"] is not None:
             cur["slo"] = ep.slo
+        if cur["prov"] is not None:
+            cur["prov"] = ep.prov
         retry_count[0] += ep.retries
         ep_rows.append(ep.results)
         count_rows.append(ep.count)
@@ -496,7 +505,8 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
         state=st, epochs=tuple(ep_rows), counts=tuple(count_rows),
         guard_trips=tuple(trip_rows), stream_fallback=1,
         retries=retry_count[0], hists=cur["hists"],
-        ledger=cur["ledger"], flight=cur["flight"], slo=cur["slo"])
+        ledger=cur["ledger"], flight=cur["flight"], slo=cur["slo"],
+        prov=cur["prov"])
 
 
 # ----------------------------------------------------------------------

@@ -159,6 +159,19 @@ class EpochJob:
     # durability discipline: what is flushed is exactly what a resume
     # will never re-close
     slo_log: Optional[str] = None
+    # decision provenance plane (obs.provenance;
+    # docs/OBSERVABILITY.md "Provenance plane"): the per-batch "why"
+    # block -- winner margins, limit-gate state, eligible-set depth,
+    # winning phase, per-client last_served watermark + starvation
+    # high-watermark -- rides the epoch scans like the PR-6
+    # telemetry.  The block's leaves ride the rotation checkpoints
+    # (prov_*), so crash equivalence extends to it bit-for-bit.
+    # NOT yet composable with ``churn``: the lifecycle boundary
+    # grows/permutes/zeroes the ledger and SLO block but not the
+    # per-client last_served watermark, so the combination is
+    # rejected up front instead of mis-attributing a recycled slot's
+    # stale serve history to its new tenant.
+    with_prov: bool = False
     # engine loop structure (docs/ENGINE.md "engine_loop"): "round"
     # launches the admission readback + ingest + epoch separately per
     # epoch (the PR-5 shape, ~3 tunnel round-trips/epoch); "stream"
@@ -224,6 +237,13 @@ class SupervisedResult(NamedTuple):
     slo_ring: Optional[np.ndarray] = None
     slo_cepoch: Optional[np.ndarray] = None
     slo: Optional[dict] = None
+    # provenance plane outputs (None when the job ran with it off):
+    # the margin histogram row, the scalar aggregates, and the
+    # per-client last_served watermark -- all deterministic, all
+    # compared by the crash-equivalence gate
+    prov_margin_hist: Optional[np.ndarray] = None
+    prov_scal: Optional[np.ndarray] = None
+    prov_last_served: Optional[np.ndarray] = None
 
 
 def assert_crash_equivalent(interrupted: SupervisedResult,
@@ -281,6 +301,20 @@ def assert_crash_equivalent(interrupted: SupervisedResult,
     assert interrupted.slo == reference.slo, \
         (f"SLO evaluator diverged across the crash: "
          f"{interrupted.slo} vs {reference.slo}")
+    # the provenance block rides the rotation checkpoints and its
+    # observations are pure functions of the replayed decisions, so
+    # margin histogram, scalar aggregates, and the last_served
+    # watermark must all be bit-identical too
+    for field in ("prov_margin_hist", "prov_scal",
+                  "prov_last_served"):
+        x = getattr(interrupted, field)
+        y = getattr(reference, field)
+        assert (x is None) == (y is None), \
+            f"provenance field {field} enabled on only one side"
+        if x is not None:
+            assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                f"provenance field {field} diverged across the crash"
+
 
 
 # ----------------------------------------------------------------------
@@ -382,7 +416,7 @@ def _tree_digest(tree) -> str:
 def _payload(job: EpochJob, state, rng, met, digest: bytes,
              epoch: int, decisions: int, ladder_vec,
              hists=None, ledger=None, flight=None,
-             plane=None, slo=None) -> dict:
+             plane=None, slo=None, prov=None) -> dict:
     import jax
 
     from ..lifecycle.plane import LifecyclePlane
@@ -441,7 +475,16 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
             "tele_flight_seq": np.int64(
                 0 if flight is None else int(flight.seq)),
             "tele_flight_batch": np.int64(
-                0 if flight is None else int(flight.batch))}
+                0 if flight is None else int(flight.batch)),
+            "prov_margin_hist": z if prov is None
+            else np.asarray(jax.device_get(prov.margin_hist),
+                            dtype=np.int64),
+            "prov_scal": z if prov is None
+            else np.asarray(jax.device_get(prov.scal),
+                            dtype=np.int64),
+            "prov_last_served": z if prov is None
+            else np.asarray(jax.device_get(prov.last_served),
+                            dtype=np.int64)}
 
 
 def _tele_init(job: EpochJob):
@@ -450,20 +493,22 @@ def _tele_init(job: EpochJob):
     capacity (it grows with the state arrays at boundaries)."""
     from ..obs import flight as obsflight
     from ..obs import histograms as obshist
+    from ..obs import provenance as obsprov
 
     n = int(job.churn["capacity0"]) if job.churn is not None else job.n
     hists = obshist.hist_zero() if job.with_hists else None
     ledger = obshist.ledger_zero(n) if job.with_ledger else None
     flight = obsflight.flight_init(job.flight_records) \
         if job.flight_records else None
-    return hists, ledger, flight
+    prov = obsprov.prov_init(n) if job.with_prov else None
+    return hists, ledger, flight, prov
 
 
 def _payload_like(job: EpochJob) -> dict:
     from ..lifecycle.plane import LifecyclePlane
     from ..obs import device as obsdev
 
-    hists, ledger, flight = _tele_init(job)
+    hists, ledger, flight, prov = _tele_init(job)
     # the SLO leaves' template stays the empty-leaf shape even for
     # with_slo jobs: their axis-0 sizes are runtime state (ring fill,
     # contract count), so such jobs restore with the axis-0-only
@@ -474,6 +519,7 @@ def _payload_like(job: EpochJob) -> dict:
                     b"\x00" * 32, 0, 0,
                     DegradationLadder().encode(),
                     hists=hists, ledger=ledger, flight=flight,
+                    prov=prov,
                     plane=LifecyclePlane(job.churn)
                     if job.churn is not None else None)
 
@@ -594,6 +640,12 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
 
     from ..obs import flight as obsflight
 
+    if job.with_prov and job.churn is not None:
+        raise ValueError(
+            "EpochJob(with_prov=True) does not compose with churn "
+            "yet: lifecycle boundaries do not carry the provenance "
+            "watermark through grow/compact/evict (see the EpochJob "
+            "field comment)")
     state = _job_state(job)
     rng = np.random.Generator(np.random.PCG64(job.seed))
     met = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
@@ -614,7 +666,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     ladder = DegradationLadder(enabled=job.ladder,
                                threshold=job.ladder_threshold,
                                tracer=tracer)
-    hists, ledger, flight = _tele_init(job)
+    hists, ledger, flight, prov = _tele_init(job)
     ckpt_dir = os.path.join(workdir, "ckpt") if workdir else None
 
     payload = None
@@ -671,6 +723,11 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 payload["tele_flight_buf"],
                 payload["tele_flight_seq"],
                 payload["tele_flight_batch"])
+        if job.with_prov:
+            from ..obs import provenance as obsprov
+            prov = obsprov.prov_from_arrays(
+                payload["prov_margin_hist"], payload["prov_scal"],
+                payload["prov_last_served"])
 
     plane = None
     if job.churn is not None:
@@ -760,8 +817,9 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
         return _stream_epochs(job, injector, ckpt_dir, scr,
                               base_cfg, state, rng, met, digest,
                               start_epoch, decisions, ladder, tracer,
-                              hists, ledger, flight, resumed_from,
-                              plane, slo_block, slo_plane, slo_eval)
+                              hists, ledger, flight, prov,
+                              resumed_from, plane, slo_block,
+                              slo_plane, slo_eval)
     assert job.engine_loop == "round", job.engine_loop
     ingest = _jit_ingest(job) \
         if job.arrival_lam > 0 and plane is None else None
@@ -835,7 +893,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                         calendar_impl=cfg["calendar_impl"],
                         ladder_levels=job.ladder_levels,
                         hists=hists, ledger=ledger, flight=flight,
-                        slo=slo_block, tracer=tracer)
+                        slo=slo_block, prov=prov, tracer=tracer)
                     break
                 except RECOVERABLE_ERRORS:
                     # bounded retries EXHAUSTED inside the guarded
@@ -861,6 +919,8 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 ledger = ep.ledger
             if job.flight_records:
                 flight = ep.flight
+            if job.with_prov:
+                prov = ep.prov
             if job.with_slo:
                 slo_block = ep.slo
             with _spans.span(tracer, "supervisor.digest", "drain"):
@@ -897,7 +957,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                                        epoch + 1, decisions,
                                        ladder.encode(), hists=hists,
                                        ledger=ledger, flight=flight,
-                                       plane=plane,
+                                       prov=prov, plane=plane,
                                        slo=None if slo_plane is None
                                        else (slo_block, slo_plane,
                                              slo_eval))
@@ -959,20 +1019,30 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     return _build_result(job, state, digest, decisions, met, ladder,
                          scr.rebinds, resumed_from, hists, ledger,
                          flight, stream_fallbacks, plane,
-                         slo_block, slo_plane, slo_eval)
+                         slo_block, slo_plane, slo_eval, prov)
 
 
 def _build_result(job, state, digest, decisions, met, ladder,
                   scrape_rebinds, resumed_from, hists, ledger, flight,
                   stream_fallbacks: int, plane=None,
                   slo_block=None, slo_plane=None,
-                  slo_eval=None) -> SupervisedResult:
+                  slo_eval=None, prov=None) -> SupervisedResult:
     import jax
 
     slo_kw = {}
+    if prov is not None:
+        slo_kw.update(
+            prov_margin_hist=np.asarray(
+                jax.device_get(prov.margin_hist), dtype=np.int64),
+            prov_scal=np.asarray(jax.device_get(prov.scal),
+                                 dtype=np.int64),
+            prov_last_served=np.asarray(
+                jax.device_get(prov.last_served), dtype=np.int64))
     if slo_plane is not None:
         enc = slo_plane.encode()
-        slo_kw = dict(
+        # update, never rebind: the provenance entries added above
+        # must survive a job that runs BOTH planes
+        slo_kw.update(
             slo_window=np.asarray(jax.device_get(slo_block),
                                   dtype=np.int64),
             slo_ring=enc["slo_ring"],
@@ -1015,7 +1085,7 @@ def _draw_counts_churn(rng: np.random.Generator, spec: dict,
 def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                    scr: _ScrapeCtl, base_cfg: dict, state, rng, met,
                    digest: bytes, start_epoch: int, decisions: int,
-                   ladder, tracer, hists, ledger, flight,
+                   ladder, tracer, hists, ledger, flight, prov,
                    resumed_from, plane=None, slo_block=None,
                    slo_plane=None, slo_eval=None) -> SupervisedResult:
     """The always-on streaming serve loop (docs/ENGINE.md
@@ -1118,7 +1188,8 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                         calendar_impl=cfg["calendar_impl"],
                         ladder_levels=job.ladder_levels,
                         hists=hists, ledger=ledger, flight=flight,
-                        slo=slo_block, tracer=tracer, overlap=overlap)
+                        slo=slo_block, prov=prov, tracer=tracer,
+                        overlap=overlap)
                     break
                 except RECOVERABLE_ERRORS:
                     # retries exhausted at stream-chunk granularity:
@@ -1140,6 +1211,8 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                 ledger = g.ledger
             if job.flight_records:
                 flight = g.flight
+            if job.with_prov:
+                prov = g.prov
             if job.with_slo:
                 slo_block = g.slo
             stream_fallbacks += g.stream_fallback
@@ -1193,7 +1266,7 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                                        digest, b, decisions,
                                        ladder.encode(), hists=hists,
                                        ledger=ledger, flight=flight,
-                                       plane=plane,
+                                       prov=prov, plane=plane,
                                        slo=None if slo_plane is None
                                        else (slo_block, slo_plane,
                                              slo_eval))
@@ -1237,7 +1310,7 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
     return _build_result(job, state, digest, decisions, met, ladder,
                          scr.rebinds, resumed_from, hists, ledger,
                          flight, stream_fallbacks, plane,
-                         slo_block, slo_plane, slo_eval)
+                         slo_block, slo_plane, slo_eval, prov)
 
 
 def _healthz_ok(scrape, timeout_s: float = 2.0) -> bool:
@@ -1390,7 +1463,10 @@ def _spawn_once(job: EpochJob, workdir: str,
         slo_window=arr2("slo_window", obsslo.W_FIELDS),
         slo_ring=arr2("slo_ring", obsslo.RING_COLS),
         slo_cepoch=arr2("slo_cepoch", 2),
-        slo=obj.get("slo"))
+        slo=obj.get("slo"),
+        prov_margin_hist=arr("prov_margin_hist"),
+        prov_scal=arr("prov_scal"),
+        prov_last_served=arr("prov_last_served"))
 
 
 def _child_main(workdir: str) -> int:
@@ -1435,7 +1511,11 @@ def _child_main(workdir: str) -> int:
                    "slo_window": lst(result.slo_window),
                    "slo_ring": lst(result.slo_ring),
                    "slo_cepoch": lst(result.slo_cepoch),
-                   "slo": result.slo}, fh)
+                   "slo": result.slo,
+                   "prov_margin_hist": lst(result.prov_margin_hist),
+                   "prov_scal": lst(result.prov_scal),
+                   "prov_last_served":
+                       lst(result.prov_last_served)}, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, res_path)

@@ -182,6 +182,27 @@ def main(argv=None) -> int:
             print(f"# ledger-check: ok ({chk['clients']} clients, "
                   f"{chk['ops']} ops; backend ledger == host "
                   "recount)")
+    if args.ledger_check and args.trace:
+        # trace-vs-counters cross-check (schema v2): the JSONL trace's
+        # per-phase totals must equal the harness recount (= the
+        # device MET_RESV/MET_PROP mirror the ledger-check above
+        # already pinned against it) -- a hard error unless rows were
+        # deliberately dropped past --trace-limit
+        if trace.rows_dropped:
+            print(f"# trace-check: skipped ({trace.rows_dropped} "
+                  "rows dropped past --trace-limit; totals cannot "
+                  "match by construction)")
+        else:
+            from ..obs.trace import summarize
+            try:
+                stats = summarize(args.trace,
+                                  report.phase_totals())
+            except ValueError as e:
+                print(f"# trace-check: FAILED -- {e}")
+                return 1
+            print(f"# trace-check: ok ({stats['rows']} rows; "
+                  "per-phase totals == host recount == device "
+                  "counters)")
     if args.slo_check:
         chk = report.slo_window_check()
         if chk is None:

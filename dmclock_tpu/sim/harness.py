@@ -723,10 +723,47 @@ class SimReport:
                                    "host_ops": hst[0],
                                    "ledger_resv": led[1],
                                    "host_resv": hst[1]})
-        return {"clients": len(ledgers),
-                "ops": sum(v[0] for v in ledgers.values()),
-                "recycled_clients": len(set(host) - set(ledgers)),
-                "mismatches": mismatches}
+        # device phase-counter cross-check (trace schema v2
+        # satellite, docs/OBSERVABILITY.md): the backends' running
+        # reservation/priority counters are the host mirror of the
+        # device MET_RESV/MET_PROP rows -- they must equal the
+        # harness's own per-phase recount exactly, or decisions were
+        # dropped/duplicated/mis-phased somewhere on the way up
+        resv_dev = prop_dev = 0
+        have_counters = False
+        for s in self.sim.servers.values():
+            queue = getattr(s, "queue", None)
+            if queue is not None and \
+                    hasattr(queue, "reserv_sched_count"):
+                have_counters = True
+                resv_dev += int(queue.reserv_sched_count)
+                prop_dev += int(queue.prop_sched_count)
+        out = {"clients": len(ledgers),
+               "ops": sum(v[0] for v in ledgers.values()),
+               "recycled_clients": len(set(host) - set(ledgers)),
+               "mismatches": mismatches}
+        if have_counters:
+            resv_host, prop_host = self.phase_totals()
+            out["phase_counters"] = {"reservation": resv_dev,
+                                     "priority": prop_dev}
+            if (resv_dev, prop_dev) != (resv_host, prop_host):
+                mismatches.append({
+                    "phase_counters": {"reservation": resv_dev,
+                                       "priority": prop_dev},
+                    "host": {"reservation": resv_host,
+                             "priority": prop_host}})
+        return out
+
+    def phase_totals(self) -> Tuple[int, int]:
+        """(reservation, priority) decision totals from the host
+        per-phase recount -- what the device ``MET_RESV``/``MET_PROP``
+        counters (and a decision trace's ``per_phase`` summary) must
+        match exactly."""
+        resv = sum(s.stats.reservation_ops
+                   for s in self.sim.servers.values())
+        prop = sum(s.stats.priority_ops
+                   for s in self.sim.servers.values())
+        return resv, prop
 
     def slo_window_check(self) -> Optional[dict]:
         """The queue backends' SLO window mirror vs their own ledger

@@ -50,6 +50,18 @@ on jitter or a first stray retrace.  Workload rows the capacity gate
 skipped (projected HBM over budget; "capacity_skipped": true) are
 excluded from every median and never judged -- a skip is a capacity
 verdict, not a rate.
+
+margin_p99_ns and starvation_max_ns (the provenance plane's
+per-workload scalars, docs/OBSERVABILITY.md "Provenance plane") are
+warn-only series too: a COLLAPSING margin p99 means decisions got
+contested (the proportional race tightened -- a QoS-fragility signal
+even when dec/s held), and a GROWING starvation watermark means some
+backlogged client sat unserved longer.  Both medians are floored (1ms
+margin / 100ms starvation, one epoch of virtual time) so log2-bucket
+quantization and calibration shifts never flap a clean history.
+Provenance-off sessions ("provenance_on": false) form their own
+series identity and are never compared against provenance-on records
+in either direction.
 """
 
 from __future__ import annotations
@@ -196,13 +208,16 @@ def main() -> int:
              if r.get("device") == dev and not is_fallback(r)
              and not is_chaos(r) and not is_restarted(r)
              and not is_degraded(r)]
-    def series(wl, key, impl, cal, loop, scen=None, pop=None):
+    def series(wl, key, impl, cal, loop, scen=None, pop=None,
+               provon=True):
         """Prior values of one per-workload scalar column, filtered to
         the same fast-path identity (select_impl + calendar_impl +
-        engine_loop) the throughput series uses.  Churn workloads add
-        scenario + scripted population (total_ids) to the identity:
-        the POPULATION IS DYNAMIC, so a record against a different id
-        space is a different workload, not a comparable session."""
+        engine_loop + provenance_on) the throughput series uses.
+        Churn workloads add scenario + scripted population
+        (total_ids) to the identity: the POPULATION IS DYNAMIC, so a
+        record against a different id space is a different workload,
+        not a comparable session.  Rows predating the provenance knob
+        count as provenance-on (the default)."""
         return [r["workloads"][wl][key] for _, r in prior
                 if wl in r.get("workloads", {})
                 and key in r["workloads"][wl]
@@ -214,7 +229,9 @@ def main() -> int:
                 and r["workloads"][wl].get("engine_loop",
                                            "round") == loop
                 and r["workloads"][wl].get("scenario") == scen
-                and r["workloads"][wl].get("total_ids") == pop]
+                and r["workloads"][wl].get("total_ids") == pop
+                and bool(r["workloads"][wl].get("provenance_on",
+                                                True)) == provon]
 
     status = 0
     for wl, row in sorted(newest.get("workloads", {}).items()):
@@ -254,6 +271,7 @@ def main() -> int:
         # identity and the tag
         scen = row.get("scenario")
         pop = row.get("total_ids")
+        provon = bool(row.get("provenance_on", True))
         tag = f"{wl}[{impl}]" if impl != "sort" else wl
         if cal != "minstop":
             tag += f"[{cal}]"
@@ -261,7 +279,9 @@ def main() -> int:
             tag += f"[{loop}]"
         if scen is not None:
             tag += f"[N={pop}]"
-        hist = series(wl, "dps", impl, cal, loop, scen, pop)
+        if not provon:
+            tag += "[prov-off]"
+        hist = series(wl, "dps", impl, cal, loop, scen, pop, provon)
         if len(hist) < args.min_records:
             print(f"bench_guard: {tag}: {dps/1e6:.1f}M "
                   f"({len(hist)} prior record(s) -- not judged)")
@@ -302,7 +322,7 @@ def main() -> int:
         p99 = row.get("tardiness_p99_ns")
         if p99 is not None:
             t_hist = series(wl, "tardiness_p99_ns", impl, cal, loop,
-                            scen, pop)
+                            scen, pop, provon)
             if len(t_hist) < args.min_records:
                 print(f"bench_guard: {tag}: p99 tardiness "
                       f"{p99/1e6:.2f}ms ({len(t_hist)} prior "
@@ -334,7 +354,7 @@ def main() -> int:
         disp = row.get("dispatch_ms_per_launch")
         if disp is not None:
             d_hist = series(wl, "dispatch_ms_per_launch", impl, cal,
-                            loop, scen, pop)
+                            loop, scen, pop, provon)
             if len(d_hist) < args.min_records:
                 print(f"bench_guard: {tag}: dispatch "
                       f"{disp:.2f}ms/launch ({len(d_hist)} prior "
@@ -367,7 +387,7 @@ def main() -> int:
         viol = row.get("slo_violations_total")
         if viol is not None:
             v_hist = series(wl, "slo_violations_total", impl, cal,
-                            loop, scen, pop)
+                            loop, scen, pop, provon)
             if len(v_hist) < args.min_records:
                 print(f"bench_guard: {tag}: slo violations {viol} "
                       f"({len(v_hist)} prior record(s) -- not "
@@ -391,7 +411,7 @@ def main() -> int:
         serr = row.get("slo_worst_share_err")
         if serr is not None:
             s_hist = series(wl, "slo_worst_share_err", impl, cal,
-                            loop, scen, pop)
+                            loop, scen, pop, provon)
             if len(s_hist) < args.min_records:
                 print(f"bench_guard: {tag}: worst-window share err "
                       f"{serr:.3f} ({len(s_hist)} prior record(s) "
@@ -423,7 +443,7 @@ def main() -> int:
         cms = row.get("compile_ms_total")
         if cms is not None:
             c_hist = series(wl, "compile_ms_total", impl, cal, loop,
-                            scen, pop)
+                            scen, pop, provon)
             if len(c_hist) < args.min_records:
                 print(f"bench_guard: {tag}: compile {cms:.0f}ms "
                       f"({len(c_hist)} prior record(s) -- not "
@@ -453,7 +473,7 @@ def main() -> int:
         rt = row.get("retraces")
         if rt is not None:
             r_hist = series(wl, "retraces", impl, cal, loop, scen,
-                            pop)
+                            pop, provon)
             if len(r_hist) < args.min_records:
                 print(f"bench_guard: {tag}: retraces {rt} "
                       f"({len(r_hist)} prior record(s) -- not "
@@ -472,6 +492,64 @@ def main() -> int:
                 else:
                     print(f"bench_guard: {tag}: retraces {rt} vs "
                           f"median {r_med:g} -- OK")
+        # provenance margin p99 (docs/OBSERVABILITY.md "Provenance
+        # plane") as a warn-only series in the COLLAPSE direction: a
+        # p99 winner margin falling past tolerance BELOW the median
+        # means the proportional race tightened -- decisions that used
+        # to win comfortably are now contested, the QoS-fragility
+        # precursor to share skew.  Median floored at 1ms: histories
+        # whose margins are already octave-noise never judge.
+        mp99 = row.get("margin_p99_ns")
+        if mp99 is not None:
+            m_hist = series(wl, "margin_p99_ns", impl, cal, loop,
+                            scen, pop, provon)
+            if len(m_hist) < args.min_records:
+                print(f"bench_guard: {tag}: margin p99 "
+                      f"{mp99/1e6:.2f}ms ({len(m_hist)} prior "
+                      "record(s) -- not judged)")
+            else:
+                m_med = median(m_hist)
+                if m_med >= 1e6 and mp99 < m_med / args.tolerance:
+                    print(f"bench_guard: {tag}: WARNING margin p99 "
+                          f"{mp99/1e6:.2f}ms vs median "
+                          f"{m_med/1e6:.2f}ms over {len(m_hist)} "
+                          f"sessions (< 1/{args.tolerance:g}x) -- "
+                          "decision margins collapsed; the "
+                          "proportional race tightened even though "
+                          "throughput held; investigate",
+                          file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: margin p99 "
+                          f"{mp99/1e6:.2f}ms vs median "
+                          f"{m_med/1e6:.2f}ms -- OK")
+        # starvation watermark as a warn-only series in the GROWTH
+        # direction (the tardiness rule's shape): median floored at
+        # 100ms -- one round of virtual time -- so an always-served
+        # history never flaps on scheduling jitter
+        sv = row.get("starvation_max_ns")
+        if sv is not None:
+            s_hist2 = series(wl, "starvation_max_ns", impl, cal,
+                             loop, scen, pop, provon)
+            if len(s_hist2) < args.min_records:
+                print(f"bench_guard: {tag}: starvation max "
+                      f"{sv/1e6:.0f}ms ({len(s_hist2)} prior "
+                      "record(s) -- not judged)")
+            else:
+                s_med = median(s_hist2)
+                ceil = max(s_med, 1e8) * args.tolerance
+                if sv > ceil:
+                    print(f"bench_guard: {tag}: WARNING starvation "
+                          f"max {sv/1e6:.0f}ms vs median "
+                          f"{s_med/1e6:.0f}ms over {len(s_hist2)} "
+                          f"sessions (> {args.tolerance:g}x) -- a "
+                          "backlogged client sat unserved longer; "
+                          "run scripts/explain.py on the slo_log "
+                          "before trusting this session",
+                          file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: starvation max "
+                          f"{sv/1e6:.0f}ms vs median "
+                          f"{s_med/1e6:.0f}ms -- OK")
     if status:
         print(f"bench_guard: FAILED on {newest_name} -- a >"
               f"{args.tolerance:g}x drop survived the drift margin; "

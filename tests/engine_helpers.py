@@ -49,3 +49,98 @@ def build_state(infos, adds, *, capacity=64, ring=64,
 def deep_state(infos, depth, t=1 * S, capacity=64):
     adds = [(c, t, 1, 1, 1) for _ in range(depth) for c in infos]
     return build_state(infos, adds, capacity=capacity)
+
+
+def starvation_scenario(engine="prefix", engine_loop="round", *,
+                        epochs=8, every=2, n=8, ring=32,
+                        slo_log=None, flight_dump=None):
+    """The provenance plane's seeded limit-starvation scenario (ci.sh
+    provenance smoke + tests/test_provenance.py): client 0 is a heavy
+    over-limit tenant (high demand, LOW limit ceiling), client 1 a
+    well-provisioned competitor, the rest light filler -- client 0's
+    delivered rate pins at its limit with backlog queued, so
+    ``scripts/explain.py`` must attribute its violating windows to
+    ``limit_capped`` from the slo_log + flight dump this writes.
+
+    Runs ``epochs`` guarded epochs (round loop) or chunk launches
+    (stream loop) with the SLO window block + provenance block +
+    flight ring riding the scans, rolling windows on the ``every``
+    grid.  Returns ``(prov, slo_plane, state, now_ns)``.
+    """
+    import numpy as np
+
+    from dmclock_tpu.core.timebase import rate_to_inv_ns
+    from dmclock_tpu.engine import init_state, stream as stream_mod
+    from dmclock_tpu.obs import flight as obsflight
+    from dmclock_tpu.obs import provenance as obsprov
+    from dmclock_tpu.obs import slo as obsslo
+    from dmclock_tpu.robust.guarded import (run_epoch_guarded,
+                                            run_stream_chunk_guarded)
+
+    dt = 10 ** 8
+    st = init_state(n, ring)
+    resv = np.zeros(n)
+    # client 0: huge weight entitlement but a LOW limit ceiling --
+    # the limit, not the proportional race, must be what caps it
+    weights = np.asarray([32.0] + [8.0] + [1.0] * (n - 2))
+    limits = np.asarray([10.0] + [0.0] * (n - 1))   # client 0 capped
+
+    def inv(rates):
+        return jnp.asarray([rate_to_inv_ns(r) for r in rates],
+                           jnp.int64)
+
+    st = st._replace(
+        active=jnp.ones(n, dtype=bool),
+        order=jnp.arange(n, dtype=jnp.int64),
+        resv_inv=inv(resv), weight_inv=inv(weights),
+        limit_inv=inv(limits))
+    # heavy demand for clients 0/1, light filler for the rest, fed
+    # through the real superwave ingest so limit tags are the tag
+    # algebra's own (head_limit in the future = the gate signal)
+    lam = np.asarray([12, 12] + [1] * (n - 2), np.int32)
+    rng = np.random.default_rng(5)
+
+    slo_plane = obsslo.SloPlane(n, dt_epoch_ns=dt, ring_depth=64)
+    slo_plane.register_from_inv(st.resv_inv, st.weight_inv,
+                                st.limit_inv)
+    slo_block = slo_plane.stamp(obsslo.window_zero(n))
+    prov = obsprov.prov_init(n)
+    flight = obsflight.flight_init(256)
+    w0 = 0
+
+    def roll(state, e1):
+        nonlocal slo_block, w0
+        slo_block, closed = slo_plane.roll(slo_block, w0, e1,
+                                           depth=state.depth)
+        w0 = e1
+        if slo_log:
+            slo_plane.export_jsonl(slo_log, closed)
+
+    if engine_loop == "stream":
+        for e0, b in stream_mod.chunk_bounds(0, epochs, every):
+            counts = np.stack([
+                np.minimum(rng.poisson(lam), 8).astype(np.int32)
+                for _ in range(b - e0)])
+            g = run_stream_chunk_guarded(
+                st, e0, counts, engine=engine, epochs=b - e0, m=2,
+                k=8, chain_depth=3, dt_epoch_ns=dt, waves=8,
+                slo=slo_block, prov=prov, flight=flight)
+            st, slo_block, prov, flight = (g.state, g.slo, g.prov,
+                                           g.flight)
+            roll(st, b)
+    else:
+        ingest = stream_mod.jit_ingest_step(dt_epoch_ns=dt, waves=8)
+        for e in range(epochs):
+            counts = np.minimum(rng.poisson(lam), 8).astype(np.int32)
+            st = ingest(st, jnp.asarray(counts), jnp.int64(e * dt))
+            ep = run_epoch_guarded(
+                st, (e + 1) * dt, engine=engine, m=2, k=8,
+                chain_depth=3, slo=slo_block, prov=prov,
+                flight=flight)
+            st, slo_block, prov, flight = (ep.state, ep.slo, ep.prov,
+                                           ep.flight)
+            if (e + 1) % every == 0 or e + 1 == epochs:
+                roll(st, e + 1)
+    if flight_dump:
+        obsflight.flight_dump(flight, flight_dump)
+    return prov, slo_plane, st, epochs * dt

@@ -526,3 +526,118 @@ def test_capacity_skipped_rows_excluded_and_not_judged(monkeypatch,
     rc2, out2 = run_guard(monkeypatch, capsys, hist2)
     assert rc2 == 0
     assert "REGRESSION" not in out2
+
+
+# ----------------------------------------------------------------------
+# provenance series (margin_p99_ns / starvation_max_ns; warn-only)
+# ----------------------------------------------------------------------
+
+def write_history_prov(tmp_path, rows):
+    """rows = [(dps, margin_p99_ns, starvation_max_ns, provenance_on)]
+    on one device."""
+    h = tmp_path / "history"
+    h.mkdir()
+    for i, (dps, mp99, sv, provon) in enumerate(rows):
+        wl = {"dps": dps, "provenance_on": provon}
+        if provon:
+            wl["margin_p99_ns"] = mp99
+            wl["starvation_max_ns"] = sv
+        (h / f"bench_{1000 + i}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": "tpu0",
+             "workloads": {"cfg4": wl}}))
+    return h
+
+
+def test_prov_series_ok_when_stable(monkeypatch, capsys, tmp_path):
+    hist = write_history_prov(tmp_path, [
+        (40e6, 8e6, 2e8, True), (42e6, 6e6, 3e8, True),
+        (41e6, 7e6, 2.5e8, True)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "margin p99" in out and "starvation max" in out
+    assert "OK" in out
+
+
+def test_margin_collapse_warns_but_passes(monkeypatch, capsys,
+                                          tmp_path):
+    # margins collapsed 10x below the median while dec/s held: the
+    # proportional race tightened -- warn-only, exit 0
+    monkeypatch.setattr(bg, "HISTORY", write_history_prov(
+        tmp_path, [(40e6, 8e6, 1e8, True), (42e6, 10e6, 1e8, True),
+                   (41e6, 0.5e6, 1e8, True)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING margin p99" in cap.err
+    assert "margins collapsed" in cap.err
+
+
+def test_margin_noise_floor_never_flaps(monkeypatch, capsys,
+                                        tmp_path):
+    # a history whose margins are already sub-ms octave noise must
+    # not warn whatever the newest value does
+    hist = write_history_prov(tmp_path, [
+        (40e6, 0.3e6, 1e8, True), (42e6, 0.4e6, 1e8, True),
+        (41e6, 0.01e6, 1e8, True)])
+    monkeypatch.setattr(bg, "HISTORY", hist)
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING margin" not in cap.err
+
+
+def test_starvation_growth_warns_but_passes(monkeypatch, capsys,
+                                            tmp_path):
+    monkeypatch.setattr(bg, "HISTORY", write_history_prov(
+        tmp_path, [(40e6, 8e6, 2e8, True), (42e6, 8e6, 3e8, True),
+                   (41e6, 8e6, 30e8, True)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING starvation max" in cap.err
+    assert "explain.py" in cap.err
+
+
+def test_starvation_floor_never_flaps(monkeypatch, capsys, tmp_path):
+    # sub-100ms watermarks are one-epoch scheduling jitter: the
+    # floored median (1e8) absorbs a 50x "growth" from 1ms to 150ms
+    hist = write_history_prov(tmp_path, [
+        (40e6, 8e6, 1e6, True), (42e6, 8e6, 2e6, True),
+        (41e6, 8e6, 1.5e8, True)])
+    monkeypatch.setattr(bg, "HISTORY", hist)
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING starvation" not in cap.err
+
+
+def test_provenance_off_rows_split_the_series(monkeypatch, capsys,
+                                              tmp_path):
+    # a provenance-off session: its dps never enters the on-series
+    # medians, its tag prints [prov-off], and on-rows' provenance
+    # scalars never compare against it (it has none)
+    hist = write_history_prov(tmp_path, [
+        (40e6, 8e6, 1e8, True), (42e6, 8e6, 1e8, True),
+        (10e6, 0, 0, False)])   # 4x "drop" -- but a DIFFERENT series
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "[prov-off]" in out
+    assert "not judged" in out
+
+
+def test_provenance_on_medians_unpolluted_by_off_rows(monkeypatch,
+                                                      capsys,
+                                                      tmp_path):
+    # two off-rows at 10x the rate must not raise the on-series
+    # median past the newest on-row's floor
+    hist = write_history_prov(tmp_path, [
+        (400e6, 8e6, 1e8, False), (400e6, 8e6, 1e8, False),
+        (40e6, 8e6, 1e8, True), (42e6, 8e6, 1e8, True),
+        (41e6, 8e6, 1e8, True)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "REGRESSION" not in out

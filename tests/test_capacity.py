@@ -468,11 +468,18 @@ class TestDocDrift:
         from dmclock_tpu.lifecycle.plane import LifecyclePlane
         from dmclock_tpu.obs import device as obsdev
         from dmclock_tpu.obs import histograms as obshist
+        from dmclock_tpu.obs import provenance as obsprov
         from dmclock_tpu.obs import slo as obsslo
         from dmclock_tpu.obs.alerts import SloEvaluator
         from dmclock_tpu.obs.registry import publish_span_gauges
 
         reg = MetricsRegistry()
+        obsprov.publish_provenance(reg, obsprov.prov_init(2))
+        obsprov.publish_shard_pressure(
+            reg, np.zeros((1, obsprov.PRESS_FIELDS), dtype=np.int64),
+            np.zeros(obsprov.PRESS_FIELDS, dtype=np.int64))
+        obsprov.StarvationMonitor(10 ** 9, registry=reg,
+                                  log=lambda _l: None)
         obsdev.publish(reg, np.zeros(obsdev.NUM_METRICS,
                                      dtype=np.int64))
         obshist.publish_hists(reg, obshist.hist_zero())

@@ -163,7 +163,8 @@ class TestTelemetryCrashEquivalence:
         # device truth: the ledger's ops column covers every decision
         assert ref.ledger[:, 0].sum() == ref.decisions
         assert ref.flight_seq > 0
-        assert ref.flight_buf.shape == (64, 6)
+        from dmclock_tpu.obs import flight as obsflight
+        assert ref.flight_buf.shape == (64, obsflight.FLIGHT_COLS)
 
     def test_kill_mid_run_telemetry_bit_identical(self, tmp_path):
         ref = self._ref()
@@ -209,7 +210,8 @@ class TestTelemetryCrashEquivalence:
         seqs = [r["seq"] for r in rows]
         assert seqs == sorted(seqs)
         assert all(set(r) == {"seq", "batch", "client", "cls",
-                              "tag", "cost"} for r in rows)
+                              "tag", "cost", "margin", "gate"}
+                   for r in rows)
 
 
 class TestScrapeLoss:

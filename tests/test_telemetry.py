@@ -445,7 +445,8 @@ class TestFlightRecorder:
         rows = [json.loads(l) for l in p.read_text().splitlines()]
         assert n == len(rows) == 2
         assert rows[1] == {"seq": 1, "batch": 0, "client": 2,
-                           "cls": 1, "tag": 20, "cost": 3}
+                           "cls": 1, "tag": 20, "cost": 3,
+                           "margin": -1, "gate": 0}
 
     def test_epoch_flight_matches_stream(self):
         """Prefix-epoch flight records ARE the decision stream's tail
