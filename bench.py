@@ -1524,6 +1524,227 @@ def bench_churn(scenario: str = "flash_crowd", *,
     return out
 
 
+def plan_mesh_shards(clients: int, n_shards=None, *,
+                     ring: int = 16, engine: str = "prefix",
+                     m: int = 4, k: int = 256,
+                     telemetry: bool = True, slo: bool = True,
+                     stream_chunk: int = 8) -> dict:
+    """Shard planning for ``--mode mesh``: when ``--n-shards`` is not
+    given, the count FALLS OUT of the client target by inverting the
+    capacity plane's HBM ledger (``obs.capacity.plan_capacity`` over
+    ``device_hbm_budget()`` -- the ROADMAP rule: never guessed on
+    silicon).  Returns the plan record the JSON line carries:
+    ``shards_planned`` (None when no budget is detectable, e.g. cpu),
+    the effective shard count (capped at the attached device count),
+    per-shard clients, and ``projected_hbm_bytes_per_shard``."""
+    from dmclock_tpu.obs import capacity as obscap
+
+    cap_cfg = dict(ring=ring, engine=engine, m=m, k=k,
+                   telemetry=telemetry, slo=slo,
+                   stream_chunk=stream_chunk)
+    budget = obscap.device_hbm_budget()
+    shards_planned = None
+    max_per_shard = None
+    if budget is not None:
+        plan = obscap.plan_capacity(budget, **cap_cfg)
+        max_per_shard = max(int(plan["max_clients"]), 1)
+        shards_planned = max(1, -(-int(clients) // max_per_shard))
+    n_dev = len(jax.devices())
+    eff = int(n_shards) if n_shards else (shards_planned or n_dev)
+    if eff > n_dev:
+        print(f"# mesh: {eff} shards requested/planned but only "
+              f"{n_dev} devices attached -- capping (force a host "
+              "mesh with --xla_force_host_platform_device_count)",
+              file=__import__("sys").stderr)
+        eff = n_dev
+    per_shard = -(-int(clients) // eff)
+    plan = {
+        "clients_total": int(clients),
+        "n_shards": eff,
+        "clients_per_shard": per_shard,
+        "shards_planned": shards_planned,
+        "max_clients_per_shard": max_per_shard,
+        "hbm_budget_bytes": budget,
+        "projected_hbm_bytes_per_shard":
+            int(obscap.projected_hbm(per_shard, **cap_cfg)),
+    }
+    # the device cap can push the per-shard partition BACK over the
+    # budget the planner just inverted (e.g. 9 shards planned, 8
+    # devices attached): surface it so bench_mesh can apply the
+    # PR-11 capacity-gate discipline (warn + skip, never OOM)
+    if max_per_shard is not None and per_shard > max_per_shard:
+        plan["over_budget"] = True
+    return plan
+
+
+def bench_mesh(clients: int = 100_000, *, n_shards=None,
+               counter_sync_every: int = 1, engine: str = "prefix",
+               epochs: int = 24, warmup_epochs: int = 8,
+               chunk: int = 8, m: int = 4, k: int = 256,
+               ring: int = 16, depth: int = 12,
+               arrival_lam: float = 2.0, waves: int = 4,
+               dt_epoch_ns: int = 10 ** 8,
+               with_metrics: bool = True, slo: bool = True,
+               tracer=None) -> dict:
+    """The mesh serving plane's aggregate-throughput trajectory
+    (docs/ENGINE.md "Mesh serving"; the MULTICHIP v2 record shape):
+    S full per-device engines -- each one server owning a DISTINCT
+    ``clients/S``-client partition with its own queue state and
+    Poisson arrival stream, so ``clients`` total contracts live
+    across the mesh -- advance whole chunks of fused ingest+serve
+    epochs inside ONE shard_map launch per chunk, exchanging only the
+    [clients/S]-sized delta/rho counter psum at epoch boundaries
+    (views refresh on the ``counter_sync_every`` grid).  On CPU
+    (forced host devices) this proves the SCALING SHAPE; the silicon
+    campaign inherits it as the >=100M dec/s @ 1M clients one-command
+    repro."""
+    import dataclasses
+
+    from dmclock_tpu.obs import slo as obsslo
+    from dmclock_tpu.parallel import mesh as mesh_mod
+    from dmclock_tpu.parallel import tracker as trk
+    from dmclock_tpu.robust.supervisor import EpochJob, _job_state
+
+    plan = plan_mesh_shards(clients, n_shards, ring=ring,
+                            engine=engine, m=m, k=k, slo=slo,
+                            stream_chunk=chunk)
+    S = plan["n_shards"]
+    n = plan["clients_per_shard"]
+    if plan.pop("over_budget", False):
+        # the capacity-gate discipline (PR-11): a partition the
+        # planner's own inversion says exceeds the per-device budget
+        # is warned + skipped with a tagged row, never launched into
+        # an OOM mid-session
+        import sys as _sys
+
+        print(f"# mesh: SKIPPED -- {n} clients/shard exceeds the "
+              f"planned {plan['max_clients_per_shard']} for the "
+              f"detected budget even at the device-capped {S} "
+              "shards; lower --clients or attach more devices",
+              file=_sys.stderr)
+        return {"workload": "mesh", "engine": engine,
+                "engine_loop": "mesh", "dps": 0.0, "decisions": 0,
+                "capacity_skipped": True,
+                "projected_hbm_bytes":
+                    plan["projected_hbm_bytes_per_shard"],
+                "counter_sync_every":
+                    int(max(counter_sync_every, 1)),
+                **{key: val for key, val in plan.items()
+                   if val is not None}}
+    job = EpochJob(engine=engine, engine_loop="mesh", n_shards=S,
+                   counter_sync_every=counter_sync_every, n=n,
+                   depth=depth, ring=ring, m=m, k=k,
+                   arrival_lam=arrival_lam, waves=waves,
+                   dt_epoch_ns=dt_epoch_ns)
+    mesh = mesh_mod.make_mesh(S)
+    state = mesh_mod.stack_shards(
+        _job_state(dataclasses.replace(job, engine_loop="stream")),
+        S, mesh)
+    cd, cr, vd, vr = mesh_mod.counter_init(S, n)
+    wblock = mesh_mod.stack_shards(obsslo.window_zero(n), S, mesh)
+    fn = mesh_mod.jit_mesh_chunk(
+        mesh, engine=engine, epochs=chunk, m=m, k=k,
+        dt_epoch_ns=dt_epoch_ns, waves=waves,
+        with_metrics=with_metrics,
+        counter_sync_every=counter_sync_every, ingest=True)
+    rng = np.random.Generator(np.random.PCG64(29))
+
+    def draw(e):
+        return jnp.asarray(np.swapaxes(np.stack(
+            [rng.poisson(arrival_lam, (S, n)).astype(np.int32)
+             for _ in range(e)]), 0, 1))
+
+    def launch(out, e0, counts):
+        with obsspans.span(tracer, "mesh.bench_chunk", "dispatch",
+                           epoch0=e0, shards=S):
+            return fn(out.state, out.cd, out.cr, out.view_d,
+                      out.view_r, jnp.int64(e0), counts,
+                      None, None, out.slo, None)
+
+    # warmup (covers compile + tag-transient), untimed
+    out = mesh_mod.MeshChunk(state=state, outs={}, cd=cd, cr=cr,
+                             view_d=vd, view_r=vr, slo=wblock)
+    e0 = 0
+    warm_chunks = max(1, warmup_epochs // chunk)
+    for _ in range(warm_chunks):
+        out = launch(out, e0, draw(chunk))
+        e0 += chunk
+    jax.block_until_ready(out.state)
+
+    # timed window: ALL raw draws pre-generated (and device-resident)
+    # before the clock starts -- the every-other-bench pregen
+    # discipline; host RNG time must not serialize into the async
+    # chunk chain and bias the aggregate dec/s the MULTICHIP record
+    # reads -- then chain chunks asynchronously, one sync at the end
+    n_chunks = max(1, epochs // chunk)
+    pregen = [draw(chunk) for _ in range(n_chunks)]
+    jax.block_until_ready(pregen)
+    timed = []
+    t0 = time.perf_counter()
+    for counts_c in pregen:
+        out = launch(out, e0, counts_c)
+        timed.append(out.outs["count"])
+        e0 += chunk
+    jax.block_until_ready(out.state)
+    wall = time.perf_counter() - t0
+
+    # exact decision counts, fetched untimed; [S, E, ...] per chunk
+    per_shard = np.zeros(S, dtype=np.int64)
+    for counts_arr in timed:
+        a = np.asarray(jax.device_get(counts_arr))
+        per_shard += a.reshape(S, -1).sum(axis=1)
+    total = int(per_shard.sum())
+    dps = total / wall
+    shard_dps = per_shard / wall
+    # the timed window starts at the post-warmup GLOBAL epoch: the
+    # device sync grid is epoch % K == 0, so the sync count inside
+    # the window depends on where it starts
+    sched = trk.exchange_schedule(n_chunks * chunk,
+                                  counter_sync_every,
+                                  start=warm_chunks * chunk)
+    bytes_per_sync = trk.counter_view_bytes(n)
+    row = {
+        "workload": "mesh",
+        "engine": engine,
+        "engine_loop": "mesh",
+        "dps": dps,
+        "dps_per_shard_mean": float(shard_dps.mean()),
+        "dps_per_shard_min": float(shard_dps.min()),
+        "dps_per_shard_max": float(shard_dps.max()),
+        "dps_per_shard": [float(x) for x in shard_dps],
+        "decisions": total,
+        "wall_s": wall,
+        "epochs": n_chunks * chunk,
+        "stream_chunk": chunk,
+        "counter_sync_every": int(max(counter_sync_every, 1)),
+        "counter_syncs": sched["syncs"],
+        "counter_bytes_per_sync": bytes_per_sync,
+        # what the compiled program EXECUTES: the [C]-sized psum runs
+        # every epoch (K gates only the view refresh; skipping the
+        # collective on non-sync epochs is the ROADMAP on-silicon
+        # remainder) -- recording the K-discounted figure here would
+        # project 1/K of the real cross-chip bandwidth
+        "counter_bytes_per_epoch": float(bytes_per_sync),
+        # what the staleness cadence WILL realize once the collective
+        # is group-structured: view-refresh bytes amortized over the
+        # sync grid
+        "counter_view_bytes_per_epoch":
+            bytes_per_sync * sched["syncs"] / max(sched["epochs"], 1),
+        **{key: val for key, val in plan.items() if val is not None},
+    }
+    # the cluster-wide conformance table (window_mesh_reduce merge)
+    # rides the scrape registry with per-shard decomposition
+    try:
+        from dmclock_tpu.obs import default_registry
+        obsslo.publish_shard_windows(
+            default_registry(), np.asarray(jax.device_get(out.slo)),
+            merged=np.asarray(jax.device_get(out.slo_merged)),
+            workload="mesh")
+    except Exception:
+        pass
+    return row
+
+
 def _with_ladder(ladder, cfg: dict, fn):
     """Run one workload under the degradation ladder
     (robust.guarded.DegradationLadder): a failed run whose config
@@ -1651,8 +1872,29 @@ def main() -> None:
     ap.add_argument("--profile", metavar="DIR", default=None)
     ap.add_argument("--mode",
                     choices=["all", "serve", "cfg3", "cfg4",
-                             "frontier", "churn"],
+                             "frontier", "churn", "mesh"],
                     default="all")
+    ap.add_argument("--clients", type=int, default=100_000,
+                    metavar="N",
+                    help="--mode mesh: TOTAL client population across "
+                    "all shards; without --n-shards the shard count "
+                    "is derived by inverting the capacity plane's HBM "
+                    "ledger (obs.capacity.plan_capacity over the "
+                    "detected device budget) -- the shard count falls "
+                    "out of the client target, never guessed")
+    ap.add_argument("--n-shards", type=int, default=None, metavar="S",
+                    help="--mode mesh: per-device engine count (caps "
+                    "at the attached device count; on cpu boxes "
+                    "bench forces a virtual host mesh of this size "
+                    "before backend init)")
+    ap.add_argument("--counter-sync-every", type=int, default=1,
+                    metavar="K",
+                    help="--mode mesh: exchange the [C]-sized "
+                    "delta/rho counter psum only on epochs where "
+                    "epoch %% K == 0 (the staleness knob; the "
+                    "paper's piggybacked views are naturally stale, "
+                    "and K>1 is pinned decision-exact against the "
+                    "host loop's delay_counters fault)")
     ap.add_argument("--churn-scenario",
                     choices=["flash_crowd", "diurnal", "churn_storm",
                              "limit_thrash"],
@@ -1799,6 +2041,19 @@ def main() -> None:
                     "down to its exact twin and retrying)")
     args = ap.parse_args()
     restarts = int(os.environ.get("DMCLOCK_RESTARTS", "0") or 0)
+    if args.mode == "mesh" and args.n_shards:
+        # force a virtual host mesh of the requested size BEFORE any
+        # backend initializes (the conftest.py discipline; a no-op on
+        # accelerator backends -- it only sizes the cpu client)
+        try:
+            jax.config.update("jax_num_cpu_devices", args.n_shards)
+        except AttributeError:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count="
+                f"{args.n_shards}")
+        except RuntimeError:
+            pass   # backend already up; bench_mesh caps the count
     if args.target_latency:
         args.mode = "frontier"
     if args.metrics_port is not None:
@@ -2004,6 +2259,16 @@ def main() -> None:
             results[key] = bench_churn(args.churn_scenario,
                                        slo=slo_on, tracer=tracer,
                                        **churn_shape)
+        if args.mode == "mesh":
+            # the mesh serving plane's aggregate-throughput series
+            # (any backend: cpu with forced host devices proves the
+            # scaling shape; the silicon campaign inherits the
+            # >=100M dec/s @ 1M clients target as the same command)
+            results["mesh"] = bench_mesh(
+                args.clients, n_shards=args.n_shards,
+                counter_sync_every=args.counter_sync_every,
+                chunk=args.stream_chunk, with_metrics=wm,
+                slo=slo_on, tracer=tracer)
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster
@@ -2129,6 +2394,26 @@ def main() -> None:
             f"{r4.get('round_ms_p50', 0):.0f}ms p99 "
             f"{r4.get('round_ms_p99', 0):.0f}ms tunnel-inclusive "
             f"upper bounds)")
+    if results.get("mesh", {}).get("capacity_skipped"):
+        r = results["mesh"]
+        parts.append(
+            f"mesh SKIPPED by the capacity gate "
+            f"({r['clients_per_shard']} clients/shard > planned "
+            f"{r.get('max_clients_per_shard')} for the detected "
+            "budget)")
+    elif "mesh" in results:
+        r = results["mesh"]
+        planned = r.get("shards_planned")
+        parts.append(
+            f"mesh {r['n_shards']} shards x "
+            f"{r['clients_per_shard']} clients "
+            f"{r['dps']/1e6:.1f}M aggregate "
+            f"({r['dps_per_shard_mean']/1e6:.2f}M/shard, "
+            f"sync every {r['counter_sync_every']} epochs, "
+            f"{r['counter_bytes_per_epoch']:.0f} B/epoch counter "
+            f"exchange"
+            + (f", {planned} shards planned from the HBM ledger"
+               if planned is not None else "") + ")")
     for key in sorted(results):
         if not key.startswith("churn_"):
             continue
@@ -2231,6 +2516,12 @@ def main() -> None:
                   if wl.startswith("churn_")}
     if churn_rows:
         final["churn"] = churn_rows
+    # the mesh serving plane's full row (aggregate + per-shard dec/s,
+    # counter-exchange accounting, shard plan) rides the JSON line --
+    # the MULTICHIP v2 record reads it straight off stdout
+    if "mesh" in results:
+        final["mesh"] = {k: v for k, v in results["mesh"].items()
+                         if k != "_hist_block"}
     if wm and "device_metrics" in primary:
         final["device_metrics"] = primary["device_metrics"]
     # per-epoch XLA attribution + what bounded each sustained run ride

@@ -110,6 +110,40 @@ def clamped_ingest(state: EngineState, counts, t_base, *, waves: int,
         state, c, wave_times, cost1, cost1, cost1, anticipation_ns=0)
 
 
+def make_epoch_step(*, engine: str, m: int, kw: dict, dt_epoch_ns: int,
+                    waves: int, ingest: bool):
+    """The ONE fused per-epoch step shared by the stream chunk body
+    and the mesh serving plane's per-shard chunk
+    (``parallel.mesh.build_mesh_chunk``): clamped superwave ingest at
+    ``t_base`` + one full epoch of ``engine`` serving at ``t_base +
+    dt`` with the telemetry accumulators riding the carry.  Factoring
+    it here is what makes the S=1 mesh == stream bit-identity a
+    construction, not a test-only coincidence -- the two loops cannot
+    drift because they trace the same step.
+
+    Returns ``step(state, t_base, counts_e, hists, ledger, flight,
+    slo, prov) -> ((state', hists', ledger', flight', slo', prov'),
+    outs)`` with ``outs`` the engine's :data:`STREAM_OUT_FIELDS` plus
+    ``"metrics"``."""
+    fn = fastpath.epoch_scan_fn(engine)
+    fields = STREAM_OUT_FIELDS[engine]
+    dt = int(dt_epoch_ns)
+    dt_wave = dt // int(waves)
+
+    def step(st, t_base, counts_e, h, l, f, s, p):
+        if ingest:
+            st = clamped_ingest(st, counts_e, t_base,
+                                waves=waves, dt_wave=dt_wave)
+        ep = fn(st, t_base + dt, m=m, **kw,
+                hists=h, ledger=l, flight=f, slo=s, prov=p)
+        outs = {name: getattr(ep, name) for name in fields}
+        outs["metrics"] = ep.metrics
+        return (ep.state, ep.hists, ep.ledger, ep.flight,
+                ep.slo, ep.prov), outs
+
+    return step
+
+
 def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
                        chain_depth: int = 4, dt_epoch_ns: int,
                        waves: int, anticipation_ns: int = 0,
@@ -134,7 +168,6 @@ def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
     assert engine in fastpath.EPOCH_ENGINES, engine
     epochs = int(epochs)
     assert epochs >= 1, "a stream chunk needs at least one epoch"
-    fn = fastpath.epoch_scan_fn(engine)
     kw = fastpath.epoch_scan_kwargs(
         engine, k=k, chain_depth=chain_depth, select_impl=select_impl,
         tag_width=tag_width, window_m=window_m,
@@ -143,8 +176,9 @@ def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
         allow_limit_break=allow_limit_break,
         with_metrics=with_metrics)
     dt = int(dt_epoch_ns)
-    dt_wave = dt // int(waves)
-    fields = STREAM_OUT_FIELDS[engine]
+    epoch_step = make_epoch_step(engine=engine, m=m, kw=kw,
+                                 dt_epoch_ns=dt, waves=waves,
+                                 ingest=ingest)
 
     def chunk(state: EngineState, epoch0, counts, hists=None,
               ledger=None, flight=None, slo=None,
@@ -155,15 +189,7 @@ def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
             st, h, l, f, s, p = carry
             counts_e, i = xs
             t_base = (epoch0 + i) * dt
-            if ingest:
-                st = clamped_ingest(st, counts_e, t_base,
-                                    waves=waves, dt_wave=dt_wave)
-            ep = fn(st, t_base + dt, m=m, **kw,
-                    hists=h, ledger=l, flight=f, slo=s, prov=p)
-            outs = {name: getattr(ep, name) for name in fields}
-            outs["metrics"] = ep.metrics
-            return (ep.state, ep.hists, ep.ledger, ep.flight,
-                    ep.slo, ep.prov), outs
+            return epoch_step(st, t_base, counts_e, h, l, f, s, p)
 
         idx = jnp.arange(epochs, dtype=jnp.int64)
         if ingest:

@@ -467,11 +467,20 @@ class InstrumentedJit:
             return self._jit(*args, **kwargs)
         try:
             return comp(*args, **kwargs)
-        except TypeError:
+        except (TypeError, ValueError) as e:
             # an aval aspect the signature cannot see (layout,
             # sharding): this signature routes through plain jit
-            # dispatch from now on.  TypeError is raised BEFORE
-            # execution/donation, so the re-dispatch is safe.
+            # dispatch from now on.  TypeError covers the classic
+            # aval mismatch; newer jax raises ValueError for a
+            # committed-sharding mismatch (e.g. a mesh-placed array
+            # calling an executable compiled for a single device --
+            # the mesh fallback path's shape).  Both are raised
+            # BEFORE execution/donation, so the re-dispatch is safe;
+            # any OTHER ValueError surfaces unchanged.
+            if isinstance(e, ValueError) and \
+                    "sharding" not in str(e) and \
+                    "layout" not in str(e):
+                raise
             with self._mtx:
                 self._compiled[sig] = _DISPATCH
             pl.note_dispatch_fallback(self._cache, self._entry)

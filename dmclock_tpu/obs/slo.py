@@ -118,6 +118,63 @@ def window_mesh_reduce(w, axis_name: str):
                      lax.psum(w, axis_name))
 
 
+def window_combine_axis(mat):
+    """Reduce a stacked ``[S, N, W_FIELDS]`` block along its leading
+    shard axis (counter columns sum, contract-epoch max) -- the
+    local half of a mesh merge (vmapped servers within a shard reduce
+    here, then :func:`window_mesh_reduce` crosses the mesh), the
+    window analog of ``obs.device.metrics_combine_axis``."""
+    import jax.numpy as jnp
+
+    return jnp.where(_W_MAX_MASK, jnp.max(mat, axis=0),
+                     jnp.sum(mat, axis=0))
+
+
+def window_combine_np(acc, *blocks):
+    """Host-side mirror of :func:`window_combine` over numpy blocks
+    (counters add, contract-epoch max) -- what the mesh merge tests
+    compare the in-graph ``window_mesh_reduce`` result against, and
+    what the supervisor uses to merge fetched per-shard blocks when
+    no mesh program is live.  Derives the max column from the same
+    ``_W_MAX_MASK`` as the device merge, so the two cannot drift."""
+    acc = np.asarray(acc, dtype=np.int64)
+    for b in blocks:
+        b = np.asarray(b, dtype=np.int64)
+        acc = np.where(_W_MAX_MASK, np.maximum(acc, b), acc + b)
+    return acc
+
+
+def publish_shard_windows(registry, blocks, merged=None,
+                          workload: Optional[str] = None) -> None:
+    """Publish per-shard window-block totals as ``dmclock_slo_window_*``
+    gauges labelled by ``shard`` (the ROADMAP PR-10 fold-in: the
+    cluster-wide delivered-vs-contract table keeps its per-shard
+    decomposition visible), plus the mesh-merged cluster total under
+    ``shard="all"``.  ``blocks`` is ``[S, N, W_FIELDS]`` (stacked) or
+    an iterable of per-shard blocks; ``merged`` defaults to the host
+    combine of the shards."""
+    blocks = [np.asarray(b, dtype=np.int64) for b in blocks]
+    if merged is None and blocks:
+        merged = window_combine_np(np.zeros_like(blocks[0]), *blocks)
+
+    def emit(block, shard: str) -> None:
+        labels = {"shard": shard}
+        if workload is not None:
+            labels["workload"] = workload
+        for name, val in window_totals(block).items():
+            registry.gauge(
+                f"dmclock_slo_window_{name}",
+                "cluster-wide windowed conformance column, per shard "
+                "(docs/OBSERVABILITY.md SLO plane; shard=all is the "
+                "window_mesh_reduce merge)",
+                labels=labels).set(float(val))
+
+    for s, block in enumerate(blocks):
+        emit(block, str(s))
+    if merged is not None:
+        emit(np.asarray(merged, dtype=np.int64), "all")
+
+
 def stamp_cepoch(block, cepochs):
     """Write the per-slot contract-epoch ids into the block's
     :data:`W_CEPOCH` column (one cheap device launch per boundary --

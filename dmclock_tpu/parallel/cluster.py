@@ -307,21 +307,30 @@ def cluster_step(cluster: ClusterState, arrivals: jnp.ndarray,
 _ROUNDS_JIT_CACHE: dict = {}
 
 
+def mesh_cache_key(mesh: Mesh, cfg: tuple) -> tuple:
+    """THE cache key for every mesh-program module-jit cache
+    (``mesh_step_jit``, :func:`jit_mesh_rounds`,
+    ``parallel.mesh.jit_mesh_chunk``): (mesh, cfg) with the
+    unhashable-mesh ``id()`` fallback some jax versions need.  One
+    implementation so a jax-version fix lands in one place."""
+    try:
+        key = (mesh,) + cfg
+        hash(key)
+        return key
+    except TypeError:            # unhashable mesh on some jax versions
+        return (id(mesh),) + cfg
+
+
 def mesh_step_jit(cache: dict, step_fn, mesh: Mesh, cfg: tuple):
     """Shared module-jit-cache helper for mesh step drivers (this
     module's healthy rounds and ``robust.cluster``'s faulty steps):
     one compiled ``jax.jit(partial(step_fn, mesh=mesh, <cfg>))`` per
     (mesh, static-config) pair.  ``cfg`` is the five-tuple
     (decisions_per_step, max_arrivals, anticipation_ns,
-    allow_limit_break, advance_ns).  The unhashable-mesh id() fallback
-    lives HERE so a jax-version fix lands in one place."""
+    allow_limit_break, advance_ns)."""
     from ..obs import compile_plane as _cplane
 
-    try:
-        key = (mesh,) + cfg
-        hash(key)
-    except TypeError:            # unhashable mesh on some jax versions
-        key = (id(mesh),) + cfg
+    key = mesh_cache_key(mesh, cfg)
     if key not in cache:
         (decisions_per_step, max_arrivals, anticipation_ns,
          allow_limit_break, advance_ns) = cfg
@@ -376,6 +385,232 @@ def run_cluster_rounds(cluster: ClusterState, arrivals_seq, cost,
         with _spans.span(tracer, "cluster.fetch", "fetch", step=t):
             decs_seq.append(jax.device_get(decs))
     return cluster, decs_seq
+
+
+# ----------------------------------------------------------------------
+# mesh serving plane: fused multi-round programs with batched
+# delta/rho exchange (docs/ENGINE.md "Mesh serving")
+# ----------------------------------------------------------------------
+
+class MeshRounds(NamedTuple):
+    """One fused mesh launch's outputs (``run_mesh_rounds``).
+
+    ``decs`` leaves are ``[S, E, k]`` (server, round, decision slot);
+    slice round ``t`` with :func:`mesh_decs_seq` to recover the
+    per-step ``[S, k]`` stream the host-loop drivers emit.  ``metrics``
+    is the per-shard ``int64[S, NUM_METRICS]`` vector accumulated
+    across all E rounds with the robust path's delta accounting, so a
+    zero-fault host loop and a mesh launch produce the same totals."""
+
+    cluster: ClusterState
+    view_delta: jnp.ndarray   # int64[S, C] held counter views
+    view_rho: jnp.ndarray     # int64[S, C]
+    metrics: jnp.ndarray      # int64[S, NUM_METRICS]
+    decs: object              # kernels.Decision, [S, E, k] leaves
+    merged: object = None     # int64[NUM_METRICS] (with_merged)
+    pressure: object = None   # int64[S, PRESS_FIELDS] (with_pressure)
+    pressure_merged: object = None
+
+
+def init_mesh_views(n_servers: int, n_clients: int):
+    """Held counter views at the protocol origin (counters start at 1,
+    ``dmclock_client.h:191-198``) -- the same origin ``robust.cluster.
+    init_robust`` gives its view arrays, so a mesh launch and the
+    host-loop degraded path start from identical state."""
+    return (jnp.ones((n_servers, n_clients), dtype=jnp.int64),
+            jnp.ones((n_servers, n_clients), dtype=jnp.int64))
+
+
+def _mesh_round_body(engine, tracker, now, arr, vd, vr, met, sync, *,
+                     cost, decisions_per_step, anticipation_ns,
+                     allow_limit_break, max_arrivals, advance_ns):
+    """One fused round (inside the per-server scan): refresh the held
+    counter view from the mesh psum on sync rounds only (the
+    ``counter_sync_every`` staleness knob -- the paper's piggybacked
+    views are naturally stale, and ``server_round`` takes the view as
+    an argument precisely so a stale one is protocol-safe), then run
+    the round and fold the completion metrics with the degraded path's
+    delta accounting (``robust.cluster._one_server_step_faulty``'s
+    zero-fault arm), so mesh and host-loop totals are comparable."""
+    g_d, g_r = global_counters(
+        tracker, lambda x: lax.psum(x, SERVER_AXIS))
+    vd = jnp.where(sync, g_d, vd)
+    vr = jnp.where(sync, g_r, vr)
+    engine, tracker, now, decs = server_round(
+        engine, tracker, now + advance_ns, arr, cost, vd, vr,
+        decisions_per_step=decisions_per_step,
+        anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break,
+        max_arrivals=max_arrivals)
+    served = decs.type == kernels.RETURNING
+    n_served = jnp.sum(served).astype(jnp.int64)
+    n_resv = jnp.sum(served & (decs.phase == 0)).astype(jnp.int64)
+    met = obsdev.metrics_combine(met, obsdev.metrics_delta(
+        decisions=n_served, resv=n_resv, prop=n_served - n_resv,
+        limit_break=jnp.sum(decs.limit_break).astype(jnp.int64),
+        ring_hwm=jnp.max(engine.depth).astype(jnp.int64)))
+    return engine, tracker, now, vd, vr, met, decs
+
+
+def run_mesh_rounds(cluster: ClusterState, arrivals_seq, cost,
+                    mesh: Mesh, *, decisions_per_step: int,
+                    max_arrivals: int = 1, anticipation_ns: int = 0,
+                    allow_limit_break: bool = False,
+                    advance_ns: int = 0,
+                    counter_sync_every: int = 1, round0: int = 0,
+                    view_delta=None, view_rho=None, metrics=None,
+                    with_merged: bool = False,
+                    with_pressure: bool = False) -> MeshRounds:
+    """The mesh serving plane's cluster program: ONE ``shard_map``
+    launch advances every server by ``E = arrivals_seq.shape[0]``
+    whole rounds (a ``lax.scan`` over rounds inside each shard), with
+    the [C]-sized delta/rho counter psum -- the paper's piggyback
+    protocol, batched -- exchanged once per round boundary instead of
+    once per decision batch, and only on rounds where
+    ``t % counter_sync_every == 0`` (round 0 always syncs; between
+    syncs every server serves from its HELD view, exactly the
+    stale-counter tolerance ``robust.cluster`` injects as the
+    ``delay_counters`` fault -- the K>1 digest gate in
+    ``tests/test_cluster_realism.py`` pins the two paths equal).
+
+    ``arrivals_seq`` is int32[E, S, C] in round order.  With K=1 the
+    launch is decision-for-decision AND counter-view-for-counter-view
+    identical to ``E`` host-driven ``robust_cluster_step``s under a
+    zero-fault plan; the only difference is launches: 1 vs 3E host
+    round-trips.  ``view_delta``/``view_rho``/``metrics`` resume held
+    state across launches (``None`` = the protocol origin / zeros)
+    and ``round0`` anchors this launch on the GLOBAL round grid --
+    the sync mask is ``(round0 + t) % K == 0`` -- so chunked mesh
+    launches compose exactly like the host loop at ANY K (pass the
+    previous launch's end round; the composition test pins K=2).
+
+    ``with_merged`` additionally mesh-reduces the per-shard metric
+    vectors in-graph (psum counters / pmax hwm); ``with_pressure``
+    returns the post-run per-shard pressure gauges + their merged
+    total (``obs.provenance``), replicated."""
+    from ..obs import provenance as obsprov
+
+    arrivals_seq = jnp.asarray(arrivals_seq, dtype=jnp.int32)
+    epochs = int(arrivals_seq.shape[0])
+    n_servers = cluster.now.shape[0]
+    n_clients = arrivals_seq.shape[2]
+    cost = jnp.asarray(cost, dtype=jnp.int64)
+    every = max(int(counter_sync_every), 1)
+    sync_mask = jnp.asarray(
+        (int(round0) + np.arange(epochs)) % every == 0)
+    if view_delta is None or view_rho is None:
+        view_delta, view_rho = init_mesh_views(n_servers, n_clients)
+    if metrics is None:
+        metrics = jnp.zeros((n_servers, obsdev.NUM_METRICS),
+                            dtype=jnp.int64)
+    # [E, S, C] -> [S, E, C]: the shard axis must lead for P(servers)
+    arr_s = jnp.swapaxes(arrivals_seq, 0, 1)
+
+    def per_server(engine, tracker, now, arrs, vd, vr, met):
+        def body(carry, xs):
+            engine, tracker, now, vd, vr, met = carry
+            arr, sync = xs
+            engine, tracker, now, vd, vr, met, decs = \
+                _mesh_round_body(
+                    engine, tracker, now, arr, vd, vr, met, sync,
+                    cost=cost, decisions_per_step=decisions_per_step,
+                    anticipation_ns=anticipation_ns,
+                    allow_limit_break=allow_limit_break,
+                    max_arrivals=max_arrivals, advance_ns=advance_ns)
+            return (engine, tracker, now, vd, vr, met), decs
+
+        (engine, tracker, now, vd, vr, met), decs = lax.scan(
+            body, (engine, tracker, now, vd, vr, met),
+            (arrs, sync_mask))
+        return engine, tracker, now, vd, vr, met, decs
+
+    def shard_fn(engine, tracker, now, arrs, vd, vr, met):
+        out = jax.vmap(per_server)(engine, tracker, now, arrs, vd,
+                                   vr, met)
+        if with_merged:
+            out = out + (obsdev.metrics_mesh_reduce(
+                obsdev.metrics_combine_axis(out[5]), SERVER_AXIS),)
+        if with_pressure:
+            press = jax.vmap(obsprov.pressure_vec)(out[0], out[2])
+            out = out + (press, obsprov.pressure_mesh_reduce(
+                obsprov.pressure_combine_axis(press), SERVER_AXIS))
+        return out
+
+    spec = P(SERVER_AXIS)
+    out_specs = (spec,) * 7
+    if with_merged:
+        out_specs += (P(),)
+    if with_pressure:
+        out_specs += (spec, P())
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(spec,) * 7, out_specs=out_specs,
+                   check_vma=False)
+    outs = fn(cluster.engine, cluster.tracker, cluster.now, arr_s,
+              view_delta, view_rho, metrics)
+    engine, tracker, now, vd, vr, met, decs = outs[:7]
+    rest = list(outs[7:])
+    merged = rest.pop(0) if with_merged else None
+    press, press_merged = (rest if with_pressure else (None, None))
+    return MeshRounds(
+        cluster=ClusterState(engine=engine, tracker=tracker, now=now),
+        view_delta=vd, view_rho=vr, metrics=met, decs=decs,
+        merged=merged, pressure=press, pressure_merged=press_merged)
+
+
+_MESH_ROUNDS_JIT_CACHE: dict = {}
+
+
+def jit_mesh_rounds(mesh: Mesh, *, epochs: int,
+                    decisions_per_step: int, max_arrivals: int = 1,
+                    anticipation_ns: int = 0,
+                    allow_limit_break: bool = False,
+                    advance_ns: int = 0, counter_sync_every: int = 1,
+                    round0: int = 0, with_merged: bool = False,
+                    with_pressure: bool = False):
+    """Module-cached jit of :func:`run_mesh_rounds` for one (mesh,
+    static-config) pair -- ``(cluster, arrivals_seq, cost, view_d,
+    view_r, metrics) -> MeshRounds``.  The fused multi-round program
+    is the mesh plane's expensive compile; the entry is keyed with the
+    mesh SHAPE (not its repr) like ``mesh_step_jit``.  ``round0``
+    anchors the sync grid (static; distinct chunk positions at K>1
+    are distinct programs -- at K=1 every position shares one)."""
+    from ..obs import compile_plane as _cplane
+
+    cfg = (epochs, decisions_per_step, max_arrivals, anticipation_ns,
+           allow_limit_break, advance_ns, counter_sync_every,
+           int(round0) % max(int(counter_sync_every), 1),
+           with_merged, with_pressure)
+    key = mesh_cache_key(mesh, cfg)
+    if key not in _MESH_ROUNDS_JIT_CACHE:
+        def run(cluster, arrivals_seq, cost, view_d, view_r, met):
+            return run_mesh_rounds(
+                cluster, arrivals_seq, cost, mesh,
+                decisions_per_step=decisions_per_step,
+                max_arrivals=max_arrivals,
+                anticipation_ns=anticipation_ns,
+                allow_limit_break=allow_limit_break,
+                advance_ns=advance_ns,
+                counter_sync_every=counter_sync_every,
+                round0=round0,
+                view_delta=view_d, view_rho=view_r, metrics=met,
+                with_merged=with_merged, with_pressure=with_pressure)
+
+        mesh_shape = tuple(np.shape(getattr(mesh, "devices", ())))
+        _MESH_ROUNDS_JIT_CACHE[key] = _cplane.instrumented_jit(
+            run, cache="cluster.mesh_rounds",
+            entry=cfg + (mesh_shape,))
+    return _MESH_ROUNDS_JIT_CACHE[key]
+
+
+def mesh_decs_seq(decs) -> list:
+    """Re-slice a fused launch's ``[S, E, k]`` decision leaves into
+    the per-round ``[S, k]`` stream the host-loop drivers produce
+    (``robust.cluster.run_with_plan``), so ``decision_digest`` applies
+    to both unchanged."""
+    epochs = int(np.asarray(decs.type).shape[1])
+    host = jax.tree.map(lambda a: np.asarray(jax.device_get(a)), decs)
+    return [jax.tree.map(lambda a: a[:, t], host)
+            for t in range(epochs)]
 
 
 def create_clients(cluster: ClusterState, new_mask: jnp.ndarray,

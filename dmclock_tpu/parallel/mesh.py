@@ -1,0 +1,275 @@
+"""Mesh serving plane: shard_map'd full per-device epoch engines.
+
+The paper's distributed story -- many servers each running a complete
+mClock queue, coordinated only by piggybacked per-client delta/rho
+counters -- as one TPU mesh program.  Each shard owns a full
+client-state pytree + rings (the ``parallel.cluster`` stacked layout)
+and runs the COMPLETE fused epoch program (the PR-8 stream-chunk body:
+on-device admission clamp + superwave ingest + one full epoch of any
+of the three engines, telemetry riding the carry) for a whole chunk of
+epochs inside ONE mesh launch.  The only cross-shard traffic is the
+[C]-sized counter-view psum -- the paper's per-request four-scalar
+piggyback contract, batched to epoch boundaries -- refreshed on epochs
+where ``epoch % counter_sync_every == 0`` (the staleness knob: the
+protocol tolerates stale views by construction, which is what makes
+K>1 safe; ``parallel.cluster.run_mesh_rounds`` pins the same knob
+decision-exact against the host-loop ``delay_counters`` fault).
+
+Model: each shard is one SERVER owning a DISTINCT ``n``-client
+partition of the deployment's population -- ``S * n`` client
+contracts live across the mesh, each with its own queue state and
+arrival stream (what makes ``obs.capacity.plan_capacity``'s per-shard
+HBM inversion the shard-count planner: more clients -> more shards).
+The partitions share one contract LAYOUT (slot i carries the same QoS
+triple on every shard), so the initial per-shard states are
+numerically identical and only the independent arrival streams
+diverge them.  Aggregate throughput is the sum of per-shard decision
+streams.  The counter plane exchanges the [n]-sized per-slot
+delta/rho psum at epoch boundaries: the piggyback protocol's wire
+shape and cadence, measured for real; under partitioning the psum'd
+view aggregates the S like-contracted clients sharing a slot index
+(at S=1 it degenerates to the exact single-server counters, and the
+REPLICATED-population model -- where the view IS client i's global
+counter feeding its ReqParams -- is the ``parallel.cluster``
+``run_mesh_rounds`` program, digest-pinned against the host loop).
+Counters count unit-cost completions (the job's superwave is
+unit-cost), folded per epoch from the SLO window block's exact
+per-client delivered columns -- threaded scatter-free through all
+three engines since PR-10 -- so the fold cannot perturb a decision.
+
+Layering (the ``engine.stream`` convention): this module owns the pure
+device program + host helpers; ``robust.guarded.run_mesh_chunk_guarded``
+adds retry + the guard-trip fallback; ``robust.supervisor`` drives
+chunks between checkpoint boundaries as ``EpochJob(engine_loop="mesh",
+n_shards=S)``; ``bench.py --mode mesh`` runs the aggregate-throughput
+trajectory.  S=1 is bit-identical to the single-engine stream loop BY
+CONSTRUCTION: both trace ``engine.stream.make_epoch_step``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine import fastpath
+from ..engine import stream as stream_mod
+from ..obs import slo as obsslo
+from ..utils.compat import shard_map
+from .cluster import SERVER_AXIS, make_mesh  # noqa: F401 (re-export)
+from .tracker import global_counters_from
+
+
+class MeshChunk(NamedTuple):
+    """One fused mesh chunk's device outputs.
+
+    ``outs`` holds the engine's stacked per-epoch fields with a
+    leading ``[S, E]`` (shard, epoch) axis pair; ``cd``/``cr`` are the
+    per-shard per-client completion counters (``int64[S, N]``, the
+    psum source), ``view_d``/``view_r`` the held counter views after
+    the chunk.  ``slo_merged`` is the cluster-wide window block merged
+    IN-GRAPH across the mesh via ``obs.slo.window_mesh_reduce``
+    (replicated; ``int64[N, W_FIELDS]``) -- the one conformance table
+    the SLO plane rolls."""
+
+    state: object             # stacked EngineState, [S, ...] leaves
+    outs: dict                # [S, E, ...] stacked engine fields
+    cd: jnp.ndarray           # int64[S, N] completions (delta source)
+    cr: jnp.ndarray           # int64[S, N] resv-phase completions
+    view_d: jnp.ndarray       # int64[S, N] held global-delta views
+    view_r: jnp.ndarray       # int64[S, N]
+    hists: object = None      # stacked telemetry accumulators
+    ledger: object = None
+    slo: object = None        # int64[S, N, W_FIELDS] per-shard blocks
+    prov: object = None
+    slo_merged: object = None  # int64[N, W_FIELDS] (window_mesh_reduce)
+
+
+def stack_shards(tree, n_shards: int, mesh: Optional[Mesh] = None):
+    """Broadcast a single-engine pytree to the stacked ``[S, ...]``
+    per-shard layout: every shard's DISTINCT client partition starts
+    from the identical contract layout/state (independent arrival
+    streams supply the divergence), optionally placing each leaf
+    split over the ``servers`` mesh axis."""
+    stacked = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (n_shards,) + jnp.shape(a)),
+        tree)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P(SERVER_AXIS))
+        stacked = jax.tree.map(
+            lambda a: jax.device_put(a, sharding), stacked)
+    return stacked
+
+
+def unstack_shard(tree, s: int = 0):
+    """Slice shard ``s`` back out of a stacked pytree (the S=1
+    canonicalization: a 1-shard mesh IS a single engine, and the
+    identity gate compares it against the round/stream loops)."""
+    return jax.tree.map(lambda a: a[s], tree)
+
+
+def counter_init(n_shards: int, n: int):
+    """Fresh counter plane: zero per-shard completions, views at the
+    protocol's counters-start-at-1 origin (``dmclock_client.h``)."""
+    z = jnp.zeros((n_shards, n), dtype=jnp.int64)
+    one = jnp.ones((n_shards, n), dtype=jnp.int64)
+    return z, z, one, one
+
+
+def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
+                     k: int = 0, chain_depth: int = 4,
+                     dt_epoch_ns: int, waves: int,
+                     anticipation_ns: int = 0,
+                     allow_limit_break: bool = False,
+                     with_metrics: bool = True,
+                     select_impl: str = "sort", tag_width: int = 64,
+                     window_m: Optional[int] = None,
+                     calendar_impl: str = "minstop",
+                     ladder_levels: int = 8,
+                     counter_sync_every: int = 1,
+                     ingest: bool = True):
+    """Build the pure mesh chunk program ``(state, cd, cr, view_d,
+    view_r, epoch0, counts, hists, ledger, slo, prov) -> MeshChunk``
+    for one static configuration.
+
+    ``counts`` is ``int32[S, E, N]`` of RAW per-shard Poisson draws
+    (shard axis leading so ``P(servers)`` splits it); ``epoch0`` is a
+    TRACED int64 scalar, and the counter-sync mask is computed
+    IN-GRAPH from the global epoch index ``(epoch0 + i) %
+    counter_sync_every == 0``, so one compiled program serves every
+    chunk position and the sync grid is global, not per-chunk.  ``slo``
+    must always be a window block (``int64[S, N, W_FIELDS]``): the
+    counter plane diffs its delivered columns per epoch -- when the
+    job runs with the SLO plane off the caller passes a throwaway
+    zero block."""
+    assert engine in fastpath.EPOCH_ENGINES, engine
+    epochs = int(epochs)
+    assert epochs >= 1, "a mesh chunk needs at least one epoch"
+    kw = fastpath.epoch_scan_kwargs(
+        engine, k=k, chain_depth=chain_depth, select_impl=select_impl,
+        tag_width=tag_width, window_m=window_m,
+        calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break,
+        with_metrics=with_metrics)
+    dt = int(dt_epoch_ns)
+    every = max(int(counter_sync_every), 1)
+    epoch_step = stream_mod.make_epoch_step(
+        engine=engine, m=m, kw=kw, dt_epoch_ns=dt, waves=waves,
+        ingest=ingest)
+
+    def per_server(st, cd, cr, vd, vr, epoch0, counts_s, h, l, s, p):
+        def body(carry, xs):
+            st, cd, cr, vd, vr, h, l, s, p = carry
+            counts_e, i = xs
+            # batched delta/rho exchange at the epoch boundary: the
+            # views refresh from the mesh psum only on the global
+            # sync grid; between syncs every shard serves from its
+            # held (stale) view -- the paper's tolerance, as data
+            g_d, g_r = global_counters_from(
+                cd, cr, lambda x: lax.psum(x, SERVER_AXIS))
+            sync = ((epoch0 + i) % every) == 0
+            vd = jnp.where(sync, g_d, vd)
+            vr = jnp.where(sync, g_r, vr)
+            t_base = (epoch0 + i) * dt
+            (st, h, l, f, s2, p), outs = epoch_step(
+                st, t_base, counts_e, h, l, None, s, p)
+            # completions -> counters: the window block's delivered
+            # columns are exact per-client counts (PR-10), so the
+            # per-epoch diff IS this epoch's completion fold -- no
+            # scatter, no second accumulator, no decision perturbed
+            cd = cd + (s2[:, obsslo.W_OPS] - s[:, obsslo.W_OPS])
+            cr = cr + (s2[:, obsslo.W_RESV_OPS]
+                       - s[:, obsslo.W_RESV_OPS])
+            return (st, cd, cr, vd, vr, h, l, s2, p), outs
+
+        idx = jnp.arange(epochs, dtype=jnp.int64)
+        if not ingest:
+            counts_s = jnp.zeros((epochs, 0), dtype=jnp.int32)
+        (st, cd, cr, vd, vr, h, l, s, p), outs = lax.scan(
+            body, (st, cd, cr, vd, vr, h, l, s, p), (counts_s, idx))
+        return st, cd, cr, vd, vr, h, l, s, p, outs
+
+    def shard_fn(state, cd, cr, vd, vr, epoch0, counts,
+                 hists, ledger, slo, prov):
+        out = jax.vmap(
+            per_server,
+            in_axes=(0, 0, 0, 0, 0, None, 0, 0, 0, 0, 0),
+        )(state, cd, cr, vd, vr, epoch0, counts, hists, ledger, slo,
+          prov)
+        # cluster-wide conformance: local combine over this shard's
+        # vmapped servers, then ONE collective across the mesh --
+        # counter columns psum, the contract-epoch column pmax
+        # (obs.slo.window_mesh_reduce); replicated out-spec
+        merged = obsslo.window_mesh_reduce(
+            obsslo.window_combine_axis(out[7]), SERVER_AXIS)
+        return out + (merged,)
+
+    spec = P(SERVER_AXIS)
+    in_specs = (spec,) * 5 + (P(),) + (spec,) * 5
+    out_specs = (spec,) * 10 + (P(),)
+
+    def chunk(state, cd, cr, vd, vr, epoch0, counts, hists=None,
+              ledger=None, slo=None, prov=None) -> MeshChunk:
+        epoch0 = jnp.asarray(epoch0, dtype=jnp.int64)
+        fn = shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+        (state, cd, cr, vd, vr, hists, ledger, slo, prov, outs,
+         merged) = fn(state, cd, cr, vd, vr, epoch0, counts, hists,
+                      ledger, slo, prov)
+        return MeshChunk(state=state, outs=outs, cd=cd, cr=cr,
+                         view_d=vd, view_r=vr, hists=hists,
+                         ledger=ledger, slo=slo, prov=prov,
+                         slo_merged=merged)
+
+    return chunk
+
+
+# module-level jit cache keyed by the full static configuration + the
+# mesh SHAPE (the mesh_step_jit convention: the object id is
+# meaningless across runs, but distinct meshes at one cfg are distinct
+# programs and colliding them would record phantom retraces)
+_MESH_CHUNK_JIT_CACHE: dict = {}
+
+
+def jit_mesh_chunk(mesh: Mesh, **cfg):
+    from ..obs import compile_plane as _cplane
+
+    from .cluster import mesh_cache_key
+
+    mesh_shape = tuple(np.shape(getattr(mesh, "devices", ())))
+    key = (mesh_shape,) + tuple(sorted(cfg.items()))
+    full_key = mesh_cache_key(mesh, key)
+    if full_key not in _MESH_CHUNK_JIT_CACHE:
+        fn = build_mesh_chunk(mesh, **cfg)
+        _MESH_CHUNK_JIT_CACHE[full_key] = _cplane.instrumented_jit(
+            fn, cache="mesh.chunk", entry=key)
+    return _MESH_CHUNK_JIT_CACHE[full_key]
+
+
+def shard_epoch_view(engine: str, outs: dict, s: int, i: int):
+    """Reconstruct shard ``s``'s epoch ``i`` result object from the
+    fetched ``[S, E, ...]`` stacked outputs -- the stream loop's
+    ``epoch_view`` over one shard's slice, so the supervisor's chain
+    digest sees byte-identical arrays at S=1."""
+    return stream_mod.epoch_view(
+        engine, {name: arr[s] for name, arr in outs.items()}, i)
+
+
+def mesh_epoch_results(engine: str, outs: dict, i: int) -> tuple:
+    """Epoch ``i``'s digest-ready result tuple: one view per shard in
+    shard order (the chain digest hashes the per-shard decision
+    streams; at S=1 this is exactly the stream loop's tuple)."""
+    n_shards = next(iter(outs.values())).shape[0]
+    return tuple(shard_epoch_view(engine, outs, s, i)
+                 for s in range(n_shards))
+
+
+def mesh_epoch_decisions(engine: str, outs: dict, i: int) -> int:
+    """Decisions epoch ``i`` committed across ALL shards (the
+    aggregate-throughput numerator)."""
+    return int(np.asarray(outs["count"][:, i]).sum())

@@ -132,6 +132,45 @@ def tracker_prepare(tracker: TrackerState, requesting: jnp.ndarray,
     return tracker, delta_out, rho_out
 
 
+def global_counters_from(completed_delta: jnp.ndarray,
+                         completed_rho: jnp.ndarray, psum):
+    """:func:`global_counters` over RAW per-client completion-count
+    arrays (the mesh serving plane's counter plane keeps ``int64[C]``
+    arrays instead of a full ``TrackerState`` -- its per-shard engines
+    ingest unit-rate superwaves, so only the completions half of the
+    protocol is live).  Same start-at-1 origin, same collective."""
+    return 1 + psum(completed_delta), 1 + psum(completed_rho)
+
+
+def counter_view_bytes(n_clients: int) -> int:
+    """Wire bytes of ONE counter-view exchange: the [C]-sized
+    delta + rho int64 psum -- the paper's per-request four-scalar
+    piggyback contract, batched into one collective.  This is the
+    number the mesh bench records as ``counter_bytes_per_sync``."""
+    return 2 * 8 * int(n_clients)
+
+
+def exchange_schedule(epochs: int, counter_sync_every: int,
+                      start: int = 0) -> dict:
+    """Host-side accounting of the mesh plane's batched counter
+    exchange over the ``epochs`` boundaries starting at GLOBAL epoch
+    ``start`` with the ``counter_sync_every`` staleness knob (the
+    device grid is ``epoch % K == 0``, so epoch 0 always syncs):
+    sync count and cadence -- multiply by :func:`counter_view_bytes`
+    for the wire totals in the MULTICHIP v2 record.  ``start``
+    matters whenever a measured window begins off-grid (the bench's
+    timed window starts after warmup; ``run_mesh_rounds``'s
+    ``round0`` is the same anchor)."""
+    every = max(int(counter_sync_every), 1)
+    e0 = int(start)
+    n = max(int(epochs), 0)
+    first = -(-e0 // every) * every       # first sync epoch >= e0
+    syncs = len(range(first, e0 + n, every))
+    return {"epochs": n, "counter_sync_every": every,
+            "start": e0, "syncs": syncs,
+            "sync_frac": syncs / max(n, 1)}
+
+
 # ----------------------------------------------------------------------
 # observability (obs.registry wiring)
 # ----------------------------------------------------------------------

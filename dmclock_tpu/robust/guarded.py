@@ -509,6 +509,257 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
         prov=cur["prov"])
 
 
+class MeshGuarded(NamedTuple):
+    """Result of :func:`run_mesh_chunk_guarded` -- one mesh chunk of
+    epochs across all shards, drained and normalized to per-epoch rows
+    (each a tuple of digest-ready result objects in SHARD ORDER, so
+    the supervisor's chain digest covers the per-shard decision
+    streams; at S=1 the rows are exactly the stream loop's)."""
+
+    state: object            # stacked EngineState [S, ...]
+    cd: object               # int64[S, N] completion counters
+    cr: object
+    view_d: object           # int64[S, N] held counter views
+    view_r: object
+    epochs: tuple            # per-epoch tuples of result objects
+    counts: tuple            # per-epoch AGGREGATE decisions (int)
+    guard_trips: tuple       # per-epoch rebase+serial fallback count
+    mesh_fallback: int       # 1 when the chunk tripped a guard and
+    #                          was discarded + re-run epoch-major on
+    #                          the round path (slower, never divergent)
+    retries: int
+    hists: object = None     # stacked telemetry accumulators
+    ledger: object = None
+    slo: object = None       # int64[S, N, W_FIELDS] per-shard blocks
+    prov: object = None
+    slo_merged: object = None  # int64[N, W_FIELDS] cluster-wide block
+
+
+def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
+                           epoch0: int, counts, *, mesh,
+                           engine: str, epochs: int, m: int,
+                           k: int = 0, chain_depth: int = 4,
+                           dt_epoch_ns: int, waves: int,
+                           anticipation_ns: int = 0,
+                           allow_limit_break: bool = False,
+                           with_metrics: bool = True,
+                           select_impl: str = "sort",
+                           tag_width: int = 64,
+                           window_m: Optional[int] = None,
+                           calendar_impl: str = "minstop",
+                           ladder_levels: int = 8,
+                           counter_sync_every: int = 1,
+                           hists=None, ledger=None, slo=None,
+                           prov=None,
+                           retries: int = 3, base_s: float = 0.05,
+                           sleep: Callable[[float], None] =
+                           _time.sleep,
+                           on_retry=None, tracer=None) -> MeshGuarded:
+    """Run one fused mesh chunk (``parallel.mesh``) under the
+    guarded-commit contract at MESH-CHUNK granularity: bounded retry
+    around the single launch, and -- on a guard trip ANYWHERE in the
+    chunk, on any shard -- the whole chunk is discarded and its epochs
+    replay EPOCH-MAJOR, SHARD-MINOR on the proven round path
+    (``run_epoch_guarded`` per shard per epoch, with the counter-view
+    psum recomputed on the host at each global sync boundary), which
+    reproduces the fused program's lockstep sync semantics exactly:
+    epoch e's views on every shard read the cluster counters as of the
+    end of epoch e-1.  ``slo`` must always be a window block (the
+    counter plane diffs it); ``counts`` is ``int32[S, E, N]`` raw
+    draws or None for serve-only chunks."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import stream as stream_mod
+    from ..obs import slo as obsslo
+    from ..obs import spans as _spans
+    from ..parallel import mesh as mesh_mod
+    from ..parallel.tracker import global_counters_from
+
+    epochs = int(epochs)
+    do_ingest = counts is not None
+    n_shards = int(np.asarray(jax.device_get(cd)).shape[0])
+    # normalize EVERY sharded input onto the servers mesh axis before
+    # the launch: entry state arrives from three sources (fresh init,
+    # checkpoint restore, a previous chunk's host fallback restack)
+    # with three different placements, and a compiled mesh executable
+    # called with a mismatched input sharding either errors or forces
+    # a silent recompile (phantom retraces in the capacity plane)
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    sharding = NamedSharding(mesh, _P(mesh_mod.SERVER_AXIS))
+
+    def put(tree):
+        return None if tree is None else jax.tree.map(
+            lambda a: jax.device_put(a, sharding), tree)
+
+    if slo is None:
+        # the counter plane diffs the window block's delivered
+        # columns, so a block must ride even when the caller runs the
+        # SLO plane off -- build the throwaway here (chunk-local:
+        # only cd/cr persist) instead of trapping the caller with a
+        # default that crashes mid-trace
+        n = int(np.asarray(jax.device_get(cd)).shape[1])
+        slo = mesh_mod.stack_shards(obsslo.window_zero(n), n_shards)
+    state, cd, cr, view_d, view_r = (put(x) for x in
+                                     (state, cd, cr, view_d, view_r))
+    hists, ledger, slo, prov = (put(x) for x in
+                                (hists, ledger, slo, prov))
+    fn = mesh_mod.jit_mesh_chunk(
+        mesh, engine=engine, epochs=epochs, m=m, k=k,
+        chain_depth=chain_depth, dt_epoch_ns=dt_epoch_ns, waves=waves,
+        anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break,
+        with_metrics=with_metrics, select_impl=select_impl,
+        tag_width=tag_width, window_m=window_m,
+        calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        counter_sync_every=counter_sync_every, ingest=do_ingest)
+    retry_count = [0]
+
+    def count_retry(attempt, exc):
+        retry_count[0] += 1
+        _spans.instant(tracer, "mesh.retry", "retry",
+                       error=type(exc).__name__)
+        if on_retry is not None:
+            on_retry(attempt, exc)
+
+    counts_dev = None if counts is None \
+        else jax.device_put(jnp.asarray(counts, dtype=jnp.int32),
+                            sharding)
+
+    def one():
+        with _spans.span(tracer, "mesh.dispatch", "dispatch",
+                         engine=engine, epochs=epochs,
+                         shards=n_shards):
+            out = fn(state, cd, cr, view_d, view_r,
+                     jnp.int64(epoch0), counts_dev, hists, ledger,
+                     slo, prov)
+        with _spans.span(tracer, "mesh.device_wait",
+                         "device_compute"):
+            return jax.block_until_ready(out)
+
+    out = retry_with_backoff(one, retries=retries, base_s=base_s,
+                             sleep=sleep, on_retry=count_retry)
+
+    guard_field = stream_mod.STREAM_GUARD_FIELD[engine]
+    guards = np.asarray(jax.device_get(out.outs[guard_field]))
+    if bool(guards.all()):
+        fetched = jax.device_get(out.outs)
+        return MeshGuarded(
+            state=out.state, cd=out.cd, cr=out.cr,
+            view_d=out.view_d, view_r=out.view_r,
+            epochs=tuple(
+                mesh_mod.mesh_epoch_results(engine, fetched, i)
+                for i in range(epochs)),
+            counts=tuple(
+                mesh_mod.mesh_epoch_decisions(engine, fetched, i)
+                for i in range(epochs)),
+            guard_trips=(0,) * epochs, mesh_fallback=0,
+            retries=retry_count[0], hists=out.hists,
+            ledger=out.ledger, slo=out.slo, prov=out.prov,
+            slo_merged=out.slo_merged)
+
+    # a guard tripped somewhere in the mesh chunk: discard it (the
+    # entry state/counters are never donated) and replay epoch-major
+    # on the round path -- the counter-view exchange becomes a host
+    # sum at the same global sync grid, every epoch before the trip
+    # recomputes bit-identically (pure integer programs), the tripped
+    # one resumes exactly as the round loop would have
+    _spans.instant(tracer, "mesh.fallback", "retry", engine=engine,
+                   epochs=epochs, shards=n_shards)
+    ingest_step = stream_mod.jit_ingest_step(
+        dt_epoch_ns=dt_epoch_ns, waves=waves) if do_ingest else None
+    every = max(int(counter_sync_every), 1)
+
+    dev0 = jax.devices()[0]
+
+    def slic(tree, s):
+        # per-shard slices re-placed on ONE device: the round-path
+        # epoch executables are compiled for single-device inputs,
+        # and a slice still committed to the mesh would reject them
+        return None if tree is None \
+            else jax.tree.map(lambda a: jax.device_put(a[s], dev0),
+                              tree)
+
+    sts = [slic(state, s) for s in range(n_shards)]
+    cur = {name: [slic(acc, s) for s in range(n_shards)]
+           for name, acc in (("hists", hists), ("ledger", ledger),
+                             ("slo", slo), ("prov", prov))}
+    cd_np = np.asarray(jax.device_get(cd), dtype=np.int64).copy()
+    cr_np = np.asarray(jax.device_get(cr), dtype=np.int64).copy()
+    vd_np = np.asarray(jax.device_get(view_d), dtype=np.int64).copy()
+    vr_np = np.asarray(jax.device_get(view_r), dtype=np.int64).copy()
+    ep_rows, count_rows, trip_rows = [], [], []
+    for i in range(epochs):
+        t_base = (int(epoch0) + i) * int(dt_epoch_ns)
+        if (int(epoch0) + i) % every == 0:
+            g_d, g_r = global_counters_from(
+                cd_np, cr_np, lambda x: x.sum(axis=0))
+            vd_np[:] = g_d[None]
+            vr_np[:] = g_r[None]
+        row, n_dec, trips = [], 0, 0
+        for s in range(n_shards):
+            if ingest_step is not None:
+                # the raw-draw slice is still committed to the whole
+                # mesh; the single-device round path needs it local
+                sts[s] = ingest_step(
+                    sts[s],
+                    jax.device_put(counts_dev[s, i], dev0),
+                    jnp.int64(t_base))
+            w_prev = np.asarray(jax.device_get(cur["slo"][s]),
+                                dtype=np.int64)
+            ep = run_epoch_guarded(
+                sts[s], t_base + int(dt_epoch_ns), engine=engine,
+                m=m, k=k, chain_depth=chain_depth,
+                anticipation_ns=anticipation_ns,
+                allow_limit_break=allow_limit_break,
+                with_metrics=with_metrics, select_impl=select_impl,
+                tag_width=tag_width, window_m=window_m,
+                calendar_impl=calendar_impl,
+                ladder_levels=ladder_levels,
+                hists=cur["hists"][s], ledger=cur["ledger"][s],
+                slo=cur["slo"][s], prov=cur["prov"][s],
+                retries=retries, base_s=base_s, sleep=sleep,
+                on_retry=on_retry, tracer=tracer)
+            sts[s] = ep.state
+            for name in ("hists", "ledger", "slo", "prov"):
+                if cur[name][s] is not None:
+                    cur[name][s] = getattr(ep, name)
+            w_now = np.asarray(jax.device_get(ep.slo),
+                               dtype=np.int64)
+            cd_np[s] += w_now[:, obsslo.W_OPS] \
+                - w_prev[:, obsslo.W_OPS]
+            cr_np[s] += w_now[:, obsslo.W_RESV_OPS] \
+                - w_prev[:, obsslo.W_RESV_OPS]
+            retry_count[0] += ep.retries
+            row.extend(ep.results)
+            n_dec += ep.count
+            trips += ep.rebase_fallbacks + ep.serial_fallbacks
+        ep_rows.append(tuple(row))
+        count_rows.append(n_dec)
+        trip_rows.append(trips)
+
+    def restack(parts):
+        if any(p is None for p in parts):
+            return None
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
+
+    slo_stacked = restack(cur["slo"])
+    return MeshGuarded(
+        state=restack(sts), cd=jnp.asarray(cd_np),
+        cr=jnp.asarray(cr_np), view_d=jnp.asarray(vd_np),
+        view_r=jnp.asarray(vr_np), epochs=tuple(ep_rows),
+        counts=tuple(count_rows), guard_trips=tuple(trip_rows),
+        mesh_fallback=1, retries=retry_count[0],
+        hists=restack(cur["hists"]), ledger=restack(cur["ledger"]),
+        slo=slo_stacked, prov=restack(cur["prov"]),
+        slo_merged=jnp.asarray(obsslo.window_combine_np(
+            np.zeros_like(np.asarray(slo_stacked[0])),
+            *np.asarray(jax.device_get(slo_stacked)))))
+
+
 # ----------------------------------------------------------------------
 # escalation / degradation ladder (docs/ROBUSTNESS.md)
 # ----------------------------------------------------------------------

@@ -184,8 +184,31 @@ class EpochJob:
     # bit-identical to "round" (ci.sh streaming smoke); a guard trip
     # inside a chunk falls back to the round path for that chunk
     # (robust.guarded.run_stream_chunk_guarded), so crash equivalence
-    # and the degradation ladder survive unchanged.
+    # and the degradation ladder survive unchanged.  "mesh" shards the
+    # stream loop over a device mesh (parallel.mesh; docs/ENGINE.md
+    # "Mesh serving"): ``n_shards`` full per-device engines each run
+    # the complete fused chunk inside ONE shard_map launch, with the
+    # paper's delta/rho counter views exchanged through a [C]-sized
+    # psum at epoch boundaries on the ``counter_sync_every`` grid.
+    # S=1 mesh is bit-identical to "stream" (and so to "round") by
+    # construction -- both trace engine.stream.make_epoch_step -- and
+    # the counter plane + per-shard telemetry ride the rotation
+    # checkpoints, so crash equivalence extends to the mesh loop
+    # unchanged.  Not composable with ``churn`` (the lifecycle plane
+    # is single-shard) or ``flight_records`` (a per-shard HBM ring
+    # has no mesh merge; both are rejected up front).
     engine_loop: str = "round"
+    # mesh serving plane knobs (engine_loop="mesh" only): shard count
+    # (devices used; obs.capacity.plan_capacity sizes it from the
+    # client target) and the counter-exchange staleness knob -- views
+    # refresh from the mesh psum only on epochs where
+    # ``epoch % counter_sync_every == 0`` (epoch 0 always syncs; the
+    # paper's piggybacked views are naturally stale, so K>1 keeps the
+    # QoS invariants -- parallel.cluster.run_mesh_rounds pins the
+    # same knob decision-exact against the host loop's
+    # delay_counters fault)
+    n_shards: int = 1
+    counter_sync_every: int = 1
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -244,6 +267,14 @@ class SupervisedResult(NamedTuple):
     prov_margin_hist: Optional[np.ndarray] = None
     prov_scal: Optional[np.ndarray] = None
     prov_last_served: Optional[np.ndarray] = None
+    # mesh serving plane outputs (engine_loop="mesh" only; None
+    # otherwise): the per-shard completion counters ([2, S, N]:
+    # delta, rho) and the held counter views ([2, S, N]) -- both
+    # deterministic, both compared by the crash-equivalence gate --
+    # plus the chunk-fallback count (the stream_fallbacks analog)
+    mesh_counters: Optional[np.ndarray] = None
+    mesh_views: Optional[np.ndarray] = None
+    mesh_fallbacks: int = 0
 
 
 def assert_crash_equivalent(interrupted: SupervisedResult,
@@ -314,6 +345,17 @@ def assert_crash_equivalent(interrupted: SupervisedResult,
         if x is not None:
             assert np.array_equal(np.asarray(x), np.asarray(y)), \
                 f"provenance field {field} diverged across the crash"
+    # the mesh counter plane (per-shard delta/rho completions + held
+    # views) rides the rotation checkpoints and replays
+    # deterministically, so both arrays must match bit-for-bit too
+    for field in ("mesh_counters", "mesh_views"):
+        x = getattr(interrupted, field)
+        y = getattr(reference, field)
+        assert (x is None) == (y is None), \
+            f"mesh field {field} enabled on only one side"
+        if x is not None:
+            assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                f"mesh field {field} diverged across the crash"
 
 
 
@@ -325,7 +367,12 @@ def _job_state(job: EpochJob):
     """Deterministic preloaded engine state (the bench serve-only
     preload shape: staggered proportion tags, ``depth`` queued ops per
     client).  A churn job starts EMPTY at the spec's initial capacity
-    instead -- its population arrives through the lifecycle plane."""
+    instead -- its population arrives through the lifecycle plane.  A
+    mesh job (``engine_loop="mesh"``) returns the STACKED ``[S, ...]``
+    layout: every shard is one server owning a DISTINCT ``n``-client
+    partition that shares this same contract layout (S * n client
+    contracts across the mesh; independent per-shard arrival streams
+    supply the divergence -- parallel.mesh module doc)."""
     import jax.numpy as jnp
 
     from ..core.timebase import rate_to_inv_ns
@@ -333,6 +380,11 @@ def _job_state(job: EpochJob):
 
     if job.churn is not None:
         return init_state(int(job.churn["capacity0"]), job.ring)
+    if job.engine_loop == "mesh":
+        from ..parallel import mesh as mesh_mod
+
+        single = dataclasses.replace(job, engine_loop="stream")
+        return mesh_mod.stack_shards(_job_state(single), job.n_shards)
     st = init_state(job.n, job.ring)
     c = np.arange(job.n)
     rinv = np.full(job.n, rate_to_inv_ns(100.0), dtype=np.int64)
@@ -416,7 +468,7 @@ def _tree_digest(tree) -> str:
 def _payload(job: EpochJob, state, rng, met, digest: bytes,
              epoch: int, decisions: int, ladder_vec,
              hists=None, ledger=None, flight=None,
-             plane=None, slo=None, prov=None) -> dict:
+             plane=None, slo=None, prov=None, mesh=None) -> dict:
     import jax
 
     from ..lifecycle.plane import LifecyclePlane
@@ -455,7 +507,18 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
                                      dtype=np.int64),
               **obsslo.SloPlane.empty_leaves(),
               **SloEvaluator.empty_leaves()}
-    return {**lc, **sl,
+    # mesh counter-plane leaves (engine_loop="mesh"): per-shard
+    # delta/rho completion counters + held views ([S, N] each) --
+    # always present (zero-size otherwise), the structure-from-config
+    # convention
+    if mesh is not None:
+        mz = {k: np.asarray(jax.device_get(v), dtype=np.int64)
+              for k, v in zip(("mesh_cd", "mesh_cr", "mesh_vd",
+                               "mesh_vr"), mesh)}
+    else:
+        mz = {k: np.zeros((0,), dtype=np.int64)
+              for k in ("mesh_cd", "mesh_cr", "mesh_vd", "mesh_vr")}
+    return {**lc, **sl, **mz,
             "digest": np.frombuffer(digest, dtype=np.uint8).copy(),
             "decisions": np.int64(decisions),
             "engine": state,
@@ -501,6 +564,17 @@ def _tele_init(job: EpochJob):
     flight = obsflight.flight_init(job.flight_records) \
         if job.flight_records else None
     prov = obsprov.prov_init(n) if job.with_prov else None
+    if job.engine_loop == "mesh":
+        # per-shard accumulator stacks (each shard's epoch program
+        # carries its own; they merge through hist/ledger/prov
+        # mesh-reduce algebra on the way out)
+        from ..parallel import mesh as mesh_mod
+
+        def stk(acc):
+            return None if acc is None \
+                else mesh_mod.stack_shards(acc, job.n_shards)
+
+        hists, ledger, prov = stk(hists), stk(ledger), stk(prov)
     return hists, ledger, flight, prov
 
 
@@ -509,19 +583,33 @@ def _payload_like(job: EpochJob) -> dict:
     from ..obs import device as obsdev
 
     hists, ledger, flight, prov = _tele_init(job)
+    mesh = None
+    if job.engine_loop == "mesh":
+        from ..parallel import mesh as mesh_mod
+
+        mesh = mesh_mod.counter_init(job.n_shards, job.n)
     # the SLO leaves' template stays the empty-leaf shape even for
     # with_slo jobs: their axis-0 sizes are runtime state (ring fill,
     # contract count), so such jobs restore with the axis-0-only
     # relaxation (trailing dims still gate) -- see _job_loop
-    return _payload(job, _job_state(job),
+    tmpl = _payload(job, _job_state(job),
                     np.random.Generator(np.random.PCG64(job.seed)),
                     np.zeros(obsdev.NUM_METRICS, dtype=np.int64),
                     b"\x00" * 32, 0, 0,
                     DegradationLadder().encode(),
                     hists=hists, ledger=ledger, flight=flight,
-                    prov=prov,
+                    prov=prov, mesh=mesh,
                     plane=LifecyclePlane(job.churn)
                     if job.churn is not None else None)
+    if job.engine_loop == "mesh" and job.with_slo:
+        # a mesh job's saved window block is the STACKED per-shard
+        # [S, N, W_FIELDS] layout -- the template must carry the rank
+        # and trailing dims (axis 0 stays relaxed like every slo leaf)
+        from ..obs import slo as obsslo
+
+        tmpl["slo_window"] = np.zeros((0, job.n, obsslo.W_FIELDS),
+                                      dtype=np.int64)
+    return tmpl
 
 
 def _slo_log_flush(slo_plane, slo_log, closed) -> None:
@@ -646,6 +734,21 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             "yet: lifecycle boundaries do not carry the provenance "
             "watermark through grow/compact/evict (see the EpochJob "
             "field comment)")
+    if job.engine_loop == "mesh":
+        if job.churn is not None:
+            raise ValueError(
+                "EpochJob(engine_loop='mesh') does not compose with "
+                "churn: the lifecycle plane's slot map and WAL are "
+                "single-shard (route registrations per shard first "
+                "-- the ROADMAP rack-scheduling item)")
+        if job.flight_records:
+            raise ValueError(
+                "EpochJob(engine_loop='mesh') does not carry the "
+                "flight recorder: a per-shard HBM ring has no mesh "
+                "merge (hists/ledger/slo/prov all do)")
+        if job.n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, "
+                             f"got {job.n_shards}")
     state = _job_state(job)
     rng = np.random.Generator(np.random.PCG64(job.seed))
     met = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
@@ -725,9 +828,21 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 payload["tele_flight_batch"])
         if job.with_prov:
             from ..obs import provenance as obsprov
+            # works for the stacked per-shard mesh blocks too --
+            # jnp.asarray keeps the [S, ...] leading axis
             prov = obsprov.prov_from_arrays(
                 payload["prov_margin_hist"], payload["prov_scal"],
                 payload["prov_last_served"])
+
+    mesh_ctrs = None
+    if job.engine_loop == "mesh":
+        from ..parallel import mesh as mesh_mod
+        if payload is not None:
+            mesh_ctrs = tuple(
+                jnp.asarray(payload[k])
+                for k in ("mesh_cd", "mesh_cr", "mesh_vd", "mesh_vr"))
+        else:
+            mesh_ctrs = mesh_mod.counter_init(job.n_shards, job.n)
 
     plane = None
     if job.churn is not None:
@@ -753,8 +868,10 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
 
         if payload is not None:
             slo_block = _jnp.asarray(payload["slo_window"])
+            # shape[-2] not [0]: a mesh job's block is the stacked
+            # per-shard [S, N, W_FIELDS] layout
             slo_plane = obsslo.SloPlane.load(
-                payload, capacity=int(slo_block.shape[0]),
+                payload, capacity=int(slo_block.shape[-2]),
                 dt_epoch_ns=job.dt_epoch_ns,
                 ring_depth=max(job.slo_ring, 1))
             slo_eval = SloEvaluator(slo_plane)
@@ -769,10 +886,23 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             if job.churn is None:
                 # closed population: every slot is a client with a
                 # fixed contract, registered once from the device
-                # truth (the inverse-rate arrays)
+                # truth (the inverse-rate arrays; a mesh job reads
+                # shard 0 -- every partition shares one contract
+                # layout, and the rolled table aggregates the S
+                # like-contracted clients per slot)
+                inv = state
+                if job.engine_loop == "mesh":
+                    from ..parallel import mesh as mesh_mod
+                    inv = mesh_mod.unstack_shard(state)
                 slo_plane.register_from_inv(
-                    state.resv_inv, state.weight_inv, state.limit_inv)
+                    inv.resv_inv, inv.weight_inv, inv.limit_inv)
                 slo_block = slo_plane.stamp(slo_block)
+            if job.engine_loop == "mesh":
+                # every shard carries its own block; the plane rolls
+                # the window_mesh_reduce merge (cluster-wide table)
+                from ..parallel import mesh as mesh_mod
+                slo_block = mesh_mod.stack_shards(slo_block,
+                                                  job.n_shards)
             slo_eval = SloEvaluator(slo_plane)
         if plane is not None:
             # lifecycle REGISTER/UPDATE/EVICT bump contract epochs
@@ -820,6 +950,12 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                               hists, ledger, flight, prov,
                               resumed_from, plane, slo_block,
                               slo_plane, slo_eval)
+    if job.engine_loop == "mesh":
+        return _mesh_epochs(job, injector, ckpt_dir, scr, base_cfg,
+                            state, rng, met, digest, start_epoch,
+                            decisions, ladder, tracer, hists, ledger,
+                            prov, resumed_from, slo_block, slo_plane,
+                            slo_eval, mesh_ctrs)
     assert job.engine_loop == "round", job.engine_loop
     ingest = _jit_ingest(job) \
         if job.arrival_lam > 0 and plane is None else None
@@ -1026,10 +1162,30 @@ def _build_result(job, state, digest, decisions, met, ladder,
                   scrape_rebinds, resumed_from, hists, ledger, flight,
                   stream_fallbacks: int, plane=None,
                   slo_block=None, slo_plane=None,
-                  slo_eval=None, prov=None) -> SupervisedResult:
+                  slo_eval=None, prov=None, mesh=None,
+                  mesh_fallbacks: int = 0) -> SupervisedResult:
     import jax
 
     slo_kw = {}
+    if mesh is not None and job.n_shards == 1:
+        # S=1 canonicalization: a 1-shard mesh IS a single engine, so
+        # the result (state digest, telemetry blocks, window block)
+        # drops the unit shard axis and the bit-identity gate against
+        # the round/stream loops compares like for like
+        from ..parallel import mesh as mesh_mod
+
+        state = mesh_mod.unstack_shard(state)
+        hists = None if hists is None else hists[0]
+        ledger = None if ledger is None else ledger[0]
+        prov = None if prov is None else mesh_mod.unstack_shard(prov)
+        if slo_block is not None:
+            slo_block = slo_block[0]
+    if mesh is not None:
+        cd, cr, vd, vr = [np.asarray(jax.device_get(x),
+                                     dtype=np.int64) for x in mesh]
+        slo_kw.update(mesh_counters=np.stack([cd, cr]),
+                      mesh_views=np.stack([vd, vr]),
+                      mesh_fallbacks=mesh_fallbacks)
     if prov is not None:
         slo_kw.update(
             prov_margin_hist=np.asarray(
@@ -1313,6 +1469,199 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                          slo_block, slo_plane, slo_eval, prov)
 
 
+def _draw_counts_mesh(rng: np.random.Generator, job: EpochJob,
+                      epochs: int) -> np.ndarray:
+    """RAW per-epoch per-shard Poisson draws ``int32[S, epochs, N]``
+    (shard axis leading for the mesh launch).  Epoch-major draw order
+    with ``(S, N)`` per epoch: at S=1 the generator consumes the
+    IDENTICAL variate sequence as the stream loop's ``_draw_counts``
+    (numpy fills C-order), which is what makes the S=1 mesh digest
+    equal the stream digest including the arrival stream."""
+    draws = np.stack([rng.poisson(job.arrival_lam,
+                                  (job.n_shards, job.n))
+                      .astype(np.int32) for _ in range(epochs)])
+    return np.swapaxes(draws, 0, 1)
+
+
+def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
+                 scr: _ScrapeCtl, base_cfg: dict, state, rng, met,
+                 digest: bytes, start_epoch: int, decisions: int,
+                 ladder, tracer, hists, ledger, prov, resumed_from,
+                 slo_block=None, slo_plane=None, slo_eval=None,
+                 mesh_ctrs=None) -> SupervisedResult:
+    """The mesh serving loop (docs/ENGINE.md "Mesh serving"):
+    ``n_shards`` full per-device engines advance a whole
+    checkpoint-boundary chunk of epochs inside ONE ``shard_map``
+    launch (``parallel.mesh.build_mesh_chunk`` -- the stream chunk's
+    own epoch step, sharded), with the paper's delta/rho counter
+    views exchanged through the [C]-sized psum on the global
+    ``counter_sync_every`` epoch grid and the per-shard SLO window
+    blocks merged in-graph through ``window_mesh_reduce`` into the
+    ONE cluster-wide conformance table the SLO plane rolls.
+
+    Crash-equivalence discipline: the chunk's raw draws are taken
+    synchronously right before the launch and the checkpointed RNG
+    state is the post-draw snapshot, so a resumed incarnation
+    re-draws epochs >= the boundary bit-identically; the counter
+    plane (per-shard completions + held views) rides the rotation
+    checkpoints as ``mesh_*`` leaves.  The per-epoch drain
+    bookkeeping (chain digest over the per-shard decision streams in
+    shard order, metric fold, ladder notes, injector kill points) is
+    the stream loop's, so at S=1 the two loops are bit-identical end
+    to end."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import stream as stream_mod
+    from ..obs import device as obsdev
+    from ..obs import spans as _spans
+    from ..parallel import mesh as mesh_mod
+    from .guarded import run_mesh_chunk_guarded
+
+    n_dev = len(jax.devices())
+    if job.n_shards > n_dev:
+        raise ValueError(
+            f"EpochJob(n_shards={job.n_shards}) needs that many "
+            f"devices; this backend has {n_dev} (force a host mesh "
+            f"with jax_num_cpu_devices / "
+            f"--xla_force_host_platform_device_count)")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = mesh_mod.make_mesh(job.n_shards)
+    sharding = NamedSharding(mesh, P(mesh_mod.SERVER_AXIS))
+    # the stacked [S, ...] state (built by _job_state or restored from
+    # a checkpoint) gets its leaves split over the servers mesh axis
+    state = jax.tree.map(lambda a: jax.device_put(a, sharding), state)
+    cd, cr, vd, vr = mesh_ctrs
+    mesh_fallbacks = 0
+    do_ingest = job.arrival_lam > 0
+    slo_w0 = start_epoch
+    # when the job's SLO plane is off, slo_block stays None and the
+    # guarded runner builds its own throwaway window block per chunk
+    # (the counter plane needs one; never checkpointed -- the diffs
+    # are chunk-local, cd/cr are what persist)
+    wblock = slo_block
+    try:
+        for e0, b in stream_mod.chunk_bounds(start_epoch, job.epochs,
+                                             job.ckpt_every):
+            scr.tick(e0, injector)
+            counts = None
+            if do_ingest:
+                with _spans.span(tracer, "mesh.pregen", "host_prep"):
+                    counts = _draw_counts_mesh(rng, job, b - e0)
+            rng_ckpt = _rng_state_array(rng)
+            while True:
+                cfg = ladder.apply(base_cfg)
+                try:
+                    g = run_mesh_chunk_guarded(
+                        state, cd, cr, vd, vr, e0, counts, mesh=mesh,
+                        engine=job.engine, epochs=b - e0, m=job.m,
+                        k=job.k, chain_depth=job.chain_depth,
+                        dt_epoch_ns=job.dt_epoch_ns, waves=job.waves,
+                        with_metrics=True,
+                        select_impl=cfg["select_impl"],
+                        tag_width=cfg["tag_width"],
+                        calendar_impl=cfg["calendar_impl"],
+                        ladder_levels=job.ladder_levels,
+                        counter_sync_every=job.counter_sync_every,
+                        hists=hists, ledger=ledger, slo=wblock,
+                        prov=prov, tracer=tracer)
+                    break
+                except RECOVERABLE_ERRORS:
+                    if not ladder.can_step(cfg):
+                        raise
+                    met[obsdev.MET_LADDER_STEPS] += \
+                        ladder.note_epoch(cfg, launch_failures=1)
+            state, cd, cr, vd, vr = g.state, g.cd, g.cr, \
+                g.view_d, g.view_r
+            if job.with_hists:
+                hists = g.hists
+            if job.with_ledger:
+                ledger = g.ledger
+            if job.with_prov:
+                prov = g.prov
+            if job.with_slo:
+                slo_block = g.slo
+                wblock = g.slo
+            mesh_fallbacks += g.mesh_fallback
+            # the drain: per-epoch bookkeeping in epoch order, the
+            # stream loop's exact sequence; the chain digest hashes
+            # every shard's decision stream in shard order per epoch
+            with _spans.span(tracer, "mesh.drain", "drain",
+                             chunk=b - e0, shards=job.n_shards):
+                for i in range(b - e0):
+                    epoch = e0 + i
+                    scr.tick(epoch, injector)
+                    decisions += g.counts[i]
+                    digest = _digest_update(digest, g.epochs[i])
+                    for r in g.epochs[i]:
+                        if hasattr(r, "metrics") and \
+                                r.metrics is not None:
+                            met = obsdev.metrics_combine_np(
+                                met, jax.device_get(r.metrics))
+                    met[obsdev.MET_LADDER_STEPS] += ladder.note_epoch(
+                        cfg, guard_trips=g.guard_trips[i])
+                    if injector is not None:
+                        injector.after_decisions(decisions)
+            _spans.instant(tracer, "mesh.heartbeat", "drain",
+                           epoch=b)
+            closed = None
+            if slo_plane is not None:
+                # roll the CLUSTER-WIDE merged table (the in-graph
+                # window_mesh_reduce output); the fresh stamped block
+                # re-broadcasts to every shard.  Backlog for the
+                # starvation predicate is the cluster total (at S=1:
+                # exactly the stream loop's per-shard depth).
+                depth_sum = jnp.sum(state.depth.astype(jnp.int64),
+                                    axis=0)
+                merged, closed = slo_plane.roll(
+                    jnp.asarray(g.slo_merged), slo_w0, b,
+                    depth=depth_sum)
+                slo_w0 = b
+                slo_eval.observe_roll(closed)
+                slo_block = mesh_mod.stack_shards(merged,
+                                                  job.n_shards)
+                wblock = slo_block
+            if ckpt_dir is not None:
+                with _spans.span(tracer, "supervisor.checkpoint_save",
+                                 "checkpoint", epoch=b):
+                    payload = _payload(job, state, rng_ckpt, met,
+                                       digest, b, decisions,
+                                       ladder.encode(), hists=hists,
+                                       ledger=ledger, prov=prov,
+                                       mesh=(cd, cr, vd, vr),
+                                       slo=None if slo_plane is None
+                                       else (slo_block, slo_plane,
+                                             slo_eval))
+
+                    def save(payload=payload):
+                        return ckpt_mod.save_pytree_rotating(
+                            ckpt_dir, payload, keep=job.keep)
+
+                    if injector is not None:
+                        injector.around_save(b - 1, save)
+                    else:
+                        save()
+                if tracer is not None:
+                    tracer.drain_jsonl(job.span_log)
+                _slo_log_flush(slo_plane, job.slo_log, closed)
+            else:
+                _slo_log_flush(slo_plane, job.slo_log, closed)
+                if tracer is not None:
+                    tracer.drain_jsonl(job.span_log)
+    finally:
+        scr.close()
+
+    if tracer is not None:
+        tracer.drain_jsonl(job.span_log)
+    return _build_result(job, state, digest, decisions, met, ladder,
+                         scr.rebinds, resumed_from, hists, ledger,
+                         None, 0, None, slo_block, slo_plane,
+                         slo_eval, prov,
+                         mesh=(cd, cr, vd, vr),
+                         mesh_fallbacks=mesh_fallbacks)
+
+
 def _healthz_ok(scrape, timeout_s: float = 2.0) -> bool:
     """One-shot liveness probe of a scrape endpoint's ``/healthz``
     (obs.registry.MetricsHTTPServer) -- what a restarted incarnation
@@ -1443,8 +1792,14 @@ def _spawn_once(job: EpochJob, workdir: str,
 
     def arr2(key, cols):
         v = obj.get(key)
-        return None if v is None else \
-            np.asarray(v, dtype=np.int64).reshape(-1, cols)
+        if v is None:
+            return None
+        a = np.asarray(v, dtype=np.int64)
+        # an empty list round-trips as shape (0,): restore the column
+        # layout.  A non-empty block keeps its own rank -- a mesh
+        # job's slo_window is the STACKED [S, N, cols] layout and a
+        # forced reshape would flatten the shard axis.
+        return a.reshape(-1, cols) if a.size == 0 or a.ndim < 2 else a
 
     from ..obs import slo as obsslo
 
@@ -1466,7 +1821,10 @@ def _spawn_once(job: EpochJob, workdir: str,
         slo=obj.get("slo"),
         prov_margin_hist=arr("prov_margin_hist"),
         prov_scal=arr("prov_scal"),
-        prov_last_served=arr("prov_last_served"))
+        prov_last_served=arr("prov_last_served"),
+        mesh_counters=arr("mesh_counters"),
+        mesh_views=arr("mesh_views"),
+        mesh_fallbacks=int(obj.get("mesh_fallbacks", 0)))
 
 
 def _child_main(workdir: str) -> int:
@@ -1515,7 +1873,10 @@ def _child_main(workdir: str) -> int:
                    "prov_margin_hist": lst(result.prov_margin_hist),
                    "prov_scal": lst(result.prov_scal),
                    "prov_last_served":
-                       lst(result.prov_last_served)}, fh)
+                       lst(result.prov_last_served),
+                   "mesh_counters": lst(result.mesh_counters),
+                   "mesh_views": lst(result.mesh_views),
+                   "mesh_fallbacks": result.mesh_fallbacks}, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, res_path)

@@ -209,15 +209,20 @@ def main() -> int:
              and not is_chaos(r) and not is_restarted(r)
              and not is_degraded(r)]
     def series(wl, key, impl, cal, loop, scen=None, pop=None,
-               provon=True):
+               provon=True, shards=None, sync=None):
         """Prior values of one per-workload scalar column, filtered to
         the same fast-path identity (select_impl + calendar_impl +
         engine_loop + provenance_on) the throughput series uses.
         Churn workloads add scenario + scripted population
         (total_ids) to the identity: the POPULATION IS DYNAMIC, so a
         record against a different id space is a different workload,
-        not a comparable session.  Rows predating the provenance knob
-        count as provenance-on (the default)."""
+        not a comparable session.  Mesh workloads (engine_loop=mesh)
+        add n_shards + counter_sync_every: an 8-shard aggregate rate
+        and a 1-shard rate are different machines, and a stale-view
+        (K>1) session exchanges fewer counters per epoch -- neither
+        may enter the other's medians in either direction.  Rows
+        predating the provenance knob count as provenance-on (the
+        default)."""
         return [r["workloads"][wl][key] for _, r in prior
                 if wl in r.get("workloads", {})
                 and key in r["workloads"][wl]
@@ -229,7 +234,11 @@ def main() -> int:
                 and r["workloads"][wl].get("engine_loop",
                                            "round") == loop
                 and r["workloads"][wl].get("scenario") == scen
-                and r["workloads"][wl].get("total_ids") == pop
+                and (r["workloads"][wl].get("total_ids")
+                     or r["workloads"][wl].get("clients_total")) == pop
+                and r["workloads"][wl].get("n_shards") == shards
+                and r["workloads"][wl].get("counter_sync_every")
+                == sync
                 and bool(r["workloads"][wl].get("provenance_on",
                                                 True)) == provon]
 
@@ -272,6 +281,19 @@ def main() -> int:
         scen = row.get("scenario")
         pop = row.get("total_ids")
         provon = bool(row.get("provenance_on", True))
+        # mesh rows carry shard count + counter-sync cadence + the
+        # client population; all three join the series identity AND
+        # the tag, so an S=8 aggregate never median-compares against
+        # S=1, K=1 against K=4, or a 100k-client session against a
+        # 1M-client one (the churn total_ids precedent: a different
+        # population is a different workload, not a comparable
+        # session -- per-epoch work grows with N while decisions per
+        # epoch stay bounded by m*k).  The population rides the same
+        # `pop` filter column the churn rows use.
+        shards = row.get("n_shards")
+        sync = row.get("counter_sync_every")
+        if shards is not None and pop is None:
+            pop = row.get("clients_total")
         tag = f"{wl}[{impl}]" if impl != "sort" else wl
         if cal != "minstop":
             tag += f"[{cal}]"
@@ -279,9 +301,11 @@ def main() -> int:
             tag += f"[{loop}]"
         if scen is not None:
             tag += f"[N={pop}]"
+        if shards is not None:
+            tag += f"[S={shards},K={sync},N={pop}]"
         if not provon:
             tag += "[prov-off]"
-        hist = series(wl, "dps", impl, cal, loop, scen, pop, provon)
+        hist = series(wl, "dps", impl, cal, loop, scen, pop, provon, shards, sync)
         if len(hist) < args.min_records:
             print(f"bench_guard: {tag}: {dps/1e6:.1f}M "
                   f"({len(hist)} prior record(s) -- not judged)")
@@ -309,9 +333,39 @@ def main() -> int:
               + (f" [{dpp:.0f} dec/pass]" if dpp else "")
               + (f" [{dpl:.0f} dec/launch]" if dpl else "")
               + (f" [peak {peak} / live {row.get('live_clients')} "
-                 "clients]" if peak is not None else ""))
+                 "clients]" if peak is not None else "")
+              + (f" [{row.get('dps_per_shard_mean', 0)/1e6:.2f}M"
+                 "/shard aggregate-of-"
+                 f"{shards}]" if shards is not None else ""))
         if dps < floor:
             status = 1
+        # per-shard dec/s (mesh rows) as its own warn-only series:
+        # the AGGREGATE can hold while per-shard throughput collapses
+        # (e.g. a session quietly ran more shards of a slower
+        # engine), and the scaling shape -- aggregate ~ S x per-shard
+        # -- is the mesh plane's whole claim, so both are tracked.
+        psm = row.get("dps_per_shard_mean")
+        if psm is not None:
+            p_hist = series(wl, "dps_per_shard_mean", impl, cal,
+                            loop, scen, pop, provon, shards, sync)
+            if len(p_hist) < args.min_records:
+                print(f"bench_guard: {tag}: per-shard "
+                      f"{psm/1e6:.2f}M ({len(p_hist)} prior "
+                      "record(s) -- not judged)")
+            else:
+                p_med = median(p_hist)
+                if psm < p_med / args.tolerance:
+                    print(f"bench_guard: {tag}: WARNING per-shard "
+                          f"dec/s {psm/1e6:.2f}M vs median "
+                          f"{p_med/1e6:.2f}M over {len(p_hist)} "
+                          f"sessions (< 1/{args.tolerance:g}x) -- "
+                          "per-shard throughput regressed even "
+                          "though the aggregate held; investigate",
+                          file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: per-shard "
+                          f"{psm/1e6:.2f}M vs median "
+                          f"{p_med/1e6:.2f}M -- OK")
         # p99 reservation tardiness rides the same per-workload
         # history as its own series: a QoS regression (tail tardiness
         # UP past tolerance x the median) is worth a warning even
@@ -322,7 +376,7 @@ def main() -> int:
         p99 = row.get("tardiness_p99_ns")
         if p99 is not None:
             t_hist = series(wl, "tardiness_p99_ns", impl, cal, loop,
-                            scen, pop, provon)
+                            scen, pop, provon, shards, sync)
             if len(t_hist) < args.min_records:
                 print(f"bench_guard: {tag}: p99 tardiness "
                       f"{p99/1e6:.2f}ms ({len(t_hist)} prior "
@@ -354,7 +408,7 @@ def main() -> int:
         disp = row.get("dispatch_ms_per_launch")
         if disp is not None:
             d_hist = series(wl, "dispatch_ms_per_launch", impl, cal,
-                            loop, scen, pop, provon)
+                            loop, scen, pop, provon, shards, sync)
             if len(d_hist) < args.min_records:
                 print(f"bench_guard: {tag}: dispatch "
                       f"{disp:.2f}ms/launch ({len(d_hist)} prior "
@@ -387,7 +441,7 @@ def main() -> int:
         viol = row.get("slo_violations_total")
         if viol is not None:
             v_hist = series(wl, "slo_violations_total", impl, cal,
-                            loop, scen, pop, provon)
+                            loop, scen, pop, provon, shards, sync)
             if len(v_hist) < args.min_records:
                 print(f"bench_guard: {tag}: slo violations {viol} "
                       f"({len(v_hist)} prior record(s) -- not "
@@ -411,7 +465,7 @@ def main() -> int:
         serr = row.get("slo_worst_share_err")
         if serr is not None:
             s_hist = series(wl, "slo_worst_share_err", impl, cal,
-                            loop, scen, pop, provon)
+                            loop, scen, pop, provon, shards, sync)
             if len(s_hist) < args.min_records:
                 print(f"bench_guard: {tag}: worst-window share err "
                       f"{serr:.3f} ({len(s_hist)} prior record(s) "
@@ -443,7 +497,7 @@ def main() -> int:
         cms = row.get("compile_ms_total")
         if cms is not None:
             c_hist = series(wl, "compile_ms_total", impl, cal, loop,
-                            scen, pop, provon)
+                            scen, pop, provon, shards, sync)
             if len(c_hist) < args.min_records:
                 print(f"bench_guard: {tag}: compile {cms:.0f}ms "
                       f"({len(c_hist)} prior record(s) -- not "
@@ -473,7 +527,7 @@ def main() -> int:
         rt = row.get("retraces")
         if rt is not None:
             r_hist = series(wl, "retraces", impl, cal, loop, scen,
-                            pop, provon)
+                            pop, provon, shards, sync)
             if len(r_hist) < args.min_records:
                 print(f"bench_guard: {tag}: retraces {rt} "
                       f"({len(r_hist)} prior record(s) -- not "
@@ -502,7 +556,7 @@ def main() -> int:
         mp99 = row.get("margin_p99_ns")
         if mp99 is not None:
             m_hist = series(wl, "margin_p99_ns", impl, cal, loop,
-                            scen, pop, provon)
+                            scen, pop, provon, shards, sync)
             if len(m_hist) < args.min_records:
                 print(f"bench_guard: {tag}: margin p99 "
                       f"{mp99/1e6:.2f}ms ({len(m_hist)} prior "
@@ -529,7 +583,7 @@ def main() -> int:
         sv = row.get("starvation_max_ns")
         if sv is not None:
             s_hist2 = series(wl, "starvation_max_ns", impl, cal,
-                             loop, scen, pop, provon)
+                             loop, scen, pop, provon, shards, sync)
             if len(s_hist2) < args.min_records:
                 print(f"bench_guard: {tag}: starvation max "
                       f"{sv/1e6:.0f}ms ({len(s_hist2)} prior "

@@ -881,6 +881,96 @@ print("provenance smoke ok (bit-identical digests on both loops; "
       "explain attribution correct; dmclock_starvation_* scrapes)")
 EOF
 
+echo "== mesh smoke (S-shard fused launch == host loop; S=1 == stream) =="
+# the mesh serving plane (docs/ENGINE.md "Mesh serving"), on an
+# 8-device forced host mesh (jax_num_cpu_devices, with the
+# --xla_force_host_platform_device_count fallback -- the conftest.py
+# discipline): (1) ONE fused shard_map launch of E whole cluster
+# rounds with the delta/rho counter psum batched to round boundaries
+# must equal E host-driven robust_cluster_steps under a zero-fault
+# plan -- decision digest, held counter views, tracker state; with
+# counter_sync_every=K>1 it must equal the host loop under a
+# delay_counters plan on exactly the non-sync rounds (the staleness
+# knob IS the paper's stale-view tolerance); (2) an
+# EpochJob(engine_loop="mesh", n_shards=1) run must be bit-identical
+# to the stream loop (digest + final state + metrics); (3) an S=4
+# mesh job's counter plane must account every decision and the
+# in-graph window_mesh_reduce merge must equal the host combine.
+timeout -k 30 1200 python - <<'EOF'
+import jax, os
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=8")
+jax.config.update("jax_enable_x64", True)
+import dataclasses
+import numpy as np
+import jax.numpy as jnp
+from dmclock_tpu.core import ClientInfo
+from dmclock_tpu.obs import device as obsdev, slo as obsslo
+from dmclock_tpu.parallel import cluster as CL
+from dmclock_tpu.robust import cluster as RC, faults as F
+from dmclock_tpu.robust import supervisor as SV
+
+S, C, E, k, adv = 8, 12, 5, 16, 10 ** 8
+mesh = CL.make_mesh(S)
+infos = [ClientInfo(10.0, 1.0 + (c % 3), 0.0) for c in range(C)]
+
+def fresh():
+    cl = CL.init_cluster(S, C)
+    cl = CL.install_clients(
+        cl,
+        jnp.asarray([i.reservation_inv_ns for i in infos], jnp.int64),
+        jnp.asarray([i.weight_inv_ns for i in infos], jnp.int64),
+        jnp.asarray([i.limit_inv_ns for i in infos], jnp.int64))
+    return CL.shard_cluster(cl, mesh)
+
+rng = np.random.Generator(np.random.PCG64(7))
+arrivals = rng.integers(0, 3, size=(E, S, C)).astype(np.int32)
+for K in (1, 2):
+    plan = F.zero_plan(E, S)
+    plan.delay_counters[:] = (np.arange(E) % K != 0)[:, None]
+    rc = RC.shard_robust(RC.init_robust(fresh()), mesh)
+    rc, decs_seq = RC.run_with_plan(
+        rc, arrivals, 1, mesh, plan=plan, decisions_per_step=k,
+        max_arrivals=2, advance_ns=adv)
+    out = CL.run_mesh_rounds(
+        fresh(), arrivals, 1, mesh, decisions_per_step=k,
+        max_arrivals=2, advance_ns=adv, counter_sync_every=K)
+    assert RC.decision_digest(CL.mesh_decs_seq(out.decs)) == \
+        RC.decision_digest(decs_seq), f"K={K}: decisions diverged"
+    assert np.array_equal(np.asarray(out.view_delta),
+                          np.asarray(rc.view_delta)), f"K={K}: views"
+    for a, b in zip(jax.tree.leaves(out.cluster.tracker),
+                    jax.tree.leaves(rc.cluster.tracker)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"K={K}: tracker diverged"
+    print(f"mesh smoke: K={K} fused launch == host loop "
+          f"({int((np.asarray(out.decs.type) == 0).sum())} decisions)")
+
+base = dict(n=96, depth=6, ring=10, epochs=5, m=2, k=16, seed=5,
+            arrival_lam=1.0, waves=2, ckpt_every=2)
+s = SV.run_job(SV.EpochJob(engine="prefix", engine_loop="stream",
+                           **base))
+m1 = SV.run_job(SV.EpochJob(engine="prefix", engine_loop="mesh",
+                            n_shards=1, **base))
+assert m1.digest == s.digest and \
+    m1.state_digest == s.state_digest and \
+    np.array_equal(m1.metrics, s.metrics), "S=1 mesh != stream"
+m8 = SV.run_job(SV.EpochJob(engine="prefix", engine_loop="mesh",
+                            n_shards=8, counter_sync_every=2,
+                            with_slo=True, **base))
+assert int(m8.mesh_counters[0].sum()) == m8.decisions, \
+    "counter plane lost completions"
+assert (m8.mesh_views[0] == m8.mesh_views[0][0]).all(), \
+    "shards disagree on the synced view"
+print(f"mesh smoke: S=1 bit-identical to stream "
+      f"({m1.decisions} decisions); S=8 aggregate {m8.decisions} "
+      f"decisions, every completion accounted")
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

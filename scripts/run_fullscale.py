@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""CI entry for the full-scale TPU parity gates.
+"""CI entry for the full-scale TPU parity gates + the MULTICHIP
+record (schema v2).
 
 Runs the env-gated minutes-long parity tests with
 ``DMCLOCK_FULLSCALE=1`` set, on the virtual CPU mesh (same backend
@@ -10,23 +11,132 @@ parity for both tracker policies
 Kept as a separate entry point so the default ``pytest tests/`` stays
 fast; ``scripts/ci.sh`` invokes this after the main suite.
 
-Usage: python scripts/run_fullscale.py [extra pytest args]
+``--record FILE`` additionally writes the MULTICHIP record in
+**schema v2**: the v1 fields (``n_devices``/``rc``/``ok``/``tail``
+from the QoS dryrun, unchanged) plus a ``mesh`` block -- the
+mesh serving plane's aggregate-throughput trajectory from one
+``bench.py --mode mesh`` run on the forced host mesh: aggregate and
+per-shard dec/s, counter-exchange bytes per epoch, and the sync
+cadence.  :func:`load_multichip` reads BOTH schemas (v1 records have
+``schema`` 1 and ``mesh`` None), so history tooling never breaks on
+old rounds.
+
+Usage: python scripts/run_fullscale.py [--record FILE]
+       [--clients N] [--n-shards S] [--counter-sync-every K]
+       [extra pytest args]
 """
 
+import argparse
+import json
 import os
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+MULTICHIP_SCHEMA = 2
+
+
+def load_multichip(path: str) -> dict:
+    """Backward-compatible MULTICHIP record reader: v1 rounds
+    (``MULTICHIP_r01..r05``, no ``schema`` key) normalize to
+    ``schema=1, mesh=None``; v2 carries the mesh throughput block.
+    Every v1 key keeps its meaning in v2."""
+    with open(path) as fh:
+        obj = json.load(fh)
+    out = {
+        "schema": int(obj.get("schema", 1)),
+        "n_devices": int(obj.get("n_devices", 0)),
+        "rc": int(obj.get("rc", 0)),
+        "ok": bool(obj.get("ok", False)),
+        "skipped": bool(obj.get("skipped", False)),
+        "tail": obj.get("tail", ""),
+        "mesh": obj.get("mesh"),
+    }
+    if out["schema"] >= 2 and out["mesh"] is not None:
+        m = out["mesh"]
+        # normalized view of the trajectory scalars (reader contract:
+        # these keys exist whenever a v2 mesh block does)
+        m.setdefault("dps", 0.0)
+        m.setdefault("n_shards", out["n_devices"])
+        m.setdefault("counter_sync_every", 1)
+        m.setdefault("counter_bytes_per_epoch", 0)
+    return out
+
+
+def _dryrun(n_devices: int):
+    """The v1 QoS dryrun block: run ``dryrun_multichip`` in a child
+    (its own device forcing must precede backend init) and keep its
+    stdout tail."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         f"import __graft_entry__ as g; g.dryrun_multichip({n_devices})"],
+        cwd=REPO, capture_output=True, text=True)
+    tail = (proc.stdout or "")[-4000:]
+    if proc.returncode != 0:
+        tail += ("\n" + (proc.stderr or "")[-2000:])
+    return proc.returncode, tail
+
+
+def _mesh_trajectory(n_devices: int, clients: int, sync: int):
+    """The v2 mesh block: one ``bench.py --mode mesh`` run on a
+    forced host mesh; the bench JSON line carries the full row
+    (aggregate + per-shard dec/s, counter-exchange accounting)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "mesh", "--clients", str(clients),
+         "--n-shards", str(n_devices),
+         "--counter-sync-every", str(sync)],
+        cwd=REPO, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    for line in reversed((proc.stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return proc.returncode, json.loads(line).get("mesh")
+            except json.JSONDecodeError:
+                break
+    return proc.returncode or 1, None
+
 
 def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--record", metavar="FILE", default=None,
+                    help="write the MULTICHIP schema-v2 record here "
+                    "(QoS dryrun block + mesh throughput trajectory)")
+    ap.add_argument("--n-devices", type=int, default=8)
+    ap.add_argument("--clients", type=int, default=100_000)
+    ap.add_argument("--counter-sync-every", type=int, default=1)
+    args, extra = ap.parse_known_args()
+
     env = dict(os.environ, DMCLOCK_FULLSCALE="1")
     cmd = [sys.executable, "-m", "pytest",
            os.path.join(REPO, "tests", "test_sim_tpu_fullscale.py"),
            os.path.join(REPO, "tests", "test_cluster_realism.py"),
-           "-q", *sys.argv[1:]]
-    return subprocess.call(cmd, cwd=REPO, env=env)
+           "-q", *extra]
+    rc = subprocess.call(cmd, cwd=REPO, env=env)
+
+    if args.record:
+        d_rc, tail = _dryrun(args.n_devices)
+        m_rc, mesh = _mesh_trajectory(args.n_devices, args.clients,
+                                      args.counter_sync_every)
+        record = {
+            "schema": MULTICHIP_SCHEMA,
+            "n_devices": args.n_devices,
+            "rc": rc or d_rc or m_rc,
+            "ok": rc == 0 and d_rc == 0 and m_rc == 0
+            and mesh is not None,
+            "skipped": False,
+            "tail": tail,
+            "mesh": mesh,
+        }
+        with open(args.record, "w") as fh:
+            json.dump(record, fh, indent=1)
+        print(f"# multichip v2 record -> {args.record} "
+              f"(dryrun rc={d_rc}, mesh rc={m_rc}, "
+              f"aggregate {0 if not mesh else mesh.get('dps', 0)/1e6:.1f}M dec/s)",
+              file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":

@@ -641,3 +641,107 @@ def test_provenance_on_medians_unpolluted_by_off_rows(monkeypatch,
     rc, out = run_guard(monkeypatch, capsys, hist)
     assert rc == 0
     assert "REGRESSION" not in out
+
+
+# -- mesh serving plane series (bench.py --mode mesh) -----------------
+
+def _mesh_row(dps, *, shards=8, sync=1, per_shard=None):
+    per = per_shard if per_shard is not None else dps / shards
+    return {"dps": dps, "engine_loop": "mesh", "n_shards": shards,
+            "counter_sync_every": sync, "dps_per_shard_mean": per,
+            "clients_total": 100_000,
+            "clients_per_shard": 100_000 // shards}
+
+
+def write_history_mesh(tmp_path, rows):
+    h = tmp_path / "history"
+    h.mkdir()
+    for i, row in enumerate(rows):
+        (h / f"bench_{1000 + i}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": "tpu0",
+             "workloads": {"mesh": row}}))
+    return h
+
+
+def test_mesh_series_judged_with_shard_tag(monkeypatch, capsys,
+                                           tmp_path):
+    hist = write_history_mesh(tmp_path, [
+        _mesh_row(80e6), _mesh_row(90e6), _mesh_row(85e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "mesh[S=8,K=1,N=100000]" in out
+    assert "/shard aggregate-of-8" in out
+    assert "OK" in out
+
+
+def test_mesh_regression_fails(monkeypatch, capsys, tmp_path):
+    hist = write_history_mesh(tmp_path, [
+        _mesh_row(80e6), _mesh_row(90e6), _mesh_row(20e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 1 and "REGRESSION" in out
+
+
+def test_mesh_shard_count_splits_the_series(monkeypatch, capsys,
+                                            tmp_path):
+    # an 8-shard aggregate must NOT be median-compared against
+    # 1-shard records even under the same workload key
+    hist = write_history_mesh(tmp_path, [
+        _mesh_row(80e6, shards=8), _mesh_row(90e6, shards=8),
+        _mesh_row(11e6, shards=1)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "mesh[S=1,K=1,N=100000]" in out
+    assert "not judged" in out
+
+
+def test_mesh_sync_cadence_splits_the_series(monkeypatch, capsys,
+                                             tmp_path):
+    # K=4 sessions exchange 4x fewer counters -- a different machine,
+    # never compared against K=1 records in either direction
+    hist = write_history_mesh(tmp_path, [
+        _mesh_row(80e6, sync=1), _mesh_row(90e6, sync=1),
+        _mesh_row(20e6, sync=4)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "mesh[S=8,K=4,N=100000]" in out
+    assert "not judged" in out
+
+
+def test_mesh_per_shard_collapse_warns_but_passes(monkeypatch,
+                                                  capsys, tmp_path):
+    # aggregate holds (more shards papering over a slower engine) but
+    # per-shard dec/s collapsed: warn-only, never a hard failure
+    hist = write_history_mesh(tmp_path, [
+        _mesh_row(80e6, per_shard=10e6),
+        _mesh_row(88e6, per_shard=11e6),
+        _mesh_row(80e6, per_shard=2e6)])
+    monkeypatch.setattr(bg, "HISTORY", hist)
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING per-shard" in cap.err
+    assert "REGRESSION" not in cap.out
+
+
+def test_mesh_per_shard_stable_ok(monkeypatch, capsys, tmp_path):
+    hist = write_history_mesh(tmp_path, [
+        _mesh_row(80e6), _mesh_row(88e6), _mesh_row(84e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "per-shard 10.50M vs median" in out
+
+
+def test_mesh_client_population_splits_the_series(monkeypatch,
+                                                  capsys, tmp_path):
+    # a 1M-client session legitimately runs slower per aggregate
+    # (per-epoch work grows with N, decisions stay bounded by m*k) --
+    # it must NOT be median-compared against 100k-client records
+    hist = write_history_mesh(tmp_path, [
+        _mesh_row(80e6), _mesh_row(90e6),
+        dict(_mesh_row(8e6), clients_total=1_000_000,
+             clients_per_shard=125_000)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "mesh[S=8,K=1,N=1000000]" in out
+    assert "not judged" in out

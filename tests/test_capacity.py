@@ -485,6 +485,8 @@ class TestDocDrift:
         obshist.publish_hists(reg, obshist.hist_zero())
         obshist.publish_ledger(reg, np.zeros((4, obshist.LED_COLS),
                                              dtype=np.int64))
+        obsslo.publish_shard_windows(
+            reg, np.zeros((2, 2, obsslo.W_FIELDS), dtype=np.int64))
         publish_span_gauges(reg, {"dispatch_ms_per_launch": 1.0,
                                   "device_ms_per_launch": 1.0,
                                   "host_overhead_frac": 0.1})

@@ -269,6 +269,114 @@ def test_robust_mesh_merge_matches_host_under_faults(mesh8):
     assert totals["faults_injected"] == ev["faults_injected"]
 
 
+def _mesh_gate_cluster(mesh8, n_servers, n_clients, tracker_kind):
+    from dmclock_tpu.core.timebase import rate_to_inv_ns
+
+    infos = [ClientInfo(10.0, 1.0 + (c % 3), 0.0)
+             for c in range(n_clients)]
+    cl = CL.init_cluster(n_servers, n_clients,
+                         tracker_kind=tracker_kind)
+    cl = CL.install_clients(
+        cl,
+        jnp.asarray([i.reservation_inv_ns for i in infos], jnp.int64),
+        jnp.asarray([i.weight_inv_ns for i in infos], jnp.int64),
+        jnp.asarray([i.limit_inv_ns for i in infos], jnp.int64))
+    return CL.shard_cluster(cl, mesh8)
+
+
+@pytest.mark.parametrize("counter_sync_every,tracker_kind", [
+    (1, "orig"),
+    pytest.param(1, "borrowing", marks=pytest.mark.slow),
+    (3, "orig"),
+    pytest.param(2, "borrowing", marks=pytest.mark.slow),
+])
+def test_mesh_rounds_match_host_loop(mesh8, counter_sync_every,
+                                     tracker_kind):
+    """The mesh serving plane's cluster digest gate (ISSUE-14): ONE
+    fused shard_map launch of E whole rounds with the delta/rho
+    counter psum exchanged only on the counter_sync_every grid must
+    equal E host-driven robust_cluster_steps -- decision stream,
+    final counter views, tracker state, AND metrics (modulo the
+    faults_injected row: a held view is an injected fault on the host
+    path, a configured cadence on the mesh path).  K=1 compares
+    against the zero-fault plan; K>1 against a plan that delays the
+    counter piggyback on exactly the non-sync rounds -- the staleness
+    knob IS the paper's stale-view tolerance, pinned exactly."""
+    from dmclock_tpu.obs import device as obsdev
+    from dmclock_tpu.robust import cluster as RC
+    from dmclock_tpu.robust import faults as F
+
+    n_servers, n_clients, rounds, k, adv = 8, 10, 6, 16, 10 ** 8
+    K = counter_sync_every
+    rng = np.random.Generator(np.random.PCG64(7))
+    arrivals = rng.integers(
+        0, 3, size=(rounds, n_servers, n_clients)).astype(np.int32)
+
+    plan = F.zero_plan(rounds, n_servers)
+    plan.delay_counters[:] = (np.arange(rounds) % K != 0)[:, None]
+    rc = RC.shard_robust(RC.init_robust(
+        _mesh_gate_cluster(mesh8, n_servers, n_clients,
+                           tracker_kind)), mesh8)
+    rc, decs_seq = RC.run_with_plan(
+        rc, arrivals, 1, mesh8, plan=plan, decisions_per_step=k,
+        max_arrivals=2, advance_ns=adv)
+
+    out = CL.run_mesh_rounds(
+        _mesh_gate_cluster(mesh8, n_servers, n_clients, tracker_kind),
+        arrivals, 1, mesh8, decisions_per_step=k, max_arrivals=2,
+        advance_ns=adv, counter_sync_every=K, with_merged=True)
+    assert RC.decision_digest(CL.mesh_decs_seq(out.decs)) == \
+        RC.decision_digest(decs_seq), "decision stream diverged"
+    assert np.array_equal(np.asarray(out.view_delta),
+                          np.asarray(rc.view_delta)), "held views"
+    assert np.array_equal(np.asarray(out.view_rho),
+                          np.asarray(rc.view_rho))
+    for a, b in zip(jax.tree.leaves(out.cluster.tracker),
+                    jax.tree.leaves(rc.cluster.tracker)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "tracker counters diverged"
+    host_met = np.asarray(rc.metrics).copy()
+    host_met[:, obsdev.MET_FAULTS_INJECTED] = 0
+    assert np.array_equal(np.asarray(out.metrics), host_met)
+    # the in-graph merged vector == host combine over the shards
+    host = obsdev.metrics_combine_np(
+        np.zeros(obsdev.NUM_METRICS, np.int64),
+        *np.asarray(out.metrics))
+    assert np.array_equal(host, np.asarray(out.merged))
+
+
+@pytest.mark.slow
+def test_mesh_rounds_one_launch_per_chunk(mesh8):
+    """The perf claim the plane ships under: E rounds = ONE compiled
+    program execution, not 3E host round-trips -- pinned by running
+    the jitted fused program once and getting E rounds of decisions
+    whose totals match the host loop's."""
+    from dmclock_tpu.robust import cluster as RC
+    from dmclock_tpu.robust import faults as F
+
+    n_servers, n_clients, rounds, k = 8, 10, 5, 16
+    rng = np.random.Generator(np.random.PCG64(11))
+    arrivals = rng.integers(
+        0, 2, size=(rounds, n_servers, n_clients)).astype(np.int32)
+    cl = _mesh_gate_cluster(mesh8, n_servers, n_clients, "orig")
+    vd, vr = CL.init_mesh_views(n_servers, n_clients)
+    from dmclock_tpu.obs import device as obsdev
+    met = jnp.zeros((n_servers, obsdev.NUM_METRICS), jnp.int64)
+    step = CL.jit_mesh_rounds(mesh8, epochs=rounds,
+                              decisions_per_step=k, max_arrivals=2,
+                              advance_ns=10 ** 8)
+    out = step(cl, jnp.asarray(arrivals), jnp.int64(1), vd, vr, met)
+    assert np.asarray(out.decs.type).shape == (n_servers, rounds, k)
+    rc = RC.shard_robust(RC.init_robust(
+        _mesh_gate_cluster(mesh8, n_servers, n_clients, "orig")),
+        mesh8)
+    rc, decs_seq = RC.run_with_plan(
+        rc, arrivals, 1, mesh8, plan=F.zero_plan(rounds, n_servers),
+        decisions_per_step=k, max_arrivals=2, advance_ns=10 ** 8)
+    assert RC.decision_digest(CL.mesh_decs_seq(out.decs)) == \
+        RC.decision_digest(decs_seq)
+
+
 @pytest.mark.skipif(os.environ.get("DMCLOCK_FULLSCALE") != "1",
                     reason="large-scale cluster parity is minutes-long; "
                     "run via scripts/run_fullscale.py (CI)")
