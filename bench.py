@@ -1585,7 +1585,7 @@ def bench_mesh(clients: int = 100_000, *, n_shards=None,
                arrival_lam: float = 2.0, waves: int = 4,
                dt_epoch_ns: int = 10 ** 8,
                with_metrics: bool = True, slo: bool = True,
-               tracer=None) -> dict:
+               tracer=None, fault_spec=None) -> dict:
     """The mesh serving plane's aggregate-throughput trajectory
     (docs/ENGINE.md "Mesh serving"; the MULTICHIP v2 record shape):
     S full per-device engines -- each one server owning a DISTINCT
@@ -1597,12 +1597,23 @@ def bench_mesh(clients: int = 100_000, *, n_shards=None,
     (views refresh on the ``counter_sync_every`` grid).  On CPU
     (forced host devices) this proves the SCALING SHAPE; the silicon
     campaign inherits it as the >=100M dec/s @ 1M clients one-command
-    repro."""
+    repro.
+
+    ``fault_spec`` (a parsed ``robust.faults.parse_fault_spec``
+    dict) turns the session into a CHAOS run: a deterministic
+    FaultPlan over every (warmup + timed) epoch is compiled INTO the
+    fused chunks, and the row records the plan tag plus the
+    per-shard dropout/resync counts read off the device metric rows
+    (cross-checked against the plan oracle by the CI mesh chaos
+    smoke).  Chaos rows never enter bench_guard's clean-run
+    medians."""
     import dataclasses
 
+    from dmclock_tpu.obs import device as obsdev
     from dmclock_tpu.obs import slo as obsslo
     from dmclock_tpu.parallel import mesh as mesh_mod
     from dmclock_tpu.parallel import tracker as trk
+    from dmclock_tpu.robust import faults as faults_mod
     from dmclock_tpu.robust.supervisor import EpochJob, _job_state
 
     plan = plan_mesh_shards(clients, n_shards, ring=ring,
@@ -1642,11 +1653,20 @@ def bench_mesh(clients: int = 100_000, *, n_shards=None,
         S, mesh)
     cd, cr, vd, vr = mesh_mod.counter_init(S, n)
     wblock = mesh_mod.stack_shards(obsslo.window_zero(n), S, mesh)
+    warm_chunks = max(1, warmup_epochs // chunk)
+    n_chunks = max(1, epochs // chunk)
+    fplan = None
+    if fault_spec is not None:
+        # one deterministic plan over EVERY epoch the session runs
+        # (warmup included: a chaos session is chaotic end to end)
+        fplan = faults_mod.plan_from_spec(
+            fault_spec, (warm_chunks + n_chunks) * chunk, S)
     fn = mesh_mod.jit_mesh_chunk(
         mesh, engine=engine, epochs=chunk, m=m, k=k,
         dt_epoch_ns=dt_epoch_ns, waves=waves,
         with_metrics=with_metrics,
-        counter_sync_every=counter_sync_every, ingest=True)
+        counter_sync_every=counter_sync_every, ingest=True,
+        with_faults=fplan is not None)
     rng = np.random.Generator(np.random.PCG64(29))
 
     def draw(e):
@@ -1654,35 +1674,54 @@ def bench_mesh(clients: int = 100_000, *, n_shards=None,
             [rng.poisson(arrival_lam, (S, n)).astype(np.int32)
              for _ in range(e)]), 0, 1))
 
-    def launch(out, e0, counts):
+    fault_mets = []
+
+    def fault_chunk(e0):
+        # sliced + device-resident BEFORE any timed launch (see the
+        # pregen discipline below): the timed loop must not pay
+        # host-side mask slicing or H2D transfers per chunk
+        if fplan is None:
+            return None
+        fc = faults_mod.plan_chunk(fplan, e0, e0 + chunk)
+        return tuple(jnp.asarray(a) for a in fc)
+
+    def launch(out, e0, counts, fc):
         with obsspans.span(tracer, "mesh.bench_chunk", "dispatch",
-                           epoch0=e0, shards=S):
-            return fn(out.state, out.cd, out.cr, out.view_d,
-                      out.view_r, jnp.int64(e0), counts,
-                      None, None, out.slo, None)
+                           epoch0=e0, shards=S,
+                           chaos=fplan is not None):
+            out = fn(out.state, out.cd, out.cr, out.view_d,
+                     out.view_r, jnp.int64(e0), counts,
+                     None, None, out.slo, None, None, fc)
+        if fplan is not None:
+            # per-shard fault rows ride the per-epoch metric vectors;
+            # fetched untimed after the run (async-safe append)
+            fault_mets.append(out.outs["metrics"])
+        return out
 
     # warmup (covers compile + tag-transient), untimed
     out = mesh_mod.MeshChunk(state=state, outs={}, cd=cd, cr=cr,
                              view_d=vd, view_r=vr, slo=wblock)
     e0 = 0
-    warm_chunks = max(1, warmup_epochs // chunk)
     for _ in range(warm_chunks):
-        out = launch(out, e0, draw(chunk))
+        out = launch(out, e0, draw(chunk), fault_chunk(e0))
         e0 += chunk
     jax.block_until_ready(out.state)
 
-    # timed window: ALL raw draws pre-generated (and device-resident)
-    # before the clock starts -- the every-other-bench pregen
-    # discipline; host RNG time must not serialize into the async
-    # chunk chain and bias the aggregate dec/s the MULTICHIP record
-    # reads -- then chain chunks asynchronously, one sync at the end
-    n_chunks = max(1, epochs // chunk)
-    pregen = [draw(chunk) for _ in range(n_chunks)]
-    jax.block_until_ready(pregen)
+    # timed window: ALL raw draws AND chaos mask slices pre-generated
+    # (and device-resident) before the clock starts -- the
+    # every-other-bench pregen discipline; host RNG/slicing time must
+    # not serialize into the async chunk chain and bias the aggregate
+    # dec/s the MULTICHIP record reads -- then chain chunks
+    # asynchronously, one sync at the end
+    pregen = [(draw(chunk), fault_chunk(e0 + i * chunk))
+              for i in range(n_chunks)]
+    jax.block_until_ready([p[0] for p in pregen])
+    if fplan is not None:
+        jax.block_until_ready([p[1] for p in pregen])
     timed = []
     t0 = time.perf_counter()
-    for counts_c in pregen:
-        out = launch(out, e0, counts_c)
+    for counts_c, fc in pregen:
+        out = launch(out, e0, counts_c, fc)
         timed.append(out.outs["count"])
         e0 += chunk
     jax.block_until_ready(out.state)
@@ -1732,6 +1771,31 @@ def bench_mesh(clients: int = 100_000, *, n_shards=None,
             bytes_per_sync * sched["syncs"] / max(sched["epochs"], 1),
         **{key: val for key, val in plan.items() if val is not None},
     }
+    # chaos accounting: the plan tag + per-shard dropout/resync
+    # counts read off the DEVICE metric rows (every launched chunk,
+    # warmup included, so the totals equal the plan_events oracle --
+    # the CI mesh chaos smoke pins the equality).  Clean sessions
+    # record fault_plan="none"; bench_guard keys both the record- and
+    # the row-level exclusion on it.
+    row["fault_plan"] = faults_mod.describe(fplan)
+    if fplan is not None:
+        mets = np.zeros((S, obsdev.NUM_METRICS), dtype=np.int64)
+        for mchunk in fault_mets:
+            a = np.asarray(jax.device_get(mchunk), dtype=np.int64)
+            for s in range(S):
+                mets[s] = obsdev.metrics_combine_np(mets[s], *a[s])
+        row["fault_dropouts_per_shard"] = [
+            int(x) for x in mets[:, obsdev.MET_SERVER_DROPOUTS]]
+        row["fault_resyncs_per_shard"] = [
+            int(x) for x in mets[:, obsdev.MET_TRACKER_RESYNCS]]
+        row["faults_injected_total"] = int(
+            mets[:, obsdev.MET_FAULTS_INJECTED].sum())
+        try:
+            from dmclock_tpu.obs import default_registry
+            obsdev.publish_shard_faults(
+                default_registry(), mets, labels={"workload": "mesh"})
+        except Exception:
+            pass
     # the cluster-wide conformance table (window_mesh_reduce merge)
     # rides the scrape registry with per-shard decomposition
     try:
@@ -2024,7 +2088,14 @@ def main() -> None:
                     "(robust.faults.describe() tag) in the JSON line "
                     "and the benchmark history record; bench_guard "
                     "keeps non-'none' (chaos) sessions out of the "
-                    "clean-run regression medians")
+                    "clean-run regression medians.  With --mode mesh "
+                    "a PARSEABLE spec (e.g. 'seed=7,p_dropout=0.05,"
+                    "mean_outage_steps=2,p_dup=0.1,max_skew_ns=1000') "
+                    "samples a real FaultPlan and compiles it INTO "
+                    "the fused chunks -- the chaos mesh session; the "
+                    "row then records per-shard dropout/resync "
+                    "counts (docs/ROBUSTNESS.md 'Degraded-mode "
+                    "mesh')")
     ap.add_argument("--supervised", action="store_true",
                     default=os.environ.get("DMCLOCK_SUPERVISED")
                     == "1",
@@ -2263,12 +2334,26 @@ def main() -> None:
             # the mesh serving plane's aggregate-throughput series
             # (any backend: cpu with forced host devices proves the
             # scaling shape; the silicon campaign inherits the
-            # >=100M dec/s @ 1M clients target as the same command)
+            # >=100M dec/s @ 1M clients target as the same command).
+            # --fault-plan "seed=..,p_dropout=.." (a parseable SPEC,
+            # not just a label) samples a real FaultPlan and compiles
+            # it INTO the chunks -- the chaos mesh session
+            # (docs/ROBUSTNESS.md "Degraded-mode mesh")
+            from dmclock_tpu.robust import faults as _faults
+            mesh_fault_spec = _faults.parse_fault_spec(
+                args.fault_plan)
             results["mesh"] = bench_mesh(
                 args.clients, n_shards=args.n_shards,
                 counter_sync_every=args.counter_sync_every,
                 chunk=args.stream_chunk, with_metrics=wm,
-                slo=slo_on, tracer=tracer)
+                slo=slo_on, tracer=tracer,
+                fault_spec=mesh_fault_spec)
+            if mesh_fault_spec is not None:
+                # the history/JSON tag becomes the sampled plan's
+                # describe() summary (chaos sessions self-identify;
+                # bench_guard keeps them out of clean medians)
+                args.fault_plan = results["mesh"].get(
+                    "fault_plan", args.fault_plan)
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster

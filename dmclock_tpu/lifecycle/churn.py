@@ -41,7 +41,8 @@ from typing import Dict, List
 
 import numpy as np
 
-SCENARIOS = ("flash_crowd", "diurnal", "churn_storm", "limit_thrash")
+SCENARIOS = ("flash_crowd", "diurnal", "churn_storm", "limit_thrash",
+             "shard_skew")
 
 
 def make_spec(scenario: str, *, total_ids: int, seed: int = 0,
@@ -91,6 +92,16 @@ def make_spec(scenario: str, *, total_ids: int, seed: int = 0,
         # adversarial control-plane load shape
         "limit_thrash": {"victim_frac": 0.25, "tight_limit": 50.0,
                          "thrash_every": 1},
+        # the mesh plane's first IMBALANCE workload (ROADMAP
+        # rack-scheduling item): everyone registered from epoch 0,
+        # but the ids OWNED BY one shard (cid % n_shards == hot_shard
+        # -- the mesh lifecycle routing) carry a Zipf(zipf_a) head
+        # melting at hot_x times the base rate while every other
+        # shard's ids trickle at idle_x.  Invisible at one shard;
+        # at S=4 it is the one-shard-melts-while-others-idle shape
+        # inter-shard placement/migration will have to fix.
+        "shard_skew": {"n_shards": 4, "hot_shard": 0,
+                       "zipf_a": 1.2, "hot_x": 8.0, "idle_x": 0.1},
     }
     d = dict(defaults[scenario])
     unknown = set(params) - set(d)
@@ -102,6 +113,10 @@ def make_spec(scenario: str, *, total_ids: int, seed: int = 0,
         spec["evict_after"] = int(params.get("evict_after", 0)) or 0
     if scenario == "limit_thrash":
         spec.setdefault("evict_after", 0)
+        spec["evict_after"] = 0
+    if scenario == "shard_skew":
+        # static-population imbalance shape: nobody departs (the cold
+        # shards' trickle is the point -- they idle, not evict)
         spec["evict_after"] = 0
     return spec
 
@@ -177,6 +192,24 @@ def lam_vector(spec: dict, epoch: int) -> np.ndarray:
         phase = (epoch + cidx * (period // max(cohorts, 1))) % period
         night = phase >= (period + 1) // 2
         lam = np.where(night, lam * night_x, lam)
+    if spec["scenario"] == "shard_skew":
+        n, S = spec["total_ids"], int(spec["n_shards"])
+        ids = np.arange(n)
+        hot = ids % S == int(spec["hot_shard"])
+        # Zipf head over the hot shard's owned ids, by ownership
+        # rank: the head client melts hardest, the tail still runs
+        # hotter than any cold shard.  Mean over the hot partition is
+        # pinned at base_lam * hot_x so the aggregate offered load is
+        # a pure function of the spec knobs.
+        rank = ids // S   # ownership rank within a shard's partition
+        zipf = 1.0 / np.power(rank + 1.0, float(spec["zipf_a"]))
+        n_hot = max(int(hot.sum()), 1)
+        zipf_mean = float(zipf[hot].sum()) / n_hot if hot.any() \
+            else 1.0
+        lam = np.where(
+            hot,
+            lam * float(spec["hot_x"]) * zipf / max(zipf_mean, 1e-12),
+            lam * float(spec["idle_x"]))
     return lam
 
 

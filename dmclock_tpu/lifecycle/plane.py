@@ -189,10 +189,20 @@ class LifecyclePlane:
     """
 
     def __init__(self, spec: dict, *, workdir: Optional[str] = None,
-                 tracer=None):
+                 tracer=None, shard: Optional[Tuple[int, int]] = None):
+        """``shard=(s, n_shards)`` makes this a PER-SHARD plane of a
+        mesh job (docs/LIFECYCLE.md "Per-shard routing"): scripted
+        events and control ops are filtered to the client ids shard
+        ``s`` OWNS (``slots.owner_shard``: ``cid % n_shards == s``),
+        its slot map covers only that partition, and ``map_counts``
+        drops un-owned ids' draws (their arrivals belong to another
+        shard's plane).  ``shard=None`` is the single-shard plane the
+        round/stream loops drive."""
         self.spec = dict(spec)
         self.static = bool(spec["static"])
         self.total = int(spec["total_ids"])
+        self.shard = None if shard is None \
+            else (int(shard[0]), int(shard[1]))
         self.slots = SlotMap(int(spec["capacity0"]))
         self.streak = np.zeros(self.total, dtype=np.int64)
         self.qos: Dict[int, Tuple[float, float, float]] = {}
@@ -245,6 +255,13 @@ class LifecyclePlane:
             raise ValueError(
                 f"client id {cid} outside the churn spec's id space "
                 f"[0, {self.total})")
+        if not self._owns(cid):
+            from .slots import owner_shard
+            raise ValueError(
+                f"client id {cid} is owned by shard "
+                f"{int(owner_shard(cid, self.shard[1]))}, not this "
+                f"plane's shard {self.shard[0]} (route by "
+                f"slots.owner_shard)")
         if kind in ("register", "update"):
             validate_client_info(
                 (op["r"], op["w"], op["l"]), name=cid)
@@ -331,22 +348,33 @@ class LifecyclePlane:
             return out
 
     # -- scripted + pending op resolution ------------------------------
+    def _owns(self, cid: int) -> bool:
+        # slots.owner_shard IS the routing contract (one place; the
+        # rack-scheduling migration item will change it there)
+        from .slots import owner_shard
+
+        return self.shard is None or \
+            int(owner_shard(cid, self.shard[1])) == self.shard[0]
+
     def _due_scripted(self, b: int, every: int) -> List[dict]:
         if self.static:
             out = []
             if b == 0:
                 for cid in range(self.total):
+                    if not self._owns(cid):
+                        continue
                     r, w, l = churn_mod.init_qos(self.spec, cid)
                     out.append({"op": "register", "cid": cid,
                                 "r": r, "w": w, "l": l})
             out += [e for e in churn_mod.events(self.spec, b, every)
-                    if e["op"] == "update"]
+                    if e["op"] == "update" and self._owns(e["cid"])]
             return out
-        return churn_mod.events(self.spec, b, every)
+        return [e for e in churn_mod.events(self.spec, b, every)
+                if self._owns(e["cid"])]
 
     # -- the boundary --------------------------------------------------
     def boundary(self, state: EngineState, b: int, every: int, *,
-                 ledger=None, slo_block=None):
+                 ledger=None, slo_block=None, extras=None):
         """Apply everything due at boundary ``b`` (the epoch index the
         next window starts at): WAL ingest, scripted registrations and
         QoS updates, pending control ops with ``apply_at <= b`` (None
@@ -362,12 +390,24 @@ class LifecyclePlane:
         and leaves re-stamped with the post-boundary contract epochs.
         Boundaries sit exactly on the window-roll grid, so the block's
         counters are zero here and only the contract-epoch column is
-        live -- a lifecycle op can never smear into a closed window."""
+        live -- a lifecycle op can never smear into a closed window.
+
+        ``extras`` (list of ``(array, fill)`` pairs; axis 0 = slot)
+        rides additional per-slot arrays through the SAME transforms:
+        grown capacity pads with ``fill``, eviction resets the
+        departing slot's row to ``fill`` (a recycled slot must look
+        fresh), compaction gathers by the same permutation -- the
+        mesh counter plane's cd/cr (fill 0) and held views (fill 1,
+        the protocol origin) follow the slot layout this way.  When
+        given, the transformed list is appended to the return
+        tuple."""
         import jax
 
         from ..obs import spans as _spans
 
         slo_wanted = slo_block is not None
+        extras_wanted = extras is not None
+        extras = list(extras) if extras is not None else None
 
         with self.lock:
             self._wal_ingest()
@@ -394,8 +434,8 @@ class LifecyclePlane:
 
             # growth happens inside _register_row via self._grow_to;
             # the grown state is staged on the instance
-            state, ledger, slo_block = self._take_growth(
-                state, ledger, slo_block)
+            state, ledger, slo_block, extras = self._take_growth(
+                state, ledger, slo_block, extras)
 
             # idle evictions: scripted policy (zero-arrival streak,
             # drained queue) + control-plane DELETEs (drained only;
@@ -444,6 +484,11 @@ class LifecyclePlane:
                 import jax.numpy as jnp
                 slo_block = slo_block.at[jnp.asarray(evict_slots)] \
                     .set(0)
+            if evict_slots and extras is not None:
+                import jax.numpy as jnp
+                idx = jnp.asarray(evict_slots)
+                extras = [(arr.at[idx].set(fill), fill)
+                          for arr, fill in extras]
 
             # streaks for the upcoming window [b, b+every): counted
             # BEFORE serving it, so boundary b+every evicts on
@@ -464,15 +509,18 @@ class LifecyclePlane:
                         reg[cid] = True
                 self.streak = np.where(reg & quiet, self.streak + 1, 0)
 
-            state, ledger, slo_block = self._maybe_compact(
-                state, ledger, slo_block, b, every, _spans)
+            state, ledger, slo_block, extras = self._maybe_compact(
+                state, ledger, slo_block, extras, b, every, _spans)
             self.peak_live = max(self.peak_live, self.slots.live_count)
+            if slo_wanted and self._slo is not None:
+                slo_block = self._slo.stamp(
+                    slo_block, self.slots.cid_of_slot)
+            out = (state, ledger)
             if slo_wanted:
-                if self._slo is not None:
-                    slo_block = self._slo.stamp(
-                        slo_block, self.slots.cid_of_slot)
-                return state, ledger, slo_block
-            return state, ledger
+                out += (slo_block,)
+            if extras_wanted:
+                out += (extras,)
+            return out
 
     # -- boundary internals --------------------------------------------
     def _register_row(self, op: dict):
@@ -512,7 +560,8 @@ class LifecyclePlane:
                  rate_to_inv_ns(op["r"]), rate_to_inv_ns(op["w"]),
                  rate_to_inv_ns(op["l"]), 0)]
 
-    def _take_growth(self, state, ledger, slo_block=None):
+    def _take_growth(self, state, ledger, slo_block=None,
+                     extras=None):
         new_n = getattr(self, "_grow_pending", 0)
         if new_n > state.capacity:
             import jax.numpy as jnp
@@ -526,9 +575,44 @@ class LifecyclePlane:
                                  slo_block.shape[1]),
                                 dtype=slo_block.dtype)
                 slo_block = jnp.concatenate([slo_block, pad], axis=0)
+            if extras is not None:
+                import jax.numpy as jnp
+                grown = []
+                for arr, fill in extras:
+                    pad = jnp.full((new_n - arr.shape[0],)
+                                   + arr.shape[1:], fill,
+                                   dtype=arr.dtype)
+                    grown.append((jnp.concatenate([arr, pad],
+                                                  axis=0), fill))
+                extras = grown
             self.counters["grows"] += 1
         self._grow_pending = 0
-        return state, ledger, slo_block
+        return state, ledger, slo_block, extras
+
+    def ensure_capacity(self, cap: int, state, ledger=None,
+                        slo_block=None, extras=None):
+        """Grow this plane's slot space AND state arrays to at least
+        ``cap`` (no-op below current capacity) -- how a mesh job keeps
+        the STACKED per-shard layout rectangular: one shard's
+        grow-on-demand doubling forces every sibling to the same
+        capacity before the restack (docs/LIFECYCLE.md "Per-shard
+        routing").  Same return shape discipline as :meth:`boundary`:
+        ``(state, ledger[, slo_block][, extras])``."""
+        with self.lock:
+            cap = int(cap)
+            if cap > self.slots.capacity:
+                self.slots.grow(cap)
+            if cap > state.capacity:
+                self._grow_pending = max(
+                    getattr(self, "_grow_pending", 0), cap)
+            state, ledger, slo_block, extras = self._take_growth(
+                state, ledger, slo_block, extras)
+            out = (state, ledger)
+            if slo_block is not None:
+                out += (slo_block,)
+            if extras is not None:
+                out += (extras,)
+            return out
 
     def _evict_candidates(self, b: int, evict_api: List[dict]):
         out = list(evict_api)
@@ -561,31 +645,35 @@ class LifecyclePlane:
         if self._slo is not None:
             self._slo.evict(cid)
 
-    def _maybe_compact(self, state, ledger, slo_block, b: int,
-                       every: int, _spans):
+    def _maybe_compact(self, state, ledger, slo_block, extras,
+                       b: int, every: int, _spans):
         ce = self.spec["compact_every"]
         if self.static or not ce or b == 0 or (b // every) % ce != 0:
-            return state, ledger, slo_block
+            return state, ledger, slo_block, extras
         perm = self.slots.compaction_perm()
         if perm is None:
-            return state, ledger, slo_block
+            return state, ledger, slo_block, extras
         with _spans.span(self.tracer, "lifecycle.compact", "dispatch",
                          boundary=b, live=self.slots.live_count):
-            extras = tuple(x for x in (ledger, slo_block)
-                           if x is not None)
-            out = compact_tree((state,) + extras, perm)
+            more = tuple(x for x in (ledger, slo_block)
+                         if x is not None)
+            xarrs = tuple(arr for arr, _fill in extras) \
+                if extras is not None else ()
+            out = compact_tree((state,) + more + xarrs, perm)
             state = out[0]
             it = iter(out[1:])
             if ledger is not None:
                 ledger = next(it)
             if slo_block is not None:
                 slo_block = next(it)
+            if extras is not None:
+                extras = [(next(it), fill) for _arr, fill in extras]
         if _compact_hook is not None:
             _compact_hook()      # crash seam: device gather done,
         #                          host map not yet re-mapped
         self.slots.apply_perm(perm)
         self.counters["compactions"] += 1
-        return state, ledger, slo_block
+        return state, ledger, slo_block, extras
 
     # -- arrival-count mapping -----------------------------------------
     def map_counts(self, raw) -> np.ndarray:
@@ -714,8 +802,10 @@ class LifecyclePlane:
     @classmethod
     def load(cls, payload: dict, spec: dict, *,
              workdir: Optional[str] = None,
-             tracer=None) -> "LifecyclePlane":
-        p = cls(spec, workdir=workdir, tracer=tracer)
+             tracer=None,
+             shard: Optional[Tuple[int, int]] = None
+             ) -> "LifecyclePlane":
+        p = cls(spec, workdir=workdir, tracer=tracer, shard=shard)
         p.slots = SlotMap.load(payload)
         p.streak = np.asarray(payload["lc_streak"],
                               dtype=np.int64).copy()

@@ -32,6 +32,22 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+def owner_shard(cids, n_shards: int):
+    """Client->shard ownership for the mesh lifecycle plane: id
+    ``c`` lives on shard ``c % n_shards``.  Deterministic and
+    spec-independent, so a dynamic run, its static variant, and a
+    resumed incarnation all route the same id to the same shard --
+    the precondition of the S>1 dynamic==static digest gate
+    (docs/LIFECYCLE.md "Per-shard routing")."""
+    return np.asarray(cids) % int(n_shards)
+
+
+def owned_ids(total: int, shard: int, n_shards: int) -> np.ndarray:
+    """Ascending client ids shard ``shard`` owns out of ``total``."""
+    ids = np.arange(int(total), dtype=np.int64)
+    return ids[ids % int(n_shards) == int(shard)]
+
+
 class SlotMap:
     """Host-side client-id <-> slot-index map with slot recycling.
 

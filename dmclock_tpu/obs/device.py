@@ -187,6 +187,47 @@ def metrics_dict(vec) -> dict:
     return {name: int(v[i]) for i, name in enumerate(METRIC_NAMES)}
 
 
+FAULT_FAMILIES = (
+    ("dmclock_fault_server_dropouts_total", MET_SERVER_DROPOUTS,
+     "up -> down shard transitions injected by the fault plan "
+     "(docs/ROBUSTNESS.md 'Degraded-mode mesh')"),
+    ("dmclock_fault_tracker_resyncs_total", MET_TRACKER_RESYNCS,
+     "down -> up restarts that re-synced the shard's held counter "
+     "view / tracker marks from the monotone global counters"),
+    ("dmclock_fault_injected_total", MET_FAULTS_INJECTED,
+     "total injected fault events (dropouts, restarts, delayed "
+     "counters, duplicated completions, nonzero clock skew)"),
+)
+
+
+def publish_shard_faults(registry, per_shard, labels=None) -> None:
+    """Register the ``shard``-labelled ``dmclock_fault_*`` families
+    from a ``[S, NUM_METRICS]`` per-shard metric matrix (or a
+    ``[S, 3]`` dropouts/resyncs/injected matrix, e.g. the
+    ``robust.faults.plan_shard_events`` oracle stacked column-wise):
+    one gauge per family per shard plus a ``shard="all"`` total --
+    the degraded-mode mesh's scrape surface next to the
+    ``dmclock_slo_window_*`` / ``dmclock_shard_pressure_*``
+    precedents."""
+    import numpy as np
+
+    mat = np.asarray(per_shard, dtype=np.int64)
+    assert mat.ndim == 2, mat.shape
+    cols = {name: (row if mat.shape[1] == NUM_METRICS else j)
+            for j, (name, row, _help) in enumerate(FAULT_FAMILIES)}
+    for name, _row, help_text in FAULT_FAMILIES:
+        col = cols[name]
+        for s in range(mat.shape[0]):
+            registry.gauge(
+                name, help_text,
+                labels={**(labels or {}), "shard": str(s)}
+            ).set(int(mat[s, col]))
+        registry.gauge(
+            name, help_text,
+            labels={**(labels or {}), "shard": "all"}
+        ).set(int(mat[:, col].sum()))
+
+
 def publish(registry, vec, prefix: str = "dmclock_engine",
             labels=None) -> None:
     """Fold a fetched metrics vector into a host ``MetricsRegistry``:

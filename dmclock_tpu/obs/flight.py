@@ -111,6 +111,25 @@ def flight_record(fl: FlightState, slot, cls, tag, cost,
                        batch=fl.batch + live.astype(jnp.int64))
 
 
+def _ring_rows(buf2d: np.ndarray) -> np.ndarray:
+    """ONE ring's valid rows in seq order (oldest -> newest) -- the
+    single drain selection every entry point (single drain, stacked
+    merge, stacked dump) builds on, so the validity sentinel / order
+    rule cannot drift between them."""
+    buf2d = np.asarray(buf2d, dtype=np.int64)
+    rows = buf2d[buf2d[:, 0] >= 0]
+    return rows[np.argsort(rows[:, 0], kind="stable")]
+
+
+def _write_jsonl(records: list, path: str) -> int:
+    import json
+
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")
+    return len(records)
+
+
 def flight_drain(fl: FlightState) -> list:
     """Host drain: ONE ``device_get`` of the ring, decoded into dict
     records ordered oldest -> newest.  Call only at epoch/checkpoint
@@ -118,27 +137,62 @@ def flight_drain(fl: FlightState) -> list:
     the hot path."""
     import jax
 
-    buf, seq = jax.device_get((fl.buf, fl.seq))
-    buf = np.asarray(buf, dtype=np.int64)
-    valid = buf[:, 0] >= 0
-    rows = buf[valid]
-    rows = rows[np.argsort(rows[:, 0], kind="stable")]
-    out = [dict(zip(FLIGHT_FIELDS, (int(x) for x in row)))
-           for row in rows]
-    return out
+    buf = jax.device_get(fl.buf)
+    return [dict(zip(FLIGHT_FIELDS, (int(x) for x in row)))
+            for row in _ring_rows(buf)]
 
 
 def flight_dump(fl: FlightState, path: str) -> int:
     """Drain the ring to a JSONL file (the supervisor's --flight-dump
     crash hook); returns the record count.  Telemetry must never kill
     what it observes -- callers wrap this in a best-effort guard."""
-    import json
+    return _write_jsonl(flight_drain(fl), path)
 
-    records = flight_drain(fl)
-    with open(path, "w") as fh:
-        for rec in records:
-            fh.write(json.dumps(rec) + "\n")
-    return len(records)
+
+def flight_merge_stacked(fl: FlightState):
+    """Deterministic SHARD-ORDER merge of a mesh job's stacked
+    per-shard rings (``buf`` int64[S, R, COLS], ``seq`` int64[S]):
+    each shard's valid rows ordered by its own seq column, shards
+    concatenated 0..S-1.  Returns ``(rows int64[V, COLS], total_seq
+    int)``.  Per-shard seq counters are independent (each ring is its
+    own black box); the shard-major order is the one deterministic
+    interleave that needs no cross-shard clock, which is what lets
+    the crash-equivalence gate compare merged rings bit-for-bit."""
+    import jax
+
+    buf = np.asarray(jax.device_get(fl.buf), dtype=np.int64)
+    seq = np.asarray(jax.device_get(fl.seq), dtype=np.int64)
+    assert buf.ndim == 3, f"expected stacked [S, R, COLS], {buf.shape}"
+    parts = [_ring_rows(buf[s]) for s in range(buf.shape[0])]
+    merged = np.concatenate(parts, axis=0) if parts else \
+        np.zeros((0, FLIGHT_COLS), dtype=np.int64)
+    return merged, int(seq.sum())
+
+
+def flight_drain_stacked(fl: FlightState) -> list:
+    """Host drain of a stacked per-shard ring: dict records with a
+    ``shard`` key added, in the :func:`flight_merge_stacked` order --
+    the mesh job's ``--flight-dump`` crash-hook format."""
+    import jax
+
+    buf = np.asarray(jax.device_get(fl.buf), dtype=np.int64)
+    out = []
+    for s in range(buf.shape[0]):
+        for row in _ring_rows(buf[s]):
+            rec = dict(zip(FLIGHT_FIELDS, (int(x) for x in row)))
+            rec["shard"] = s
+            out.append(rec)
+    return out
+
+
+def flight_dump_any(fl: FlightState, path: str) -> int:
+    """:func:`flight_dump` that accepts single OR stacked rings (the
+    supervisor's one crash-hook entry point)."""
+    import jax
+
+    if np.asarray(jax.device_get(fl.buf)).ndim == 3:
+        return _write_jsonl(flight_drain_stacked(fl), path)
+    return flight_dump(fl, path)
 
 
 def flight_from_arrays(buf, seq, batch) -> FlightState:

@@ -412,6 +412,19 @@ class MeshRounds(NamedTuple):
     pressure_merged: object = None
 
 
+def round_sync_mask(epochs: int, counter_sync_every: int,
+                    round0: int = 0) -> np.ndarray:
+    """The GLOBAL counter-sync grid as a host bool mask over one
+    launch's rounds: round ``round0 + t`` syncs iff it lies on the
+    ``counter_sync_every`` grid.  One implementation shared by the
+    healthy fused rounds (:func:`run_mesh_rounds`) and the chaos
+    fused rounds (``robust.cluster.run_mesh_rounds_with_plan``), so
+    the two programs cannot disagree about where a chunked launch
+    sits on the grid."""
+    every = max(int(counter_sync_every), 1)
+    return (int(round0) + np.arange(int(epochs))) % every == 0
+
+
 def init_mesh_views(n_servers: int, n_clients: int):
     """Held counter views at the protocol origin (counters start at 1,
     ``dmclock_client.h:191-198``) -- the same origin ``robust.cluster.
@@ -495,9 +508,8 @@ def run_mesh_rounds(cluster: ClusterState, arrivals_seq, cost,
     n_servers = cluster.now.shape[0]
     n_clients = arrivals_seq.shape[2]
     cost = jnp.asarray(cost, dtype=jnp.int64)
-    every = max(int(counter_sync_every), 1)
     sync_mask = jnp.asarray(
-        (int(round0) + np.arange(epochs)) % every == 0)
+        round_sync_mask(epochs, counter_sync_every, round0))
     if view_delta is None or view_rho is None:
         view_delta, view_rho = init_mesh_views(n_servers, n_clients)
     if metrics is None:

@@ -74,7 +74,9 @@ class MeshChunk(NamedTuple):
     the chunk.  ``slo_merged`` is the cluster-wide window block merged
     IN-GRAPH across the mesh via ``obs.slo.window_mesh_reduce``
     (replicated; ``int64[N, W_FIELDS]``) -- the one conformance table
-    the SLO plane rolls."""
+    the SLO plane rolls.  ``flight`` is the stacked per-shard HBM
+    flight-ring state (``with_flight`` chunks; each shard records its
+    own commits, the host merges rings in shard order at drain)."""
 
     state: object             # stacked EngineState, [S, ...] leaves
     outs: dict                # [S, E, ...] stacked engine fields
@@ -87,6 +89,7 @@ class MeshChunk(NamedTuple):
     slo: object = None        # int64[S, N, W_FIELDS] per-shard blocks
     prov: object = None
     slo_merged: object = None  # int64[N, W_FIELDS] (window_mesh_reduce)
+    flight: object = None     # stacked obs.flight.FlightState [S, ...]
 
 
 def stack_shards(tree, n_shards: int, mesh: Optional[Mesh] = None):
@@ -120,6 +123,30 @@ def counter_init(n_shards: int, n: int):
     return z, z, one, one
 
 
+def mask_epoch_outs(outs: dict, up, fault_vec):
+    """Mask one DOWN epoch's engine outputs to their committed-nothing
+    neutrals (the ``robust.cluster`` decision-slots-read-NONE
+    semantics, field-typed for the stream-chunk layout): guard vectors
+    read True (nothing ran, nothing tripped), slots read -1, every
+    count/cost/class reads 0.  ``metrics`` is zeroed and replaced by
+    the epoch's fault-event delta (``fault_vec``; also added on LIVE
+    epochs, where the engine metrics are kept).  The host chaos
+    replay (``robust.guarded``) builds byte-identical rows from the
+    same table -- one implementation would need shapes the host does
+    not have, so the NAME table here is the shared contract."""
+    masked = {}
+    for name, arr in outs.items():
+        if name == "metrics":
+            masked[name] = jnp.where(up, arr, 0) + fault_vec
+        elif name in ("guards_ok", "progress_ok"):
+            masked[name] = jnp.where(up, arr, jnp.ones_like(arr))
+        elif name == "slot":
+            masked[name] = jnp.where(up, arr, jnp.full_like(arr, -1))
+        else:
+            masked[name] = jnp.where(up, arr, jnp.zeros_like(arr))
+    return masked
+
+
 def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
                      k: int = 0, chain_depth: int = 4,
                      dt_epoch_ns: int, waves: int,
@@ -131,10 +158,12 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
                      calendar_impl: str = "minstop",
                      ladder_levels: int = 8,
                      counter_sync_every: int = 1,
-                     ingest: bool = True):
+                     ingest: bool = True,
+                     with_faults: bool = False,
+                     with_flight: bool = False):
     """Build the pure mesh chunk program ``(state, cd, cr, view_d,
-    view_r, epoch0, counts, hists, ledger, slo, prov) -> MeshChunk``
-    for one static configuration.
+    view_r, epoch0, counts, hists, ledger, slo, prov, flight, faults)
+    -> MeshChunk`` for one static configuration.
 
     ``counts`` is ``int32[S, E, N]`` of RAW per-shard Poisson draws
     (shard axis leading so ``P(servers)`` splits it); ``epoch0`` is a
@@ -145,7 +174,38 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
     must always be a window block (``int64[S, N, W_FIELDS]``): the
     counter plane diffs its delivered columns per epoch -- when the
     job runs with the SLO plane off the caller passes a throwaway
-    zero block."""
+    zero block.
+
+    ``with_faults`` compiles the PR-3 fault model INTO the chunk:
+    ``faults`` is a ``robust.faults.FaultChunk``-shaped 5-tuple of
+    traced per-shard arrays (``up``/``skew_ns``/``delay_counters``/
+    ``dup_completions`` [S, E] + ``up_prev`` [S]) precomputed on the
+    host from the plan oracle.  Per epoch, per shard:
+
+    - a DOWN shard commits nothing -- engine state, telemetry
+      accumulators, and the SLO window block all keep their entry
+      values, its decision outputs read the neutral masks
+      (:func:`mask_epoch_outs`), and its frozen ``cd``/``cr``
+      contribution keeps the counter psum MONOTONE (exactly the
+      ``robust.cluster`` degraded-path semantics);
+    - a live shard's view refreshes from the psum only on the global
+      sync grid AND when its piggyback updates are not delayed; a
+      RESTART (down -> up transition) always re-syncs -- the in-graph
+      twin of ``resync_tracker``'s re-marking;
+    - ``dup_completions`` folds the epoch's completion delta into the
+      counters TWICE (the at-least-once response-network failure);
+    - ``skew_ns`` lenses the shard's epoch clock (ingest + serve see
+      ``t + skew``; the index-derived clock makes it per-epoch, not
+      cumulative);
+    - every injected event lands in the epoch's metrics vector rows
+      (``server_dropouts``/``tracker_resyncs``/``faults_injected``),
+      summing to the ``plan_events`` oracle exactly.
+
+    An all-benign fault tuple (``zero_plan`` sliced) is value-
+    identical to ``with_faults=False`` -- the zero-fault gate in
+    ``scripts/ci.sh``."""
+    from ..obs import device as obsdev
+
     assert engine in fastpath.EPOCH_ENGINES, engine
     epochs = int(epochs)
     assert epochs >= 1, "a mesh chunk needs at least one epoch"
@@ -162,69 +222,134 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
         engine=engine, m=m, kw=kw, dt_epoch_ns=dt, waves=waves,
         ingest=ingest)
 
-    def per_server(st, cd, cr, vd, vr, epoch0, counts_s, h, l, s, p):
+    def per_server(st, cd, cr, vd, vr, epoch0, counts_s, h, l, s, p,
+                   f, flt):
         def body(carry, xs):
-            st, cd, cr, vd, vr, h, l, s, p = carry
-            counts_e, i = xs
+            st, cd, cr, vd, vr, h, l, s, p, f, up_prev = carry
+            if with_faults:
+                counts_e, i, up, skew, delay, dup = xs
+            else:
+                counts_e, i = xs
+                up = up_prev        # the all-up constant
+                skew = jnp.int64(0)
             # batched delta/rho exchange at the epoch boundary: the
             # views refresh from the mesh psum only on the global
             # sync grid; between syncs every shard serves from its
-            # held (stale) view -- the paper's tolerance, as data
+            # held (stale) view -- the paper's tolerance, as data.
+            # The collective runs on EVERY shard (SPMD); a down
+            # shard's counters are frozen, so the psum stays monotone
             g_d, g_r = global_counters_from(
                 cd, cr, lambda x: lax.psum(x, SERVER_AXIS))
             sync = ((epoch0 + i) % every) == 0
-            vd = jnp.where(sync, g_d, vd)
-            vr = jnp.where(sync, g_r, vr)
-            t_base = (epoch0 + i) * dt
-            (st, h, l, f, s2, p), outs = epoch_step(
-                st, t_base, counts_e, h, l, None, s, p)
+            if with_faults:
+                restart = up & ~up_prev
+                dropout = ~up & up_prev
+                # live non-delayed shards refresh on the grid; a
+                # restart always re-syncs (resync_tracker's twin); a
+                # down shard holds its frozen view
+                refresh = (sync & up & ~delay) | restart
+            else:
+                refresh = sync
+            vd = jnp.where(refresh, g_d, vd)
+            vr = jnp.where(refresh, g_r, vr)
+            t_base = (epoch0 + i) * dt + skew
+            (st2, h2, l2, f2, s2, p2), outs = epoch_step(
+                st, t_base, counts_e, h, l, f, s, p)
+            if with_faults:
+                # commit gate: a down shard keeps last-good state --
+                # engine, telemetry, flight ring, SLO block alike --
+                # and its outputs read the neutral masks
+                def keep(new, old):
+                    return None if new is None else jax.tree.map(
+                        lambda a, b: jnp.where(up, a, b), new, old)
+
+                st2, h2, l2, f2, p2 = (keep(st2, st), keep(h2, h),
+                                       keep(l2, l), keep(f2, f),
+                                       keep(p2, p))
+                s2 = jnp.where(up, s2, s)
+                perturb = ((dup & up).astype(jnp.int64)
+                           + (delay & up).astype(jnp.int64)
+                           + ((skew != 0) & up).astype(jnp.int64))
+                events = (dropout.astype(jnp.int64)
+                          + restart.astype(jnp.int64))
+                outs = mask_epoch_outs(outs, up, obsdev.metrics_delta(
+                    server_dropouts=dropout.astype(jnp.int64),
+                    tracker_resyncs=restart.astype(jnp.int64),
+                    faults_injected=events + perturb))
             # completions -> counters: the window block's delivered
             # columns are exact per-client counts (PR-10), so the
             # per-epoch diff IS this epoch's completion fold -- no
             # scatter, no second accumulator, no decision perturbed
-            cd = cd + (s2[:, obsslo.W_OPS] - s[:, obsslo.W_OPS])
-            cr = cr + (s2[:, obsslo.W_RESV_OPS]
-                       - s[:, obsslo.W_RESV_OPS])
-            return (st, cd, cr, vd, vr, h, l, s2, p), outs
+            d_ops = s2[:, obsslo.W_OPS] - s[:, obsslo.W_OPS]
+            d_resv = (s2[:, obsslo.W_RESV_OPS]
+                      - s[:, obsslo.W_RESV_OPS])
+            if with_faults:
+                # duplicated completions: this epoch's batch folds
+                # into the counters twice (masked; +0 is exact)
+                mult = 1 + (dup & up).astype(jnp.int64)
+                d_ops = d_ops * mult
+                d_resv = d_resv * mult
+            cd = cd + d_ops
+            cr = cr + d_resv
+            return (st2, cd, cr, vd, vr, h2, l2, s2, p2, f2,
+                    up if with_faults else up_prev), outs
 
         idx = jnp.arange(epochs, dtype=jnp.int64)
         if not ingest:
             counts_s = jnp.zeros((epochs, 0), dtype=jnp.int32)
-        (st, cd, cr, vd, vr, h, l, s, p), outs = lax.scan(
-            body, (st, cd, cr, vd, vr, h, l, s, p), (counts_s, idx))
-        return st, cd, cr, vd, vr, h, l, s, p, outs
+        if with_faults:
+            up_s, skew_s, delay_s, dup_s, up0 = flt
+            xs = (counts_s, idx, up_s, skew_s, delay_s, dup_s)
+        else:
+            up0 = jnp.asarray(True)
+            xs = (counts_s, idx)
+        carry, outs = lax.scan(
+            body, (st, cd, cr, vd, vr, h, l, s, p, f, up0), xs)
+        st, cd, cr, vd, vr, h, l, s, p, f = carry[:10]
+        return st, cd, cr, vd, vr, h, l, f, s, p, outs
 
     def shard_fn(state, cd, cr, vd, vr, epoch0, counts,
-                 hists, ledger, slo, prov):
+                 hists, ledger, slo, prov, flight, faults):
         out = jax.vmap(
             per_server,
-            in_axes=(0, 0, 0, 0, 0, None, 0, 0, 0, 0, 0),
+            in_axes=(0, 0, 0, 0, 0, None, 0, 0, 0, 0, 0, 0, 0),
         )(state, cd, cr, vd, vr, epoch0, counts, hists, ledger, slo,
-          prov)
+          prov, flight, faults)
         # cluster-wide conformance: local combine over this shard's
         # vmapped servers, then ONE collective across the mesh --
         # counter columns psum, the contract-epoch column pmax
         # (obs.slo.window_mesh_reduce); replicated out-spec
         merged = obsslo.window_mesh_reduce(
-            obsslo.window_combine_axis(out[7]), SERVER_AXIS)
+            obsslo.window_combine_axis(out[8]), SERVER_AXIS)
         return out + (merged,)
 
     spec = P(SERVER_AXIS)
-    in_specs = (spec,) * 5 + (P(),) + (spec,) * 5
-    out_specs = (spec,) * 10 + (P(),)
+    in_specs = (spec,) * 5 + (P(),) + (spec,) * 7
+    out_specs = (spec,) * 11 + (P(),)
 
     def chunk(state, cd, cr, vd, vr, epoch0, counts, hists=None,
-              ledger=None, slo=None, prov=None) -> MeshChunk:
+              ledger=None, slo=None, prov=None, flight=None,
+              faults=None) -> MeshChunk:
         epoch0 = jnp.asarray(epoch0, dtype=jnp.int64)
+        if with_faults:
+            assert faults is not None, \
+                "with_faults=True needs the FaultChunk arrays"
+            faults = (jnp.asarray(faults[0], dtype=bool),
+                      jnp.asarray(faults[1], dtype=jnp.int64),
+                      jnp.asarray(faults[2], dtype=bool),
+                      jnp.asarray(faults[3], dtype=bool),
+                      jnp.asarray(faults[4], dtype=bool))
+        else:
+            faults = None
         fn = shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, check_vma=False)
-        (state, cd, cr, vd, vr, hists, ledger, slo, prov, outs,
-         merged) = fn(state, cd, cr, vd, vr, epoch0, counts, hists,
-                      ledger, slo, prov)
+        (state, cd, cr, vd, vr, hists, ledger, flight, slo, prov,
+         outs, merged) = fn(state, cd, cr, vd, vr, epoch0, counts,
+                            hists, ledger, slo, prov, flight, faults)
         return MeshChunk(state=state, outs=outs, cd=cd, cr=cr,
                          view_d=vd, view_r=vr, hists=hists,
                          ledger=ledger, slo=slo, prov=prov,
-                         slo_merged=merged)
+                         slo_merged=merged, flight=flight)
 
     return chunk
 
@@ -261,11 +386,14 @@ def shard_epoch_view(engine: str, outs: dict, s: int, i: int):
 
 
 def mesh_epoch_results(engine: str, outs: dict, i: int) -> tuple:
-    """Epoch ``i``'s digest-ready result tuple: one view per shard in
-    shard order (the chain digest hashes the per-shard decision
-    streams; at S=1 this is exactly the stream loop's tuple)."""
+    """Epoch ``i``'s digest-ready result rows: one PER-SHARD tuple of
+    result views in shard order (flatten for the chain digest -- the
+    flat order is unchanged from before the grouping; the per-shard
+    structure is what lets a churn job canonicalize each shard's
+    results through that shard's own slot map).  At S=1 the flattened
+    row is exactly the stream loop's tuple."""
     n_shards = next(iter(outs.values())).shape[0]
-    return tuple(shard_epoch_view(engine, outs, s, i)
+    return tuple((shard_epoch_view(engine, outs, s, i),)
                  for s in range(n_shards))
 
 

@@ -347,6 +347,106 @@ def run_with_plan(rc: RobustClusterState, arrivals, cost, mesh,
     return rc, decs_seq
 
 
+def effective_plan(plan: FaultPlan, counter_sync_every: int = 1,
+                   round0: int = 0) -> FaultPlan:
+    """Fold the ``counter_sync_every`` staleness grid into a plan's
+    ``delay_counters`` mask: a non-sync round IS the delay fault (the
+    PR-13 equivalence -- the knob is the stale-view tolerance turned
+    into a cadence), so the host loop under the effective plan is the
+    exact reference for a fused K-grid launch under the raw plan.  At
+    K=1 the plan is returned unchanged."""
+    sync = CL.round_sync_mask(plan.steps, counter_sync_every, round0)
+    if sync.all():
+        return plan
+    return plan._replace(
+        delay_counters=plan.delay_counters | ~sync[:, None])
+
+
+def run_mesh_rounds_with_plan(rc: RobustClusterState, arrivals_seq,
+                              cost, mesh, plan: FaultPlan, *,
+                              decisions_per_step: int,
+                              max_arrivals: int = 1,
+                              anticipation_ns: int = 0,
+                              allow_limit_break: bool = False,
+                              advance_ns: int = 0,
+                              counter_sync_every: int = 1,
+                              round0: int = 0):
+    """The CHAOS twin of ``parallel.cluster.run_mesh_rounds``: ONE
+    ``shard_map`` launch advances every server by ``E`` whole degraded
+    rounds -- a ``lax.scan`` over :func:`_one_server_step_faulty`, the
+    SAME per-round program the host loop (:func:`run_with_plan`) jits
+    per step -- with the seeded :class:`FaultPlan` riding the scan as
+    traced per-round mask slices and the ``counter_sync_every``
+    staleness grid folded into the delay mask
+    (:func:`effective_plan`).  Dropout/restart/skew/dup semantics,
+    tracker re-sync, the frozen-contribution monotone psum, and the
+    per-shard fault metric rows are all byte-the-same construction as
+    the host loop's, so the digest gate
+
+    ``run_mesh_rounds_with_plan(plan, K) == run_with_plan(
+    effective_plan(plan, K))``
+
+    (decisions + held views + tracker state + metric vectors) is an
+    identity of launch structure only: E round-trips collapse to one.
+    Returns ``(rc, decs)`` with ``decs`` leaves ``[S, E, k]``
+    (re-slice with ``parallel.cluster.mesh_decs_seq``)."""
+    import functools
+
+    arrivals_seq = jnp.asarray(arrivals_seq, dtype=jnp.int32)
+    epochs = int(arrivals_seq.shape[0])
+    cost = jnp.asarray(cost, dtype=jnp.int64)
+    eff = effective_plan(plan, counter_sync_every, round0)
+    assert eff.steps == epochs, (eff.steps, epochs)
+    # [T, S] plan leaves -> [S, T] so P(servers) splits them
+    f_up = jnp.asarray(np.ascontiguousarray(eff.up.T))
+    f_skew = jnp.asarray(np.ascontiguousarray(eff.skew_ns.T))
+    f_delay = jnp.asarray(np.ascontiguousarray(eff.delay_counters.T))
+    f_dup = jnp.asarray(np.ascontiguousarray(eff.dup_completions.T))
+    arr_s = jnp.swapaxes(arrivals_seq, 0, 1)
+    adv = jnp.int64(advance_ns)
+
+    step = functools.partial(
+        _one_server_step_faulty, cost=cost,
+        decisions_per_step=decisions_per_step,
+        anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break,
+        max_arrivals=max_arrivals)
+
+    def per_server(engine, tracker, now, arrs, vd, vr, up_prev, met,
+                   ups, skews, delays, dups):
+        def body(carry, xs):
+            engine, tracker, now, vd, vr, up_prev, met = carry
+            arr, up, skew, delay, dup = xs
+            engine, tracker, now, vd, vr, up_now, met, decs = step(
+                engine, tracker, now + adv, arr, vd, vr, up_prev,
+                met, up, skew, delay, dup)
+            return (engine, tracker, now, vd, vr, up_now, met), decs
+
+        carry, decs = lax.scan(
+            body, (engine, tracker, now, vd, vr, up_prev, met),
+            (arrs, ups, skews, delays, dups))
+        engine, tracker, now, vd, vr, up_prev, met = carry
+        return engine, tracker, now, vd, vr, up_prev, met, decs
+
+    def shard_fn(engine, tracker, now, arrs, vd, vr, up_prev, met,
+                 ups, skews, delays, dups):
+        return jax.vmap(per_server)(engine, tracker, now, arrs, vd,
+                                    vr, up_prev, met, ups, skews,
+                                    delays, dups)
+
+    spec = P(SERVER_AXIS)
+    fn = shard_map(shard_fn, mesh=mesh, in_specs=(spec,) * 12,
+                   out_specs=(spec,) * 8, check_vma=False)
+    engine, tracker, now, vd, vr, up_prev, met, decs = fn(
+        rc.cluster.engine, rc.cluster.tracker, rc.cluster.now, arr_s,
+        rc.view_delta, rc.view_rho, rc.up_prev, rc.metrics,
+        f_up, f_skew, f_delay, f_dup)
+    rc = RobustClusterState(
+        cluster=ClusterState(engine=engine, tracker=tracker, now=now),
+        view_delta=vd, view_rho=vr, up_prev=up_prev, metrics=met)
+    return rc, decs
+
+
 def decision_digest(decs_seq) -> str:
     """sha256 over the decision stream (type/slot/phase/cost per step)
     -- the bit-identity currency of the chaos differential gate."""

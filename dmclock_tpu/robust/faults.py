@@ -33,7 +33,7 @@ plumbing with every mask off and is pinned bit-identical to ``None``
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import numpy as np
 
@@ -124,6 +124,41 @@ def plan_step(plan: FaultPlan, t: int) -> FaultStep:
                      dup_completions=plan.dup_completions[t])
 
 
+class FaultChunk(NamedTuple):
+    """A chunk-window slice of a plan for the FUSED mesh chunk
+    (``parallel.mesh.build_mesh_chunk``): shard-axis-leading ``[S, E]``
+    mask/value arrays (so ``P(servers)`` splits them) plus the
+    liveness entering the window (``up_prev``, [S] -- derived from the
+    plan's previous step, so dropout/restart transitions land on the
+    same epochs the host loop sees).  Host numpy data; the chunk
+    traces them as inputs."""
+
+    up: np.ndarray               # bool[S, E]
+    skew_ns: np.ndarray          # int64[S, E]
+    delay_counters: np.ndarray   # bool[S, E]
+    dup_completions: np.ndarray  # bool[S, E]
+    up_prev: np.ndarray          # bool[S] liveness entering the chunk
+
+
+def plan_chunk(plan: FaultPlan, e0: int, e1: int) -> FaultChunk:
+    """Slice epochs ``[e0, e1)`` of a plan into the fused-chunk layout.
+    ``up_prev`` comes from step ``e0 - 1`` (all-up at the origin), so
+    chunked chaos launches compose exactly like the per-step host
+    loop."""
+    e0, e1 = int(e0), int(e1)
+    assert 0 <= e0 < e1 <= plan.steps, (e0, e1, plan.steps)
+    prev = plan.up[e0 - 1] if e0 > 0 \
+        else np.ones((plan.n_servers,), dtype=bool)
+    return FaultChunk(
+        up=np.ascontiguousarray(plan.up[e0:e1].T),
+        skew_ns=np.ascontiguousarray(plan.skew_ns[e0:e1].T),
+        delay_counters=np.ascontiguousarray(
+            plan.delay_counters[e0:e1].T),
+        dup_completions=np.ascontiguousarray(
+            plan.dup_completions[e0:e1].T),
+        up_prev=prev.copy())
+
+
 def plan_events(plan: FaultPlan) -> dict:
     """Host-side ground truth of the fault events a run of this plan
     must surface in the device metrics vector -- the exact-match oracle
@@ -142,6 +177,78 @@ def plan_events(plan: FaultPlan) -> dict:
         "tracker_resyncs": resyncs,
         "faults_injected": dropouts + resyncs + perturbations,
     }
+
+
+def plan_shard_events(plan: FaultPlan) -> dict:
+    """Per-shard form of :func:`plan_events` (``int64[S]`` arrays):
+    the exact-match oracle for the ``shard``-labelled
+    ``dmclock_fault_*`` families and the bench's per-shard
+    dropout/resync record rows.  Summing each array reproduces the
+    cluster totals of :func:`plan_events` by construction."""
+    prev = np.vstack([np.ones((1, plan.n_servers), dtype=bool),
+                      plan.up[:-1]])
+    dropouts = (prev & ~plan.up).sum(axis=0).astype(np.int64)
+    resyncs = (~prev & plan.up).sum(axis=0).astype(np.int64)
+    live = plan.up
+    perturb = ((plan.delay_counters & live).sum(axis=0)
+               + (plan.dup_completions & live).sum(axis=0)
+               + ((plan.skew_ns != 0) & live).sum(axis=0)
+               ).astype(np.int64)
+    return {"server_dropouts": dropouts,
+            "tracker_resyncs": resyncs,
+            "faults_injected": dropouts + resyncs + perturb}
+
+
+# keys parse_fault_spec accepts (everything sample_plan takes except
+# the run-derived steps/n_servers); "seed" rides separately
+_SPEC_KEYS = ("p_dropout", "mean_outage_steps", "p_delay", "p_dup",
+              "max_skew_ns")
+
+
+def parse_fault_spec(spec) -> Optional[dict]:
+    """Parse a ``--fault-plan`` value into :func:`sample_plan` kwargs
+    (plus ``seed``), or None when the value is a plain LABEL (the
+    PR-3 semantics: ``--fault-plan`` tagged a session without running
+    anything).  A spec is a comma-separated ``key=value`` string --
+    e.g. ``"seed=7,p_dropout=0.05,mean_outage_steps=2,p_dup=0.1"`` --
+    or an already-parsed dict; ``"none"``/empty parses to None."""
+    if spec is None:
+        return None
+    if isinstance(spec, dict):
+        out = dict(spec)
+    else:
+        s = str(spec).strip()
+        if not s or s.lower() == "none" or "=" not in s:
+            return None
+        out = {}
+        for part in s.split(","):
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k not in _SPEC_KEYS + ("seed",):
+                raise ValueError(
+                    f"unknown fault-plan spec key {k!r} (one of "
+                    f"{('seed',) + _SPEC_KEYS})")
+            out[k] = float(v) if "." in v or "e" in v.lower() \
+                else int(v)
+    out.setdefault("seed", 0)
+    unknown = set(out) - set(_SPEC_KEYS) - {"seed"}
+    if unknown:
+        raise ValueError(f"unknown fault-plan spec keys "
+                         f"{sorted(unknown)}")
+    out["seed"] = int(out["seed"])
+    out["max_skew_ns"] = int(out.get("max_skew_ns", 0))
+    return out
+
+
+def plan_from_spec(spec: dict, steps: int, n_servers: int) -> FaultPlan:
+    """Sample the plan a parsed spec describes for a ``steps`` x
+    ``n_servers`` run -- the one deterministic construction shared by
+    ``EpochJob(fault_plan=...)`` and ``bench.py --fault-plan``, so a
+    bench session and its supervised twin inject the identical
+    schedule."""
+    kw = dict(spec)
+    seed = int(kw.pop("seed", 0))
+    return sample_plan(seed, int(steps), int(n_servers), **kw)
 
 
 def describe(plan: FaultPlan | None) -> str:

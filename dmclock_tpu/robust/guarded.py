@@ -511,28 +511,101 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
 
 class MeshGuarded(NamedTuple):
     """Result of :func:`run_mesh_chunk_guarded` -- one mesh chunk of
-    epochs across all shards, drained and normalized to per-epoch rows
-    (each a tuple of digest-ready result objects in SHARD ORDER, so
-    the supervisor's chain digest covers the per-shard decision
-    streams; at S=1 the rows are exactly the stream loop's)."""
+    epochs across all shards, drained and normalized to per-epoch rows.
+    Each row is a tuple of PER-SHARD result-object tuples in SHARD
+    ORDER (flatten a row for the chain digest; the grouping is what
+    lets a churn job apply each shard's canonical slot->cid view to
+    exactly that shard's results).  At S=1 a flattened row is exactly
+    the stream loop's."""
 
     state: object            # stacked EngineState [S, ...]
     cd: object               # int64[S, N] completion counters
     cr: object
     view_d: object           # int64[S, N] held counter views
     view_r: object
-    epochs: tuple            # per-epoch tuples of result objects
+    epochs: tuple            # per-epoch tuples of per-shard tuples
     counts: tuple            # per-epoch AGGREGATE decisions (int)
     guard_trips: tuple       # per-epoch rebase+serial fallback count
     mesh_fallback: int       # 1 when the chunk tripped a guard and
     #                          was discarded + re-run epoch-major on
-    #                          the round path (slower, never divergent)
+    #                          the host robust loop (slower, never
+    #                          divergent; under a fault plan the
+    #                          supervisor counts it as a
+    #                          mesh_chaos_fallback)
     retries: int
     hists: object = None     # stacked telemetry accumulators
     ledger: object = None
     slo: object = None       # int64[S, N, W_FIELDS] per-shard blocks
     prov: object = None
     slo_merged: object = None  # int64[N, W_FIELDS] cluster-wide block
+    flight: object = None    # stacked per-shard flight rings
+
+
+# eval_shape'd neutral epoch results for the host chaos replay's DOWN
+# epochs, keyed by the static epoch configuration + state shape (the
+# module-jit-cache convention; eval_shape traces, so it is not free)
+_NEUTRAL_EPOCH_CACHE: dict = {}
+
+
+def neutral_epoch_view(engine: str, state_slice, m: int, kw: dict,
+                       fault_met=None):
+    """The committed-nothing epoch result of a DOWN shard, host-built:
+    guard vectors True, slots -1, every count/cost/class 0, metrics =
+    the epoch's fault-event delta -- byte-identical (dtype + shape +
+    values) to slicing ``parallel.mesh.mask_epoch_outs``'s device
+    masks, which is what makes the host chaos replay digest-equal to
+    the fused chaos chunk.  Shapes come from ``jax.eval_shape`` of the
+    same epoch program the chunk traces (nothing runs)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..engine import fastpath
+    from ..engine import stream as stream_mod
+
+    key = (engine, m, tuple(sorted(kw.items())),
+           int(state_slice.capacity), int(state_slice.ring_capacity))
+    if key not in _NEUTRAL_EPOCH_CACHE:
+        fn = fastpath.epoch_scan_fn(engine)
+        shapes = jax.eval_shape(
+            lambda st: fn(st, jnp.int64(0), m=m, **kw), state_slice)
+        fields = {}
+        for name in stream_mod.STREAM_OUT_FIELDS[engine]:
+            sd = getattr(shapes, name)
+            if name in ("guards_ok", "progress_ok"):
+                arr = np.ones(sd.shape, dtype=sd.dtype)
+            elif name == "slot":
+                arr = np.full(sd.shape, -1, dtype=sd.dtype)
+            else:
+                arr = np.zeros(sd.shape, dtype=sd.dtype)
+            arr.setflags(write=False)
+            fields[name] = arr
+        msd = shapes.metrics
+        _NEUTRAL_EPOCH_CACHE[key] = (fields, msd.shape,
+                                     np.dtype(msd.dtype))
+    fields, mshape, mdtype = _NEUTRAL_EPOCH_CACHE[key]
+    metrics = np.zeros(mshape, dtype=mdtype)
+    if fault_met is not None:
+        metrics += np.asarray(fault_met, dtype=mdtype)
+    cls = {"prefix": fastpath.PrefixEpoch,
+           "chain": fastpath.ChainEpoch,
+           "calendar": fastpath.CalendarEpoch}[engine]
+    return cls(state=None, metrics=metrics, **fields)
+
+
+def _fault_met_vec(dropout: bool, restart: bool, perturb: int):
+    """Host numpy twin of the fused chunk's per-epoch fault metric
+    delta (rows 9-11 of the obs vector)."""
+    import numpy as np
+
+    from ..obs import device as obsdev
+
+    v = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
+    v[obsdev.MET_SERVER_DROPOUTS] = int(dropout)
+    v[obsdev.MET_TRACKER_RESYNCS] = int(restart)
+    v[obsdev.MET_FAULTS_INJECTED] = \
+        int(dropout) + int(restart) + int(perturb)
+    return v
 
 
 def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
@@ -550,7 +623,7 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
                            ladder_levels: int = 8,
                            counter_sync_every: int = 1,
                            hists=None, ledger=None, slo=None,
-                           prov=None,
+                           prov=None, flight=None, faults=None,
                            retries: int = 3, base_s: float = 0.05,
                            sleep: Callable[[float], None] =
                            _time.sleep,
@@ -559,14 +632,22 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
     guarded-commit contract at MESH-CHUNK granularity: bounded retry
     around the single launch, and -- on a guard trip ANYWHERE in the
     chunk, on any shard -- the whole chunk is discarded and its epochs
-    replay EPOCH-MAJOR, SHARD-MINOR on the proven round path
-    (``run_epoch_guarded`` per shard per epoch, with the counter-view
-    psum recomputed on the host at each global sync boundary), which
-    reproduces the fused program's lockstep sync semantics exactly:
-    epoch e's views on every shard read the cluster counters as of the
-    end of epoch e-1.  ``slo`` must always be a window block (the
-    counter plane diffs it); ``counts`` is ``int32[S, E, N]`` raw
-    draws or None for serve-only chunks."""
+    replay EPOCH-MAJOR, SHARD-MINOR on the proven host robust loop
+    (:func:`mesh_chunk_host_replay`: ``run_epoch_guarded`` per shard
+    per epoch, with the counter-view psum recomputed on the host at
+    each global sync boundary), which reproduces the fused program's
+    lockstep sync semantics exactly: epoch e's views on every shard
+    read the cluster counters as of the end of epoch e-1.  ``slo``
+    must always be a window block (the counter plane diffs it);
+    ``counts`` is ``int32[S, E, N]`` raw draws or None for serve-only
+    chunks.
+
+    ``faults`` (a ``robust.faults.FaultChunk`` or None) compiles the
+    PR-3 fault model into the launch (``parallel.mesh`` documents the
+    in-chunk semantics); the guard-trip fallback replays the SAME
+    fault schedule on the host robust loop, so a chaos chunk degrades
+    to the proven path without ever dropping the plan.  ``flight`` is
+    the stacked per-shard flight-ring state (or None)."""
     import numpy as np
 
     import jax
@@ -576,7 +657,6 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
     from ..obs import slo as obsslo
     from ..obs import spans as _spans
     from ..parallel import mesh as mesh_mod
-    from ..parallel.tracker import global_counters_from
 
     epochs = int(epochs)
     do_ingest = counts is not None
@@ -605,8 +685,13 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
         slo = mesh_mod.stack_shards(obsslo.window_zero(n), n_shards)
     state, cd, cr, view_d, view_r = (put(x) for x in
                                      (state, cd, cr, view_d, view_r))
-    hists, ledger, slo, prov = (put(x) for x in
-                                (hists, ledger, slo, prov))
+    hists, ledger, slo, prov, flight = (put(x) for x in
+                                        (hists, ledger, slo, prov,
+                                         flight))
+    faults_dev = None
+    if faults is not None:
+        faults_dev = tuple(
+            jax.device_put(jnp.asarray(a), sharding) for a in faults)
     fn = mesh_mod.jit_mesh_chunk(
         mesh, engine=engine, epochs=epochs, m=m, k=k,
         chain_depth=chain_depth, dt_epoch_ns=dt_epoch_ns, waves=waves,
@@ -615,7 +700,9 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
         with_metrics=with_metrics, select_impl=select_impl,
         tag_width=tag_width, window_m=window_m,
         calendar_impl=calendar_impl, ladder_levels=ladder_levels,
-        counter_sync_every=counter_sync_every, ingest=do_ingest)
+        counter_sync_every=counter_sync_every, ingest=do_ingest,
+        with_faults=faults is not None,
+        with_flight=flight is not None)
     retry_count = [0]
 
     def count_retry(attempt, exc):
@@ -632,10 +719,10 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
     def one():
         with _spans.span(tracer, "mesh.dispatch", "dispatch",
                          engine=engine, epochs=epochs,
-                         shards=n_shards):
+                         shards=n_shards, chaos=faults is not None):
             out = fn(state, cd, cr, view_d, view_r,
                      jnp.int64(epoch0), counts_dev, hists, ledger,
-                     slo, prov)
+                     slo, prov, flight, faults_dev)
         with _spans.span(tracer, "mesh.device_wait",
                          "device_compute"):
             return jax.block_until_ready(out)
@@ -659,20 +746,97 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
             guard_trips=(0,) * epochs, mesh_fallback=0,
             retries=retry_count[0], hists=out.hists,
             ledger=out.ledger, slo=out.slo, prov=out.prov,
-            slo_merged=out.slo_merged)
+            slo_merged=out.slo_merged, flight=out.flight)
 
     # a guard tripped somewhere in the mesh chunk: discard it (the
     # entry state/counters are never donated) and replay epoch-major
-    # on the round path -- the counter-view exchange becomes a host
-    # sum at the same global sync grid, every epoch before the trip
-    # recomputes bit-identically (pure integer programs), the tripped
-    # one resumes exactly as the round loop would have
+    # on the host robust loop -- under a fault plan this is the
+    # proven DEGRADED path (the supervisor counts it as a
+    # mesh_chaos_fallback), and the replay carries the identical
+    # fault schedule
     _spans.instant(tracer, "mesh.fallback", "retry", engine=engine,
-                   epochs=epochs, shards=n_shards)
+                   epochs=epochs, shards=n_shards,
+                   chaos=faults is not None)
+    return mesh_chunk_host_replay(
+        state, cd, cr, view_d, view_r, epoch0, counts_dev,
+        engine=engine, epochs=epochs, m=m, k=k,
+        chain_depth=chain_depth, dt_epoch_ns=dt_epoch_ns,
+        waves=waves, anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break,
+        with_metrics=with_metrics, select_impl=select_impl,
+        tag_width=tag_width, window_m=window_m,
+        calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        counter_sync_every=counter_sync_every,
+        hists=hists, ledger=ledger, slo=slo, prov=prov,
+        flight=flight, faults=faults, retries=retries,
+        base_s=base_s, sleep=sleep, on_retry=on_retry,
+        tracer=tracer, _retries_so_far=retry_count[0])
+
+
+def mesh_chunk_host_replay(state, cd, cr, view_d, view_r,
+                           epoch0: int, counts, *,
+                           engine: str, epochs: int, m: int,
+                           k: int = 0, chain_depth: int = 4,
+                           dt_epoch_ns: int, waves: int,
+                           anticipation_ns: int = 0,
+                           allow_limit_break: bool = False,
+                           with_metrics: bool = True,
+                           select_impl: str = "sort",
+                           tag_width: int = 64,
+                           window_m: Optional[int] = None,
+                           calendar_impl: str = "minstop",
+                           ladder_levels: int = 8,
+                           counter_sync_every: int = 1,
+                           hists=None, ledger=None, slo=None,
+                           prov=None, flight=None, faults=None,
+                           retries: int = 3, base_s: float = 0.05,
+                           sleep: Callable[[float], None] =
+                           _time.sleep,
+                           on_retry=None, tracer=None,
+                           _retries_so_far: int = 0) -> MeshGuarded:
+    """The HOST ROBUST LOOP: drive one mesh chunk's epochs epoch-major
+    shard-minor on the proven per-epoch path, with the counter-view
+    psum recomputed as a host sum at the same global sync grid and --
+    when ``faults`` is given -- the exact in-chunk fault semantics of
+    ``parallel.mesh.build_mesh_chunk``: a down shard runs nothing and
+    contributes a :func:`neutral_epoch_view` row, its state/telemetry
+    /counters frozen; restarts re-sync the held views off-grid; dup
+    doubles the completion fold; skew lenses the shard's clock; fault
+    events patch the epoch's metrics rows.
+
+    This is both the guard-trip fallback of
+    :func:`run_mesh_chunk_guarded` AND the digest reference the chaos
+    gates compare the fused chunk against (tests/test_mesh.py,
+    scripts/ci.sh mesh chaos smoke): a seeded chaos chunk must be
+    decision-for-decision and counter-view-for-counter-view identical
+    to this loop under the same plan.  ``slo`` must be a window block
+    (stacked [S, N, W_FIELDS]); ``counts`` is ``int32[S, E, N]`` or
+    None."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import fastpath
+    from ..engine import stream as stream_mod
+    from ..obs import slo as obsslo
+    from ..parallel.tracker import global_counters_from
+
+    epochs = int(epochs)
+    do_ingest = counts is not None
+    n_shards = int(np.asarray(jax.device_get(cd)).shape[0])
+    if slo is None:
+        # the counter plane diffs the window block's delivered
+        # columns; when the caller runs the SLO plane off, ride a
+        # throwaway zero block (chunk-local -- only cd/cr persist),
+        # exactly like run_mesh_chunk_guarded's fused leg
+        from ..parallel import mesh as mesh_mod
+        n = int(np.asarray(jax.device_get(cd)).shape[1])
+        slo = mesh_mod.stack_shards(obsslo.window_zero(n), n_shards)
+    every = max(int(counter_sync_every), 1)
+    retry_count = [_retries_so_far]
     ingest_step = stream_mod.jit_ingest_step(
         dt_epoch_ns=dt_epoch_ns, waves=waves) if do_ingest else None
-    every = max(int(counter_sync_every), 1)
-
     dev0 = jax.devices()[0]
 
     def slic(tree, s):
@@ -686,28 +850,76 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
     sts = [slic(state, s) for s in range(n_shards)]
     cur = {name: [slic(acc, s) for s in range(n_shards)]
            for name, acc in (("hists", hists), ("ledger", ledger),
-                             ("slo", slo), ("prov", prov))}
+                             ("slo", slo), ("prov", prov),
+                             ("flight", flight))}
     cd_np = np.asarray(jax.device_get(cd), dtype=np.int64).copy()
     cr_np = np.asarray(jax.device_get(cr), dtype=np.int64).copy()
     vd_np = np.asarray(jax.device_get(view_d), dtype=np.int64).copy()
     vr_np = np.asarray(jax.device_get(view_r), dtype=np.int64).copy()
+    if faults is not None:
+        f_up = np.asarray(faults[0], dtype=bool)
+        f_skew = np.asarray(faults[1], dtype=np.int64)
+        f_delay = np.asarray(faults[2], dtype=bool)
+        f_dup = np.asarray(faults[3], dtype=bool)
+        up_prev = np.asarray(faults[4], dtype=bool).copy()
+    neutral_kw = fastpath.epoch_scan_kwargs(
+        engine, k=k, chain_depth=chain_depth, select_impl=select_impl,
+        tag_width=tag_width, window_m=window_m,
+        calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        anticipation_ns=anticipation_ns,
+        allow_limit_break=allow_limit_break,
+        with_metrics=with_metrics)
     ep_rows, count_rows, trip_rows = [], [], []
     for i in range(epochs):
         t_base = (int(epoch0) + i) * int(dt_epoch_ns)
-        if (int(epoch0) + i) % every == 0:
+        sync = (int(epoch0) + i) % every == 0
+        # the epoch-entry psum, from the counters as of the end of
+        # epoch i-1 (the fused program's lockstep semantics); under a
+        # plan each shard refreshes only per its own masks below.
+        # Only reduced when some shard CAN refresh this epoch -- a
+        # sync epoch, or an off-grid restart -- so a plain fallback
+        # replay at K>1 skips the O(S*N) host sum on non-sync epochs
+        may_refresh = sync or (
+            faults is not None and bool((f_up[:, i] & ~up_prev).any()))
+        g_d = g_r = None
+        if may_refresh:
             g_d, g_r = global_counters_from(
                 cd_np, cr_np, lambda x: x.sum(axis=0))
-            vd_np[:] = g_d[None]
-            vr_np[:] = g_r[None]
         row, n_dec, trips = [], 0, 0
         for s in range(n_shards):
+            if faults is not None:
+                up = bool(f_up[s, i])
+                skew = int(f_skew[s, i])
+                delay = bool(f_delay[s, i])
+                dup = bool(f_dup[s, i])
+                restart = up and not up_prev[s]
+                dropout = (not up) and up_prev[s]
+                refresh = (sync and up and not delay) or restart
+                perturb = (int(dup and up) + int(delay and up)
+                           + int(skew != 0 and up))
+            else:
+                up, skew, dup = True, 0, False
+                restart = dropout = False
+                perturb = 0
+                refresh = sync
+            if refresh:
+                vd_np[s] = g_d
+                vr_np[s] = g_r
+            if not up:
+                # the shard is DOWN this epoch: nothing runs, nothing
+                # commits (arrivals posted to it are lost), its row
+                # reads the committed-nothing neutrals + fault rows
+                row.append((neutral_epoch_view(
+                    engine, sts[s], m, neutral_kw,
+                    _fault_met_vec(dropout, restart, perturb)),))
+                continue
             if ingest_step is not None:
                 # the raw-draw slice is still committed to the whole
                 # mesh; the single-device round path needs it local
                 sts[s] = ingest_step(
                     sts[s],
-                    jax.device_put(counts_dev[s, i], dev0),
-                    jnp.int64(t_base))
+                    jax.device_put(counts[s, i], dev0),
+                    jnp.int64(t_base + skew))
             w_prev = np.asarray(jax.device_get(cur["slo"][s]),
                                 dtype=np.int64)
             ep = run_epoch_guarded(
@@ -718,25 +930,39 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
                 with_metrics=with_metrics, select_impl=select_impl,
                 tag_width=tag_width, window_m=window_m,
                 calendar_impl=calendar_impl,
-                ladder_levels=ladder_levels,
+                ladder_levels=ladder_levels, skew_ns=skew,
                 hists=cur["hists"][s], ledger=cur["ledger"][s],
+                flight=cur["flight"][s],
                 slo=cur["slo"][s], prov=cur["prov"][s],
                 retries=retries, base_s=base_s, sleep=sleep,
                 on_retry=on_retry, tracer=tracer)
             sts[s] = ep.state
-            for name in ("hists", "ledger", "slo", "prov"):
+            for name in ("hists", "ledger", "slo", "prov", "flight"):
                 if cur[name][s] is not None:
                     cur[name][s] = getattr(ep, name)
             w_now = np.asarray(jax.device_get(ep.slo),
                                dtype=np.int64)
-            cd_np[s] += w_now[:, obsslo.W_OPS] \
-                - w_prev[:, obsslo.W_OPS]
-            cr_np[s] += w_now[:, obsslo.W_RESV_OPS] \
-                - w_prev[:, obsslo.W_RESV_OPS]
+            mult = 2 if dup else 1
+            cd_np[s] += (w_now[:, obsslo.W_OPS]
+                         - w_prev[:, obsslo.W_OPS]) * mult
+            cr_np[s] += (w_now[:, obsslo.W_RESV_OPS]
+                         - w_prev[:, obsslo.W_RESV_OPS]) * mult
             retry_count[0] += ep.retries
-            row.extend(ep.results)
+            results = ep.results
+            if restart or perturb:
+                # the fused chunk folds the epoch's fault-event delta
+                # into its metrics row; patch the first result so the
+                # host loop's metric totals match the oracle exactly
+                fv = _fault_met_vec(False, restart, perturb)
+                r0 = results[0]
+                results = (r0._replace(
+                    metrics=r0.metrics + jnp.asarray(fv)),) \
+                    + results[1:]
+            row.append(tuple(results))
             n_dec += ep.count
             trips += ep.rebase_fallbacks + ep.serial_fallbacks
+        if faults is not None:
+            up_prev = f_up[:, i].copy()
         ep_rows.append(tuple(row))
         count_rows.append(n_dec)
         trip_rows.append(trips)
@@ -755,6 +981,7 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
         mesh_fallback=1, retries=retry_count[0],
         hists=restack(cur["hists"]), ledger=restack(cur["ledger"]),
         slo=slo_stacked, prov=restack(cur["prov"]),
+        flight=restack(cur["flight"]),
         slo_merged=jnp.asarray(obsslo.window_combine_np(
             np.zeros_like(np.asarray(slo_stacked[0])),
             *np.asarray(jax.device_get(slo_stacked)))))

@@ -194,9 +194,15 @@ class EpochJob:
     # construction -- both trace engine.stream.make_epoch_step -- and
     # the counter plane + per-shard telemetry ride the rotation
     # checkpoints, so crash equivalence extends to the mesh loop
-    # unchanged.  Not composable with ``churn`` (the lifecycle plane
-    # is single-shard) or ``flight_records`` (a per-shard HBM ring
-    # has no mesh merge; both are rejected up front).
+    # unchanged.  ``churn`` composes via PER-SHARD lifecycle planes
+    # (client ids routed by ``cid % n_shards``; docs/LIFECYCLE.md
+    # "Per-shard routing") and ``flight_records`` via per-shard HBM
+    # rings merged in shard order at drain; mesh churn does not yet
+    # compose with ``with_slo`` (the merged window table would need
+    # an id-space merge across per-shard slot layouts) or with
+    # ``fault_plan`` (a down shard's boundary semantics are the
+    # rack-scheduling item's migration question) -- both rejected up
+    # front.
     engine_loop: str = "round"
     # mesh serving plane knobs (engine_loop="mesh" only): shard count
     # (devices used; obs.capacity.plan_capacity sizes it from the
@@ -209,6 +215,21 @@ class EpochJob:
     # delay_counters fault)
     n_shards: int = 1
     counter_sync_every: int = 1
+    # degraded-mode mesh serving (docs/ROBUSTNESS.md "Degraded-mode
+    # mesh"; engine_loop="mesh" only): a JSON-able fault-plan SPEC
+    # (dict, or the bench's "seed=..,p_dropout=.." string form) --
+    # ``robust.faults.parse_fault_spec`` keys: seed, p_dropout,
+    # mean_outage_steps, p_delay, p_dup, max_skew_ns -- sampled
+    # deterministically at job start into a ``FaultPlan`` over
+    # (epochs, n_shards) and COMPILED INTO every fused mesh chunk as
+    # traced per-epoch masks (parallel.mesh).  The plan is pure host
+    # data recomputed per incarnation from this spec, so crash
+    # equivalence needs no new checkpoint state; a guard trip during
+    # a chaos chunk replays the identical schedule on the host robust
+    # loop (counted as a mesh_chaos_fallback).  None = no fault
+    # plumbing (byte-identical to the pre-chaos chunk program).
+    fault_plan: object = None   # dict spec or
+    #                             "seed=..,p_dropout=.." string
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -275,6 +296,10 @@ class SupervisedResult(NamedTuple):
     mesh_counters: Optional[np.ndarray] = None
     mesh_views: Optional[np.ndarray] = None
     mesh_fallbacks: int = 0
+    # chaos chunks (fault_plan set) that tripped a guard and replayed
+    # on the host robust loop -- the degraded-mode mesh's
+    # slow-but-on-plan path (a subset of mesh_fallbacks)
+    mesh_chaos_fallbacks: int = 0
 
 
 def assert_crash_equivalent(interrupted: SupervisedResult,
@@ -378,13 +403,17 @@ def _job_state(job: EpochJob):
     from ..core.timebase import rate_to_inv_ns
     from ..engine import init_state
 
-    if job.churn is not None:
-        return init_state(int(job.churn["capacity0"]), job.ring)
     if job.engine_loop == "mesh":
         from ..parallel import mesh as mesh_mod
 
         single = dataclasses.replace(job, engine_loop="stream")
         return mesh_mod.stack_shards(_job_state(single), job.n_shards)
+    if job.churn is not None:
+        # open population: EMPTY at the spec's initial capacity (a
+        # mesh churn job stacks S of these -- every shard starts at
+        # the same capacity0, its partition arriving through its own
+        # per-shard plane)
+        return init_state(int(job.churn["capacity0"]), job.ring)
     st = init_state(job.n, job.ring)
     c = np.arange(job.n)
     rinv = np.full(job.n, rate_to_inv_ns(100.0), dtype=np.int64)
@@ -490,9 +519,19 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
     # lifecycle leaves are ALWAYS present too (empty for closed-
     # population jobs) -- same structure-from-config convention; their
     # capacities vary at runtime, so churn jobs restore with
-    # strict_shapes=False (utils.checkpoint)
-    lc = plane.encode() if plane is not None \
-        else LifecyclePlane.empty_leaves()
+    # strict_shapes=False (utils.checkpoint).  A mesh churn job
+    # carries a LIST of per-shard planes: each encodes under
+    # lc_s{s}_* (S is job config, so the payload structure still
+    # depends only on the config), the base lc_* leaves stay empty.
+    if isinstance(plane, (list, tuple)):
+        lc = dict(LifecyclePlane.empty_leaves())
+        for s, pl in enumerate(plane):
+            lc.update({f"lc_s{s}{k[2:]}": v
+                       for k, v in pl.encode().items()})
+    elif plane is not None:
+        lc = plane.encode()
+    else:
+        lc = LifecyclePlane.empty_leaves()
     # SLO leaves follow the same always-present convention: the block,
     # the plane's ring/contract-epoch state, and the evaluator's
     # episode accounting (slo = (block, SloPlane, SloEvaluator) or
@@ -535,10 +574,14 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
                 if flight is None
                 else np.asarray(jax.device_get(flight.buf),
                                 dtype=np.int64),
-            "tele_flight_seq": np.int64(
-                0 if flight is None else int(flight.seq)),
-            "tele_flight_batch": np.int64(
-                0 if flight is None else int(flight.batch)),
+            # seq/batch are scalars on single-engine loops and [S]
+            # arrays for the mesh's stacked per-shard rings
+            "tele_flight_seq": np.int64(0) if flight is None
+            else np.asarray(jax.device_get(flight.seq),
+                            dtype=np.int64),
+            "tele_flight_batch": np.int64(0) if flight is None
+            else np.asarray(jax.device_get(flight.batch),
+                            dtype=np.int64),
             "prov_margin_hist": z if prov is None
             else np.asarray(jax.device_get(prov.margin_hist),
                             dtype=np.int64),
@@ -566,16 +609,42 @@ def _tele_init(job: EpochJob):
     prov = obsprov.prov_init(n) if job.with_prov else None
     if job.engine_loop == "mesh":
         # per-shard accumulator stacks (each shard's epoch program
-        # carries its own; they merge through hist/ledger/prov
-        # mesh-reduce algebra on the way out)
+        # carries its own; hists/ledger/prov merge through their
+        # mesh-reduce algebra on the way out, the flight rings merge
+        # in shard order at drain)
         from ..parallel import mesh as mesh_mod
 
         def stk(acc):
             return None if acc is None \
                 else mesh_mod.stack_shards(acc, job.n_shards)
 
-        hists, ledger, prov = stk(hists), stk(ledger), stk(prov)
+        hists, ledger, prov, flight = (stk(hists), stk(ledger),
+                                       stk(prov), stk(flight))
     return hists, ledger, flight, prov
+
+
+def _mesh_planes(job: EpochJob, *, tracer=None, payload=None):
+    """The per-shard lifecycle planes of a mesh churn job (client ids
+    routed by ``cid % n_shards`` -- ``lifecycle.slots.owner_shard``),
+    fresh or restored from the namespaced ``lc_s{s}_*`` checkpoint
+    leaves.  Planes run WITHOUT a workdir: the admin WAL/API surface
+    is single-shard, mesh churn is scripted-events-only (routing live
+    control ops per shard is the ROADMAP rack-scheduling item)."""
+    from ..lifecycle.plane import LifecyclePlane
+
+    planes = []
+    for s in range(job.n_shards):
+        if payload is not None:
+            pre = f"lc_s{s}_"
+            sub = {"lc_" + k[len(pre):]: v
+                   for k, v in payload.items() if k.startswith(pre)}
+            planes.append(LifecyclePlane.load(
+                sub, job.churn, tracer=tracer,
+                shard=(s, job.n_shards)))
+        else:
+            planes.append(LifecyclePlane(
+                job.churn, tracer=tracer, shard=(s, job.n_shards)))
+    return planes
 
 
 def _payload_like(job: EpochJob) -> dict:
@@ -587,20 +656,24 @@ def _payload_like(job: EpochJob) -> dict:
     if job.engine_loop == "mesh":
         from ..parallel import mesh as mesh_mod
 
-        mesh = mesh_mod.counter_init(job.n_shards, job.n)
+        n0 = int(job.churn["capacity0"]) \
+            if job.churn is not None else job.n
+        mesh = mesh_mod.counter_init(job.n_shards, n0)
     # the SLO leaves' template stays the empty-leaf shape even for
     # with_slo jobs: their axis-0 sizes are runtime state (ring fill,
     # contract count), so such jobs restore with the axis-0-only
     # relaxation (trailing dims still gate) -- see _job_loop
+    plane = None
+    if job.churn is not None:
+        plane = _mesh_planes(job) if job.engine_loop == "mesh" \
+            else LifecyclePlane(job.churn)
     tmpl = _payload(job, _job_state(job),
                     np.random.Generator(np.random.PCG64(job.seed)),
                     np.zeros(obsdev.NUM_METRICS, dtype=np.int64),
                     b"\x00" * 32, 0, 0,
                     DegradationLadder().encode(),
                     hists=hists, ledger=ledger, flight=flight,
-                    prov=prov, mesh=mesh,
-                    plane=LifecyclePlane(job.churn)
-                    if job.churn is not None else None)
+                    prov=prov, mesh=mesh, plane=plane)
     if job.engine_loop == "mesh" and job.with_slo:
         # a mesh job's saved window block is the STACKED per-shard
         # [S, N, W_FIELDS] layout -- the template must carry the rank
@@ -735,20 +808,47 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             "watermark through grow/compact/evict (see the EpochJob "
             "field comment)")
     if job.engine_loop == "mesh":
-        if job.churn is not None:
+        if job.churn is not None and job.with_slo:
             raise ValueError(
-                "EpochJob(engine_loop='mesh') does not compose with "
-                "churn: the lifecycle plane's slot map and WAL are "
-                "single-shard (route registrations per shard first "
-                "-- the ROADMAP rack-scheduling item)")
-        if job.flight_records:
+                "EpochJob(engine_loop='mesh', churn=...) does not "
+                "compose with with_slo yet: the cluster-wide "
+                "window_mesh_reduce table is slot-indexed, and "
+                "per-shard slot layouts diverge under churn -- the "
+                "merge needs an id-space scatter first")
+        if job.churn is not None and job.fault_plan is not None:
             raise ValueError(
-                "EpochJob(engine_loop='mesh') does not carry the "
-                "flight recorder: a per-shard HBM ring has no mesh "
-                "merge (hists/ledger/slo/prov all do)")
+                "EpochJob(engine_loop='mesh') does not compose "
+                "churn with fault_plan yet: a down shard's lifecycle "
+                "boundary (register into a dead server? migrate?) is "
+                "the ROADMAP rack-scheduling placement question")
         if job.n_shards < 1:
             raise ValueError(f"n_shards must be >= 1, "
                              f"got {job.n_shards}")
+        if job.churn is not None and \
+                job.churn.get("scenario") == "shard_skew" and \
+                int(job.churn.get("n_shards", 0)) != job.n_shards:
+            # the spec's hot-shard mask is cid % spec.n_shards; a
+            # mismatched job would silently smear the melt across
+            # shards instead of concentrating it on one
+            raise ValueError(
+                f"shard_skew spec was built for "
+                f"n_shards={job.churn.get('n_shards')} but the job "
+                f"runs {job.n_shards} shards -- pass "
+                f"make_spec('shard_skew', n_shards={job.n_shards})")
+    if job.fault_plan is not None:
+        if job.engine_loop != "mesh":
+            raise ValueError(
+                "EpochJob(fault_plan=...) is the in-chunk mesh fault "
+                "model (engine_loop='mesh'); the round/stream loops "
+                "inject faults through robust.cluster.run_with_plan")
+        from .faults import parse_fault_spec
+        # parse_fault_spec accepts dicts AND "seed=7,p_dropout=.."
+        # strings (the bench --fault-plan form); a plain LABEL parses
+        # to None and is rejected here -- a label cannot seed a plan
+        if parse_fault_spec(job.fault_plan) is None:
+            raise ValueError(f"fault_plan spec did not parse: "
+                             f"{job.fault_plan!r} (expected keys like "
+                             f"seed=.., p_dropout=..)")
     state = _job_state(job)
     rng = np.random.Generator(np.random.PCG64(job.seed))
     met = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
@@ -842,10 +942,19 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 jnp.asarray(payload[k])
                 for k in ("mesh_cd", "mesh_cr", "mesh_vd", "mesh_vr"))
         else:
-            mesh_ctrs = mesh_mod.counter_init(job.n_shards, job.n)
+            # per-slot counters follow the SLOT layout: a churn job's
+            # slots start at the spec's capacity0 and grow/permute
+            # with each shard's boundary (the extras discipline)
+            n0 = int(job.churn["capacity0"]) \
+                if job.churn is not None else job.n
+            mesh_ctrs = mesh_mod.counter_init(job.n_shards, n0)
 
     plane = None
-    if job.churn is not None:
+    mesh_planes = None
+    if job.churn is not None and job.engine_loop == "mesh":
+        mesh_planes = _mesh_planes(job, tracer=tracer,
+                                   payload=payload)
+    elif job.churn is not None:
         from ..lifecycle.plane import LifecyclePlane
         if payload is not None:
             plane = LifecyclePlane.load(payload, job.churn,
@@ -954,8 +1063,9 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
         return _mesh_epochs(job, injector, ckpt_dir, scr, base_cfg,
                             state, rng, met, digest, start_epoch,
                             decisions, ladder, tracer, hists, ledger,
-                            prov, resumed_from, slo_block, slo_plane,
-                            slo_eval, mesh_ctrs)
+                            flight, prov, resumed_from, slo_block,
+                            slo_plane, slo_eval, mesh_ctrs,
+                            mesh_planes)
     assert job.engine_loop == "round", job.engine_loop
     ingest = _jit_ingest(job) \
         if job.arrival_lam > 0 and plane is None else None
@@ -1163,29 +1273,45 @@ def _build_result(job, state, digest, decisions, met, ladder,
                   stream_fallbacks: int, plane=None,
                   slo_block=None, slo_plane=None,
                   slo_eval=None, prov=None, mesh=None,
-                  mesh_fallbacks: int = 0) -> SupervisedResult:
+                  mesh_fallbacks: int = 0,
+                  mesh_chaos_fallbacks: int = 0) -> SupervisedResult:
     import jax
 
     slo_kw = {}
     if mesh is not None and job.n_shards == 1:
         # S=1 canonicalization: a 1-shard mesh IS a single engine, so
-        # the result (state digest, telemetry blocks, window block)
-        # drops the unit shard axis and the bit-identity gate against
-        # the round/stream loops compares like for like
+        # the result (state digest, telemetry blocks, window block,
+        # flight ring) drops the unit shard axis and the bit-identity
+        # gate against the round/stream loops compares like for like
         from ..parallel import mesh as mesh_mod
 
         state = mesh_mod.unstack_shard(state)
         hists = None if hists is None else hists[0]
         ledger = None if ledger is None else ledger[0]
         prov = None if prov is None else mesh_mod.unstack_shard(prov)
+        flight = None if flight is None \
+            else mesh_mod.unstack_shard(flight)
         if slo_block is not None:
             slo_block = slo_block[0]
+    elif mesh is not None and flight is not None:
+        # S>1: merge the per-shard rings in DETERMINISTIC shard order
+        # at drain -- each shard's valid rows in seq order, shards
+        # concatenated 0..S-1 (obs.flight.flight_merge_stacked); the
+        # crash-equivalence gate compares the merged rows, seq is the
+        # cluster total
+        from ..obs import flight as obsflight
+
+        buf, seq = obsflight.flight_merge_stacked(flight)
+        flight = obsflight.FlightState(
+            buf=buf, seq=seq, batch=np.asarray(
+                jax.device_get(flight.batch)).sum())
     if mesh is not None:
         cd, cr, vd, vr = [np.asarray(jax.device_get(x),
                                      dtype=np.int64) for x in mesh]
         slo_kw.update(mesh_counters=np.stack([cd, cr]),
                       mesh_views=np.stack([vd, vr]),
-                      mesh_fallbacks=mesh_fallbacks)
+                      mesh_fallbacks=mesh_fallbacks,
+                      mesh_chaos_fallbacks=mesh_chaos_fallbacks)
     if prov is not None:
         slo_kw.update(
             prov_margin_hist=np.asarray(
@@ -1204,9 +1330,27 @@ def _build_result(job, state, digest, decisions, met, ladder,
             slo_ring=enc["slo_ring"],
             slo_cepoch=enc["slo_cepoch"],
             slo=slo_eval.summary())
+    if isinstance(plane, (list, tuple)):
+        # mesh churn: one snapshot per shard (deterministic, so the
+        # crash-equivalence dict compare still bites) + the cluster
+        # rollup the bench/result consumers read
+        shots = [p.snapshot() for p in plane]
+        lifecycle = {
+            "live_clients": sum(s["live_clients"] for s in shots),
+            "peak_clients": sum(s["peak_clients"] for s in shots),
+            "capacity": sum(s["capacity"] for s in shots),
+            **{key: sum(s[key] for s in shots)
+               for key in shots[0]
+               if key not in ("live_clients", "peak_clients",
+                              "capacity", "pending_ops")},
+            "pending_ops": sum(s["pending_ops"] for s in shots),
+            "shards": shots,
+        }
+    else:
+        lifecycle = plane.snapshot() if plane is not None else None
     return SupervisedResult(
         **slo_kw,
-        lifecycle=plane.snapshot() if plane is not None else None,
+        lifecycle=lifecycle,
         digest=hashlib.sha256(digest).hexdigest(),
         state_digest=_tree_digest(state),
         decisions=decisions, epochs=job.epochs,
@@ -1483,12 +1627,57 @@ def _draw_counts_mesh(rng: np.random.Generator, job: EpochJob,
     return np.swapaxes(draws, 0, 1)
 
 
+def _mesh_boundary(job: EpochJob, planes, state, ledger,
+                   cd, cr, vd, vr, b: int):
+    """One mesh churn job's lifecycle boundary: every shard's plane
+    applies its own due ops to its own slice (registrations routed by
+    ``cid % n_shards``, per-shard SlotMaps), the counter plane's
+    cd/cr (fill 0) and held views (fill 1) ride each shard's
+    grow/evict/compact transforms as boundary extras, and the stacked
+    layout is forced back RECTANGULAR: one shard's grow-on-demand
+    doubling grows every sibling to the max capacity before the
+    restack."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import mesh as mesh_mod
+
+    S = job.n_shards
+    sts, leds, ctrs = [], [], []
+    for s in range(S):
+        st_s = mesh_mod.unstack_shard(state, s)
+        led_s = None if ledger is None else ledger[s]
+        extras = [(jnp.asarray(cd[s]), 0), (jnp.asarray(cr[s]), 0),
+                  (jnp.asarray(vd[s]), 1), (jnp.asarray(vr[s]), 1)]
+        st_s, led_s, extras = planes[s].boundary(
+            st_s, b, job.ckpt_every, ledger=led_s, extras=extras)
+        sts.append(st_s)
+        leds.append(led_s)
+        ctrs.append(extras)
+    cap = max(int(st.capacity) for st in sts)
+    for s in range(S):
+        out = planes[s].ensure_capacity(cap, sts[s], ledger=leds[s],
+                                        extras=ctrs[s])
+        sts[s], leds[s] = out[0], out[1]
+        ctrs[s] = out[-1]
+
+    def restack(parts):
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
+
+    state = restack(sts)
+    ledger = None if ledger is None else jnp.stack(leds)
+    cd, cr, vd, vr = (jnp.stack([ctrs[s][j][0] for s in range(S)])
+                      for j in range(4))
+    return state, ledger, cd, cr, vd, vr
+
+
 def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                  scr: _ScrapeCtl, base_cfg: dict, state, rng, met,
                  digest: bytes, start_epoch: int, decisions: int,
-                 ladder, tracer, hists, ledger, prov, resumed_from,
-                 slo_block=None, slo_plane=None, slo_eval=None,
-                 mesh_ctrs=None) -> SupervisedResult:
+                 ladder, tracer, hists, ledger, flight, prov,
+                 resumed_from, slo_block=None, slo_plane=None,
+                 slo_eval=None, mesh_ctrs=None,
+                 planes=None) -> SupervisedResult:
     """The mesh serving loop (docs/ENGINE.md "Mesh serving"):
     ``n_shards`` full per-device engines advance a whole
     checkpoint-boundary chunk of epochs inside ONE ``shard_map``
@@ -1499,16 +1688,27 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
     blocks merged in-graph through ``window_mesh_reduce`` into the
     ONE cluster-wide conformance table the SLO plane rolls.
 
+    ``job.fault_plan`` (docs/ROBUSTNESS.md "Degraded-mode mesh")
+    samples a deterministic ``FaultPlan`` over (epochs, n_shards) and
+    compiles each chunk's slice INTO the fused launch as traced fault
+    masks; a guard trip during a chaos chunk replays the same
+    schedule on the host robust loop (``mesh_chaos_fallbacks``).
+    ``planes`` (mesh churn) drives per-shard lifecycle boundaries at
+    the chunk grid with the counter plane riding each shard's
+    slot transforms; the chain digest hashes each shard's results
+    through that shard's canonical slot->cid view, so the S>1
+    dynamic==static gate holds.
+
     Crash-equivalence discipline: the chunk's raw draws are taken
     synchronously right before the launch and the checkpointed RNG
     state is the post-draw snapshot, so a resumed incarnation
     re-draws epochs >= the boundary bit-identically; the counter
     plane (per-shard completions + held views) rides the rotation
-    checkpoints as ``mesh_*`` leaves.  The per-epoch drain
-    bookkeeping (chain digest over the per-shard decision streams in
-    shard order, metric fold, ladder notes, injector kill points) is
-    the stream loop's, so at S=1 the two loops are bit-identical end
-    to end."""
+    checkpoints as ``mesh_*`` leaves, the fault plan is recomputed
+    from its spec (pure host data).  The per-epoch drain bookkeeping
+    (chain digest over the per-shard decision streams in shard order,
+    metric fold, ladder notes, injector kill points) is the stream
+    loop's, so at S=1 the two loops are bit-identical end to end."""
     import jax
     import jax.numpy as jnp
 
@@ -1516,6 +1716,7 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
     from ..obs import device as obsdev
     from ..obs import spans as _spans
     from ..parallel import mesh as mesh_mod
+    from .faults import parse_fault_spec, plan_chunk, plan_from_spec
     from .guarded import run_mesh_chunk_guarded
 
     n_dev = len(jax.devices())
@@ -1533,8 +1734,13 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
     # a checkpoint) gets its leaves split over the servers mesh axis
     state = jax.tree.map(lambda a: jax.device_put(a, sharding), state)
     cd, cr, vd, vr = mesh_ctrs
+    plan = None
+    if job.fault_plan is not None:
+        plan = plan_from_spec(parse_fault_spec(job.fault_plan),
+                              job.epochs, job.n_shards)
     mesh_fallbacks = 0
-    do_ingest = job.arrival_lam > 0
+    mesh_chaos_fallbacks = 0
+    do_ingest = job.arrival_lam > 0 or planes is not None
     slo_w0 = start_epoch
     # when the job's SLO plane is off, slo_block stays None and the
     # guarded runner builds its own throwaway window block per chunk
@@ -1545,11 +1751,34 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
         for e0, b in stream_mod.chunk_bounds(start_epoch, job.epochs,
                                              job.ckpt_every):
             scr.tick(e0, injector)
+            # mesh churn: every shard's lifecycle boundary applies
+            # BEFORE the chunk, on the chunk grid (the stream loop's
+            # discipline); the counter plane follows each shard's
+            # slot transforms as boundary extras
+            if planes is not None:
+                with _spans.span(tracer, "lifecycle.boundary",
+                                 "host_prep", epoch=e0):
+                    state, ledger, cd, cr, vd, vr = _mesh_boundary(
+                        job, planes, state, ledger, cd, cr, vd, vr,
+                        e0)
             counts = None
             if do_ingest:
                 with _spans.span(tracer, "mesh.pregen", "host_prep"):
-                    counts = _draw_counts_mesh(rng, job, b - e0)
+                    if planes is not None:
+                        # ONE id-space draw per epoch for the whole
+                        # cluster (identical RNG consumption in the
+                        # dynamic run and its static variant), mapped
+                        # onto each shard's POST-boundary slot layout
+                        raw = _draw_counts_churn(rng, job.churn,
+                                                 e0, b)
+                        counts = np.stack(
+                            [planes[s].map_counts(raw)
+                             for s in range(job.n_shards)])
+                    else:
+                        counts = _draw_counts_mesh(rng, job, b - e0)
             rng_ckpt = _rng_state_array(rng)
+            faults = plan_chunk(plan, e0, b) \
+                if plan is not None else None
             while True:
                 cfg = ladder.apply(base_cfg)
                 try:
@@ -1565,7 +1794,8 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                         ladder_levels=job.ladder_levels,
                         counter_sync_every=job.counter_sync_every,
                         hists=hists, ledger=ledger, slo=wblock,
-                        prov=prov, tracer=tracer)
+                        prov=prov, flight=flight, faults=faults,
+                        tracer=tracer)
                     break
                 except RECOVERABLE_ERRORS:
                     if not ladder.can_step(cfg):
@@ -1580,21 +1810,37 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                 ledger = g.ledger
             if job.with_prov:
                 prov = g.prov
+            if job.flight_records:
+                flight = g.flight
             if job.with_slo:
                 slo_block = g.slo
                 wblock = g.slo
             mesh_fallbacks += g.mesh_fallback
+            if plan is not None:
+                # a chaos chunk that degraded to the host robust loop
+                # -- the fallback carried the identical fault
+                # schedule, so the run stays on-plan, just slower
+                mesh_chaos_fallbacks += g.mesh_fallback
             # the drain: per-epoch bookkeeping in epoch order, the
             # stream loop's exact sequence; the chain digest hashes
             # every shard's decision stream in shard order per epoch
+            # (a churn job hashes each shard's CANONICAL slot->cid
+            # view through that shard's own plane)
             with _spans.span(tracer, "mesh.drain", "drain",
                              chunk=b - e0, shards=job.n_shards):
                 for i in range(b - e0):
                     epoch = e0 + i
                     scr.tick(epoch, injector)
                     decisions += g.counts[i]
-                    digest = _digest_update(digest, g.epochs[i])
-                    for r in g.epochs[i]:
+                    if planes is not None:
+                        flat = tuple(
+                            r for s, grp in enumerate(g.epochs[i])
+                            for r in planes[s].canon_results(grp))
+                    else:
+                        flat = tuple(r for grp in g.epochs[i]
+                                     for r in grp)
+                    digest = _digest_update(digest, flat)
+                    for r in flat:
                         if hasattr(r, "metrics") and \
                                 r.metrics is not None:
                             met = obsdev.metrics_combine_np(
@@ -1629,6 +1875,7 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                                        digest, b, decisions,
                                        ladder.encode(), hists=hists,
                                        ledger=ledger, prov=prov,
+                                       flight=flight, plane=planes,
                                        mesh=(cd, cr, vd, vr),
                                        slo=None if slo_plane is None
                                        else (slo_block, slo_plane,
@@ -1649,6 +1896,18 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                 _slo_log_flush(slo_plane, job.slo_log, closed)
                 if tracer is not None:
                     tracer.drain_jsonl(job.span_log)
+    except BaseException:
+        # the crash hook, as in the round/stream loops: best-effort
+        # per-shard flight dump (shard column added), NO span flush
+        if job.flight_dump and flight is not None:
+            try:
+                from ..obs import flight as obsflight
+                n = obsflight.flight_dump_any(flight, job.flight_dump)
+                print(f"# supervisor: dumped {n} flight records to "
+                      f"{job.flight_dump}", file=sys.stderr)
+            except Exception:
+                pass
+        raise
     finally:
         scr.close()
 
@@ -1656,10 +1915,11 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
         tracer.drain_jsonl(job.span_log)
     return _build_result(job, state, digest, decisions, met, ladder,
                          scr.rebinds, resumed_from, hists, ledger,
-                         None, 0, None, slo_block, slo_plane,
+                         flight, 0, planes, slo_block, slo_plane,
                          slo_eval, prov,
                          mesh=(cd, cr, vd, vr),
-                         mesh_fallbacks=mesh_fallbacks)
+                         mesh_fallbacks=mesh_fallbacks,
+                         mesh_chaos_fallbacks=mesh_chaos_fallbacks)
 
 
 def _healthz_ok(scrape, timeout_s: float = 2.0) -> bool:
@@ -1824,7 +2084,8 @@ def _spawn_once(job: EpochJob, workdir: str,
         prov_last_served=arr("prov_last_served"),
         mesh_counters=arr("mesh_counters"),
         mesh_views=arr("mesh_views"),
-        mesh_fallbacks=int(obj.get("mesh_fallbacks", 0)))
+        mesh_fallbacks=int(obj.get("mesh_fallbacks", 0)),
+        mesh_chaos_fallbacks=int(obj.get("mesh_chaos_fallbacks", 0)))
 
 
 def _child_main(workdir: str) -> int:
@@ -1876,7 +2137,9 @@ def _child_main(workdir: str) -> int:
                        lst(result.prov_last_served),
                    "mesh_counters": lst(result.mesh_counters),
                    "mesh_views": lst(result.mesh_views),
-                   "mesh_fallbacks": result.mesh_fallbacks}, fh)
+                   "mesh_fallbacks": result.mesh_fallbacks,
+                   "mesh_chaos_fallbacks":
+                       result.mesh_chaos_fallbacks}, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, res_path)

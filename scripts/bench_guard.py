@@ -227,6 +227,8 @@ def main() -> int:
                 if wl in r.get("workloads", {})
                 and key in r["workloads"][wl]
                 and not r["workloads"][wl].get("capacity_skipped")
+                and r["workloads"][wl].get("fault_plan",
+                                           "none") == "none"
                 and r["workloads"][wl].get("select_impl",
                                            "sort") == impl
                 and r["workloads"][wl].get("calendar_impl",
@@ -305,6 +307,19 @@ def main() -> int:
             tag += f"[S={shards},K={sync},N={pop}]"
         if not provon:
             tag += "[prov-off]"
+        # a fault-bearing WORKLOAD ROW (bench.py --mode mesh
+        # --fault-plan <spec>): its rates reflect injected dropouts
+        # and skew, not the engine -- the record-level is_chaos()
+        # exclusion extended to the mesh series identity, so a chaos
+        # mesh row in an otherwise clean record neither seeds nor is
+        # judged against the clean medians
+        if row.get("fault_plan", "none") != "none":
+            print(f"bench_guard: {tag}: chaos (fault-injection) row "
+                  f"(fault_plan {row.get('fault_plan')!r}, "
+                  f"dropouts {row.get('fault_dropouts_per_shard')}) "
+                  "-- recorded for the trajectory, not judged "
+                  "against clean-run medians")
+            continue
         hist = series(wl, "dps", impl, cal, loop, scen, pop, provon, shards, sync)
         if len(hist) < args.min_records:
             print(f"bench_guard: {tag}: {dps/1e6:.1f}M "

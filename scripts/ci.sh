@@ -971,6 +971,149 @@ print(f"mesh smoke: S=1 bit-identical to stream "
       f"decisions, every completion accounted")
 EOF
 
+echo "== mesh chaos smoke (fault plane inside the fused chunk; degraded-mode serving) =="
+# the degraded-mode mesh (docs/ROBUSTNESS.md "Degraded-mode mesh"), on
+# an 8-device forced host mesh: (1) a CHAOS-CAPABLE chunk under an
+# all-benign plan must be BIT-IDENTICAL to the plain mesh chunk
+# (decisions, counters, views, state digest); (2) a seeded
+# dropout+restart chunk must equal the host robust loop
+# (mesh_chunk_host_replay) decision-for-decision and
+# counter-view-for-counter-view, with the fault metric rows equal to
+# the plan_events oracle EXACTLY; (3) the cluster-model chaos rounds
+# (run_mesh_rounds_with_plan) must equal the host robust_cluster_step
+# loop at K in {1,2,4}; (4) EpochJob(engine_loop="mesh", churn=...)
+# at S>1 must pass the dynamic==static canonical-digest gate.
+timeout -k 30 1200 python - <<'EOF'
+import jax, os
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=8")
+jax.config.update("jax_enable_x64", True)
+import dataclasses, hashlib
+import numpy as np
+import jax.numpy as jnp
+from dmclock_tpu.core import ClientInfo
+from dmclock_tpu.obs import device as obsdev
+from dmclock_tpu.parallel import cluster as CL, mesh as M
+from dmclock_tpu.robust import cluster as RC, faults as F
+from dmclock_tpu.robust import supervisor as SV
+from dmclock_tpu.robust.guarded import (mesh_chunk_host_replay,
+                                        run_mesh_chunk_guarded)
+from dmclock_tpu.lifecycle import churn as churn_mod
+
+S, E, N = 8, 6, 48
+job = SV.EpochJob(engine="prefix", k=16, n=N, depth=6, ring=10,
+                  epochs=E, m=2, seed=5, arrival_lam=1.0, waves=2,
+                  ckpt_every=E, engine_loop="mesh", n_shards=S)
+mesh = M.make_mesh(S)
+state = M.stack_shards(
+    SV._job_state(dataclasses.replace(job, engine_loop="stream")),
+    S, mesh)
+cd, cr, vd, vr = M.counter_init(S, N)
+rng = np.random.Generator(np.random.PCG64(9))
+counts = rng.poisson(1.0, (S, E, N)).astype(np.int32)
+kw = dict(engine="prefix", epochs=E, m=2, k=16,
+          dt_epoch_ns=job.dt_epoch_ns, waves=2, with_metrics=True,
+          counter_sync_every=2)
+
+def digest_of(g):
+    d = b"\x00" * 32
+    for i in range(E):
+        d = SV._digest_update(
+            d, tuple(r for grp in g.epochs[i] for r in grp))
+    return hashlib.sha256(d).hexdigest()
+
+# (1) zero-fault chaos-capable chunk == plain chunk, bit-identical
+plain = run_mesh_chunk_guarded(state, cd, cr, vd, vr, 0, counts,
+                               mesh=mesh, **kw)
+zero = run_mesh_chunk_guarded(state, cd, cr, vd, vr, 0, counts,
+                              mesh=mesh,
+                              faults=F.plan_chunk(F.zero_plan(E, S),
+                                                  0, E), **kw)
+assert digest_of(plain) == digest_of(zero), "zero-fault digest"
+for f in ("cd", "cr", "view_d", "view_r"):
+    assert np.array_equal(np.asarray(jax.device_get(getattr(plain, f))),
+                          np.asarray(jax.device_get(getattr(zero, f)))), f
+assert SV._tree_digest(plain.state) == SV._tree_digest(zero.state)
+print(f"mesh chaos smoke: zero-fault chaos chunk bit-identical "
+      f"({digest_of(plain)[:16]})")
+
+# (2) seeded dropout+restart chunk == host robust loop + exact counters
+plan = F.sample_plan(11, E, S, p_dropout=0.3, mean_outage_steps=2.0,
+                     p_delay=0.2, p_dup=0.2, max_skew_ns=1000)
+ev = F.plan_events(plan)
+assert ev["server_dropouts"] > 0 and ev["tracker_resyncs"] > 0, ev
+fc = F.plan_chunk(plan, 0, E)
+fused = run_mesh_chunk_guarded(state, cd, cr, vd, vr, 0, counts,
+                               mesh=mesh, faults=fc, **kw)
+host = mesh_chunk_host_replay(state, cd, cr, vd, vr, 0, counts,
+                              faults=fc, **kw)
+assert fused.mesh_fallback == 0 and host.mesh_fallback == 1
+assert digest_of(fused) == digest_of(host), "chaos digest diverged"
+for f in ("cd", "cr", "view_d", "view_r"):
+    assert np.array_equal(np.asarray(jax.device_get(getattr(fused, f))),
+                          np.asarray(jax.device_get(getattr(host, f)))), f
+met = np.zeros(obsdev.NUM_METRICS, np.int64)
+for i in range(E):
+    for grp in fused.epochs[i]:
+        for r in grp:
+            met = obsdev.metrics_combine_np(met,
+                                            jax.device_get(r.metrics))
+md = obsdev.metrics_dict(met)
+for key in ("server_dropouts", "tracker_resyncs", "faults_injected"):
+    assert md[key] == ev[key], (key, md[key], ev[key])
+print(f"mesh chaos smoke: seeded chunk == host robust loop "
+      f"(plan {F.describe(plan)}; fault counters exact)")
+
+# (3) cluster-model chaos rounds == host loop at K in {1, 2, 4}
+C = 10
+infos = [ClientInfo(10.0, 1.0 + (c % 3), 0.0) for c in range(C)]
+def fresh():
+    cl = CL.init_cluster(S, C)
+    cl = CL.install_clients(
+        cl,
+        jnp.asarray([i.reservation_inv_ns for i in infos], jnp.int64),
+        jnp.asarray([i.weight_inv_ns for i in infos], jnp.int64),
+        jnp.asarray([i.limit_inv_ns for i in infos], jnp.int64))
+    return RC.shard_robust(RC.init_robust(CL.shard_cluster(cl, mesh)),
+                           mesh)
+arrivals = rng.integers(0, 3, size=(E, S, C)).astype(np.int32)
+cplan = F.sample_plan(13, E, S, p_dropout=0.3, p_delay=0.2,
+                      p_dup=0.2, max_skew_ns=500)
+for K in (1, 2, 4):
+    rc_h, seq = RC.run_with_plan(fresh(), arrivals, 1, mesh,
+                                 RC.effective_plan(cplan, K),
+                                 decisions_per_step=16,
+                                 max_arrivals=2, advance_ns=10 ** 8)
+    rc_m, decs = RC.run_mesh_rounds_with_plan(
+        fresh(), arrivals, 1, mesh, cplan, decisions_per_step=16,
+        max_arrivals=2, advance_ns=10 ** 8, counter_sync_every=K)
+    assert RC.decision_digest(CL.mesh_decs_seq(decs)) == \
+        RC.decision_digest(seq), f"K={K} cluster chaos digest"
+    assert np.array_equal(np.asarray(rc_m.metrics),
+                          np.asarray(rc_h.metrics)), f"K={K} metrics"
+print("mesh chaos smoke: cluster-model chaos rounds == host loop "
+      "at K in {1,2,4}")
+
+# (4) S>1 churn: dynamic == static canonical digest
+spec = churn_mod.make_spec("churn_storm", total_ids=32, seed=3)
+base = dict(engine="prefix", k=16, n=N, depth=6, ring=10, epochs=8,
+            m=2, seed=5, arrival_lam=1.0, waves=2, ckpt_every=2,
+            engine_loop="mesh", n_shards=4)
+dyn = SV.run_job(SV.EpochJob(churn=spec, **base))
+st = SV.run_job(SV.EpochJob(churn=churn_mod.static_variant(spec),
+                            **base))
+assert dyn.digest == st.digest, "S=4 churn dynamic != static"
+assert dyn.lifecycle["registrations"] > 0
+print(f"mesh chaos smoke: S=4 churn dynamic == static canonical "
+      f"digest ({dyn.digest[:16]}; "
+      f"{dyn.lifecycle['registrations']} registrations, "
+      f"{dyn.lifecycle['grows']} grows)")
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

@@ -56,11 +56,17 @@ def load_multichip(path: str) -> dict:
     if out["schema"] >= 2 and out["mesh"] is not None:
         m = out["mesh"]
         # normalized view of the trajectory scalars (reader contract:
-        # these keys exist whenever a v2 mesh block does)
+        # these keys exist whenever a v2 mesh block does).  The chaos
+        # fields joined in PR-15; pre-chaos v2 records normalize to a
+        # clean run -- schema v2 stays backward-compatible
         m.setdefault("dps", 0.0)
         m.setdefault("n_shards", out["n_devices"])
         m.setdefault("counter_sync_every", 1)
         m.setdefault("counter_bytes_per_epoch", 0)
+        m.setdefault("fault_plan", "none")
+        m.setdefault("fault_dropouts_per_shard", [])
+        m.setdefault("fault_resyncs_per_shard", [])
+        m.setdefault("faults_injected_total", 0)
     return out
 
 
@@ -78,15 +84,19 @@ def _dryrun(n_devices: int):
     return proc.returncode, tail
 
 
-def _mesh_trajectory(n_devices: int, clients: int, sync: int):
+def _mesh_trajectory(n_devices: int, clients: int, sync: int,
+                     fault_plan: str = "none"):
     """The v2 mesh block: one ``bench.py --mode mesh`` run on a
     forced host mesh; the bench JSON line carries the full row
-    (aggregate + per-shard dec/s, counter-exchange accounting)."""
+    (aggregate + per-shard dec/s, counter-exchange accounting, and --
+    when ``fault_plan`` is a parseable spec -- the chaos counters:
+    plan tag + per-shard dropout/resync counts)."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"),
          "--mode", "mesh", "--clients", str(clients),
          "--n-shards", str(n_devices),
-         "--counter-sync-every", str(sync)],
+         "--counter-sync-every", str(sync),
+         "--fault-plan", fault_plan],
         cwd=REPO, capture_output=True, text=True,
         env=dict(os.environ, JAX_PLATFORMS="cpu"))
     for line in reversed((proc.stdout or "").splitlines()):
@@ -107,6 +117,11 @@ def main() -> int:
     ap.add_argument("--n-devices", type=int, default=8)
     ap.add_argument("--clients", type=int, default=100_000)
     ap.add_argument("--counter-sync-every", type=int, default=1)
+    ap.add_argument("--fault-plan", default="none",
+                    help="forwarded to the bench mesh run: a "
+                    "parseable spec makes the recorded trajectory a "
+                    "CHAOS session (mesh block carries fault_plan + "
+                    "per-shard dropout/resync counts)")
     args, extra = ap.parse_known_args()
 
     env = dict(os.environ, DMCLOCK_FULLSCALE="1")
@@ -119,7 +134,8 @@ def main() -> int:
     if args.record:
         d_rc, tail = _dryrun(args.n_devices)
         m_rc, mesh = _mesh_trajectory(args.n_devices, args.clients,
-                                      args.counter_sync_every)
+                                      args.counter_sync_every,
+                                      args.fault_plan)
         record = {
             "schema": MULTICHIP_SCHEMA,
             "n_devices": args.n_devices,

@@ -745,3 +745,60 @@ def test_mesh_client_population_splits_the_series(monkeypatch,
     assert rc == 0
     assert "mesh[S=8,K=1,N=1000000]" in out
     assert "not judged" in out
+
+
+# -- chaos (fault-bearing) mesh rows (bench.py --fault-plan <spec>) ---
+
+def _chaos_mesh_row(dps, **over):
+    row = _mesh_row(dps)
+    row.update({"fault_plan": "T32xS8:drop12+resync11+inject138",
+                "fault_dropouts_per_shard": [2] * 8,
+                "fault_resyncs_per_shard": [1] * 8}, **over)
+    return row
+
+
+def test_chaos_mesh_row_not_judged(monkeypatch, capsys, tmp_path):
+    # the newest row bears a fault plan: its rate reflects injected
+    # dropouts, not the engine -- announced, never judged, rc 0 even
+    # though the rate cratered
+    hist = write_history_mesh(tmp_path, [
+        _mesh_row(80e6), _mesh_row(90e6), _chaos_mesh_row(5e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "chaos (fault-injection) row" in out
+    assert "REGRESSION" not in out
+
+
+def test_chaos_mesh_rows_excluded_from_medians(monkeypatch, capsys,
+                                               tmp_path):
+    # two prior chaos rows at 1/10th the clean rate must not drag the
+    # clean median under the newest clean row's floor
+    hist = write_history_mesh(tmp_path, [
+        _chaos_mesh_row(8e6), _chaos_mesh_row(9e6),
+        _mesh_row(80e6), _mesh_row(90e6), _mesh_row(84e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "REGRESSION" not in out
+    assert "vs median 85.0M over 2 sessions" in out
+
+
+def test_chaos_mesh_medians_unpolluted_upward(monkeypatch, capsys,
+                                              tmp_path):
+    # the mirror direction: a chaos row at 10x must not RAISE the
+    # clean median and fail an honest clean session
+    hist = write_history_mesh(tmp_path, [
+        _chaos_mesh_row(900e6), _chaos_mesh_row(950e6),
+        _mesh_row(80e6), _mesh_row(90e6), _mesh_row(84e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "REGRESSION" not in out
+
+
+def test_chaos_row_prints_dropout_accounting(monkeypatch, capsys,
+                                             tmp_path):
+    hist = write_history_mesh(tmp_path, [
+        _mesh_row(80e6), _mesh_row(90e6), _chaos_mesh_row(40e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "fault_plan 'T32xS8:drop12+resync11+inject138'" in out
+    assert "dropouts [2, 2, 2, 2, 2, 2, 2, 2]" in out

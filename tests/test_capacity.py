@@ -482,6 +482,8 @@ class TestDocDrift:
                                   log=lambda _l: None)
         obsdev.publish(reg, np.zeros(obsdev.NUM_METRICS,
                                      dtype=np.int64))
+        obsdev.publish_shard_faults(
+            reg, np.zeros((2, 3), dtype=np.int64))
         obshist.publish_hists(reg, obshist.hist_zero())
         obshist.publish_ledger(reg, np.zeros((4, obshist.LED_COLS),
                                              dtype=np.int64))

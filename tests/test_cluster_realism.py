@@ -387,3 +387,72 @@ def test_cluster_parity_fullscale(mesh8, tracker_kind):
     run_parity(mesh8, n_servers=8, n_clients=1000, rounds=10, k=1100,
                max_arr=1, tracker_kind=tracker_kind, seed=53,
                cost_of=lambda c: 1 + (c % 3))
+
+
+@pytest.mark.parametrize("counter_sync_every,tracker_kind", [
+    (1, "orig"),
+    (2, "orig"),
+    pytest.param(4, "orig", marks=pytest.mark.slow),
+    pytest.param(1, "borrowing", marks=pytest.mark.slow),
+    pytest.param(2, "borrowing", marks=pytest.mark.slow),
+    pytest.param(4, "borrowing", marks=pytest.mark.slow),
+])
+def test_chaos_mesh_rounds_match_host_loop(mesh8, counter_sync_every,
+                                           tracker_kind):
+    """The degraded-mode mesh's cluster digest gate (ISSUE-15): ONE
+    fused launch of E whole rounds under a SEEDED FaultPlan
+    (dropout/restart Markov chains, delayed views, duplicated
+    completions, clock skew -- all riding the scan as traced masks)
+    must equal E host-driven robust_cluster_steps under the same plan
+    with the K staleness grid folded into the delay mask
+    (robust.cluster.effective_plan): decisions, held views, tracker
+    state, metric vectors -- and the K=1 fault rows equal the
+    plan_events oracle."""
+    from dmclock_tpu.obs import device as obsdev
+    from dmclock_tpu.robust import cluster as RC
+    from dmclock_tpu.robust import faults as F
+
+    n_servers, n_clients, rounds, k, adv = 8, 10, 6, 16, 10 ** 8
+    K = counter_sync_every
+    rng = np.random.Generator(np.random.PCG64(7))
+    arrivals = rng.integers(
+        0, 3, size=(rounds, n_servers, n_clients)).astype(np.int32)
+    plan = F.sample_plan(13, rounds, n_servers, p_dropout=0.3,
+                         mean_outage_steps=2.0, p_delay=0.2,
+                         p_dup=0.2, max_skew_ns=500)
+    assert F.plan_events(plan)["server_dropouts"] > 0, \
+        "seed must actually drop a server or the gate is vacuous"
+
+    rc_h = RC.shard_robust(RC.init_robust(
+        _mesh_gate_cluster(mesh8, n_servers, n_clients,
+                           tracker_kind)), mesh8)
+    rc_h, decs_seq = RC.run_with_plan(
+        rc_h, arrivals, 1, mesh8, plan=RC.effective_plan(plan, K),
+        decisions_per_step=k, max_arrivals=2, advance_ns=adv)
+
+    rc_m = RC.shard_robust(RC.init_robust(
+        _mesh_gate_cluster(mesh8, n_servers, n_clients,
+                           tracker_kind)), mesh8)
+    rc_m, decs = RC.run_mesh_rounds_with_plan(
+        rc_m, arrivals, 1, mesh8, plan, decisions_per_step=k,
+        max_arrivals=2, advance_ns=adv, counter_sync_every=K)
+
+    assert RC.decision_digest(CL.mesh_decs_seq(decs)) == \
+        RC.decision_digest(decs_seq), "chaos decision stream diverged"
+    assert np.array_equal(np.asarray(rc_m.view_delta),
+                          np.asarray(rc_h.view_delta)), "held views"
+    assert np.array_equal(np.asarray(rc_m.view_rho),
+                          np.asarray(rc_h.view_rho))
+    for a, b in zip(jax.tree.leaves(rc_m.cluster.tracker),
+                    jax.tree.leaves(rc_h.cluster.tracker)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "tracker counters diverged under chaos"
+    assert np.array_equal(np.asarray(rc_m.metrics),
+                          np.asarray(rc_h.metrics)), \
+        "fault metric rows diverged"
+    if K == 1:
+        totals = RC.metrics_totals(rc_m)
+        ev = F.plan_events(plan)
+        for key in ("server_dropouts", "tracker_resyncs",
+                    "faults_injected"):
+            assert totals[key] == ev[key], (key, totals[key], ev)

@@ -122,14 +122,40 @@ class TestMeshIdentityGate:
             arrival_lam=0.0))
         assert_core_equal(m, r)
 
-    def test_mesh_rejects_churn_and_flight(self):
+    def test_mesh_composition_rejections(self):
+        """What mesh still rejects up front (each with a reasoned
+        message): churn+slo (slot-indexed merge), churn+fault_plan
+        (dead-shard boundary semantics), fault_plan off-mesh, and an
+        unparseable fault spec.  Plain churn and flight_records now
+        COMPOSE (TestMeshChurn / TestMeshFlight)."""
         from dmclock_tpu.lifecycle import churn as churn_mod
 
         spec = churn_mod.make_spec("flash_crowd", total_ids=32)
-        with pytest.raises(ValueError, match="churn"):
-            SV.run_job(mesh_job("prefix-sort", churn=spec))
-        with pytest.raises(ValueError, match="flight"):
-            SV.run_job(mesh_job("prefix-sort", flight_records=8))
+        with pytest.raises(ValueError, match="with_slo"):
+            SV.run_job(mesh_job("prefix-sort", churn=spec,
+                                with_slo=True))
+        with pytest.raises(ValueError, match="fault_plan"):
+            SV.run_job(mesh_job("prefix-sort", churn=spec,
+                                fault_plan={"seed": 1}))
+        with pytest.raises(ValueError, match="mesh"):
+            SV.run_job(dataclasses.replace(
+                JOBS["prefix-sort"], engine_loop="stream",
+                fault_plan={"seed": 1}))
+        with pytest.raises(ValueError, match="spec"):
+            SV.run_job(mesh_job("prefix-sort",
+                                fault_plan={"bogus_key": 1}))
+        # a plain LABEL cannot seed a plan -- rejected, not silently
+        # run benign; the bench's spec-STRING form is accepted
+        with pytest.raises(ValueError, match="did not parse"):
+            SV.run_job(mesh_job("prefix-sort",
+                                fault_plan="chaos-label"))
+        # a shard_skew spec built for a different shard count would
+        # silently smear the melt across shards -- rejected
+        skew = churn_mod.make_spec("shard_skew", total_ids=32,
+                                   n_shards=4)
+        with pytest.raises(ValueError, match="shard_skew"):
+            SV.run_job(mesh_job("prefix-sort", n_shards=2,
+                                churn=skew))
 
     def test_mesh_rejects_oversubscribed_shards(self):
         with pytest.raises(ValueError, match="devices"):
@@ -503,3 +529,390 @@ class TestMultichipRecordV2:
         assert rec["mesh"]["n_shards"] == 4
         assert rec["mesh"]["counter_sync_every"] == 1
         assert rec["mesh"]["counter_bytes_per_epoch"] == 0
+        # pre-chaos v2 records normalize to a clean run (backward
+        # compatibility of the PR-15 chaos fields)
+        assert rec["mesh"]["fault_plan"] == "none"
+        assert rec["mesh"]["fault_dropouts_per_shard"] == []
+        assert rec["mesh"]["faults_injected_total"] == 0
+
+    def test_v2_chaos_fields_round_trip(self, tmp_path):
+        import json as _json
+
+        mod = self._load_reader()
+        p = tmp_path / "r.json"
+        p.write_text(_json.dumps({
+            "schema": 2, "n_devices": 8, "rc": 0, "ok": True,
+            "tail": "", "mesh": {
+                "dps": 1e6, "n_shards": 8,
+                "fault_plan": "T32xS8:drop12+resync11+inject138",
+                "fault_dropouts_per_shard": [2] * 8,
+                "fault_resyncs_per_shard": [1] * 8,
+                "faults_injected_total": 138}}))
+        rec = mod.load_multichip(str(p))
+        assert rec["mesh"]["fault_plan"].startswith("T32xS8")
+        assert sum(rec["mesh"]["fault_dropouts_per_shard"]) == 16
+        assert rec["mesh"]["faults_injected_total"] == 138
+
+
+# ----------------------------------------------------------------------
+# degraded-mode mesh serving (ISSUE-15; docs/ROBUSTNESS.md
+# "Degraded-mode mesh")
+# ----------------------------------------------------------------------
+
+CHAOS_SPEC = {"seed": 11, "p_dropout": 0.3, "mean_outage_steps": 2.0,
+              "p_delay": 0.2, "p_dup": 0.2, "max_skew_ns": 1000}
+
+
+def _chaos_chunk_pair(name: str, K: int, *, S: int = 4, E: int = 6,
+                      seed: int = 11):
+    """Run ONE seeded chaos chunk fused (run_mesh_chunk_guarded) and
+    on the host robust loop (mesh_chunk_host_replay) from identical
+    inputs; returns (fused, host, plan, job)."""
+    from dmclock_tpu.robust import faults as F
+    from dmclock_tpu.robust.guarded import (mesh_chunk_host_replay,
+                                            run_mesh_chunk_guarded)
+
+    job = mesh_job(name, n_shards=S, epochs=E, ckpt_every=E,
+                   counter_sync_every=K)
+    plan = F.sample_plan(seed, E, S, p_dropout=0.3,
+                         mean_outage_steps=2.0, p_delay=0.2,
+                         p_dup=0.2, max_skew_ns=1000)
+    mesh = M.make_mesh(S)
+    state = M.stack_shards(
+        SV._job_state(dataclasses.replace(job, engine_loop="stream")),
+        S, mesh)
+    cd, cr, vd, vr = M.counter_init(S, job.n)
+    rng = np.random.Generator(np.random.PCG64(9))
+    counts = rng.poisson(1.0, (S, E, job.n)).astype(np.int32)
+    fc = F.plan_chunk(plan, 0, E)
+    kw = dict(engine=job.engine, epochs=E, m=job.m, k=job.k,
+              chain_depth=job.chain_depth,
+              dt_epoch_ns=job.dt_epoch_ns, waves=job.waves,
+              with_metrics=True, select_impl=job.select_impl,
+              calendar_impl=job.calendar_impl,
+              ladder_levels=job.ladder_levels, counter_sync_every=K)
+    fused = run_mesh_chunk_guarded(state, cd, cr, vd, vr, 0, counts,
+                                   mesh=mesh, faults=fc, **kw)
+    host = mesh_chunk_host_replay(state, cd, cr, vd, vr, 0, counts,
+                                  faults=fc, **kw)
+    return fused, host, plan, job
+
+
+def _rows_digest(g, epochs: int) -> str:
+    import hashlib
+
+    d = b"\x00" * 32
+    for i in range(epochs):
+        flat = tuple(r for grp in g.epochs[i] for r in grp)
+        d = SV._digest_update(d, flat)
+    return hashlib.sha256(d).hexdigest()
+
+
+def _fold_rows_metrics(g, epochs: int) -> np.ndarray:
+    met = np.zeros(obsdev.NUM_METRICS, dtype=np.int64)
+    for i in range(epochs):
+        for grp in g.epochs[i]:
+            for r in grp:
+                met = obsdev.metrics_combine_np(
+                    met, jax.device_get(r.metrics))
+    return met
+
+
+class TestMeshChaos:
+    """The fault plane INSIDE the fused chunk: a seeded chaos mesh
+    chunk must be decision-for-decision, counter-view-for-counter-
+    view, and fault-counter-row identical to the host robust loop
+    under the same plan -- and an all-benign plan bit-identical to no
+    fault plumbing at all."""
+
+    def test_zero_fault_chaos_job_bit_identical(self):
+        plain = SV.run_job(mesh_job("prefix-sort", n_shards=2))
+        zero = SV.run_job(mesh_job("prefix-sort", n_shards=2,
+                                   fault_plan={"seed": 3}))
+        assert_core_equal(zero, plain)
+        assert zero.mesh_fallbacks == 0
+        assert zero.mesh_chaos_fallbacks == 0
+
+    # one engine stays in the quick sweep; the full engine x K matrix
+    # runs slow-marked (scripts/run_tests.sh + ci.sh mesh chaos smoke)
+    @pytest.mark.parametrize("name,K", [
+        ("prefix-sort", 2),
+        pytest.param("chain", 1, marks=pytest.mark.slow),
+        pytest.param("chain", 4, marks=pytest.mark.slow),
+        pytest.param("calendar-minstop", 4,
+                     marks=pytest.mark.slow),
+        pytest.param("calendar-minstop", 1,
+                     marks=pytest.mark.slow),
+        pytest.param("prefix-sort", 1, marks=pytest.mark.slow),
+        pytest.param("prefix-sort", 4, marks=pytest.mark.slow),
+        pytest.param("prefix-radix", 2, marks=pytest.mark.slow),
+        pytest.param("calendar-bucketed", 2,
+                     marks=pytest.mark.slow),
+    ])
+    def test_chaos_chunk_equals_host_replay(self, name, K):
+        """THE tentpole gate: fused seeded-chaos chunk == E
+        host-driven robust steps (digest + counters + views + metric
+        fold), at the staleness cadence K."""
+        from dmclock_tpu.robust import faults as F
+
+        fused, host, plan, job = _chaos_chunk_pair(name, K)
+        E = 6
+        assert fused.mesh_fallback == 0, \
+            "gate must compare the FUSED path, not its own fallback"
+        assert host.mesh_fallback == 1
+        assert _rows_digest(fused, E) == _rows_digest(host, E)
+        for f in ("cd", "cr", "view_d", "view_r"):
+            assert np.array_equal(
+                np.asarray(jax.device_get(getattr(fused, f))),
+                np.asarray(jax.device_get(getattr(host, f)))), f
+        assert fused.counts == host.counts
+        mf = _fold_rows_metrics(fused, E)
+        assert np.array_equal(mf, _fold_rows_metrics(host, E))
+        ev = F.plan_events(plan)
+        md = obsdev.metrics_dict(mf)
+        for key in ("server_dropouts", "tracker_resyncs",
+                    "faults_injected"):
+            assert md[key] == ev[key], (key, md[key], ev[key])
+
+    def test_supervised_chaos_counters_match_oracle(self):
+        """Supervisor-level: a chaos mesh job's metric totals carry
+        the plan oracle's fault rows exactly, and per-shard counts
+        are recoverable from the oracle."""
+        from dmclock_tpu.robust import faults as F
+
+        job = mesh_job("prefix-sort", n_shards=4,
+                       fault_plan=CHAOS_SPEC)
+        r = SV.run_job(job)
+        plan = F.plan_from_spec(F.parse_fault_spec(dict(CHAOS_SPEC)),
+                                job.epochs, 4)
+        ev = F.plan_events(plan)
+        md = obsdev.metrics_dict(r.metrics)
+        for key in ("server_dropouts", "tracker_resyncs",
+                    "faults_injected"):
+            assert md[key] == ev[key]
+        per = F.plan_shard_events(plan)
+        assert per["server_dropouts"].sum() == ev["server_dropouts"]
+        assert per["faults_injected"].sum() == ev["faults_injected"]
+        # chaos serves fewer decisions than the clean twin (shards
+        # were down), but never zero -- degraded, not dead
+        clean = SV.run_job(mesh_job("prefix-sort", n_shards=4))
+        assert 0 < r.decisions < clean.decisions
+
+    def test_chaos_fallback_replays_on_host_loop(self):
+        """A guard trip DURING a chaos chunk (tag32 window blown)
+        discards it and replays the identical fault schedule on the
+        host robust loop -- counted as mesh_chaos_fallbacks, and
+        deterministic (two runs agree on everything)."""
+        trip = dict(tag_width=32, tag_spread_ns=1 << 33,
+                    fault_plan=CHAOS_SPEC)
+        a = SV.run_job(mesh_job("prefix-sort", n_shards=2, **trip))
+        b = SV.run_job(mesh_job("prefix-sort", n_shards=2, **trip))
+        assert a.mesh_chaos_fallbacks > 0
+        assert a.mesh_chaos_fallbacks == a.mesh_fallbacks
+        assert_core_equal(a, b)
+        assert np.array_equal(a.mesh_counters, b.mesh_counters)
+
+    def test_publish_shard_faults_labels(self):
+        from dmclock_tpu.obs.registry import MetricsRegistry
+        from dmclock_tpu.robust import faults as F
+
+        plan = F.sample_plan(5, 12, 3, p_dropout=0.4, p_dup=0.3)
+        per = F.plan_shard_events(plan)
+        mat = np.stack([per["server_dropouts"],
+                        per["tracker_resyncs"],
+                        per["faults_injected"]], axis=1)
+        reg = MetricsRegistry()
+        obsdev.publish_shard_faults(reg, mat)
+        text = reg.prometheus()
+        total = int(per["server_dropouts"].sum())
+        assert (f'dmclock_fault_server_dropouts_total'
+                f'{{shard="all"}} {total}') in text
+        assert 'dmclock_fault_injected_total{shard="0"}' in text
+
+
+class TestMeshChaosCrashEquivalence:
+    """SIGKILL mid-chaos-mesh-chunk (and mid-churn-mesh-chunk): the
+    crash-equivalence matrix over kill points x {chaos, churn} x
+    engines, with a slow spawn-mode REAL SIGKILL."""
+
+    def _chaos_job(self, name, **over):
+        over.setdefault("n_shards", 4)
+        return mesh_job(name, fault_plan=CHAOS_SPEC, **over)
+
+    def _churn_job(self, name, **over):
+        from dmclock_tpu.lifecycle import churn as churn_mod
+
+        spec = churn_mod.make_spec("churn_storm", total_ids=32,
+                                   seed=3)
+        return mesh_job(name, n_shards=4, churn=spec, epochs=8,
+                        **over)
+
+    @pytest.mark.parametrize("mode,name,frac", [
+        ("chaos", "prefix-sort", 0.35),
+        ("churn", "prefix-sort", 0.6),
+        pytest.param("chaos", "prefix-sort", 0.75,
+                     marks=pytest.mark.slow),
+        pytest.param("chaos", "chain", 0.5,
+                     marks=pytest.mark.slow),
+        pytest.param("chaos", "calendar-minstop", 0.5,
+                     marks=pytest.mark.slow),
+        pytest.param("churn", "chain", 0.35,
+                     marks=pytest.mark.slow),
+        pytest.param("churn", "calendar-minstop", 0.75,
+                     marks=pytest.mark.slow),
+    ])
+    def test_sigkill_matrix(self, tmp_path, mode, name, frac):
+        job = self._chaos_job(name) if mode == "chaos" \
+            else self._churn_job(name)
+        ref = SV.run_job(job)
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(int(ref.decisions * frac), 1),))
+        sup = SV.run_supervised(job, tmp_path / "wd", plan)
+        assert sup.restarts >= 1
+        SV.assert_crash_equivalent(sup, ref)
+
+    def test_kill_during_save_mid_chaos(self, tmp_path):
+        job = self._chaos_job("prefix-sort")
+        ref = SV.run_job(job)
+        plan = HF.HostFaultPlan(kill_at_save=((1, "data_written"),))
+        sup = SV.run_supervised(job, tmp_path / "wd", plan)
+        assert sup.restarts >= 1
+        SV.assert_crash_equivalent(sup, ref)
+
+    @pytest.mark.slow
+    def test_spawn_sigkill_mid_chaos(self, tmp_path):
+        """Spawn mode: a REAL SIGKILL in a child interpreter mid-
+        chaos, plus the result-file round-trip of the chaos fields."""
+        job = self._chaos_job("prefix-sort", n_shards=2)
+        ref = SV.run_job(job)
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(int(ref.decisions * 0.5), 1),))
+        sup = SV.run_supervised(job, tmp_path / "wd", plan,
+                                mode="spawn")
+        assert sup.restarts >= 1
+        SV.assert_crash_equivalent(sup, ref)
+        assert sup.mesh_chaos_fallbacks == ref.mesh_chaos_fallbacks
+
+
+class TestMeshChurn:
+    """Per-shard slot maps: EpochJob(engine_loop='mesh', churn=...)
+    routes REGISTER/UPDATE/EVICT/IDLE by client->shard ownership
+    (cid % n_shards) through S independent LifecyclePlanes, and the
+    dynamic==static canonical-digest gate extends to S>1."""
+
+    def _gate(self, name, scenario, S, total=32, epochs=8, **spec_kw):
+        from dmclock_tpu.lifecycle import churn as churn_mod
+
+        spec = churn_mod.make_spec(scenario, total_ids=total, seed=3,
+                                   **spec_kw)
+        dyn = SV.run_job(mesh_job(name, n_shards=S, churn=spec,
+                                  epochs=epochs))
+        st = SV.run_job(mesh_job(
+            name, n_shards=S, epochs=epochs,
+            churn=churn_mod.static_variant(spec)))
+        assert dyn.digest == st.digest, \
+            f"{scenario} S={S}: dynamic != static canonical digest"
+        assert dyn.decisions == st.decisions > 0
+        return dyn
+
+    @pytest.mark.parametrize("name,scenario,S", [
+        ("prefix-sort", "churn_storm", 4),
+        pytest.param("prefix-sort", "churn_storm", 1,
+                     marks=pytest.mark.slow),
+        pytest.param("chain", "flash_crowd", 4,
+                     marks=pytest.mark.slow),
+        pytest.param("calendar-minstop", "churn_storm", 2,
+                     marks=pytest.mark.slow),
+        pytest.param("prefix-radix", "flash_crowd", 2,
+                     marks=pytest.mark.slow),
+    ])
+    def test_dynamic_equals_static_at_s(self, name, scenario, S):
+        dyn = self._gate(name, scenario, S)
+        assert dyn.lifecycle["registrations"] > 0
+        if S > 1:
+            assert len(dyn.lifecycle["shards"]) == S
+
+    def test_ownership_routing_is_exact(self):
+        """Every registration lands on its owner shard: per-shard
+        snapshots count exactly the ids with cid % S == s."""
+        from dmclock_tpu.lifecycle import churn as churn_mod
+        from dmclock_tpu.lifecycle.slots import owned_ids
+
+        spec = churn_mod.make_spec("diurnal", total_ids=32, seed=3)
+        dyn = SV.run_job(mesh_job("prefix-sort", n_shards=4,
+                                  churn=spec, epochs=8))
+        for s, shot in enumerate(dyn.lifecycle["shards"]):
+            assert shot["registrations"] == len(owned_ids(32, s, 4))
+
+    def test_shard_skew_imbalance_workload(self):
+        """The first IMBALANCE workload (ROADMAP rack-scheduling
+        entry point): one shard's Zipf head melts while the others
+        idle -- visible in the per-shard completion counters, and
+        still digest-equal to its static variant."""
+        from dmclock_tpu.lifecycle import churn as churn_mod
+
+        skew = churn_mod.make_spec("shard_skew", total_ids=64,
+                                   base_lam=1.0, n_shards=4)
+        job = mesh_job("prefix-sort", n_shards=4, churn=skew,
+                       epochs=8, waves=4)
+        dyn = SV.run_job(job)
+        st = SV.run_job(dataclasses.replace(
+            job, churn=churn_mod.static_variant(skew)))
+        assert dyn.digest == st.digest
+        per_shard = dyn.mesh_counters[0].sum(axis=1)
+        hot, cold = per_shard[0], per_shard[1:]
+        assert hot > 4 * cold.max(), \
+            (f"hot shard should melt while others idle: "
+             f"{per_shard.tolist()}")
+
+    def test_churn_mesh_crash_equivalent(self, tmp_path):
+        from dmclock_tpu.lifecycle import churn as churn_mod
+
+        spec = churn_mod.make_spec("churn_storm", total_ids=32,
+                                   seed=3)
+        job = mesh_job("prefix-sort", n_shards=4, churn=spec,
+                       epochs=8, with_ledger=True)
+        ref = SV.run_job(job)
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(int(ref.decisions * 0.5), 1),))
+        sup = SV.run_supervised(job, tmp_path / "wd", plan)
+        assert sup.restarts >= 1
+        SV.assert_crash_equivalent(sup, ref)
+
+
+class TestMeshFlight:
+    """Per-shard flight rings (the PR-13 leftover): each shard
+    records its own commits in its own HBM ring; the host merges in
+    deterministic shard order at drain."""
+
+    def test_s1_flight_bit_identical_to_stream(self):
+        fl = dict(flight_records=16)
+        s = SV.run_job(dataclasses.replace(
+            JOBS["prefix-sort"], engine_loop="stream", **fl))
+        m = SV.run_job(mesh_job("prefix-sort", **fl))
+        assert_core_equal(m, s)
+        assert np.array_equal(m.flight_buf, s.flight_buf)
+        assert m.flight_seq == s.flight_seq
+
+    def test_s4_merge_deterministic_and_ordered(self):
+        a = SV.run_job(mesh_job("prefix-sort", n_shards=4,
+                                flight_records=16))
+        b = SV.run_job(mesh_job("prefix-sort", n_shards=4,
+                                flight_records=16))
+        assert np.array_equal(a.flight_buf, b.flight_buf)
+        assert a.flight_seq == b.flight_seq > 0
+        # shard-major merge: within each shard's span the seq column
+        # is strictly increasing (ring rows in write order)
+        seqs = a.flight_buf[:, 0]
+        drops = int((np.diff(seqs) < 0).sum())
+        assert drops <= 3, "more seq resets than shard boundaries"
+
+    @pytest.mark.slow
+    def test_s2_flight_crash_equivalent(self, tmp_path):
+        job = mesh_job("prefix-sort", n_shards=2, flight_records=16)
+        ref = SV.run_job(job)
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(int(ref.decisions * 0.5), 1),))
+        sup = SV.run_supervised(job, tmp_path / "wd", plan)
+        assert sup.restarts >= 1
+        SV.assert_crash_equivalent(sup, ref)
