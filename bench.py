@@ -393,7 +393,8 @@ def _per_pass_cap(n: int, k: int, calendar_steps: int,
     it is A/B'd against."""
     if not calendar_steps:
         return k
-    levels = ladder_levels if calendar_impl == "bucketed" else 1
+    levels = ladder_levels \
+        if calendar_impl in ("bucketed", "wheel") else 1
     return n * calendar_steps * levels
 
 
@@ -469,6 +470,7 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                     select_impl: str = "sort",
                     calendar_impl: str = "minstop",
                     ladder_levels: int = 8,
+                    wheel_kernel: str = "xla",
                     engine_loop: str = "round",
                     stream_chunk: int = 8,
                     telemetry: bool = True, slo: bool = False,
@@ -660,6 +662,7 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
                                      with_metrics=with_metrics,
                                      calendar_impl=calendar_impl,
                                      ladder_levels=ladder_levels,
+                                     wheel_kernel=wheel_kernel,
                                      hists=th, ledger=tl, slo=ts,
                                     prov=tp)
             return (ep.state, ep.count, ep.progress_ok,
@@ -706,8 +709,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
     run = _cplane.aot_record(
         "bench.round",
         (n, k, m, ring, cap_engine, select_impl, calendar_impl,
-         calendar_steps, ladder_levels, chain_depth, telemetry, slo,
-         with_metrics),
+         calendar_steps, ladder_levels, wheel_kernel, chain_depth,
+         telemetry, slo, with_metrics),
         jax.jit(round_fn, donate_argnums=(0, 3)),
         state, jnp.zeros((n,), jnp.int32), jnp.int64(0), tele)
     # NOT named `cost`: round_fn closes over the per-client cost
@@ -750,8 +753,8 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
             _chunk_jits[c] = _cplane.aot_record(
                 "bench.chunk",
                 (n, k, m, ring, cap_engine, select_impl,
-                 calendar_impl, calendar_steps, telemetry, slo,
-                 with_metrics, c),
+                 calendar_impl, calendar_steps, wheel_kernel,
+                 telemetry, slo, with_metrics, c),
                 jax.jit(chunk_fn, donate_argnums=(0, 3)),
                 state, jnp.zeros((c, n), jnp.int32), jnp.int64(0),
                 tele)
@@ -1040,8 +1043,18 @@ def bench_sustained(n: int, k: int, m: int, rounds: int, *,
         n_passes = n_pre * m
         out["calendar_impl"] = calendar_impl
         out["decisions_per_pass"] = total / max(n_passes, 1)
-        if calendar_impl == "bucketed":
+        if calendar_impl in ("bucketed", "wheel"):
             out["ladder_levels"] = ladder_levels
+        if calendar_impl == "wheel":
+            # the kernel tag: requested + what actually ran (the
+            # Pallas path falls back to the XLA reference off-TPU or
+            # past the padded-lane budget, counted per batch in the
+            # wheel_pallas_fallbacks metric row)
+            from dmclock_tpu.engine.fastpath import _wheel_resolve
+            out["wheel_kernel"] = wheel_kernel
+            _, fb = _wheel_resolve(wheel_kernel, n)
+            out["wheel_kernel_effective"] = \
+                "xla" if fb else wheel_kernel
     if with_metrics:
         md = obsdev.metrics_dict(met_acc)
         out["device_metrics"] = md
@@ -1661,12 +1674,18 @@ def bench_mesh(clients: int = 100_000, *, n_shards=None,
         # (warmup included: a chaos session is chaotic end to end)
         fplan = faults_mod.plan_from_spec(
             fault_spec, (warm_chunks + n_chunks) * chunk, S)
+    # chunks launch at e0 = multiples of chunk, so chunk % K == 0
+    # keeps every group head on the sync grid and the grouped
+    # (collective-free non-sync epoch) program stays bit-identical
+    every = int(max(counter_sync_every, 1))
+    skipping = fplan is None and every > 1 and chunk % every == 0
     fn = mesh_mod.jit_mesh_chunk(
         mesh, engine=engine, epochs=chunk, m=m, k=k,
         dt_epoch_ns=dt_epoch_ns, waves=waves,
         with_metrics=with_metrics,
         counter_sync_every=counter_sync_every, ingest=True,
-        with_faults=fplan is not None)
+        with_faults=fplan is not None,
+        collective_skipping=skipping)
     rng = np.random.Generator(np.random.PCG64(29))
 
     def draw(e):
@@ -1758,15 +1777,19 @@ def bench_mesh(clients: int = 100_000, *, n_shards=None,
         "counter_sync_every": int(max(counter_sync_every, 1)),
         "counter_syncs": sched["syncs"],
         "counter_bytes_per_sync": bytes_per_sync,
-        # what the compiled program EXECUTES: the [C]-sized psum runs
-        # every epoch (K gates only the view refresh; skipping the
-        # collective on non-sync epochs is the ROADMAP on-silicon
-        # remainder) -- recording the K-discounted figure here would
-        # project 1/K of the real cross-chip bandwidth
-        "counter_bytes_per_epoch": float(bytes_per_sync),
-        # what the staleness cadence WILL realize once the collective
-        # is group-structured: view-refresh bytes amortized over the
-        # sync grid
+        "collective_skipping": bool(skipping),
+        # what the compiled program EXECUTES: with collective
+        # skipping the [C]-sized psum runs once per K-epoch sync
+        # group (non-sync epochs are collective-free by program
+        # structure), so the per-epoch wire cost is bytes/K; the
+        # flat program (chaos, or K not dividing the chunk) still
+        # pays it every epoch
+        "counter_bytes_per_epoch":
+            float(bytes_per_sync / every if skipping
+                  else bytes_per_sync),
+        # view-refresh bytes amortized over the sync grid -- the
+        # window-aware figure (sync count depends on where the timed
+        # window starts on the epoch % K grid)
         "counter_view_bytes_per_epoch":
             bytes_per_sync * sched["syncs"] / max(sched["epochs"], 1),
         **{key: val for key, val in plan.items() if val is not None},
@@ -1987,18 +2010,30 @@ def main() -> None:
                     "cfg4's calendar engine is sortless and ignores "
                     "this)")
     ap.add_argument("--calendar-impl",
-                    choices=["minstop", "bucketed", "both"],
+                    choices=["minstop", "bucketed", "wheel", "both"],
                     default="minstop",
                     help="calendar-engine commit-boundary scheme for "
                     "the cfg4 workload (fastpath calendar_impl): "
                     "'bucketed' fuses a stop-key ladder of "
                     "--ladder-levels refreshed boundaries per batch "
                     "(more decisions per pass on skewed populations); "
-                    "'both' runs cfg4 under each and reports cfg4 + "
-                    "cfg4_bucketed (separate bench_guard series)")
+                    "'wheel' drives the same ladder from a maintained "
+                    "timer-wheel bucket index (O(1)-bucket re-slot "
+                    "per commit; --wheel-kernel picks its kernel); "
+                    "'both' runs cfg4 under all three and reports "
+                    "cfg4 + cfg4_bucketed + cfg4_wheel (separate "
+                    "bench_guard series)")
     ap.add_argument("--ladder-levels", type=int, default=8,
                     metavar="L",
-                    help="ladder levels per bucketed calendar batch")
+                    help="ladder levels per bucketed/wheel calendar "
+                    "batch")
+    ap.add_argument("--wheel-kernel", choices=["xla", "pallas"],
+                    default="xla",
+                    help="wheel-calendar bucket scatter/scan backend "
+                    "(fastpath wheel_kernel): 'pallas' runs the "
+                    "hand-written fused kernel on TPU (bit-identical; "
+                    "falls back to 'xla' off-TPU, counted in the "
+                    "wheel_pallas_fallbacks metric row)")
     ap.add_argument("--engine-loop",
                     choices=["round", "stream", "both"],
                     default="round",
@@ -2368,7 +2403,7 @@ def main() -> None:
             # --calendar-impl A/Bs the bucketed stop-key ladder
             # against minstop (separate bench_guard series; the JSON
             # line records decisions_per_pass for each).
-            cals = ("minstop", "bucketed") \
+            cals = ("minstop", "bucketed", "wheel") \
                 if args.calendar_impl == "both" \
                 else (args.calendar_impl,)
             for cal in cals:
@@ -2385,6 +2420,7 @@ def main() -> None:
                             reps=4, with_metrics=wm,
                             calendar_impl=calendar_impl,
                             ladder_levels=args.ladder_levels,
+                            wheel_kernel=args.wheel_kernel,
                             engine_loop=loop,
                             stream_chunk=args.stream_chunk,
                             conformance_out=args.conformance_out,
@@ -2392,8 +2428,11 @@ def main() -> None:
                             provenance=prov_on,
                             capacity_check=args.capacity == "on",
                             tracer=tracer, watchdog=watchdog))
+                    # keyed by the EFFECTIVE impl: a ladder step-down
+                    # mid-session must land the row in the series it
+                    # actually measured (wheel -> bucketed -> minstop)
                     key = "cfg4" if eff["calendar_impl"] == "minstop" \
-                        else "cfg4_bucketed"
+                        else f"cfg4_{eff['calendar_impl']}"
                     if loop == "stream":
                         key += "_stream"
                     results.setdefault(key, row)
@@ -2439,8 +2478,10 @@ def main() -> None:
               "vs_baseline": 0.0})
         return
     c4 = results.get("cfg4") or results.get("cfg4_bucketed") \
+        or results.get("cfg4_wheel") \
         or results.get("cfg4_stream") \
-        or results.get("cfg4_bucketed_stream")
+        or results.get("cfg4_bucketed_stream") \
+        or results.get("cfg4_wheel_stream")
     primary = c4 or results.get("cfg3") or results.get("cfg3_stream") \
         or results.get("serve") or next(iter(results.values()))
     parts = []
@@ -2462,9 +2503,12 @@ def main() -> None:
                      f"chunk {r.get('stream_chunk', 0)})")
     for key, label in (("cfg4", "cfg4"),
                        ("cfg4_bucketed", "cfg4[bucketed]"),
+                       ("cfg4_wheel", "cfg4[wheel]"),
                        ("cfg4_stream", "cfg4[stream]"),
                        ("cfg4_bucketed_stream",
-                        "cfg4[bucketed,stream]")):
+                        "cfg4[bucketed,stream]"),
+                       ("cfg4_wheel_stream",
+                        "cfg4[wheel,stream]")):
         r4 = results.get(key)
         if not r4:
             continue
@@ -2497,6 +2541,8 @@ def main() -> None:
             f"sync every {r['counter_sync_every']} epochs, "
             f"{r['counter_bytes_per_epoch']:.0f} B/epoch counter "
             f"exchange"
+            + (", collective-free non-sync epochs"
+               if r.get("collective_skipping") else "")
             + (f", {planned} shards planned from the HBM ledger"
                if planned is not None else "") + ")")
     for key in sorted(results):

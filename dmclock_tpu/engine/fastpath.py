@@ -69,6 +69,7 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +83,7 @@ from ..obs import histograms as obshist
 from ..obs import provenance as obsprov
 from ..obs import slo as obsslo
 from . import kernels
+from . import kernels_pallas
 from .kernels import (KEY_INF, NONE, RETURNING, Decision, _make_tag,
                       _fold_prev)
 from .state import EngineState, TAG_I64_FIELDS
@@ -1043,7 +1045,8 @@ class PrefixEpoch(NamedTuple):
 def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
                    guards_ok, rebase_fallback=False, live=True,
                    ladder_levels_used=0, ladder_base_decisions=0,
-                   ladder_fallbacks=0):
+                   ladder_fallbacks=0, wheel_occ_hwm=0,
+                   wheel_reslots=0, pallas_fallbacks=0):
     """Fold one batch's contribution into the epoch metrics vector --
     pure reductions over arrays the batch already materialized, so the
     decision stream cannot be perturbed.  A stall is a batch that
@@ -1069,7 +1072,10 @@ def _batch_metrics(met, st: EngineState, *, count, resv, prop, lb,
                                      jnp.int64),
         cal_ladder_levels_used=ladder_levels_used,
         cal_ladder_base_decisions=ladder_base_decisions,
-        cal_ladder_fallbacks=ladder_fallbacks))
+        cal_ladder_fallbacks=ladder_fallbacks,
+        wheel_occ_hwm=wheel_occ_hwm,
+        wheel_reslots=wheel_reslots,
+        pallas_fallbacks=pallas_fallbacks))
 
 
 def _telemetry_delta(st_post: EngineState, now, cls, key, served_pc,
@@ -1772,7 +1778,8 @@ def _calendar_pass(state: EngineState, now, arr_rows, cost_rows,
 
 def _calendar_batch_core(state: EngineState, now, arr_rows, cost_rows,
                          *, anticipation_ns: int,
-                         allow_limit_break: bool):
+                         allow_limit_break: bool,
+                         origins=None, stop_min=None):
     """The measure + boundary + commit + promote pipeline of one
     calendar batch, given the prefetched window rows.  Shared by
     :func:`calendar_batch` (one boundary per launch) and the bucketed
@@ -1787,17 +1794,28 @@ def _calendar_batch_core(state: EngineState, now, arr_rows, cost_rows,
     rounds proper serve where ranks beyond 1 are genuinely needed: the
     quantile planner (:func:`calendar_stop_ladder`).
 
+    ``origins`` injects precomputed ``(kresv, kprop1, kprop2,
+    any_cand)`` pack origins -- the wheel ladder reads them from its
+    maintained bucket index in O(buckets) instead of the dense
+    per-class mins here.  ``stop_min`` likewise replaces the dense
+    ``jnp.min`` boundary with the wheel's occupancy-min-scan.  Both
+    must be BIT-IDENTICAL to the dense reductions they replace (the
+    wheel exactness argument, see the kernels wheel section).
+
     Returns ``(CalendarBatch, b_eff, stop_pk)``."""
-    cls0, key0 = _classify(state, now, allow_limit_break)
-    kresv = jnp.min(jnp.where(cls0 == CLS_RESV, key0, KEY_INF))
-    kprop1 = jnp.min(jnp.where(cls0 == CLS_WEIGHT, key0, KEY_INF))
-    kprop2 = jnp.min(jnp.where(cls0 == CLS_LB, key0, KEY_INF))
-    any_cand = jnp.any(cls0 != CLS_NONE)
+    if origins is None:
+        cls0, key0 = _classify(state, now, allow_limit_break)
+        kresv = jnp.min(jnp.where(cls0 == CLS_RESV, key0, KEY_INF))
+        kprop1 = jnp.min(jnp.where(cls0 == CLS_WEIGHT, key0, KEY_INF))
+        kprop2 = jnp.min(jnp.where(cls0 == CLS_LB, key0, KEY_INF))
+        any_cand = jnp.any(cls0 != CLS_NONE)
+    else:
+        kresv, kprop1, kprop2, any_cand = origins
 
     stop_pk = _calendar_pass(state, now, arr_rows, cost_rows,
                              allow_limit_break, anticipation_ns,
                              kresv, kprop1, kprop2, None)
-    b_eff = jnp.min(stop_pk)
+    b_eff = jnp.min(stop_pk) if stop_min is None else stop_min(stop_pk)
     (fields, qadv, units, served, served_resv, lb, last_pk,
      last_cls, cost_pc) = _calendar_pass(
          state, now, arr_rows, cost_rows, allow_limit_break,
@@ -1928,7 +1946,169 @@ def calendar_batch(state: EngineState, now, *, steps: int,
 # boundaries track the stop quantiles) and prices a ladder depth L
 # before running it; the commit path keeps the provable boundary.
 
-_CAL_IMPLS = ("minstop", "bucketed")
+_CAL_IMPLS = ("minstop", "bucketed", "wheel")
+
+
+# ----------------------------------------------------------------------
+# the timer-wheel calendar: a maintained bucket index over the tags
+# ----------------------------------------------------------------------
+#
+# calendar_impl="wheel" keeps the bucketed ladder's commit structure
+# (L refreshed-budget boundaries per launch) but replaces its dense
+# O(N) reductions with O(buckets) reads of a MAINTAINED calendar
+# wheel: three per-class bucket wheels (occupancy count + exact min
+# key per bucket) built once per batch, then adjusted IN PLACE
+# between ladder levels -- only the clients a commit actually moved
+# re-slot; the rest of the population is never touched.  The level
+# boundary B_i comes from a transient stop-key wheel: bucket-scatter
+# the per-client stop packs and read the first occupied bucket's min
+# (the occupancy-min-scan) -- the shape hand-written as the repo's
+# first Pallas kernel (engine.kernels_pallas), behind the
+# ``wheel_kernel`` switch with a counted XLA fallback.
+#
+# Exactness is inherited, not re-proven: every wheel read is
+# bit-identical to the dense reduction it replaces (first occupied
+# bucket's stored min == global masked min, because bucketing is
+# monotone in the key -- kernels.py wheel section), so the committed
+# stream, state, metrics, and telemetry equal bucketed-L and hence
+# the serial engine exactly (ci.sh wheel digest gates).  The in-place
+# adjust is exact because at FIXED now an unserved client's (class,
+# key) cannot change across a commit: readiness is ``limit <= now``
+# under monotone now (the stored head_ready bit adds nothing, see
+# _calendar_pass), and the promote pass only flips stored bits that
+# _ready_now already implied.  Re-slotting exactly the served clients
+# therefore reproduces a full rebuild bit-for-bit (the adjust ==
+# rebuild pin in tests/test_calendar_wheel.py).
+
+_WHEEL_KERNELS = ("xla", "pallas")
+_WHEEL_BUCKETS = 256
+_WHEEL_SHIFT = 20        # 2^20 ns ~ 1ms fine buckets, ~268ms span
+_WHEEL_STOP_SHIFT = 52   # stop packs live in [0, 2^60): 256 buckets
+
+
+def _wheel_resolve(wheel_kernel: str, n: int):
+    """STATIC resolution of the ``wheel_kernel`` switch: returns
+    ``(scan_fn, fallback)`` with ``scan_fn(keys, slot, nb)`` matching
+    :func:`kernels.wheel_scan`.  "pallas" resolves to the real kernel
+    on TPU backends, to interpret mode anywhere when
+    ``DMCLOCK_WHEEL_INTERPRET=1`` (the CI parity path), and otherwise
+    falls back to the XLA reference with ``fallback=True`` -- counted
+    per live batch in the pallas_fallbacks metric row, so a fleet
+    silently running the fallback is visible in /metrics."""
+    if wheel_kernel not in _WHEEL_KERNELS:
+        raise ValueError(f"unknown wheel_kernel {wheel_kernel!r} "
+                         f"(one of {_WHEEL_KERNELS})")
+    if wheel_kernel == "pallas":
+        interpret = os.environ.get("DMCLOCK_WHEEL_INTERPRET") == "1"
+        if kernels_pallas.wheel_supported(n, 3 * _WHEEL_BUCKETS) and \
+                (interpret or jax.default_backend() == "tpu"):
+            return (functools.partial(kernels_pallas.wheel_scan_pallas,
+                                      interpret=interpret), False)
+        return kernels.wheel_scan, True
+    return kernels.wheel_scan, False
+
+
+class WheelIndex(NamedTuple):
+    """The maintained calendar wheel: three class wheels of
+    ``_WHEEL_BUCKETS`` buckets each, concatenated on one axis
+    (slot = cls * B + bucket; 3B = unslotted), plus the per-client
+    slot/key mirror that makes the in-place adjust self-contained."""
+
+    origin: jnp.ndarray   # int64 bucket-0 left edge (all 3 wheels)
+    cnt: jnp.ndarray      # int32[3B] occupancy per (class, bucket)
+    bmin: jnp.ndarray     # int64[3B] exact min key per bucket
+    slot: jnp.ndarray     # int32[N] current slot (3B = unslotted)
+    key: jnp.ndarray      # int64[N] slotted key (where slot < 3B)
+    reslots: jnp.ndarray  # int64 in-place re-slots since build
+    hwm: jnp.ndarray      # int64 bucket-occupancy high-water mark
+
+
+def _wheel_slots(cls, key, origin):
+    """(class, key) -> wheel slot; non-candidates unslot (3B)."""
+    b = kernels.wheel_slot(key, origin, _WHEEL_SHIFT, _WHEEL_BUCKETS)
+    return jnp.where(cls == CLS_NONE,
+                     jnp.int32(3 * _WHEEL_BUCKETS),
+                     cls * _WHEEL_BUCKETS + b).astype(jnp.int32)
+
+
+def wheel_build(state: EngineState, now, allow: bool, *,
+                scan_fn=kernels.wheel_scan) -> WheelIndex:
+    """Full O(N) bucket-scatter of the entry classification -- once
+    per batch; levels and API events adjust in place from here."""
+    cls, key = _classify(state, now, allow)
+    origin = now - (jnp.int64(_WHEEL_BUCKETS // 2)
+                    << _WHEEL_SHIFT)
+    slot = _wheel_slots(cls, key, origin)
+    cnt, bmin, _val, _found = scan_fn(key, slot, 3 * _WHEEL_BUCKETS)
+    return WheelIndex(origin=origin, cnt=cnt, bmin=bmin, slot=slot,
+                      key=key, reslots=jnp.int64(0),
+                      hwm=jnp.max(cnt).astype(jnp.int64))
+
+
+def wheel_origins(w: WheelIndex):
+    """Batch-entry pack origins read from the wheel in O(buckets):
+    per class, the first occupied bucket's stored min -- bit-equal to
+    the dense masked min ``_calendar_batch_core`` would compute.
+    Returns ``(kresv, kprop1, kprop2, any_cand)``."""
+    b = _WHEEL_BUCKETS
+    vals, founds = [], []
+    for c in range(3):
+        v, _b0, f = kernels.wheel_nearest(w.cnt[c * b:(c + 1) * b],
+                                          w.bmin[c * b:(c + 1) * b])
+        vals.append(v)
+        founds.append(f)
+    return vals[0], vals[1], vals[2], founds[0] | founds[1] | founds[2]
+
+
+def wheel_adjust(w: WheelIndex, state: EngineState, now, allow: bool,
+                 moved) -> WheelIndex:
+    """In-place re-slot of exactly the ``moved`` clients: decrement
+    their old buckets, increment the new ones, and recompute the min
+    of ONLY the touched buckets from the stored keys.  Every
+    untouched bucket keeps its count and min bit-identically, so the
+    result equals :func:`wheel_build` of the new state whenever
+    ``moved`` covers every client whose (class, key) changed -- the
+    served set of a fixed-now commit, a live QoS update's target, an
+    idle re-entry, a churn re-slot (section comment; pinned by
+    tests/test_calendar_wheel.py's adjust == rebuild gates)."""
+    nb = 3 * _WHEEL_BUCKETS
+    cls, key = _classify(state, now, allow)
+    new_slot = _wheel_slots(cls, key, w.origin)
+    slot2 = jnp.where(moved, new_slot, w.slot)
+    key2 = jnp.where(moved, key, w.key)
+    out_s = jnp.where(moved, w.slot, jnp.int32(nb))
+    in_s = jnp.where(moved, slot2, jnp.int32(nb))
+    cnt2 = w.cnt.at[out_s].add(jnp.int32(-1), mode="drop") \
+                .at[in_s].add(jnp.int32(1), mode="drop")
+    touched = jnp.zeros((nb,), bool) \
+        .at[out_s].set(True, mode="drop") \
+        .at[in_s].set(True, mode="drop")
+    fresh = jnp.full((nb,), jnp.int64(KEY_INF)) \
+        .at[slot2].min(key2, mode="drop")
+    bmin2 = jnp.where(touched, fresh, w.bmin)
+    changed = moved & ((slot2 != w.slot) | (key2 != w.key))
+    return WheelIndex(
+        origin=w.origin, cnt=cnt2, bmin=bmin2, slot=slot2, key=key2,
+        reslots=w.reslots + jnp.sum(changed, dtype=jnp.int64),
+        hwm=jnp.maximum(w.hwm, jnp.max(cnt2).astype(jnp.int64)))
+
+
+def _wheel_stop_min(stop_pk, scan_fn):
+    """The level boundary B_eff as the stop wheel's fused
+    bucket-scatter + occupancy-min-scan (the Pallas kernel's shape)
+    -- bit-identical to ``jnp.min(stop_pk)``: stop packs are
+    non-negative and below 2^60, so 256 buckets of 2^52 cover the
+    space exactly and the first occupied bucket's min IS the global
+    min; all-KEY_INF distributions return KEY_INF like the dense
+    min."""
+    finite = stop_pk < jnp.int64(KEY_INF)
+    slot = jnp.where(
+        finite,
+        kernels.wheel_slot(stop_pk, jnp.int64(0), _WHEEL_STOP_SHIFT,
+                           _WHEEL_BUCKETS),
+        jnp.int32(_WHEEL_BUCKETS))
+    _cnt, _bmin, val, _found = scan_fn(stop_pk, slot, _WHEEL_BUCKETS)
+    return val
 
 
 class CalendarLadderBatch(NamedTuple):
@@ -1964,13 +2144,13 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
                           use_pallas, with_hists: bool = False,
                           with_ledger: bool = False,
                           with_slo: bool = False,
-                          prov0=None):
+                          prov0=None, wheel_scan_fn=None):
     """The fused ladder: a lax.scan over L levels, each a full
     window-prefetch + measure + histogram boundary + commit from the
     previous level's committed state.  Carries only the mutable epoch
     fields (the ring pair and QoS identity stay loop-invariant,
     exactly like the epoch scans).  Returns ``(mut', acc, tele_delta,
-    outs)`` with ``acc`` the [N] per-client counters summed over
+    outs, wstats)`` with ``acc`` the [N] per-client counters summed over
     levels, ``tele_delta`` the zero-based histogram/ledger deltas
     accumulated per LEVEL (so a level equals one minstop batch and
     bucketed-L telemetry equals the L-batch composition exactly; the
@@ -1980,7 +2160,16 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
     through the levels as FULL STATE (not a delta): each level
     observes its own entry classification and boundary margins, and
     the caller selects the returned block against the entry block on
-    batch liveness."""
+    batch liveness.
+
+    ``wheel_scan_fn`` (static, a :func:`kernels.wheel_scan`-shaped
+    callable) switches the ladder to the WHEEL calendar: one bucket
+    index built at entry, per-level origins/boundary read from it in
+    O(buckets), and only each level's served clients re-slotted in
+    place (see the wheel section comment -- every read is bit-equal
+    to the dense reduction it replaces, so the committed stream is
+    unchanged).  ``wstats`` is then ``(reslots, occ_hwm)`` int64
+    scalars for the metrics plane, else None."""
     n = invariant["active"].shape[-1]
     acc0 = dict(units=jnp.zeros((n,), jnp.int32),
                 served=jnp.zeros((n,), jnp.int32),
@@ -2001,14 +2190,30 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
     if prov0 is not None:
         tacc0["p"] = prov0
 
+    wheel0 = None
+    if wheel_scan_fn is not None:
+        wheel0 = wheel_build(EngineState(**invariant, **mut), now,
+                             allow, scan_fn=wheel_scan_fn)
+
     def level(carry, _):
-        mut, acc, tacc = carry
+        if wheel_scan_fn is not None:
+            mut, acc, tacc, w = carry
+        else:
+            mut, acc, tacc = carry
+            w = None
         st = EngineState(**invariant, **mut)
         win = ring_window(st, steps, use_pallas=use_pallas)
         arr_rows, cost_rows = _heads_rows((win.arr, win.cost), steps)
         batch, b_eff, _ = _calendar_batch_core(
             st, now, arr_rows, cost_rows,
-            anticipation_ns=anticipation_ns, allow_limit_break=allow)
+            anticipation_ns=anticipation_ns, allow_limit_break=allow,
+            origins=None if w is None else wheel_origins(w),
+            stop_min=None if w is None else functools.partial(
+                _wheel_stop_min, scan_fn=wheel_scan_fn))
+        if w is not None:
+            # fixed-now commit: exactly the served clients moved
+            w = wheel_adjust(w, batch.state, now, allow,
+                             batch.served > 0)
         new_mut = {f: getattr(batch.state, f) for f in _EPOCH_MUTABLE}
         acc = dict(units=acc["units"] + batch.units,
                    served=acc["served"] + batch.served,
@@ -2047,12 +2252,18 @@ def _calendar_ladder_scan(invariant: dict, mut: dict, now, *,
         # ladder stall: progress_ok's per-level analog (later levels
         # deterministically repeat it -- same state, same boundary)
         stall = ~batch.progress_ok
-        return (new_mut, acc, tacc), (batch.count, batch.resv_count,
-                                      b_eff, stall)
+        out = (batch.count, batch.resv_count, b_eff, stall)
+        if wheel_scan_fn is not None:
+            return (new_mut, acc, tacc, w), out
+        return (new_mut, acc, tacc), out
 
+    if wheel_scan_fn is not None:
+        (mut, acc, tacc, wfin), outs = lax.scan(
+            level, (mut, acc0, tacc0, wheel0), None, length=levels)
+        return mut, acc, tacc, outs, (wfin.reslots, wfin.hwm)
     (mut, acc, tacc), outs = lax.scan(level, (mut, acc0, tacc0), None,
                                       length=levels)
-    return mut, acc, tacc, outs
+    return mut, acc, tacc, outs, None
 
 
 def calendar_batch_bucketed(state: EngineState, now, *, steps: int,
@@ -2072,11 +2283,46 @@ def calendar_batch_bucketed(state: EngineState, now, *, steps: int,
     assert levels >= 1, "the ladder needs at least one level"
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mut0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
-    mut, acc, _tacc, (count, resv, bound, stall) = \
+    mut, acc, _tacc, (count, resv, bound, stall), _w = \
         _calendar_ladder_scan(
             invariant, mut0, now, steps=steps, levels=levels,
             anticipation_ns=anticipation_ns, allow=allow_limit_break,
             use_pallas=use_pallas)
+    total = jnp.sum(count).astype(jnp.int32)
+    return CalendarLadderBatch(
+        state=EngineState(**invariant, **mut),
+        count=total,
+        resv_count=jnp.sum(resv).astype(jnp.int32),
+        units=acc["units"], served=acc["served"],
+        served_resv=acc["served_resv"], lb=acc["lb"],
+        progress_ok=~stall[0],
+        level_count=count, level_bound=bound, level_stall=stall,
+        served_cost=acc["cost"])
+
+
+def calendar_batch_wheel(state: EngineState, now, *, steps: int,
+                         levels: int, anticipation_ns: int = 0,
+                         allow_limit_break: bool = False,
+                         use_pallas: bool | None = None,
+                         wheel_kernel: str = "xla"
+                         ) -> CalendarLadderBatch:
+    """One WHEEL calendar batch: the bucketed ladder driven by the
+    maintained bucket index (wheel section comment) -- same
+    :class:`CalendarLadderBatch` contract, bit-identical committed
+    set/state/counters to :func:`calendar_batch_bucketed` at the same
+    ``levels`` (and to :func:`calendar_batch` at ``levels=1``); the
+    ci.sh wheel digest gates pin both."""
+    assert steps <= state.ring_capacity, \
+        "calendar steps exceed the ring window"
+    assert levels >= 1, "the ladder needs at least one level"
+    scan_fn, _fb = _wheel_resolve(wheel_kernel, state.capacity)
+    invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
+    mut0 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
+    mut, acc, _tacc, (count, resv, bound, stall), _w = \
+        _calendar_ladder_scan(
+            invariant, mut0, now, steps=steps, levels=levels,
+            anticipation_ns=anticipation_ns, allow=allow_limit_break,
+            use_pallas=use_pallas, wheel_scan_fn=scan_fn)
     total = jnp.sum(count).astype(jnp.int32)
     return CalendarLadderBatch(
         state=EngineState(**invariant, **mut),
@@ -2149,6 +2395,7 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                         tag_width: int = 64,
                         calendar_impl: str = "minstop",
                         ladder_levels: int = 8,
+                        wheel_kernel: str = "xla",
                         hists=None, ledger=None,
                         flight=None, slo=None,
                         prov=None) -> CalendarEpoch:
@@ -2157,14 +2404,18 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
     :func:`scan_prefix_epoch` (a window trip reports
     ``progress_ok=False`` for that batch and every later one).
 
-    ``calendar_impl`` (STATIC, "minstop"|"bucketed") picks the commit
-    boundary scheme, mirroring the prefix engine's ``select_impl``
-    switch: "minstop" is one global min-stop boundary per batch;
-    "bucketed" fuses ``ladder_levels`` refreshed-budget boundaries per
-    batch (see the bucketed section comment), so one launch commits
-    what took ``ladder_levels`` minstop batches.  Both produce exact
-    serial prefixes; ``ladder_levels=1`` is bit-identical to
-    "minstop" (ci.sh digest gate).
+    ``calendar_impl`` (STATIC, "minstop"|"bucketed"|"wheel") picks the
+    commit boundary scheme, mirroring the prefix engine's
+    ``select_impl`` switch: "minstop" is one global min-stop boundary
+    per batch; "bucketed" fuses ``ladder_levels`` refreshed-budget
+    boundaries per batch (see the bucketed section comment), so one
+    launch commits what took ``ladder_levels`` minstop batches;
+    "wheel" is the bucketed ladder driven by the maintained bucket
+    index (wheel section comment) with its boundary scan behind the
+    ``wheel_kernel`` switch ("xla" reference or the "pallas" kernel
+    with a counted fallback).  All produce exact serial prefixes;
+    ``ladder_levels=1`` is bit-identical to "minstop" (ci.sh digest
+    gates).
 
     ``hists`` / ``ledger`` / ``flight`` telemetry accumulators as in
     :func:`scan_prefix_epoch`.  Histogram/ledger observations are per
@@ -2175,9 +2426,15 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
     carrying the client's committed decisions."""
     assert tag_width in (32, 64), tag_width
     assert calendar_impl in _CAL_IMPLS, calendar_impl
-    bucketed = calendar_impl == "bucketed"
+    wheel = calendar_impl == "wheel"
+    bucketed = calendar_impl == "bucketed" or wheel
     levels = int(ladder_levels) if bucketed else 1
     assert levels >= 1, "the ladder needs at least one level"
+    if wheel:
+        wheel_fn, wheel_fb = _wheel_resolve(wheel_kernel,
+                                            state.capacity)
+    else:
+        wheel_fn, wheel_fb = None, False
     narrow32 = tag_width == 32
     invariant = {f: getattr(state, f) for f in _EPOCH_INVARIANT}
     mutable0_64 = {f: getattr(state, f) for f in _EPOCH_MUTABLE}
@@ -2210,16 +2467,21 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
             # its own per-LEVEL classification internally, and XLA
             # drops this one when nothing reads it
             cls_e, key_e = _classify(st, now, allow_limit_break)
+        w_reslots = jnp.int64(0)
+        w_hwm = jnp.int64(0)
         if bucketed:
             mut_in = {f: getattr(st, f) for f in _EPOCH_MUTABLE}
             new_mut, lacc, tdelta, \
-                (lvl_count, lvl_resv, _bound, lvl_stall) = \
+                (lvl_count, lvl_resv, _bound, lvl_stall), wstats = \
                 _calendar_ladder_scan(
                     invariant, mut_in, now, steps=steps,
                     levels=levels, anticipation_ns=anticipation_ns,
                     allow=allow_limit_break, use_pallas=use_pallas,
                     with_hists="h" in tele, with_ledger="l" in tele,
-                    with_slo="s" in tele, prov0=tele.get("p"))
+                    with_slo="s" in tele, prov0=tele.get("p"),
+                    wheel_scan_fn=wheel_fn)
+            if wstats is not None:
+                w_reslots, w_hwm = wstats
             hd, ld, sd = (tdelta.get("h"), tdelta.get("l"),
                           tdelta.get("s"))
             p_new = tdelta.get("p")
@@ -2274,13 +2536,13 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
             mut, dead, good, trip, \
                 (count, resv_count, progress, served, lb_total,
                  lvl_count, levels_used, ladder_fb,
-                 base_decs) = tc.gate(
+                 base_decs, w_reslots, w_hwm) = tc.gate(
                     dead, mut, new_mut,
                     [(count, 0), (resv_count, 0), (progress, False),
                      (served, 0), (lb_total, 0),
                      (lvl_count, jnp.zeros((levels,), jnp.int32)),
                      (levels_used, 0), (ladder_fb, 0),
-                     (base_decs, 0)])
+                     (base_decs, 0), (w_reslots, 0), (w_hwm, 0)])
         else:
             mut = new_mut
         out = (count, resv_count, progress, lvl_count)
@@ -2296,7 +2558,13 @@ def scan_calendar_epoch(state: EngineState, now, m: int, *,
                 live=good,
                 ladder_levels_used=levels_used,
                 ladder_base_decisions=base_decs,
-                ladder_fallbacks=ladder_fb)
+                ladder_fallbacks=ladder_fb,
+                wheel_occ_hwm=w_hwm, wheel_reslots=w_reslots,
+                # static per-trace: the requested Pallas kernel
+                # resolved to the XLA reference for this program
+                pallas_fallbacks=jnp.where(
+                    good, jnp.int64(1 if wheel_fb else 0),
+                    jnp.int64(0)))
         if need_tele:
             tele = _tele_fold(tele, hd, ld, good, sd)
             if "p" in tele:
@@ -2377,6 +2645,7 @@ def epoch_scan_kwargs(engine: str, *, k: int = 0, chain_depth: int = 4,
                       window_m: int | None = None,
                       calendar_impl: str = "minstop",
                       ladder_levels: int = 8,
+                      wheel_kernel: str = "xla",
                       anticipation_ns: int = 0,
                       allow_limit_break: bool = False,
                       with_metrics: bool = False) -> dict:
@@ -2397,5 +2666,6 @@ def epoch_scan_kwargs(engine: str, *, k: int = 0, chain_depth: int = 4,
                   chain_depth=chain_depth)
     else:
         kw.update(steps=max(k, 1), calendar_impl=calendar_impl,
-                  ladder_levels=ladder_levels)
+                  ladder_levels=ladder_levels,
+                  wheel_kernel=wheel_kernel)
     return kw

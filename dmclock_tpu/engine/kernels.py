@@ -215,6 +215,76 @@ def radix_quantile_ladder(pk, levels: int):
 
 
 # ----------------------------------------------------------------------
+# timer-wheel scatter/scan: bucketed calendars over the key space
+# ----------------------------------------------------------------------
+#
+# The timer-wheel primitives behind the calendar engine's
+# calendar_impl="wheel": keys scatter into a fixed grid of buckets
+# (count + exact per-bucket minimum), and nearest-deadline is an
+# O(buckets) hierarchical occupancy scan (coarse group any-reduction,
+# then first-set fine bucket) instead of a dense min over N lanes.
+# The exactness argument is one line: ``wheel_slot`` is monotone
+# NONDECREASING in the key for ANY origin/shift (out-of-span keys
+# clamp to the edge buckets, which preserves monotonicity), so the
+# first occupied bucket contains the global masked minimum and its
+# stored ``bmin`` -- a scatter-min of the ACTUAL keys, not a bucket
+# edge -- IS that minimum, bit for bit.  Geometry therefore only
+# affects discrimination (how many keys share the clamp buckets),
+# never the result.
+
+WHEEL_GROUPS = 8
+
+
+def wheel_slot(key, origin, shift: int, nb: int):
+    """Bucket index of ``key`` on a wheel of ``nb`` buckets of width
+    ``2**shift`` ns starting at ``origin``.  Out-of-span keys clamp to
+    the edge buckets (monotone, hence exact -- see section comment)."""
+    rel = (key - origin) >> shift
+    return jnp.clip(rel, 0, nb - 1).astype(jnp.int32)
+
+
+def wheel_scatter(keys, slot, nb: int):
+    """Scatter ``keys`` into ``nb`` buckets: per-bucket occupancy
+    count and exact minimum key.  ``slot == nb`` masks a lane out
+    (dropped by the scatter).  Returns ``(cnt int32[nb],
+    bmin int64[nb])`` with KEY_INF in empty buckets."""
+    cnt = jnp.zeros((nb,), jnp.int32).at[slot].add(
+        jnp.int32(1), mode="drop")
+    bmin = jnp.full((nb,), jnp.int64(KEY_INF)).at[slot].min(
+        keys, mode="drop")
+    return cnt, bmin
+
+
+def wheel_nearest(cnt, bmin, groups: int = WHEEL_GROUPS):
+    """O(buckets) nearest-deadline: hierarchical occupancy ffs --
+    coarse any-reduction over ``groups`` bucket groups, argmax picks
+    the first occupied group, a dynamic slice finds its first occupied
+    fine bucket -- then the bucket's stored min.  Returns
+    ``(val, b0, found)`` with ``val = KEY_INF`` and ``b0 = nb`` when
+    every bucket is empty."""
+    nb = cnt.shape[0]
+    gw = nb // groups
+    occ = cnt > 0
+    gany = jnp.any(occ.reshape(groups, gw), axis=1)
+    g = jnp.argmax(gany).astype(jnp.int32)
+    fine = lax.dynamic_slice(occ, (g * gw,), (gw,))
+    b0 = g * gw + jnp.argmax(fine).astype(jnp.int32)
+    found = jnp.any(gany)
+    val = jnp.where(found, bmin[b0], jnp.int64(KEY_INF))
+    return val, jnp.where(found, b0, nb).astype(jnp.int32), found
+
+
+def wheel_scan(keys, slot, nb: int, *, groups: int = WHEEL_GROUPS):
+    """Fused bucket-scatter + occupancy-min-scan: one pass from lanes
+    to ``(cnt, bmin, nearest, found)``.  This is the XLA reference of
+    the Pallas kernel in :mod:`engine.kernels_pallas`; the two are
+    bit-identical (ci.sh wheel smoke, interpret mode on CPU)."""
+    cnt, bmin = wheel_scatter(keys, slot, nb)
+    val, _b0, found = wheel_nearest(cnt, bmin, groups)
+    return cnt, bmin, val, found
+
+
+# ----------------------------------------------------------------------
 # selection: masked lexicographic argmin = a heap top
 # ----------------------------------------------------------------------
 

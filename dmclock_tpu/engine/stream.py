@@ -153,6 +153,7 @@ def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
                        window_m: Optional[int] = None,
                        calendar_impl: str = "minstop",
                        ladder_levels: int = 8,
+                       wheel_kernel: str = "xla",
                        ingest: bool = True):
     """Build the pure chunk program ``(state, epoch0, counts, hists,
     ledger, flight) -> StreamChunk`` for one static configuration.
@@ -172,6 +173,7 @@ def build_stream_chunk(*, engine: str, epochs: int, m: int, k: int = 0,
         engine, k=k, chain_depth=chain_depth, select_impl=select_impl,
         tag_width=tag_width, window_m=window_m,
         calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        wheel_kernel=wheel_kernel,
         anticipation_ns=anticipation_ns,
         allow_limit_break=allow_limit_break,
         with_metrics=with_metrics)

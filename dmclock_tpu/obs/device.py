@@ -75,7 +75,20 @@ MET_SUPERVISOR_RESUMES = 16  # supervisor restarts that resumed from a
 #                              compares metric totals MODULO this row
 #                              (an interrupted run legitimately differs
 #                              here and nowhere else).
-NUM_METRICS = 17
+MET_WHEEL_OCC_HWM = 17    # wheel calendar: bucket-occupancy high-water
+#                           mark (max clients sharing one (class,
+#                           bucket) cell -- discrimination health of
+#                           the wheel geometry; an hwm row)
+MET_WHEEL_RESLOTS = 18    # wheel calendar: in-place bucket re-slots
+#                           (clients whose (class, key) moved between
+#                           ladder levels / API adjust events -- the
+#                           O(moved) work the wheel does instead of a
+#                           full O(N) re-measure)
+MET_PALLAS_FALLBACKS = 19  # batches that requested wheel_kernel=
+#                            "pallas" but ran the XLA reference (non-
+#                            TPU backend or unsupported shape) -- a
+#                            fleet silently off its kernel is visible
+NUM_METRICS = 20
 
 METRIC_NAMES = (
     "decisions_total", "decisions_reservation", "decisions_priority",
@@ -84,7 +97,8 @@ METRIC_NAMES = (
     "server_dropouts", "tracker_resyncs", "faults_injected",
     "calendar_ladder_levels_used", "calendar_ladder_base_decisions",
     "calendar_ladder_fallbacks", "degradation_ladder_steps",
-    "supervisor_resumes",
+    "supervisor_resumes", "wheel_bucket_occupancy_hwm",
+    "wheel_reslots_total", "wheel_pallas_fallbacks",
 )
 
 # rows an interrupted-and-resumed run may legitimately grow relative
@@ -97,7 +111,7 @@ RESUME_ROWS = (MET_SUPERVISOR_RESUMES,)
 # from inside jitted code paths, and a module-level jnp array built
 # under an active trace would leak a tracer into the global --
 # jnp.where folds the numpy constant in at trace time either way.
-_HWM_ROWS = (MET_RING_HWM,)
+_HWM_ROWS = (MET_RING_HWM, MET_WHEEL_OCC_HWM)
 _HWM_MASK = np.zeros((NUM_METRICS,), dtype=bool)
 for _i in _HWM_ROWS:
     _HWM_MASK[_i] = True
@@ -122,13 +136,15 @@ def metrics_delta(*, decisions=0, resv=0, prop=0, limit_break=0,
                   faults_injected=0, cal_ladder_levels_used=0,
                   cal_ladder_base_decisions=0,
                   cal_ladder_fallbacks=0, ladder_steps=0,
-                  supervisor_resumes=0) -> jnp.ndarray:
+                  supervisor_resumes=0, wheel_occ_hwm=0,
+                  wheel_reslots=0, pallas_fallbacks=0) -> jnp.ndarray:
     """Build a one-batch delta vector from scalar contributions."""
     rows = [decisions, resv, prop, limit_break, stalls, ring_hwm,
             guard_trips, ingest_drops, rebase_fallbacks,
             server_dropouts, tracker_resyncs, faults_injected,
             cal_ladder_levels_used, cal_ladder_base_decisions,
-            cal_ladder_fallbacks, ladder_steps, supervisor_resumes]
+            cal_ladder_fallbacks, ladder_steps, supervisor_resumes,
+            wheel_occ_hwm, wheel_reslots, pallas_fallbacks]
     return jnp.stack([jnp.asarray(r, dtype=jnp.int64) for r in rows])
 
 

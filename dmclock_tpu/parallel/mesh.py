@@ -157,7 +157,9 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
                      window_m: Optional[int] = None,
                      calendar_impl: str = "minstop",
                      ladder_levels: int = 8,
+                     wheel_kernel: str = "xla",
                      counter_sync_every: int = 1,
+                     collective_skipping: Optional[bool] = None,
                      ingest: bool = True,
                      with_faults: bool = False,
                      with_flight: bool = False):
@@ -203,7 +205,23 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
 
     An all-benign fault tuple (``zero_plan`` sliced) is value-
     identical to ``with_faults=False`` -- the zero-fault gate in
-    ``scripts/ci.sh``."""
+    ``scripts/ci.sh``.
+
+    ``collective_skipping`` (STATIC) restructures the epoch scan into
+    ``epochs // counter_sync_every``-sized SYNC GROUPS: the delta/rho
+    psum executes ONCE at each group head and the non-sync epochs run
+    COLLECTIVE-FREE -- zero all-reduces in the compiled HLO (the
+    tests/test_mesh.py cost-analysis gate), where the flat scan
+    executed the psum every epoch and K only gated the view refresh.
+    Bit-identical to the flat scan when ``epoch0`` lands on the sync
+    grid (``epoch0 % counter_sync_every == 0``): the group head IS
+    the one on-grid epoch of its group, and its psum reads the same
+    entry counters the flat program read there.  Off-grid chunks keep
+    the flat program (the guarded runner picks per chunk).  Default
+    ``None`` auto-enables for fault-free chunks with ``epochs``
+    divisible by K > 1; faulty chunks always run flat -- a mid-group
+    restart must re-sync from a FRESH psum, which is exactly the
+    collective the skipping removes."""
     from ..obs import device as obsdev
 
     assert engine in fastpath.EPOCH_ENGINES, engine
@@ -213,18 +231,29 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
         engine, k=k, chain_depth=chain_depth, select_impl=select_impl,
         tag_width=tag_width, window_m=window_m,
         calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        wheel_kernel=wheel_kernel,
         anticipation_ns=anticipation_ns,
         allow_limit_break=allow_limit_break,
         with_metrics=with_metrics)
     dt = int(dt_epoch_ns)
     every = max(int(counter_sync_every), 1)
+    if collective_skipping is None:
+        collective_skipping = (not with_faults and every > 1
+                               and epochs % every == 0)
+    if collective_skipping:
+        assert not with_faults, \
+            "collective skipping needs the fault-free chunk (a " \
+            "mid-group restart must re-sync from a fresh psum)"
+        assert epochs % every == 0, \
+            f"collective skipping needs epochs ({epochs}) divisible " \
+            f"by counter_sync_every ({every})"
     epoch_step = stream_mod.make_epoch_step(
         engine=engine, m=m, kw=kw, dt_epoch_ns=dt, waves=waves,
         ingest=ingest)
 
     def per_server(st, cd, cr, vd, vr, epoch0, counts_s, h, l, s, p,
                    f, flt):
-        def body(carry, xs):
+        def body(carry, xs, counters=None):
             st, cd, cr, vd, vr, h, l, s, p, f, up_prev = carry
             if with_faults:
                 counts_e, i, up, skew, delay, dup = xs
@@ -237,9 +266,16 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
             # sync grid; between syncs every shard serves from its
             # held (stale) view -- the paper's tolerance, as data.
             # The collective runs on EVERY shard (SPMD); a down
-            # shard's counters are frozen, so the psum stays monotone
-            g_d, g_r = global_counters_from(
-                cd, cr, lambda x: lax.psum(x, SERVER_AXIS))
+            # shard's counters are frozen, so the psum stays monotone.
+            # Under collective skipping the GROUP-HEAD psum arrives in
+            # ``counters`` instead -- on an aligned chunk the head is
+            # the only epoch that reads it, and it read the same
+            # values here
+            if counters is None:
+                g_d, g_r = global_counters_from(
+                    cd, cr, lambda x: lax.psum(x, SERVER_AXIS))
+            else:
+                g_d, g_r = counters
             sync = ((epoch0 + i) % every) == 0
             if with_faults:
                 restart = up & ~up_prev
@@ -303,8 +339,33 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
         else:
             up0 = jnp.asarray(True)
             xs = (counts_s, idx)
-        carry, outs = lax.scan(
-            body, (st, cd, cr, vd, vr, h, l, s, p, f, up0), xs)
+        carry0 = (st, cd, cr, vd, vr, h, l, s, p, f, up0)
+        if collective_skipping:
+            # sync groups: ONE psum per group of ``every`` epochs,
+            # computed at the group head from the carried counters,
+            # and the inner scan runs collective-free.  On an aligned
+            # chunk the head is the group's only on-grid epoch, so
+            # the refresh mask inside ``body`` consumes exactly the
+            # values the flat program's per-epoch psum produced there
+            # (off-grid epochs never read ``g_d``/``g_r`` at all)
+            groups = epochs // every
+            gxs = jax.tree.map(
+                lambda a: a.reshape((groups, every) + a.shape[1:]),
+                xs)
+
+            def group(carry, xs_g):
+                counters = global_counters_from(
+                    carry[1], carry[2],
+                    lambda x: lax.psum(x, SERVER_AXIS))
+                return lax.scan(
+                    lambda c, x: body(c, x, counters=counters),
+                    carry, xs_g)
+
+            carry, outs = lax.scan(group, carry0, gxs)
+            outs = jax.tree.map(
+                lambda a: a.reshape((epochs,) + a.shape[2:]), outs)
+        else:
+            carry, outs = lax.scan(body, carry0, xs)
         st, cd, cr, vd, vr, h, l, s, p, f = carry[:10]
         return st, cd, cr, vd, vr, h, l, f, s, p, outs
 

@@ -172,6 +172,7 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
                       window_m: Optional[int] = None,
                       calendar_impl: str = "minstop",
                       ladder_levels: int = 8,
+                      wheel_kernel: str = "xla",
                       skew_ns: int = 0,
                       hists=None, ledger=None, flight=None, slo=None,
                       prov=None,
@@ -218,6 +219,7 @@ def run_epoch_guarded(state, now, *, engine: str = "prefix",
         engine, k=k, chain_depth=chain_depth, select_impl=select_impl,
         tag_width=tag_width, window_m=window_m,
         calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        wheel_kernel=wheel_kernel,
         anticipation_ns=anticipation_ns,
         allow_limit_break=allow_limit_break,
         with_metrics=with_metrics)
@@ -367,6 +369,7 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
                              window_m: Optional[int] = None,
                              calendar_impl: str = "minstop",
                              ladder_levels: int = 8,
+                             wheel_kernel: str = "xla",
                              hists=None, ledger=None, flight=None,
                              slo=None, prov=None,
                              retries: int = 3, base_s: float = 0.05,
@@ -414,7 +417,8 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
         allow_limit_break=allow_limit_break, with_metrics=with_metrics,
         select_impl=select_impl, tag_width=tag_width,
         window_m=window_m, calendar_impl=calendar_impl,
-        ladder_levels=ladder_levels, ingest=do_ingest, donate=False)
+        ladder_levels=ladder_levels, wheel_kernel=wheel_kernel,
+        ingest=do_ingest, donate=False)
     retry_count = [0]
 
     def count_retry(attempt, exc):
@@ -482,6 +486,7 @@ def run_stream_chunk_guarded(state, epoch0: int, counts, *,
             with_metrics=with_metrics, select_impl=select_impl,
             tag_width=tag_width, window_m=window_m,
             calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+            wheel_kernel=wheel_kernel,
             hists=cur["hists"], ledger=cur["ledger"],
             flight=cur["flight"], slo=cur["slo"], prov=cur["prov"],
             retries=retries, base_s=base_s,
@@ -621,7 +626,9 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
                            window_m: Optional[int] = None,
                            calendar_impl: str = "minstop",
                            ladder_levels: int = 8,
+                           wheel_kernel: str = "xla",
                            counter_sync_every: int = 1,
+                           collective_skipping: Optional[bool] = None,
                            hists=None, ledger=None, slo=None,
                            prov=None, flight=None, faults=None,
                            retries: int = 3, base_s: float = 0.05,
@@ -647,7 +654,15 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
     in-chunk semantics); the guard-trip fallback replays the SAME
     fault schedule on the host robust loop, so a chaos chunk degrades
     to the proven path without ever dropping the plan.  ``flight`` is
-    the stacked per-shard flight-ring state (or None)."""
+    the stacked per-shard flight-ring state (or None).
+
+    ``collective_skipping=None`` resolves PER CHUNK from the host-side
+    ``epoch0``: the grouped (collective-free non-sync epochs) program
+    is picked only when the chunk is fault-free, ``epochs`` divides by
+    ``counter_sync_every`` > 1, AND ``epoch0`` lands on the sync grid
+    -- the alignment ``parallel.mesh.build_mesh_chunk`` documents as
+    the bit-identity condition.  Off-grid chunks run the flat program
+    (bit-identity over raw launch count)."""
     import numpy as np
 
     import jax
@@ -692,6 +707,11 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
     if faults is not None:
         faults_dev = tuple(
             jax.device_put(jnp.asarray(a), sharding) for a in faults)
+    every = max(int(counter_sync_every), 1)
+    if collective_skipping is None:
+        collective_skipping = (faults is None and every > 1
+                               and epochs % every == 0
+                               and int(epoch0) % every == 0)
     fn = mesh_mod.jit_mesh_chunk(
         mesh, engine=engine, epochs=epochs, m=m, k=k,
         chain_depth=chain_depth, dt_epoch_ns=dt_epoch_ns, waves=waves,
@@ -700,7 +720,9 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
         with_metrics=with_metrics, select_impl=select_impl,
         tag_width=tag_width, window_m=window_m,
         calendar_impl=calendar_impl, ladder_levels=ladder_levels,
-        counter_sync_every=counter_sync_every, ingest=do_ingest,
+        wheel_kernel=wheel_kernel,
+        counter_sync_every=counter_sync_every,
+        collective_skipping=collective_skipping, ingest=do_ingest,
         with_faults=faults is not None,
         with_flight=flight is not None)
     retry_count = [0]
@@ -766,6 +788,7 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
         with_metrics=with_metrics, select_impl=select_impl,
         tag_width=tag_width, window_m=window_m,
         calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        wheel_kernel=wheel_kernel,
         counter_sync_every=counter_sync_every,
         hists=hists, ledger=ledger, slo=slo, prov=prov,
         flight=flight, faults=faults, retries=retries,
@@ -786,6 +809,7 @@ def mesh_chunk_host_replay(state, cd, cr, view_d, view_r,
                            window_m: Optional[int] = None,
                            calendar_impl: str = "minstop",
                            ladder_levels: int = 8,
+                           wheel_kernel: str = "xla",
                            counter_sync_every: int = 1,
                            hists=None, ledger=None, slo=None,
                            prov=None, flight=None, faults=None,
@@ -866,6 +890,7 @@ def mesh_chunk_host_replay(state, cd, cr, view_d, view_r,
         engine, k=k, chain_depth=chain_depth, select_impl=select_impl,
         tag_width=tag_width, window_m=window_m,
         calendar_impl=calendar_impl, ladder_levels=ladder_levels,
+        wheel_kernel=wheel_kernel,
         anticipation_ns=anticipation_ns,
         allow_limit_break=allow_limit_break,
         with_metrics=with_metrics)
@@ -930,7 +955,8 @@ def mesh_chunk_host_replay(state, cd, cr, view_d, view_r,
                 with_metrics=with_metrics, select_impl=select_impl,
                 tag_width=tag_width, window_m=window_m,
                 calendar_impl=calendar_impl,
-                ladder_levels=ladder_levels, skew_ns=skew,
+                ladder_levels=ladder_levels,
+                wheel_kernel=wheel_kernel, skew_ns=skew,
                 hists=cur["hists"][s], ledger=cur["ledger"][s],
                 flight=cur["flight"][s],
                 slo=cur["slo"][s], prov=cur["prov"][s],
@@ -994,9 +1020,13 @@ def mesh_chunk_host_replay(state, cd, cr, view_d, view_r,
 # Rung order is cheapest-concession-first: each (knob, fast, safe)
 # step trades a fast path for its always-exact twin, and every rung is
 # already pinned bit-identical/exact by the differential suites
-# (tests/test_calendar_bucketed.py, tests/test_radix.py), so a
-# degraded run is SLOWER, never DIVERGENT.
+# (tests/test_calendar_wheel.py, tests/test_calendar_bucketed.py,
+# tests/test_radix.py), so a degraded run is SLOWER, never DIVERGENT.
+# The two calendar rungs share a knob and CHAIN: wheel steps down to
+# bucketed first, and a second concession carries bucketed to minstop
+# -- rung engagement is keyed by (knob, fast), not knob alone.
 LADDER_RUNGS = (
+    ("calendar_impl", "wheel", "bucketed"),
     ("calendar_impl", "bucketed", "minstop"),
     ("select_impl", "radix", "sort"),
     ("tag_width", 32, 64),
@@ -1042,15 +1072,21 @@ class DegradationLadder:
     def steps_taken(self) -> int:
         return len(self.steps)
 
-    def _engaged(self, knob: str) -> bool:
-        return any(s.knob == knob for s in self.steps)
+    def _engaged(self, knob: str, fast) -> bool:
+        # keyed by (knob, fast): the two calendar rungs share a knob,
+        # and engaging wheel->bucketed must not imply
+        # bucketed->minstop
+        return any(s.knob == knob and s.from_value == fast
+                   for s in self.steps)
 
     def apply(self, cfg: dict) -> dict:
         """Map a config through the engaged rungs (a knob already at
-        its safe value is untouched)."""
+        its safe value is untouched).  Rung order chains the shared-
+        knob calendar rungs: wheel->bucketed rewrites the value the
+        bucketed->minstop rung then reads."""
         out = dict(cfg)
         for knob, fast, safe in LADDER_RUNGS:
-            if self._engaged(knob) and out.get(knob) == fast:
+            if self._engaged(knob, fast) and out.get(knob) == fast:
                 out[knob] = safe
         return out
 
@@ -1059,7 +1095,7 @@ class DegradationLadder:
         retry loops use this to bound re-attempts: a failure with
         nothing left to concede must surface, not spin."""
         return self.enabled and any(
-            cfg.get(knob) == fast and not self._engaged(knob)
+            cfg.get(knob) == fast and not self._engaged(knob, fast)
             for knob, fast, _safe in LADDER_RUNGS)
 
     def note_epoch(self, cfg: dict, *, guard_trips: int = 0,
@@ -1077,7 +1113,7 @@ class DegradationLadder:
             return 0
         self._consecutive = 0
         for knob, fast, safe in LADDER_RUNGS:
-            if cfg.get(knob) == fast and not self._engaged(knob):
+            if cfg.get(knob) == fast and not self._engaged(knob, fast):
                 reason = "guard_trips" if guard_trips \
                     else "launch_failures"
                 self.steps.append(LadderStep(knob, fast, safe, reason))
@@ -1097,8 +1133,8 @@ class DegradationLadder:
     # -- checkpoint round-trip (int64[R + 1]: engaged flags + counter)
     def encode(self):
         import numpy as np
-        vec = [1 if self._engaged(knob) else 0
-               for knob, _, _ in LADDER_RUNGS]
+        vec = [1 if self._engaged(knob, fast) else 0
+               for knob, fast, _ in LADDER_RUNGS]
         return np.asarray(vec + [self._consecutive], dtype=np.int64)
 
     def load(self, vec) -> None:

@@ -92,6 +92,7 @@ class EpochJob:
     tag_width: int = 64
     calendar_impl: str = "minstop"
     ladder_levels: int = 4
+    wheel_kernel: str = "xla"       # wheel bucket kernel: xla | pallas
     seed: int = 11                  # arrival RNG seed
     arrival_lam: float = 2.0        # Poisson mean arrivals/client/epoch
     waves: int = 4
@@ -1138,6 +1139,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                         tag_width=cfg["tag_width"],
                         calendar_impl=cfg["calendar_impl"],
                         ladder_levels=job.ladder_levels,
+                        wheel_kernel=job.wheel_kernel,
                         hists=hists, ledger=ledger, flight=flight,
                         slo=slo_block, prov=prov, tracer=tracer)
                     break
@@ -1487,6 +1489,7 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                         tag_width=cfg["tag_width"],
                         calendar_impl=cfg["calendar_impl"],
                         ladder_levels=job.ladder_levels,
+                        wheel_kernel=job.wheel_kernel,
                         hists=hists, ledger=ledger, flight=flight,
                         slo=slo_block, prov=prov, tracer=tracer,
                         overlap=overlap)
@@ -1792,6 +1795,7 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                         tag_width=cfg["tag_width"],
                         calendar_impl=cfg["calendar_impl"],
                         ladder_levels=job.ladder_levels,
+                        wheel_kernel=job.wheel_kernel,
                         counter_sync_every=job.counter_sync_every,
                         hists=hists, ledger=ledger, slo=wblock,
                         prov=prov, flight=flight, faults=faults,

@@ -55,7 +55,8 @@ from ..engine import kernels
 # would stage its module-level jnp constants into the caller's trace
 # (cached in module globals -> UnexpectedTracerError on reuse)
 from ..engine.fastpath import (_window_heads, calendar_batch,
-                               calendar_batch_bucketed, ring_window,
+                               calendar_batch_bucketed,
+                               calendar_batch_wheel, ring_window,
                                speculate_prefix_batch)
 from ..engine.state import EngineState, init_state
 from ..parallel.cluster import SERVER_AXIS, make_mesh
@@ -165,7 +166,8 @@ def init_device_sim(cfg: SimConfig, ring_capacity: int = 256,
                     calendar_steps: int = 8,
                     ladder_levels: int = 4
                     ) -> tuple[DeviceSim, DeviceSimSpec]:
-    assert calendar_impl in (None, "minstop", "bucketed"), calendar_impl
+    assert calendar_impl in (None, "minstop", "bucketed",
+                             "wheel"), calendar_impl
     assert 1 <= calendar_steps <= ring_capacity, \
         "calendar_steps must fit the ring window"
     assert ladder_levels >= 1
@@ -426,7 +428,15 @@ def device_sim_step(sim: DeviceSim, spec: DeviceSimSpec, mesh: Mesh,
 
                         def cal_body(carry):
                             eng, srv, rsv, total, _ = carry
-                            if spec.calendar_impl == "bucketed":
+                            if spec.calendar_impl == "wheel":
+                                b = calendar_batch_wheel(
+                                    eng, t_end, steps=steps,
+                                    levels=spec.ladder_levels,
+                                    anticipation_ns=0,
+                                    allow_limit_break=spec
+                                    .allow_limit_break,
+                                    use_pallas=False)
+                            elif spec.calendar_impl == "bucketed":
                                 b = calendar_batch_bucketed(
                                     eng, t_end, steps=steps,
                                     levels=spec.ladder_levels,
@@ -639,10 +649,11 @@ def run_device_sim(cfg: SimConfig, *, mesh: Optional[Mesh] = None,
     validates statically, made CHECKED so future edits that weaken the
     validation surface instead of silently under-serving.
 
-    ``calendar_impl`` (None|"minstop"|"bucketed") front-loads each
-    slice with sortless calendar batches (DeviceSimSpec.calendar_impl)
-    -- service stays exactly the q-step serial stream, pinned by
-    tests/test_calendar_bucketed.py.
+    ``calendar_impl`` (None|"minstop"|"bucketed"|"wheel") front-loads
+    each slice with sortless calendar batches
+    (DeviceSimSpec.calendar_impl) -- service stays exactly the q-step
+    serial stream, pinned by tests/test_calendar_bucketed.py and
+    tests/test_calendar_wheel.py.
 
     Returns (sim, spec, report_str)."""
     if mesh is None:

@@ -101,14 +101,17 @@ def _zipf_state(n, ring, depth):
 
 
 def measure_calendar(name, state, *, impl, levels, m_lo=4, m_hi=12,
-                     steps=8):
-    """Calendar-epoch A/B row (minstop vs bucketed ladder): marginal
-    batch cost AND marginal decisions -- the two impls commit
+                     steps=8, **cal_kw):
+    """Calendar-epoch A/B row (minstop vs bucketed ladder vs wheel):
+    marginal batch cost AND marginal decisions -- the impls commit
     different amounts per batch, so dec/s is the honest comparison,
-    not us/batch alone."""
+    not us/batch alone.  ``cal_kw`` forwards to
+    ``scan_calendar_epoch`` (the wheel_kernel xla/pallas A/B differs
+    only there)."""
     mk = lambda m: jax.jit(functools.partial(       # noqa: E731
         fastpath.scan_calendar_epoch, m=m, steps=steps,
-        anticipation_ns=0, calendar_impl=impl, ladder_levels=levels))
+        anticipation_ns=0, calendar_impl=impl, ladder_levels=levels,
+        **cal_kw))
     f_lo, f_hi = mk(m_lo), mk(m_hi)
     now = jnp.int64(0)
     jax.device_get(state_digest(f_lo(state, now).state))
@@ -176,6 +179,19 @@ def main():
                      impl="bucketed", levels=4)
     measure_calendar("scan_calendar_epoch bucketed L=8 (steps=8)", zs,
                      impl="bucketed", levels=8)
+    # -- wheel: same ladder driven from the maintained bucket index
+    # (O(1)-bucket re-slot per commit instead of an O(N) rebuild per
+    # boundary), then the bucket kernel itself A/B'd xla vs pallas.
+    # The pallas row prints the EFFECTIVE kernel: off-TPU (or on an
+    # unsupported shape) the wheel falls back to the XLA kernel and
+    # the two rows honestly measure the same program.
+    measure_calendar("scan_calendar_epoch wheel L=8 (steps=8)", zs,
+                     impl="wheel", levels=8)
+    _, fb = fastpath._wheel_resolve("pallas", n)
+    eff = "xla-fallback" if fb else "pallas"
+    measure_calendar(
+        f"scan_calendar_epoch wheel L=8 kernel={eff}", zs,
+        impl="wheel", levels=8, wheel_kernel="pallas")
 
     # -- selection core of _prefix_select: the 5-array 2-key i32 sort
     # plus the cumulative-min prefix validation

@@ -209,7 +209,7 @@ def main() -> int:
              and not is_chaos(r) and not is_restarted(r)
              and not is_degraded(r)]
     def series(wl, key, impl, cal, loop, scen=None, pop=None,
-               provon=True, shards=None, sync=None):
+               provon=True, shards=None, sync=None, wk="xla"):
         """Prior values of one per-workload scalar column, filtered to
         the same fast-path identity (select_impl + calendar_impl +
         engine_loop + provenance_on) the throughput series uses.
@@ -241,6 +241,8 @@ def main() -> int:
                 and r["workloads"][wl].get("n_shards") == shards
                 and r["workloads"][wl].get("counter_sync_every")
                 == sync
+                and r["workloads"][wl].get("wheel_kernel_effective",
+                                           "xla") == wk
                 and bool(r["workloads"][wl].get("provenance_on",
                                                 True)) == provon]
 
@@ -296,9 +298,17 @@ def main() -> int:
         sync = row.get("counter_sync_every")
         if shards is not None and pop is None:
             pop = row.get("clients_total")
+        # wheel rows carry the EFFECTIVE bucket kernel (xla vs
+        # pallas; "effective" because an unsupported shape falls
+        # back): decisions are bit-identical across kernels but the
+        # rates are the whole A/B, so they form separate histories.
+        # Rows predating the knob (and every non-wheel row) == xla.
+        wk = row.get("wheel_kernel_effective", "xla")
         tag = f"{wl}[{impl}]" if impl != "sort" else wl
         if cal != "minstop":
             tag += f"[{cal}]"
+        if wk != "xla":
+            tag += f"[{wk}]"
         if loop != "round" and loop not in wl:
             tag += f"[{loop}]"
         if scen is not None:
@@ -320,7 +330,8 @@ def main() -> int:
                   "-- recorded for the trajectory, not judged "
                   "against clean-run medians")
             continue
-        hist = series(wl, "dps", impl, cal, loop, scen, pop, provon, shards, sync)
+        hist = series(wl, "dps", impl, cal, loop, scen, pop, provon,
+                      shards, sync, wk)
         if len(hist) < args.min_records:
             print(f"bench_guard: {tag}: {dps/1e6:.1f}M "
                   f"({len(hist)} prior record(s) -- not judged)")
@@ -362,7 +373,8 @@ def main() -> int:
         psm = row.get("dps_per_shard_mean")
         if psm is not None:
             p_hist = series(wl, "dps_per_shard_mean", impl, cal,
-                            loop, scen, pop, provon, shards, sync)
+                            loop, scen, pop, provon, shards, sync,
+                            wk)
             if len(p_hist) < args.min_records:
                 print(f"bench_guard: {tag}: per-shard "
                       f"{psm/1e6:.2f}M ({len(p_hist)} prior "
@@ -391,7 +403,7 @@ def main() -> int:
         p99 = row.get("tardiness_p99_ns")
         if p99 is not None:
             t_hist = series(wl, "tardiness_p99_ns", impl, cal, loop,
-                            scen, pop, provon, shards, sync)
+                            scen, pop, provon, shards, sync, wk)
             if len(t_hist) < args.min_records:
                 print(f"bench_guard: {tag}: p99 tardiness "
                       f"{p99/1e6:.2f}ms ({len(t_hist)} prior "
@@ -423,7 +435,7 @@ def main() -> int:
         disp = row.get("dispatch_ms_per_launch")
         if disp is not None:
             d_hist = series(wl, "dispatch_ms_per_launch", impl, cal,
-                            loop, scen, pop, provon, shards, sync)
+                            loop, scen, pop, provon, shards, sync, wk)
             if len(d_hist) < args.min_records:
                 print(f"bench_guard: {tag}: dispatch "
                       f"{disp:.2f}ms/launch ({len(d_hist)} prior "
@@ -456,7 +468,7 @@ def main() -> int:
         viol = row.get("slo_violations_total")
         if viol is not None:
             v_hist = series(wl, "slo_violations_total", impl, cal,
-                            loop, scen, pop, provon, shards, sync)
+                            loop, scen, pop, provon, shards, sync, wk)
             if len(v_hist) < args.min_records:
                 print(f"bench_guard: {tag}: slo violations {viol} "
                       f"({len(v_hist)} prior record(s) -- not "
@@ -480,7 +492,7 @@ def main() -> int:
         serr = row.get("slo_worst_share_err")
         if serr is not None:
             s_hist = series(wl, "slo_worst_share_err", impl, cal,
-                            loop, scen, pop, provon, shards, sync)
+                            loop, scen, pop, provon, shards, sync, wk)
             if len(s_hist) < args.min_records:
                 print(f"bench_guard: {tag}: worst-window share err "
                       f"{serr:.3f} ({len(s_hist)} prior record(s) "
@@ -512,7 +524,7 @@ def main() -> int:
         cms = row.get("compile_ms_total")
         if cms is not None:
             c_hist = series(wl, "compile_ms_total", impl, cal, loop,
-                            scen, pop, provon, shards, sync)
+                            scen, pop, provon, shards, sync, wk)
             if len(c_hist) < args.min_records:
                 print(f"bench_guard: {tag}: compile {cms:.0f}ms "
                       f"({len(c_hist)} prior record(s) -- not "
@@ -542,7 +554,7 @@ def main() -> int:
         rt = row.get("retraces")
         if rt is not None:
             r_hist = series(wl, "retraces", impl, cal, loop, scen,
-                            pop, provon, shards, sync)
+                            pop, provon, shards, sync, wk)
             if len(r_hist) < args.min_records:
                 print(f"bench_guard: {tag}: retraces {rt} "
                       f"({len(r_hist)} prior record(s) -- not "
@@ -571,7 +583,7 @@ def main() -> int:
         mp99 = row.get("margin_p99_ns")
         if mp99 is not None:
             m_hist = series(wl, "margin_p99_ns", impl, cal, loop,
-                            scen, pop, provon, shards, sync)
+                            scen, pop, provon, shards, sync, wk)
             if len(m_hist) < args.min_records:
                 print(f"bench_guard: {tag}: margin p99 "
                       f"{mp99/1e6:.2f}ms ({len(m_hist)} prior "
@@ -598,7 +610,7 @@ def main() -> int:
         sv = row.get("starvation_max_ns")
         if sv is not None:
             s_hist2 = series(wl, "starvation_max_ns", impl, cal,
-                             loop, scen, pop, provon, shards, sync)
+                             loop, scen, pop, provon, shards, sync, wk)
             if len(s_hist2) < args.min_records:
                 print(f"bench_guard: {tag}: starvation max "
                       f"{sv/1e6:.0f}ms ({len(s_hist2)} prior "

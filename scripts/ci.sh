@@ -162,6 +162,146 @@ print(f"bucketed L={L} == {L}x minstop composition "
 print("calendar digest gate ok")
 EOF
 
+echo "== wheel smoke (maintained-calendar digest gate + pallas interpret parity) =="
+# the timer-wheel calendar (docs/ENGINE.md "Timer wheel"): (1) the
+# wheel at L=1 must be BIT-IDENTICAL to the minstop path AND to the
+# bucketed ladder at L=1 (three programs, one decision stream); (2) a
+# wheel ladder of L levels must equal the COMPOSITION of L sequential
+# minstop batches exactly (committed set + final state digest) while
+# committing strictly more per launch; (3) DMCLOCK_WHEEL_INTERPRET=1
+# must run the Pallas bucket-scan kernel in interpret mode
+# BIT-IDENTICALLY to the XLA reference on any backend -- the
+# off-silicon parity pin for the repo's first Pallas kernel; (4) a
+# wheel EpochJob must be digest-identical to the bucketed ladder
+# under the round, stream, and 4-shard mesh loops, with the wheel
+# metric rows (occupancy hwm / re-slots) live and the fallback row
+# zero on the XLA path.
+timeout -k 30 1200 python - <<'EOF'
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=8")
+jax.config.update("jax_enable_x64", True)
+import dataclasses, functools, hashlib
+import numpy as np, jax.numpy as jnp
+from __graft_entry__ import _preloaded_state
+from dmclock_tpu.core.timebase import rate_to_inv_ns
+from dmclock_tpu.engine import fastpath
+from dmclock_tpu.engine.fastpath import (calendar_batch,
+                                         calendar_batch_wheel,
+                                         scan_calendar_epoch)
+from dmclock_tpu.obs import device as obsdev
+from dmclock_tpu.robust import supervisor as SV
+from profile_util import state_digest
+
+N = 2048
+st = _preloaded_state(N, 24, ring=32)
+w = np.clip(1.0 / np.arange(1, N + 1) ** 1.1
+            / (1.0 / (N // 2) ** 1.1), 0.5, 64.0)
+rng = np.random.default_rng(7); rng.shuffle(w)
+winv = np.asarray([rate_to_inv_ns(x) for x in w], np.int64)
+st = st._replace(weight_inv=jnp.asarray(winv),
+                 head_prop=jnp.asarray(winv))
+now = jnp.int64(0)
+
+def digest(ep):
+    h = hashlib.sha256()
+    for arr in (ep.count, ep.resv_count, ep.served, ep.progress_ok):
+        h.update(jax.device_get(arr).tobytes())
+    h.update(jax.device_get(state_digest(ep.state)).tobytes())
+    return h.hexdigest()
+
+# (1) wheel L=1 == minstop == bucketed L=1, bit-identical
+eps = {}
+for impl in ("minstop", "bucketed", "wheel"):
+    eps[impl] = jax.jit(functools.partial(
+        scan_calendar_epoch, m=3, steps=8, anticipation_ns=0,
+        calendar_impl=impl, ladder_levels=1))(st, now)
+d = {impl: digest(ep) for impl, ep in eps.items()}
+assert d["wheel"] == d["minstop"] == d["bucketed"], d
+print(f"wheel L=1 bit-identical to minstop + bucketed ({d['wheel'][:16]}, "
+      f"{int(jax.device_get(eps['wheel'].count).sum())} decisions)")
+
+# (2) wheel L=4 == 4x minstop composition, strictly more per launch
+L = 4
+wb = jax.jit(functools.partial(
+    calendar_batch_wheel, steps=8, levels=L))(st, now)
+s, served = st, np.zeros(N, np.int32)
+tot = 0; first = None
+for _ in range(L):
+    b = jax.jit(functools.partial(calendar_batch, steps=8))(s, now)
+    if first is None:
+        first = int(b.count)
+    tot += int(b.count); served += np.asarray(jax.device_get(b.served))
+    s = b.state
+assert tot == int(wb.count), (tot, int(wb.count))
+assert np.array_equal(served, np.asarray(jax.device_get(wb.served)))
+assert bool(jax.device_get(state_digest(wb.state)
+                           == state_digest(s))), "final state diverged"
+assert int(wb.count) > first, \
+    f"wheel ladder committed no more per launch ({int(wb.count)} vs {first})"
+print(f"wheel L={L} == {L}x minstop composition "
+      f"({int(wb.count)} decisions/launch vs minstop {first})")
+
+# (3) pallas interpret mode bit-identical to the XLA bucket scan
+_, fb = fastpath._wheel_resolve("pallas", N)
+assert fb, "cpu backend should fall back without the interpret pin"
+os.environ["DMCLOCK_WHEEL_INTERPRET"] = "1"
+try:
+    _, fb = fastpath._wheel_resolve("pallas", N)
+    assert not fb, "interpret pin did not engage the pallas kernel"
+    pair = {}
+    for wk in ("xla", "pallas"):
+        pair[wk] = jax.jit(functools.partial(
+            calendar_batch_wheel, steps=8, levels=2,
+            wheel_kernel=wk))(st, now)
+finally:
+    del os.environ["DMCLOCK_WHEEL_INTERPRET"]
+for f in ("count", "resv_count", "units", "served", "served_resv",
+          "lb", "progress_ok", "level_count", "level_bound",
+          "level_stall", "served_cost"):
+    assert np.array_equal(
+        np.asarray(jax.device_get(getattr(pair["xla"], f))),
+        np.asarray(jax.device_get(getattr(pair["pallas"], f)))), f
+assert bool(jax.device_get(state_digest(pair["xla"].state) ==
+                           state_digest(pair["pallas"].state)))
+print(f"pallas interpret bit-identical to xla "
+      f"({int(jax.device_get(pair['pallas'].count))} decisions)")
+
+# (4) wheel EpochJob == bucketed on round, stream, and 4-shard mesh
+base = dict(n=96, depth=6, ring=12, epochs=4, m=2, k=4, seed=9,
+            arrival_lam=1.5, waves=3, ckpt_every=2)
+WROWS = (obsdev.MET_WHEEL_OCC_HWM, obsdev.MET_WHEEL_RESLOTS,
+         obsdev.MET_PALLAS_FALLBACKS)
+for loop in ("round", "stream", "mesh"):
+    extra = {"n_shards": 4} if loop == "mesh" else {}
+    rb = SV.run_job(SV.EpochJob(engine="calendar",
+                                calendar_impl="bucketed",
+                                ladder_levels=2, engine_loop=loop,
+                                **extra, **base))
+    rw = SV.run_job(SV.EpochJob(engine="calendar",
+                                calendar_impl="wheel",
+                                ladder_levels=2, engine_loop=loop,
+                                **extra, **base))
+    assert rw.decisions == rb.decisions > 0, loop
+    assert rw.digest == rb.digest, f"{loop}: wheel digest diverged"
+    assert rw.state_digest == rb.state_digest, loop
+    mb, mw = np.asarray(rb.metrics).copy(), np.asarray(rw.metrics).copy()
+    assert mw[obsdev.MET_WHEEL_OCC_HWM] > 0, \
+        f"{loop}: wheel occupancy hwm never observed"
+    assert mw[obsdev.MET_PALLAS_FALLBACKS] == 0, \
+        f"{loop}: xla path counted pallas fallbacks"
+    mb[list(WROWS)] = 0; mw[list(WROWS)] = 0
+    assert np.array_equal(mw, mb), f"{loop}: non-wheel metrics diverged"
+    print(f"{loop}: wheel == bucketed ({rw.decisions} decisions, "
+          f"digest {rw.digest[:16]})")
+print("wheel smoke ok")
+EOF
+
 echo "== telemetry smoke (histogram/ledger digest gate + scrape) =="
 # the device telemetry plane (docs/OBSERVABILITY.md): (1) enabling
 # histograms + ledger + flight recorder must leave the decision digest

@@ -48,6 +48,9 @@ JOBS = {
     "calendar-bucketed": SV.EpochJob(engine="calendar", k=4,
                                      calendar_impl="bucketed",
                                      ladder_levels=2, **BASE),
+    "calendar-wheel": SV.EpochJob(engine="calendar", k=4,
+                                  calendar_impl="wheel",
+                                  ladder_levels=2, **BASE),
 }
 
 _REFS: dict = {}
@@ -83,6 +86,7 @@ class TestMeshIdentityGate:
         "prefix-sort", "chain", "calendar-minstop",
         pytest.param("prefix-radix", marks=pytest.mark.slow),
         pytest.param("calendar-bucketed", marks=pytest.mark.slow),
+        pytest.param("calendar-wheel", marks=pytest.mark.slow),
     ])
     def test_s1_mesh_bit_identical_to_round_and_stream(self, name):
         """The acceptance gate: S=1 engine_loop="mesh" == "round" ==
@@ -219,6 +223,106 @@ class TestMeshScaling:
                     got = TRK.exchange_schedule(n, every,
                                                 start=start)["syncs"]
                     assert got == want, (start, every, n)
+
+
+def _collective_execs(jaxpr, mult=1):
+    """EXECUTED collective count: walk the jaxpr multiplying by scan
+    trip counts.  Counting "all-reduce" in compiled HLO TEXT is
+    constant across K -- lax.scan traces its body once -- so text
+    counting cannot distinguish a per-epoch psum from a per-group
+    one; this walk counts what the program runs, not what it
+    contains."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if "psum" in name or "pmax" in name or "all_reduce" in name:
+            total += mult
+            continue
+        m2 = mult
+        if name == "scan":
+            m2 = mult * eqn.params["length"]
+        for v in eqn.params.values():
+            if isinstance(v, jax.extend.core.ClosedJaxpr):
+                total += _collective_execs(v.jaxpr, m2)
+            elif hasattr(v, "eqns"):
+                total += _collective_execs(v, m2)
+    return total
+
+
+class TestCollectiveSkipping:
+    """Non-sync epochs execute ZERO collectives, by program
+    structure: the chunk scan regrouped into epochs/K sync groups
+    pays ONE counter psum per group head and must stay bit-identical
+    to the flat per-epoch program whenever the chunk starts on the
+    sync grid."""
+
+    def _chunk_fn(self, S, E, K, skipping):
+        import jax.numpy as jnp
+
+        mesh = M.make_mesh(S)
+        job = JOBS["prefix-sort"]
+        state = M.stack_shards(
+            SV._job_state(dataclasses.replace(
+                job, engine_loop="stream")), S, mesh)
+        cd, cr, vd, vr = M.counter_init(S, job.n)
+        slo0 = M.stack_shards(obsslo.window_zero(job.n), S, mesh)
+        fn = M.jit_mesh_chunk(mesh, engine="prefix", epochs=E,
+                              m=job.m, k=job.k,
+                              dt_epoch_ns=job.dt_epoch_ns,
+                              waves=job.waves, with_metrics=True,
+                              counter_sync_every=K, ingest=True,
+                              collective_skipping=skipping)
+        rng = np.random.Generator(np.random.PCG64(13))
+        counts = jnp.asarray(
+            rng.poisson(1.0, (S, E, job.n)).astype(np.int32))
+        args = (state, cd, cr, vd, vr, jnp.int64(0), counts,
+                None, None, slo0, None)
+        return fn, args
+
+    def test_grouped_bit_identical_to_flat(self):
+        """K=2 over 4 epochs, grouped vs flat, aligned chunk: every
+        output leaf bitwise equal (states, outs, counters, views,
+        merged SLO block)."""
+        fn_g, args = self._chunk_fn(2, 4, 2, True)
+        fn_f, _ = self._chunk_fn(2, 4, 2, False)
+        out_g = fn_g(*args)
+        out_f = fn_f(*args)
+        leaves_g = jax.tree.leaves(out_g)
+        leaves_f = jax.tree.leaves(out_f)
+        assert len(leaves_g) == len(leaves_f)
+        for a, b in zip(leaves_g, leaves_f):
+            assert np.array_equal(np.asarray(jax.device_get(a)),
+                                  np.asarray(jax.device_get(b)))
+
+    def test_collective_execution_counts(self):
+        """The structural gate: flat executes 2E+2 collectives (cd/cr
+        psum per epoch + the final window-merge psum/pmax); grouped
+        executes 2*(E/K)+2 -- and the a1-a8 identity
+        flat - grouped(K=E) == (E-1) * (grouped(K=E/2) - grouped(K=E))
+        pins that the difference is exactly the per-epoch pair."""
+        E = 8
+        counts = {}
+        for K, skip in ((1, False), (4, True), (8, True)):
+            fn, args = self._chunk_fn(2, E, K, skip)
+            jx = jax.make_jaxpr(fn)(*args)
+            counts[K] = _collective_execs(jx.jaxpr)
+        assert counts[1] == 2 * E + 2, counts
+        assert counts[4] == 2 * (E // 4) + 2, counts
+        assert counts[8] == 2 * (E // 8) + 2, counts
+        assert counts[1] - counts[8] == \
+            (E - 1) * (counts[4] - counts[8])
+
+    def test_supervised_grouped_digest_equals_flat(self):
+        """Supervisor-level: a K=2 mesh job whose chunks align with
+        the sync grid runs the grouped program (auto-resolved in
+        run_mesh_chunk_guarded) and must equal K=1 bit for bit."""
+        k2 = SV.run_job(mesh_job("prefix-sort", n_shards=2, epochs=4,
+                                 ckpt_every=2, counter_sync_every=2))
+        k1 = SV.run_job(mesh_job("prefix-sort", n_shards=2, epochs=4,
+                                 ckpt_every=2, counter_sync_every=1))
+        assert k2.digest == k1.digest
+        assert k2.state_digest == k1.state_digest
+        assert np.array_equal(k2.mesh_counters, k1.mesh_counters)
 
 
 class TestMeshWindowMerge:
@@ -647,6 +751,8 @@ class TestMeshChaos:
         pytest.param("prefix-sort", 4, marks=pytest.mark.slow),
         pytest.param("prefix-radix", 2, marks=pytest.mark.slow),
         pytest.param("calendar-bucketed", 2,
+                     marks=pytest.mark.slow),
+        pytest.param("calendar-wheel", 2,
                      marks=pytest.mark.slow),
     ])
     def test_chaos_chunk_equals_host_replay(self, name, K):

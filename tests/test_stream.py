@@ -38,6 +38,9 @@ JOBS = {
     "calendar-bucketed": SV.EpochJob(engine="calendar", k=4,
                                      calendar_impl="bucketed",
                                      ladder_levels=2, **BASE),
+    "calendar-wheel": SV.EpochJob(engine="calendar", k=4,
+                                  calendar_impl="wheel",
+                                  ladder_levels=2, **BASE),
 }
 
 _REFS: dict = {}
@@ -86,6 +89,7 @@ class TestStreamDigestGate:
         pytest.param("prefix-radix", marks=pytest.mark.slow),
         pytest.param("prefix-tag32", marks=pytest.mark.slow),
         pytest.param("calendar-bucketed", marks=pytest.mark.slow),
+        pytest.param("calendar-wheel", marks=pytest.mark.slow),
     ])
     def test_stream_bit_identical_to_round(self, name):
         """The tentpole gate: fused ingest+serve chunks with

@@ -34,6 +34,9 @@ ENGINE_JOBS = {
     "calendar-bucketed": SV.EpochJob(engine="calendar", k=4,
                                      calendar_impl="bucketed",
                                      ladder_levels=2),
+    "calendar-wheel": SV.EpochJob(engine="calendar", k=4,
+                                  calendar_impl="wheel",
+                                  ladder_levels=2),
 }
 ENGINE_JOBS = {
     name: dataclasses.replace(job, n=96, depth=6, ring=10, epochs=4,
@@ -60,6 +63,7 @@ class TestCrashEquivalence:
         pytest.param("prefix-radix", marks=pytest.mark.slow),
         pytest.param("prefix-tag32", marks=pytest.mark.slow),
         pytest.param("calendar-bucketed", marks=pytest.mark.slow),
+        pytest.param("calendar-wheel", marks=pytest.mark.slow),
     ])
     def test_kill_mid_run_resumes_bit_identical(self, tmp_path, name):
         """SIGKILL (trampoline form) between two checkpoints -- the
@@ -231,7 +235,7 @@ class TestScrapeLoss:
 class TestDegradationLadder:
     def test_rung_order_and_encode_round_trip(self):
         ladder = DegradationLadder(threshold=2)
-        cfg = {"calendar_impl": "bucketed", "select_impl": "radix",
+        cfg = {"calendar_impl": "wheel", "select_impl": "radix",
                "tag_width": 32}
         stepped = []
         for _ in range(12):
