@@ -1832,6 +1832,116 @@ def bench_mesh(clients: int = 100_000, *, n_shards=None,
     return row
 
 
+def bench_controller(scenarios=("shard_skew", "limit_thrash",
+                                "diurnal"), *,
+                     sides: str = "both", total_ids: int = 192,
+                     epochs: int = 48, ckpt_every: int = 4,
+                     engine: str = "prefix",
+                     engine_loop: str = "stream", m: int = 2,
+                     k: int = 32, ring: int = 16, waves: int = 6,
+                     seed: int = 17, tracer=None) -> dict:
+    """The closed-loop controller A/B (docs/CONTROLLER.md): each
+    churn scenario runs as a pair of EXACT-TWIN supervised jobs --
+    identical engine, arrival stream, lifecycle spec, and SLO plane,
+    differing ONLY in ``EpochJob(controller=...)`` -- so the row's
+    recovered dec/s and burn-episode-duration delta are attributable
+    to the controller's actuations alone (controller=off is
+    bit-identical to the bare runner by the PR-18 digest gate, so
+    the off side doubles as the clean reference).
+
+    Scenarios: ``shard_skew`` (hot-shard melt; admission clamp +
+    ladder pressure), ``limit_thrash`` (alternating tight limits;
+    limit-break burn drives the clamp rule), and the ``diurnal``
+    autoscale variant (day/night load swings; the clean-streak
+    up-rules walk the knobs back out at night).  ``sides`` picks
+    which twins run: "off", "on", or "both" (recovered deltas need
+    both).  Wall time includes compile -- both twins pay it, and the
+    row records the actuation count so a recompile-heavy trajectory
+    is visible; this is a control-plane demo row, not a throughput
+    record (bench_guard excludes controller-actuated sessions from
+    clean medians)."""
+    import dataclasses
+
+    from dmclock_tpu.lifecycle import make_spec
+    from dmclock_tpu.robust.supervisor import EpochJob, run_job
+
+    def one(job):
+        t0 = time.perf_counter()
+        res = run_job(job)
+        return res, time.perf_counter() - t0
+
+    out = {}
+    for scenario in scenarios:
+        spec = make_spec(scenario, total_ids=total_ids,
+                         capacity0=max(16, total_ids // 4),
+                         seed=seed)
+        job = EpochJob(engine=engine, engine_loop=engine_loop,
+                       churn=spec, epochs=epochs, m=m, k=k,
+                       ring=ring, waves=waves,
+                       ckpt_every=ckpt_every, seed=seed,
+                       with_slo=True)
+        row = {"workload": "controller", "scenario": scenario,
+               "engine": engine, "engine_loop": engine_loop,
+               "epochs": epochs, "ckpt_every": ckpt_every,
+               "total_ids": total_ids, "controller": sides}
+        with obsspans.span(tracer, "controller.bench_ab",
+                           "dispatch", scenario=scenario,
+                           sides=sides):
+            if sides == "both":
+                # untimed warmup: the twins share the process-level
+                # jit cache, so whoever ran FIRST would otherwise pay
+                # the whole compile and hand the other twin a free
+                # ride -- warm the cache on the off-config once, then
+                # time both (actuation-induced retraces still land on
+                # the on twin's clock; that cost is real)
+                run_job(job)
+            if sides in ("off", "both"):
+                off, wall = one(job)
+                row.update(
+                    dps_off=off.decisions / wall,
+                    decisions_off=int(off.decisions),
+                    wall_s_off=wall,
+                    violations_off=int(
+                        off.slo["violations_total"]),
+                    burn_windows_off=int(
+                        off.slo.get("burn_windows", 0)),
+                    burn_epochs_off=int(
+                        off.slo.get("burn_epochs", 0)))
+                if sides == "off":
+                    row["slo"] = off.slo
+            if sides in ("on", "both"):
+                on, wall = one(
+                    dataclasses.replace(job, controller=True))
+                traj = on.controller_trajectory or []
+                row.update(
+                    dps_on=on.decisions / wall,
+                    decisions_on=int(on.decisions),
+                    wall_s_on=wall,
+                    violations_on=int(on.slo["violations_total"]),
+                    burn_windows_on=int(
+                        on.slo.get("burn_windows", 0)),
+                    burn_epochs_on=int(
+                        on.slo.get("burn_epochs", 0)),
+                    controller_decisions=int(
+                        on.controller_decisions),
+                    controller_knobs=on.controller_knobs,
+                    controller_trajectory=traj,
+                    slo=on.slo)
+        # the A/B verdicts: throughput recovered and burn duration
+        # shed by closing the loop (positive = controller helped)
+        if sides == "both":
+            row["dps"] = row["dps_on"]
+            row["recovered_dps"] = row["dps_on"] - row["dps_off"]
+            row["burn_epochs_recovered"] = (row["burn_epochs_off"]
+                                            - row["burn_epochs_on"])
+            row["violations_recovered"] = (row["violations_off"]
+                                           - row["violations_on"])
+        else:
+            row["dps"] = row.get("dps_on", row.get("dps_off", 0.0))
+        out[f"controller_{scenario}"] = row
+    return out
+
+
 def _with_ladder(ladder, cfg: dict, fn):
     """Run one workload under the degradation ladder
     (robust.guarded.DegradationLadder): a failed run whose config
@@ -1959,7 +2069,8 @@ def main() -> None:
     ap.add_argument("--profile", metavar="DIR", default=None)
     ap.add_argument("--mode",
                     choices=["all", "serve", "cfg3", "cfg4",
-                             "frontier", "churn", "mesh"],
+                             "frontier", "churn", "mesh",
+                             "controller"],
                     default="all")
     ap.add_argument("--clients", type=int, default=100_000,
                     metavar="N",
@@ -2131,6 +2242,18 @@ def main() -> None:
                     "row then records per-shard dropout/resync "
                     "counts (docs/ROBUSTNESS.md 'Degraded-mode "
                     "mesh')")
+    ap.add_argument("--controller",
+                    choices=["off", "on", "both"], default="both",
+                    help="--mode controller: which twin(s) of the "
+                    "closed-loop controller A/B to run under the "
+                    "shard_skew / limit_thrash / diurnal churn "
+                    "scenarios (docs/CONTROLLER.md).  'both' (the "
+                    "default) runs exact twins differing only in "
+                    "EpochJob(controller=...) and reports recovered "
+                    "dec/s + burn-episode-duration deltas; the "
+                    "history record tags controller-actuated "
+                    "sessions so bench_guard keeps them out of the "
+                    "clean-run medians")
     ap.add_argument("--supervised", action="store_true",
                     default=os.environ.get("DMCLOCK_SUPERVISED")
                     == "1",
@@ -2389,6 +2512,19 @@ def main() -> None:
                 # bench_guard keeps them out of clean medians)
                 args.fault_plan = results["mesh"].get(
                     "fault_plan", args.fault_plan)
+        if args.mode == "controller":
+            # the closed-loop controller A/B (docs/CONTROLLER.md):
+            # exact supervised twins per churn scenario, differing
+            # only in EpochJob(controller=...).  A cpu box runs a
+            # scaled shape (the cfg3/churn convention): the control
+            # plane's actuation mechanics need no accelerator, and
+            # platform=cpu keeps the record out of the accelerator
+            # medians
+            ctl_shape = dict(total_ids=96, epochs=32) \
+                if backend == "cpu" \
+                else dict(total_ids=192, epochs=48)
+            results.update(bench_controller(
+                sides=args.controller, tracer=tracer, **ctl_shape))
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster
@@ -2559,6 +2695,27 @@ def main() -> None:
             f"open population (peak {r['peak_clients']} clients, "
             f"{r['evictions']} evictions, {r['slot_recycles']} "
             f"recycles, {r['compactions']} compactions{put})")
+    for key in sorted(results):
+        if not key.startswith("controller_"):
+            continue
+        r = results[key]
+        if "recovered_dps" in r:
+            parts.append(
+                f"controller[{r['scenario']}] "
+                f"{r['dps_on']/1e6:.2f}M on vs "
+                f"{r['dps_off']/1e6:.2f}M off "
+                f"({r['recovered_dps']/1e6:+.2f}M recovered; burn "
+                f"{r['burn_epochs_on']} vs {r['burn_epochs_off']} "
+                f"epochs; {r.get('controller_decisions', 0)} "
+                f"actuations)")
+        else:
+            side = "on" if "dps_on" in r else "off"
+            parts.append(
+                f"controller[{r['scenario']},{side}] "
+                f"{r['dps']/1e6:.2f}M (burn "
+                f"{r.get('burn_epochs_' + side, 0)} epochs"
+                + (f"; {r.get('controller_decisions', 0)} "
+                   f"actuations)" if side == "on" else ")"))
 
     # device histogram blocks feed the live scrape registry per
     # workload (proper Prometheus _bucket/_sum/_count families), then
@@ -2621,7 +2778,9 @@ def main() -> None:
     try:
         _record_history(results, fault_plan=args.fault_plan,
                         supervised=args.supervised, restarts=restarts,
-                        ladder_steps=ladder.describe())
+                        ladder_steps=ladder.describe(),
+                        controller=args.controller
+                        if args.mode == "controller" else "off")
     except OSError as e:      # telemetry must never eat the results
         print(f"# history record failed: {e}", file=sys.stderr)
     final = {
@@ -2647,6 +2806,17 @@ def main() -> None:
                   if wl.startswith("churn_")}
     if churn_rows:
         final["churn"] = churn_rows
+    # the controller A/B's full rows (recovered dec/s, burn-episode
+    # durations, actuation trajectory) ride the JSON line -- the
+    # PR-18 acceptance output; the scalar fields land in the history
+    # record through the same _record_history scalar filter as every
+    # other workload
+    ctl_rows = {wl: {k: v for k, v in row.items()
+                     if k != "_hist_block"}
+                for wl, row in results.items()
+                if wl.startswith("controller_")}
+    if ctl_rows:
+        final["controller"] = ctl_rows
     # the mesh serving plane's full row (aggregate + per-shard dec/s,
     # counter-exchange accounting, shard plan) rides the JSON line --
     # the MULTICHIP v2 record reads it straight off stdout
@@ -2735,7 +2905,8 @@ def main() -> None:
 
 def _record_history(results: dict, fault_plan: str = "none",
                     supervised: bool = False, restarts: int = 0,
-                    ladder_steps=None) -> None:
+                    ladder_steps=None,
+                    controller: str = "off") -> None:
     """Append this session's rates to benchmark/history/ for the
     drift-aware regression guard (scripts/bench_guard.py).  CPU
     (backend-fallback) sessions are recorded too, tagged
@@ -2745,7 +2916,11 @@ def _record_history(results: dict, fault_plan: str = "none",
     trajectory, excluded from the clean-run medians.  ``supervised``
     / ``restarts`` mark a session run under robust.supervisor: a
     restart-bearing run's wall time includes recovery (resume +
-    replay), so the guard excludes it the same way."""
+    replay), so the guard excludes it the same way.  ``controller``
+    != "off" marks a closed-loop controller A/B session
+    (docs/CONTROLLER.md): the on-twin's wall time includes actuation
+    recompiles, so the guard keeps controller-actuated sessions out
+    of the clean medians while the trajectory stays recorded."""
     from pathlib import Path
 
     if not results:
@@ -2767,6 +2942,8 @@ def _record_history(results: dict, fault_plan: str = "none",
     if supervised:
         rec["supervised"] = True
         rec["restarts"] = int(restarts)
+    if controller != "off":
+        rec["controller"] = controller
     if ladder_steps:
         rec["degradation_ladder"] = ladder_steps
     if platform == "cpu":

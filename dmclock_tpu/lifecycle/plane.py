@@ -675,6 +675,57 @@ class LifecyclePlane:
         self.counters["compactions"] += 1
         return state, ledger, slo_block, extras
 
+    def force_compact(self, state, ledger=None, slo_block=None,
+                      extras=None, *, b: int = 0):
+        """Controller-triggered compaction OFF the ``compact_every``
+        grid (the control plane's compaction actuation,
+        docs/CONTROLLER.md): same gather, same perm source, same
+        digest-neutrality invariant as the scheduled epoch -- a
+        compacted run's canonical digest equals the uncompacted one.
+        No-op (gracefully) when the layout is already dense or the
+        plane is static; deterministic either way, so a journal-
+        replayed trigger reproduces the identical layout.  Same
+        return-shape discipline as :meth:`boundary`:
+        ``(state, ledger[, slo_block][, extras])``."""
+        from ..obs import spans as _spans
+
+        slo_wanted = slo_block is not None
+        extras_wanted = extras is not None
+        extras = list(extras) if extras is not None else None
+        with self.lock:
+            perm = None if self.static else self.slots.compaction_perm()
+            if perm is not None:
+                with _spans.span(self.tracer, "lifecycle.compact",
+                                 "dispatch", boundary=b,
+                                 live=self.slots.live_count):
+                    more = tuple(x for x in (ledger, slo_block)
+                                 if x is not None)
+                    xarrs = tuple(arr for arr, _fill in extras) \
+                        if extras is not None else ()
+                    out = compact_tree((state,) + more + xarrs, perm)
+                    state = out[0]
+                    it = iter(out[1:])
+                    if ledger is not None:
+                        ledger = next(it)
+                    if slo_block is not None:
+                        slo_block = next(it)
+                    if extras is not None:
+                        extras = [(next(it), fill)
+                                  for _arr, fill in extras]
+                if _compact_hook is not None:
+                    _compact_hook()
+                self.slots.apply_perm(perm)
+                self.counters["compactions"] += 1
+                if slo_wanted and self._slo is not None:
+                    slo_block = self._slo.stamp(
+                        slo_block, self.slots.cid_of_slot)
+            out = (state, ledger)
+            if slo_wanted:
+                out += (slo_block,)
+            if extras_wanted:
+                out += (extras,)
+            return out
+
     # -- arrival-count mapping -----------------------------------------
     def map_counts(self, raw) -> np.ndarray:
         """Map RAW per-client-id Poisson draws (``[..., total_ids]``)

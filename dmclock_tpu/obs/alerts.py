@@ -82,6 +82,13 @@ class SloEvaluator:
         self.fired: List[dict] = []
         self.fired_counts: Dict[str, int] = {r: 0 for r in RULES}
         self.violations_total = 0
+        # burn-episode duration accounting: a roll with >=1 rising
+        # edge is one BURNING window, and its epoch span adds to the
+        # total burn duration -- the bench controller A/B's
+        # "how long did the run spend burning" observable.  Rides the
+        # checkpoint scalars, so the totals are crash-equivalent.
+        self.burn_windows = 0
+        self.burn_epochs = 0
         self.worst_share_err = 0.0
         # per-window mean reservation tardiness, for the p99 the bench
         # block reports.  BOUNDED: a long run accumulates one entry
@@ -222,6 +229,14 @@ class SloEvaluator:
                 self.fired.append(w)
                 self.fired_counts[rule] += 1
                 self.violations_total += 1
+        if out:
+            # every row of one roll closes the same [e0, e1) span
+            # (windows roll on the checkpoint grid), so the roll
+            # contributes its span once no matter how many clients
+            # or rules fired inside it
+            self.burn_windows += 1
+            self.burn_epochs += int(out[0]["window"][1]
+                                    - out[0]["window"][0])
         for w in out:
             if self._watchdog is not None:
                 # route through the PR-7 watchdog: one structured
@@ -251,6 +266,8 @@ class SloEvaluator:
         return {"violations_total": int(self.violations_total),
                 **{f"{r}_episodes": int(self.fired_counts[r])
                    for r in RULES},
+                "burn_windows": int(self.burn_windows),
+                "burn_epochs": int(self.burn_epochs),
                 "worst_window_share_err":
                     round(float(self.worst_share_err), 6),
                 "window_tardiness_p99_ns":
@@ -266,7 +283,8 @@ class SloEvaluator:
             dtype=np.int64).reshape(len(self.active), 3)
         return {"slo_alert_scalars": np.asarray(
                     [self.violations_total]
-                    + [self.fired_counts[r] for r in RULES],
+                    + [self.fired_counts[r] for r in RULES]
+                    + [self.burn_windows, self.burn_epochs],
                     dtype=np.int64),
                 "slo_alert_active": act,
                 "slo_alert_worst": np.float64(self.worst_share_err),
@@ -278,6 +296,9 @@ class SloEvaluator:
         self.violations_total = int(sc[0])
         for i, r in enumerate(RULES):
             self.fired_counts[r] = int(sc[1 + i])
+        if len(sc) > 1 + len(RULES):   # pre-burn-scalar checkpoints
+            self.burn_windows = int(sc[1 + len(RULES)])
+            self.burn_epochs = int(sc[2 + len(RULES)])
         self.active = {
             (int(c), int(ce), RULES[int(i)])
             for c, ce, i in np.asarray(payload["slo_alert_active"],
@@ -290,7 +311,7 @@ class SloEvaluator:
 
     @staticmethod
     def empty_leaves() -> dict:
-        return {"slo_alert_scalars": np.zeros(1 + len(RULES),
+        return {"slo_alert_scalars": np.zeros(3 + len(RULES),
                                               dtype=np.int64),
                 "slo_alert_active": np.zeros((0, 3), dtype=np.int64),
                 "slo_alert_worst": np.float64(0.0),

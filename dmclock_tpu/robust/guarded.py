@@ -61,12 +61,33 @@ def retry_with_backoff(fn: Callable, *, retries: int = 3,
                        recoverable=RECOVERABLE_ERRORS,
                        on_retry: Optional[Callable[[int, BaseException],
                                                    None]] = None,
-                       sleep: Callable[[float], None] = _time.sleep):
+                       sleep: Callable[[float], None] = _time.sleep,
+                       jitter_seed: Optional[int] = None,
+                       deadline_s: Optional[float] = None,
+                       clock: Callable[[], float] = _time.monotonic):
     """Call ``fn()``; on a recoverable error sleep
     ``min(base_s * factor**i, max_s)`` and retry, at most ``retries``
     times, then re-raise the last error.  ``on_retry(attempt, exc)``
     observes each retry (the queue counts them into its metrics).
-    ``fn`` must be pure/idempotent -- jitted device launches are."""
+    ``fn`` must be pure/idempotent -- jitted device launches are.
+
+    ``jitter_seed`` (anti-thundering-herd): scale every sleep by a
+    DETERMINISTIC per-seed multiplier in ``[0.5, 1.5)`` (PCG64, stable
+    across runs/platforms -- the host-fault-plan convention), so S
+    shards relaunching after one shared-tunnel wedge desynchronize by
+    seeding with their shard index instead of stampeding the runtime
+    in lockstep.  Unseeded behavior is the exact historical schedule.
+
+    ``deadline_s``: an overall wall-clock budget measured by
+    ``clock()`` (injectable for tests).  Once spent, the next
+    recoverable error re-raises even with retries left, and any final
+    sleep is truncated to the remaining budget -- bounded total stall,
+    retries or not."""
+    rng = None
+    if jitter_seed is not None:
+        import numpy as _np
+        rng = _np.random.Generator(_np.random.PCG64(int(jitter_seed)))
+    t0 = clock() if deadline_s is not None else 0.0
     attempt = 0
     while True:
         try:
@@ -74,9 +95,17 @@ def retry_with_backoff(fn: Callable, *, retries: int = 3,
         except recoverable as e:  # noqa: PERF203 -- the whole point
             if attempt >= retries:
                 raise
+            if deadline_s is not None and clock() - t0 >= deadline_s:
+                raise
             if on_retry is not None:
                 on_retry(attempt, e)
-            sleep(min(base_s * (factor ** attempt), max_s))
+            delay = min(base_s * (factor ** attempt), max_s)
+            if rng is not None:
+                delay *= 0.5 + rng.random()
+            if deadline_s is not None:
+                delay = min(delay, max(deadline_s - (clock() - t0),
+                                       0.0))
+            sleep(delay)
             attempt += 1
 
 

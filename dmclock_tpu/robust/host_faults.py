@@ -47,6 +47,13 @@ import numpy as np
 from ..utils import checkpoint as ckpt_mod
 
 
+# The controller's journal-then-apply sequence exposes three named
+# kill points per decision (control/journal.py "Replay" contract):
+# before the WAL line is durable, after-write-but-before-apply, and
+# after the knob vector moved (but before the boundary's checkpoint).
+CONTROLLER_STAGES = ("before_journal", "after_journal", "after_apply")
+
+
 class HostKill(BaseException):
     """In-process stand-in for SIGKILL (a BaseException, so no
     ``except Exception`` inside the job can swallow it) -- what the
@@ -63,6 +70,9 @@ class HostFaultPlan(NamedTuple):
     kill_at_save: Tuple[Tuple[int, str], ...] = ()
     corrupt_save_at: Tuple[int, ...] = ()     # epochs whose save rots
     drop_scrape_at: Tuple[int, ...] = ()      # epochs losing the port
+    # (epoch, stage) pairs; stage from CONTROLLER_STAGES -- die inside
+    # the controller's journal-then-apply sequence at that boundary
+    kill_at_controller: Tuple[Tuple[int, str], ...] = ()
 
 
 def zero_host_plan() -> HostFaultPlan:
@@ -78,15 +88,17 @@ def host_plan_events(plan: Optional[HostFaultPlan]) -> dict:
     nothing)."""
     if plan is None:
         return {"kills": 0, "save_kills": 0, "corrupt_saves": 0,
-                "scrape_drops": 0, "restarts": 0}
+                "scrape_drops": 0, "ctl_kills": 0, "restarts": 0}
     kills = len(plan.kill_at_decisions)
     save_kills = len(plan.kill_at_save)
+    ctl_kills = len(getattr(plan, "kill_at_controller", ()))
     return {
         "kills": kills,
         "save_kills": save_kills,
         "corrupt_saves": len(plan.corrupt_save_at),
         "scrape_drops": len(plan.drop_scrape_at),
-        "restarts": kills + save_kills,
+        "ctl_kills": ctl_kills,
+        "restarts": kills + save_kills + ctl_kills,
     }
 
 
@@ -97,8 +109,11 @@ def describe_host(plan: Optional[HostFaultPlan]) -> str:
     ev = host_plan_events(plan)
     if sum(ev.values()) == 0:
         return "none"
-    return (f"host:kill{ev['kills']}+savekill{ev['save_kills']}"
-            f"+corrupt{ev['corrupt_saves']}+scrape{ev['scrape_drops']}")
+    tag = (f"host:kill{ev['kills']}+savekill{ev['save_kills']}"
+           f"+corrupt{ev['corrupt_saves']}+scrape{ev['scrape_drops']}")
+    if ev["ctl_kills"]:
+        tag += f"+ctlkill{ev['ctl_kills']}"
+    return tag
 
 
 def sample_host_plan(seed: int, *, epochs: int, est_decisions: int,
@@ -140,7 +155,9 @@ def plan_to_json(plan: Optional[HostFaultPlan]) -> dict:
             "kill_at_save": [[int(e), str(s)]
                              for e, s in plan.kill_at_save],
             "corrupt_save_at": list(plan.corrupt_save_at),
-            "drop_scrape_at": list(plan.drop_scrape_at)}
+            "drop_scrape_at": list(plan.drop_scrape_at),
+            "kill_at_controller": [[int(e), str(s)]
+                                   for e, s in plan.kill_at_controller]}
 
 
 def plan_from_json(obj: dict) -> HostFaultPlan:
@@ -153,7 +170,10 @@ def plan_from_json(obj: dict) -> HostFaultPlan:
         corrupt_save_at=tuple(int(x)
                               for x in obj.get("corrupt_save_at", ())),
         drop_scrape_at=tuple(int(x)
-                             for x in obj.get("drop_scrape_at", ())))
+                             for x in obj.get("drop_scrape_at", ())),
+        kill_at_controller=tuple(
+            (int(e), str(s))
+            for e, s in obj.get("kill_at_controller", ())))
 
 
 class HostFaultInjector:
@@ -210,6 +230,17 @@ class HostFaultInjector:
             if total >= point and self._mark(f"dec:{i}"):
                 self._kill(f"kill_at_decisions[{i}]={point} "
                            f"(total {total})")
+
+    def controller_point(self, epoch: int, stage: str) -> None:
+        """The controller passes this as its ``fault`` seam: each
+        decision fires it at every CONTROLLER_STAGES point.  The first
+        unfired matching (epoch, stage) plan entry dies here --
+        write-ahead marked, so the resumed incarnation replays the
+        boundary instead of dying again."""
+        for i, (e, s) in enumerate(self.plan.kill_at_controller):
+            if e == epoch and s == stage and self._mark(f"ctl:{i}"):
+                self._kill(f"kill_at_controller epoch {epoch} "
+                           f"stage {stage}")
 
     def drop_scrape(self, epoch: int) -> bool:
         """True when this epoch's plan says the scrape port vanishes

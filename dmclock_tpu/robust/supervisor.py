@@ -167,11 +167,11 @@ class EpochJob:
     # high-watermark -- rides the epoch scans like the PR-6
     # telemetry.  The block's leaves ride the rotation checkpoints
     # (prov_*), so crash equivalence extends to it bit-for-bit.
-    # NOT yet composable with ``churn``: the lifecycle boundary
-    # grows/permutes/zeroes the ledger and SLO block but not the
-    # per-client last_served watermark, so the combination is
-    # rejected up front instead of mis-attributing a recycled slot's
-    # stale serve history to its new tenant.
+    # Composes with ``churn``: the per-slot last_served watermark
+    # rides the lifecycle boundary as an extras rider with fill 0
+    # (= never served), so a recycled slot's new tenant starts with
+    # no inherited serve history and the dynamic==static digest gate
+    # extends to the provenance plane.
     with_prov: bool = False
     # engine loop structure (docs/ENGINE.md "engine_loop"): "round"
     # launches the admission readback + ingest + epoch separately per
@@ -231,8 +231,27 @@ class EpochJob:
     # plumbing (byte-identical to the pre-chaos chunk program).
     fault_plan: object = None   # dict spec or
     #                             "seed=..,p_dropout=.." string
+    # closed-loop serving controller (control/; docs/CONTROLLER.md):
+    # a host control plane evaluated at the checkpoint-boundary grid
+    # -- one typed ControlSignals snapshot per boundary (SLO burn,
+    # backlog, capacity occupancy, starvation watermarks), a
+    # deterministic guarded-transition policy with per-rule
+    # hysteresis/cooldown, and a WAL-journaled knob vector (staleness,
+    # ladder overlay, admission clamp, compaction trigger).  Every
+    # decision is fsynced to the journal BEFORE it applies; a resumed
+    # run REPLAYS journaled decisions instead of re-deciding, so
+    # crash equivalence extends to the controller (kill at any
+    # actuation stage == the uninterrupted twin, bit-identical).
+    # Actuation routes only through exact-twin switches (ladder
+    # rungs, device admission clamp, boundary compaction), so
+    # ``controller=None`` (off) stays bit-identical to the bare
+    # runner.  Accepts None/False (off), True (defaults), a
+    # control.ControllerConfig, or its asdict() (JSON round-trip).
+    controller: object = None
 
     def to_json(self) -> dict:
+        # asdict recurses into a ControllerConfig, so a controller
+        # job JSON round-trips into the spawn-mode child unchanged
         return dataclasses.asdict(self)
 
     @classmethod
@@ -301,6 +320,17 @@ class SupervisedResult(NamedTuple):
     # on the host robust loop -- the degraded-mode mesh's
     # slow-but-on-plan path (a subset of mesh_fallbacks)
     mesh_chaos_fallbacks: int = 0
+    # closed-loop controller outputs (job.controller set; zeros/None
+    # otherwise): applied decision count, final knob vector, and the
+    # journaled decision trajectory [[seq, epoch, rule, knobs...]] --
+    # all deterministic, all compared by the crash-equivalence gate.
+    # controller_replays counts journal REPLAYS by the final
+    # incarnation (legitimately nonzero only after a crash, like the
+    # resume rows -- excluded from the gate).
+    controller_decisions: int = 0
+    controller_replays: int = 0
+    controller_knobs: Optional[list] = None
+    controller_trajectory: Optional[list] = None
 
 
 def assert_crash_equivalent(interrupted: SupervisedResult,
@@ -382,6 +412,26 @@ def assert_crash_equivalent(interrupted: SupervisedResult,
         if x is not None:
             assert np.array_equal(np.asarray(x), np.asarray(y)), \
                 f"mesh field {field} diverged across the crash"
+    # the controller journals every decision BEFORE applying it and a
+    # resumed run replays the journal instead of re-deciding, so the
+    # applied count, the final knob vector, and the full decision
+    # trajectory must be bit-identical (controller_replays is the one
+    # legitimately-different field: it counts how many of those
+    # decisions the final incarnation REPLAYED rather than made)
+    assert interrupted.controller_decisions == \
+        reference.controller_decisions, \
+        (f"controller decision count diverged: "
+         f"{interrupted.controller_decisions} vs "
+         f"{reference.controller_decisions}")
+    assert interrupted.controller_knobs == reference.controller_knobs, \
+        (f"controller knob vector diverged: "
+         f"{interrupted.controller_knobs} vs "
+         f"{reference.controller_knobs}")
+    assert interrupted.controller_trajectory == \
+        reference.controller_trajectory, \
+        (f"controller decision trajectory diverged: "
+         f"{interrupted.controller_trajectory} vs "
+         f"{reference.controller_trajectory}")
 
 
 
@@ -498,9 +548,11 @@ def _tree_digest(tree) -> str:
 def _payload(job: EpochJob, state, rng, met, digest: bytes,
              epoch: int, decisions: int, ladder_vec,
              hists=None, ledger=None, flight=None,
-             plane=None, slo=None, prov=None, mesh=None) -> dict:
+             plane=None, slo=None, prov=None, mesh=None,
+             ctl=None) -> dict:
     import jax
 
+    from ..control import Controller
     from ..lifecycle.plane import LifecyclePlane
     from ..obs import flight as obsflight
     from ..obs import slo as obsslo
@@ -558,7 +610,12 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
     else:
         mz = {k: np.zeros((0,), dtype=np.int64)
               for k in ("mesh_cd", "mesh_cr", "mesh_vd", "mesh_vr")}
-    return {**lc, **sl, **mz,
+    # controller leaves follow the same always-present convention:
+    # the applied-decision cursor, the knob vector, and the policy
+    # hysteresis/cooldown state (fixed shapes from the rule table, so
+    # even the zero template matches exactly)
+    ct = ctl.encode() if ctl is not None else Controller.empty_leaves()
+    return {**lc, **sl, **mz, **ct,
             "digest": np.frombuffer(digest, dtype=np.uint8).copy(),
             "decisions": np.int64(decisions),
             "engine": state,
@@ -787,6 +844,64 @@ def _jit_ingest(job: EpochJob):
     return _INGEST_JIT_CACHE[key]
 
 
+def _prov_extras(prov):
+    """The provenance plane's lifecycle-boundary riders: the per-slot
+    last_served watermark rides grow/evict/compact with fill 0 (=
+    never served), so a recycled slot's new tenant inherits no serve
+    history.  margin_hist and scal are population aggregates, not
+    per-slot arrays -- they pass through boundaries untouched."""
+    return None if prov is None else [(prov.last_served, 0)]
+
+
+def _prov_restamp(prov, extras):
+    if prov is None:
+        return None
+    from ..obs import provenance as obsprov
+
+    return obsprov.prov_from_arrays(prov.margin_hist, prov.scal,
+                                    extras[0][0])
+
+
+def _boundary_with_prov(plane, state, b, every, ledger, slo_block,
+                        prov):
+    """One lifecycle boundary with every rider the supervisor carries
+    (ledger, SLO block, provenance watermark) -- the single unpack
+    point the round and stream loops share, so the extras discipline
+    cannot drift between them."""
+    extras = _prov_extras(prov)
+    out = plane.boundary(state, b, every, ledger=ledger,
+                         slo_block=slo_block, extras=extras)
+    state, ledger = out[0], out[1]
+    i = 2
+    if slo_block is not None:
+        slo_block = out[i]
+        i += 1
+    if extras is not None:
+        prov = _prov_restamp(prov, out[i])
+    return state, ledger, slo_block, prov
+
+
+def _ctl_compact(plane, state, ledger, slo_block, prov, b: int):
+    """The controller's ``compact`` actuation: an out-of-band
+    compaction through the lifecycle plane's own boundary transform
+    (digest-neutral by the PR-11 gate -- the chain digest hashes
+    canonical client-id views).  Runs BEFORE the boundary's
+    checkpoint save, so the snapshot holds the compacted layout and
+    a replayed decision re-compacts the replayed layout
+    deterministically."""
+    extras = _prov_extras(prov)
+    out = plane.force_compact(state, ledger=ledger,
+                              slo_block=slo_block, extras=extras, b=b)
+    state, ledger = out[0], out[1]
+    i = 2
+    if slo_block is not None:
+        slo_block = out[i]
+        i += 1
+    if extras is not None:
+        prov = _prov_restamp(prov, out[i])
+    return state, ledger, slo_block, prov
+
+
 def _job_loop(job: EpochJob, workdir: Optional[str],
               injector: Optional[HostFaultInjector]
               ) -> SupervisedResult:
@@ -802,12 +917,6 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
 
     from ..obs import flight as obsflight
 
-    if job.with_prov and job.churn is not None:
-        raise ValueError(
-            "EpochJob(with_prov=True) does not compose with churn "
-            "yet: lifecycle boundaries do not carry the provenance "
-            "watermark through grow/compact/evict (see the EpochJob "
-            "field comment)")
     if job.engine_loop == "mesh":
         if job.churn is not None and job.with_slo:
             raise ValueError(
@@ -873,6 +982,22 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     hists, ledger, flight, prov = _tele_init(job)
     ckpt_dir = os.path.join(workdir, "ckpt") if workdir else None
 
+    # the closed-loop controller (control/; docs/CONTROLLER.md):
+    # built before the restore so ctl.load can pick up the applied
+    # cursor/knobs/policy state from the checkpoint while the journal
+    # (loaded from the workdir in the constructor) supplies the
+    # decisions to replay
+    from ..control import Controller, as_spec as _ctl_as_spec
+    ctl = None
+    _ctl_spec = _ctl_as_spec(job.controller)
+    if _ctl_spec is not None:
+        ctl = Controller(
+            _ctl_spec, n=job.n, ring=job.ring,
+            counter_sync_every=job.counter_sync_every,
+            capacity0=int(job.churn["capacity0"])
+            if job.churn is not None else 0,
+            workdir=workdir)
+
     payload = None
     resumed_from = None
     if ckpt_dir is not None and ckpt_mod.rotation_paths(ckpt_dir):
@@ -934,6 +1059,11 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             prov = obsprov.prov_from_arrays(
                 payload["prov_margin_hist"], payload["prov_scal"],
                 payload["prov_last_served"])
+        if ctl is not None:
+            # the applied cursor can only TRAIL the journal (fsync-
+            # before-apply), so loading both re-arms the replay path
+            # for every journaled-but-unapplied decision
+            ctl.load(payload)
 
     mesh_ctrs = None
     if job.engine_loop == "mesh":
@@ -1032,6 +1162,16 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
         slo_eval.observe_roll(closed)
         return closed
 
+    if ctl is not None:
+        # pin the delta baselines to the RESTORED accumulators: the
+        # previous boundary's snapshot is exactly what the killed
+        # incarnation's controller last observed, so replayed
+        # boundaries recollect identical signal deltas
+        ctl.observe_baseline(met=met, slo_eval=slo_eval)
+        from ..control import publish_controller
+        from ..obs.registry import default_registry
+        publish_controller(default_registry(), ctl)
+
     on_bind = None
     if plane is not None or slo_eval is not None:
         def on_bind(server, _plane=plane):
@@ -1059,14 +1199,14 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                               start_epoch, decisions, ladder, tracer,
                               hists, ledger, flight, prov,
                               resumed_from, plane, slo_block,
-                              slo_plane, slo_eval)
+                              slo_plane, slo_eval, ctl)
     if job.engine_loop == "mesh":
         return _mesh_epochs(job, injector, ckpt_dir, scr, base_cfg,
                             state, rng, met, digest, start_epoch,
                             decisions, ladder, tracer, hists, ledger,
                             flight, prov, resumed_from, slo_block,
                             slo_plane, slo_eval, mesh_ctrs,
-                            mesh_planes)
+                            mesh_planes, ctl)
     assert job.engine_loop == "round", job.engine_loop
     ingest = _jit_ingest(job) \
         if job.arrival_lam > 0 and plane is None else None
@@ -1099,14 +1239,10 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             if plane is not None and epoch % job.ckpt_every == 0:
                 with _spans.span(tracer, "lifecycle.boundary",
                                  "host_prep", epoch=epoch):
-                    if slo_block is not None:
-                        state, ledger, slo_block = plane.boundary(
-                            state, epoch, job.ckpt_every,
-                            ledger=ledger, slo_block=slo_block)
-                    else:
-                        state, ledger = plane.boundary(
-                            state, epoch, job.ckpt_every,
-                            ledger=ledger)
+                    state, ledger, slo_block, prov = \
+                        _boundary_with_prov(plane, state, epoch,
+                                            job.ckpt_every, ledger,
+                                            slo_block, prov)
 
             t_base = jnp.int64(epoch * job.dt_epoch_ns)
             if plane is not None:
@@ -1114,9 +1250,14 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                                  "ingest"):
                     raw = rng.poisson(churn_mod.lam_vector(
                         job.churn, epoch)).astype(np.int32)
+                    counts = plane.map_counts(raw)
+                    if ctl is not None:
+                        # admission clamp AFTER the draws: the RNG
+                        # consumption never depends on the knob, so
+                        # controller on/off replays one arrival stream
+                        counts = ctl.clamp_counts(counts, job.waves)
                     state = churn_ingest(
-                        state, jnp.asarray(plane.map_counts(raw)),
-                        t_base)
+                        state, jnp.asarray(counts), t_base)
             elif ingest is not None:
                 with _spans.span(tracer, "supervisor.ingest",
                                  "ingest"):
@@ -1126,9 +1267,12 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                         rng.poisson(job.arrival_lam, job.n),
                         np.minimum(headroom, job.waves)
                     ).astype(np.int32)
+                    if ctl is not None:
+                        counts = ctl.clamp_counts(counts, job.waves)
                     state = ingest(state, jnp.asarray(counts), t_base)
             while True:
-                cfg = ladder.apply(base_cfg)
+                cfg = ladder.apply(ctl.overlay(base_cfg)
+                                   if ctl is not None else base_cfg)
                 try:
                     ep = run_epoch_guarded(
                         state,
@@ -1198,6 +1342,24 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 # block is a freshly-opened window and the ring
                 # already holds what this boundary closed
                 closed = _slo_roll(state, epoch + 1)
+            if ctl is not None and at_boundary:
+                # the controller boundary: collect one typed signal
+                # snapshot, run the guarded-transition policy (journal
+                # fsyncs before every apply; a resumed run replays),
+                # then actuate -- all BEFORE the snapshot, so the
+                # checkpoint holds the post-actuation knobs/state
+                sig = ctl.collect(
+                    epoch + 1, state=state, met=met,
+                    slo_eval=slo_eval, prov=prov,
+                    planes=None if plane is None else [plane])
+                fired = ctl.step(
+                    epoch + 1, sig,
+                    fault=None if injector is None
+                    else injector.controller_point)
+                if "compact" in fired and plane is not None:
+                    state, ledger, slo_block, prov = _ctl_compact(
+                        plane, state, ledger, slo_block, prov,
+                        epoch + 1)
             if ckpt_dir is not None and at_boundary:
                 with _spans.span(tracer, "supervisor.checkpoint_save",
                                  "checkpoint", epoch=epoch + 1):
@@ -1208,7 +1370,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                                        prov=prov, plane=plane,
                                        slo=None if slo_plane is None
                                        else (slo_block, slo_plane,
-                                             slo_eval))
+                                             slo_eval), ctl=ctl)
 
                     def save(payload=payload):
                         return ckpt_mod.save_pytree_rotating(
@@ -1267,7 +1429,8 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
     return _build_result(job, state, digest, decisions, met, ladder,
                          scr.rebinds, resumed_from, hists, ledger,
                          flight, stream_fallbacks, plane,
-                         slo_block, slo_plane, slo_eval, prov)
+                         slo_block, slo_plane, slo_eval, prov,
+                         ctl=ctl)
 
 
 def _build_result(job, state, digest, decisions, met, ladder,
@@ -1276,10 +1439,17 @@ def _build_result(job, state, digest, decisions, met, ladder,
                   slo_block=None, slo_plane=None,
                   slo_eval=None, prov=None, mesh=None,
                   mesh_fallbacks: int = 0,
-                  mesh_chaos_fallbacks: int = 0) -> SupervisedResult:
+                  mesh_chaos_fallbacks: int = 0,
+                  ctl=None) -> SupervisedResult:
     import jax
 
     slo_kw = {}
+    if ctl is not None:
+        slo_kw.update(
+            controller_decisions=int(ctl.applied),
+            controller_replays=int(ctl.replays),
+            controller_knobs=[int(k) for k in ctl.knobs],
+            controller_trajectory=ctl.trajectory())
     if mesh is not None and job.n_shards == 1:
         # S=1 canonicalization: a 1-shard mesh IS a single engine, so
         # the result (state digest, telemetry blocks, window block,
@@ -1389,7 +1559,8 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                    digest: bytes, start_epoch: int, decisions: int,
                    ladder, tracer, hists, ledger, flight, prov,
                    resumed_from, plane=None, slo_block=None,
-                   slo_plane=None, slo_eval=None) -> SupervisedResult:
+                   slo_plane=None, slo_eval=None,
+                   ctl=None) -> SupervisedResult:
     """The always-on streaming serve loop (docs/ENGINE.md
     "engine_loop"): one fused device launch per stream chunk (= the
     epochs between two PR-5 checkpoint boundaries), with the host
@@ -1446,16 +1617,19 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
             if plane is not None:
                 with _spans.span(tracer, "lifecycle.boundary",
                                  "host_prep", epoch=e0):
-                    if slo_block is not None:
-                        state, ledger, slo_block = plane.boundary(
-                            state, e0, job.ckpt_every, ledger=ledger,
-                            slo_block=slo_block)
-                    else:
-                        state, ledger = plane.boundary(
-                            state, e0, job.ckpt_every, ledger=ledger)
+                    state, ledger, slo_block, prov = \
+                        _boundary_with_prov(plane, state, e0,
+                                            job.ckpt_every, ledger,
+                                            slo_block, prov)
                 counts_dev = plane.map_counts(counts)
             else:
                 counts_dev = counts
+            if ctl is not None and counts_dev is not None:
+                # the whole chunk admits under the knob set at ITS
+                # starting boundary -- exactly the per-epoch clamp the
+                # round loop applies, because the knob only moves at
+                # the controller boundaries (= the chunk grid)
+                counts_dev = ctl.clamp_counts(counts_dev, job.waves)
             # the double buffer: chunk T+1's draws happen between the
             # chunk launch's dispatch and its device wait (the overlap
             # seam run_stream_chunk_guarded exposes).  Idempotent: a
@@ -1477,7 +1651,8 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                 nxt["rng"] = _rng_state_array(rng)
 
             while True:
-                cfg = ladder.apply(base_cfg)
+                cfg = ladder.apply(ctl.overlay(base_cfg)
+                                   if ctl is not None else base_cfg)
                 try:
                     g = run_stream_chunk_guarded(
                         state, e0, counts_dev, engine=job.engine,
@@ -1559,6 +1734,20 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                     depth=state.depth)
                 slo_w0 = b
                 slo_eval.observe_roll(closed)
+            if ctl is not None:
+                # b is a controller boundary by construction (= the
+                # round loop's at_boundary grid): same collect ->
+                # decide -> actuate sequence, before the save
+                sig = ctl.collect(
+                    b, state=state, met=met, slo_eval=slo_eval,
+                    prov=prov,
+                    planes=None if plane is None else [plane])
+                fired = ctl.step(
+                    b, sig, fault=None if injector is None
+                    else injector.controller_point)
+                if "compact" in fired and plane is not None:
+                    state, ledger, slo_block, prov = _ctl_compact(
+                        plane, state, ledger, slo_block, prov, b)
             if ckpt_dir is not None:
                 # b is a checkpoint boundary by construction
                 # (chunk_bounds); the persisted RNG state is rng_ckpt
@@ -1572,7 +1761,7 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
                                        prov=prov, plane=plane,
                                        slo=None if slo_plane is None
                                        else (slo_block, slo_plane,
-                                             slo_eval))
+                                             slo_eval), ctl=ctl)
 
                     def save(payload=payload):
                         return ckpt_mod.save_pytree_rotating(
@@ -1613,7 +1802,8 @@ def _stream_epochs(job: EpochJob, injector, ckpt_dir,
     return _build_result(job, state, digest, decisions, met, ladder,
                          scr.rebinds, resumed_from, hists, ledger,
                          flight, stream_fallbacks, plane,
-                         slo_block, slo_plane, slo_eval, prov)
+                         slo_block, slo_plane, slo_eval, prov,
+                         ctl=ctl)
 
 
 def _draw_counts_mesh(rng: np.random.Generator, job: EpochJob,
@@ -1631,11 +1821,12 @@ def _draw_counts_mesh(rng: np.random.Generator, job: EpochJob,
 
 
 def _mesh_boundary(job: EpochJob, planes, state, ledger,
-                   cd, cr, vd, vr, b: int):
+                   cd, cr, vd, vr, b: int, prov=None):
     """One mesh churn job's lifecycle boundary: every shard's plane
     applies its own due ops to its own slice (registrations routed by
     ``cid % n_shards``, per-shard SlotMaps), the counter plane's
-    cd/cr (fill 0) and held views (fill 1) ride each shard's
+    cd/cr (fill 0), held views (fill 1), and the provenance
+    last_served watermark (fill 0 = never served) ride each shard's
     grow/evict/compact transforms as boundary extras, and the stacked
     layout is forced back RECTANGULAR: one shard's grow-on-demand
     doubling grows every sibling to the max capacity before the
@@ -1652,6 +1843,8 @@ def _mesh_boundary(job: EpochJob, planes, state, ledger,
         led_s = None if ledger is None else ledger[s]
         extras = [(jnp.asarray(cd[s]), 0), (jnp.asarray(cr[s]), 0),
                   (jnp.asarray(vd[s]), 1), (jnp.asarray(vr[s]), 1)]
+        if prov is not None:
+            extras.append((prov.last_served[s], 0))
         st_s, led_s, extras = planes[s].boundary(
             st_s, b, job.ckpt_every, ledger=led_s, extras=extras)
         sts.append(st_s)
@@ -1671,7 +1864,13 @@ def _mesh_boundary(job: EpochJob, planes, state, ledger,
     ledger = None if ledger is None else jnp.stack(leds)
     cd, cr, vd, vr = (jnp.stack([ctrs[s][j][0] for s in range(S)])
                       for j in range(4))
-    return state, ledger, cd, cr, vd, vr
+    if prov is not None:
+        from ..obs import provenance as obsprov
+
+        prov = obsprov.prov_from_arrays(
+            prov.margin_hist, prov.scal,
+            jnp.stack([ctrs[s][4][0] for s in range(S)]))
+    return state, ledger, cd, cr, vd, vr, prov
 
 
 def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
@@ -1680,7 +1879,7 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                  ladder, tracer, hists, ledger, flight, prov,
                  resumed_from, slo_block=None, slo_plane=None,
                  slo_eval=None, mesh_ctrs=None,
-                 planes=None) -> SupervisedResult:
+                 planes=None, ctl=None) -> SupervisedResult:
     """The mesh serving loop (docs/ENGINE.md "Mesh serving"):
     ``n_shards`` full per-device engines advance a whole
     checkpoint-boundary chunk of epochs inside ONE ``shard_map``
@@ -1761,9 +1960,9 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
             if planes is not None:
                 with _spans.span(tracer, "lifecycle.boundary",
                                  "host_prep", epoch=e0):
-                    state, ledger, cd, cr, vd, vr = _mesh_boundary(
-                        job, planes, state, ledger, cd, cr, vd, vr,
-                        e0)
+                    state, ledger, cd, cr, vd, vr, prov = \
+                        _mesh_boundary(job, planes, state, ledger,
+                                       cd, cr, vd, vr, e0, prov)
             counts = None
             if do_ingest:
                 with _spans.span(tracer, "mesh.pregen", "host_prep"):
@@ -1779,11 +1978,18 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                              for s in range(job.n_shards)])
                     else:
                         counts = _draw_counts_mesh(rng, job, b - e0)
+                    if ctl is not None:
+                        # whole-chunk clamp under the chunk-start knob
+                        # (the stream loop's discipline) -- applied
+                        # AFTER the draws, so RNG consumption never
+                        # depends on the controller
+                        counts = ctl.clamp_counts(counts, job.waves)
             rng_ckpt = _rng_state_array(rng)
             faults = plan_chunk(plan, e0, b) \
                 if plan is not None else None
             while True:
-                cfg = ladder.apply(base_cfg)
+                cfg = ladder.apply(ctl.overlay(base_cfg)
+                                   if ctl is not None else base_cfg)
                 try:
                     g = run_mesh_chunk_guarded(
                         state, cd, cr, vd, vr, e0, counts, mesh=mesh,
@@ -1796,7 +2002,9 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                         calendar_impl=cfg["calendar_impl"],
                         ladder_levels=job.ladder_levels,
                         wheel_kernel=job.wheel_kernel,
-                        counter_sync_every=job.counter_sync_every,
+                        counter_sync_every=ctl.knob_sync()
+                        if ctl is not None
+                        else job.counter_sync_every,
                         hists=hists, ledger=ledger, slo=wblock,
                         prov=prov, flight=flight, faults=faults,
                         tracer=tracer)
@@ -1872,6 +2080,20 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                 slo_block = mesh_mod.stack_shards(merged,
                                                   job.n_shards)
                 wblock = slo_block
+            if ctl is not None:
+                # cluster-level controller boundary: signals aggregate
+                # over every shard (backlog = cluster depth total,
+                # press_backlog = hottest shard's total).  A fired
+                # ``compact`` journals + counts as MIGRATION-ELIGIBLE
+                # only -- actually moving a partition off a pressured
+                # shard is the ROADMAP rack-scheduling item, so mesh
+                # actuation stops at the marker (staleness / ladder /
+                # clamp knobs actuate exactly as on the other loops).
+                sig = ctl.collect(b, state=state, met=met,
+                                  slo_eval=slo_eval, prov=prov,
+                                  planes=planes)
+                ctl.step(b, sig, fault=None if injector is None
+                         else injector.controller_point)
             if ckpt_dir is not None:
                 with _spans.span(tracer, "supervisor.checkpoint_save",
                                  "checkpoint", epoch=b):
@@ -1883,7 +2105,7 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                                        mesh=(cd, cr, vd, vr),
                                        slo=None if slo_plane is None
                                        else (slo_block, slo_plane,
-                                             slo_eval))
+                                             slo_eval), ctl=ctl)
 
                     def save(payload=payload):
                         return ckpt_mod.save_pytree_rotating(
@@ -1923,7 +2145,8 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                          slo_eval, prov,
                          mesh=(cd, cr, vd, vr),
                          mesh_fallbacks=mesh_fallbacks,
-                         mesh_chaos_fallbacks=mesh_chaos_fallbacks)
+                         mesh_chaos_fallbacks=mesh_chaos_fallbacks,
+                         ctl=ctl)
 
 
 def _healthz_ok(scrape, timeout_s: float = 2.0) -> bool:
@@ -2089,7 +2312,11 @@ def _spawn_once(job: EpochJob, workdir: str,
         mesh_counters=arr("mesh_counters"),
         mesh_views=arr("mesh_views"),
         mesh_fallbacks=int(obj.get("mesh_fallbacks", 0)),
-        mesh_chaos_fallbacks=int(obj.get("mesh_chaos_fallbacks", 0)))
+        mesh_chaos_fallbacks=int(obj.get("mesh_chaos_fallbacks", 0)),
+        controller_decisions=int(obj.get("controller_decisions", 0)),
+        controller_replays=int(obj.get("controller_replays", 0)),
+        controller_knobs=obj.get("controller_knobs"),
+        controller_trajectory=obj.get("controller_trajectory"))
 
 
 def _child_main(workdir: str) -> int:
@@ -2143,7 +2370,13 @@ def _child_main(workdir: str) -> int:
                    "mesh_views": lst(result.mesh_views),
                    "mesh_fallbacks": result.mesh_fallbacks,
                    "mesh_chaos_fallbacks":
-                       result.mesh_chaos_fallbacks}, fh)
+                       result.mesh_chaos_fallbacks,
+                   "controller_decisions":
+                       result.controller_decisions,
+                   "controller_replays": result.controller_replays,
+                   "controller_knobs": result.controller_knobs,
+                   "controller_trajectory":
+                       result.controller_trajectory}, fh)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, res_path)

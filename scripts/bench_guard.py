@@ -62,6 +62,17 @@ quantization and calibration shifts never flap a clean history.
 Provenance-off sessions ("provenance_on": false) form their own
 series identity and are never compared against provenance-on records
 in either direction.
+
+Controller sessions (bench.py --mode controller; docs/CONTROLLER.md)
+carry the `controller` tag ("on"/"both") in the series identity --
+a closed-loop A/B row is never median-compared against a bare row --
+and a record whose controller actually ACTUATED (>= 1 journaled
+decision) joins the clean-median exclusion set the same way chaos
+and restart-bearing records do: the on-twin's wall time includes
+actuation recompiles, so it extends the trajectory but never seeds
+nor is judged against the clean medians.  The actuation count is
+printed next to the rate so a knob-thrashing session is visible at
+a glance.
 """
 
 from __future__ import annotations
@@ -126,6 +137,22 @@ def is_restarted(rec: dict) -> bool:
                                                        0) or 0) > 0
 
 
+def is_controller_actuated(rec: dict) -> bool:
+    """A closed-loop controller session that actually ACTUATED
+    (bench.py --mode controller with >= 1 journaled decision): the
+    on-twin's wall time includes knob transitions and their
+    recompiles, so like a chaos session it extends the trajectory
+    but never enters -- and is never judged against -- the clean-run
+    medians.  A controller session with ZERO decisions is a clean
+    run (the PR-18 digest gate pins controller=off -- and an
+    actuation-free controller=on -- bit-identical to the bare
+    runner).  Records predating the field are bare runs."""
+    if rec.get("controller", "off") == "off":
+        return False
+    return any(int(row.get("controller_decisions", 0) or 0) > 0
+               for row in rec.get("workloads", {}).values())
+
+
 def is_degraded(rec: dict) -> bool:
     """A session where the degradation ladder stepped a fast path
     down mid-run (bench.py records the step list): the rates are
@@ -164,6 +191,10 @@ def main() -> int:
         print(f"bench_guard: {n_restarted} restart-bearing "
               "supervised record(s) in history -- excluded from "
               "clean-run medians")
+    n_ctl = sum(1 for _, r in recs if is_controller_actuated(r))
+    if n_ctl:
+        print(f"bench_guard: {n_ctl} controller-actuated record(s) "
+              "in history -- excluded from clean-run medians")
     n_degraded = sum(1 for _, r in recs if is_degraded(r))
     if n_degraded:
         print(f"bench_guard: {n_degraded} ladder-degraded record(s) "
@@ -187,6 +218,15 @@ def main() -> int:
               "trajectory, not judged against clean-run history; "
               "pass")
         return 0
+    if is_controller_actuated(newest):
+        n_dec = sum(int(row.get("controller_decisions", 0) or 0)
+                    for row in newest.get("workloads", {}).values())
+        print(f"bench_guard: newest record {newest_name} is a "
+              f"controller-actuated session ({n_dec} journaled "
+              "decision(s)) -- its on-twin wall time includes "
+              "actuation recompiles; recorded for the trajectory, "
+              "not judged against clean-run history; pass")
+        return 0
     if is_chaos(newest):
         print(f"bench_guard: newest record {newest_name} is a chaos "
               f"session (fault_plan "
@@ -207,9 +247,11 @@ def main() -> int:
     prior = [(n, r) for n, r in recs[:-1]
              if r.get("device") == dev and not is_fallback(r)
              and not is_chaos(r) and not is_restarted(r)
+             and not is_controller_actuated(r)
              and not is_degraded(r)]
     def series(wl, key, impl, cal, loop, scen=None, pop=None,
-               provon=True, shards=None, sync=None, wk="xla"):
+               provon=True, shards=None, sync=None, wk="xla",
+               ctl="off"):
         """Prior values of one per-workload scalar column, filtered to
         the same fast-path identity (select_impl + calendar_impl +
         engine_loop + provenance_on) the throughput series uses.
@@ -220,7 +262,10 @@ def main() -> int:
         add n_shards + counter_sync_every: an 8-shard aggregate rate
         and a 1-shard rate are different machines, and a stale-view
         (K>1) session exchanges fewer counters per epoch -- neither
-        may enter the other's medians in either direction.  Rows
+        may enter the other's medians in either direction.
+        Controller rows (bench.py --mode controller) add the
+        ``controller`` tag the same way: a closed-loop A/B row never
+        median-compares against a bare row.  Rows
         predating the provenance knob count as provenance-on (the
         default)."""
         return [r["workloads"][wl][key] for _, r in prior
@@ -243,6 +288,8 @@ def main() -> int:
                 == sync
                 and r["workloads"][wl].get("wheel_kernel_effective",
                                            "xla") == wk
+                and r["workloads"][wl].get("controller",
+                                           "off") == ctl
                 and bool(r["workloads"][wl].get("provenance_on",
                                                 True)) == provon]
 
@@ -304,6 +351,10 @@ def main() -> int:
         # rates are the whole A/B, so they form separate histories.
         # Rows predating the knob (and every non-wheel row) == xla.
         wk = row.get("wheel_kernel_effective", "xla")
+        # controller rows (closed-loop A/B, docs/CONTROLLER.md) carry
+        # which twin(s) ran; the tag joins the series identity so an
+        # A/B session never median-compares against a bare one
+        ctl = row.get("controller", "off")
         tag = f"{wl}[{impl}]" if impl != "sort" else wl
         if cal != "minstop":
             tag += f"[{cal}]"
@@ -315,6 +366,8 @@ def main() -> int:
             tag += f"[N={pop}]"
         if shards is not None:
             tag += f"[S={shards},K={sync},N={pop}]"
+        if ctl != "off":
+            tag += f"[ctl={ctl}]"
         if not provon:
             tag += "[prov-off]"
         # a fault-bearing WORKLOAD ROW (bench.py --mode mesh
@@ -331,7 +384,7 @@ def main() -> int:
                   "against clean-run medians")
             continue
         hist = series(wl, "dps", impl, cal, loop, scen, pop, provon,
-                      shards, sync, wk)
+                      shards, sync, wk, ctl)
         if len(hist) < args.min_records:
             print(f"bench_guard: {tag}: {dps/1e6:.1f}M "
                   f"({len(hist)} prior record(s) -- not judged)")
@@ -362,7 +415,10 @@ def main() -> int:
                  "clients]" if peak is not None else "")
               + (f" [{row.get('dps_per_shard_mean', 0)/1e6:.2f}M"
                  "/shard aggregate-of-"
-                 f"{shards}]" if shards is not None else ""))
+                 f"{shards}]" if shards is not None else "")
+              + (f" [{row.get('controller_decisions', 0)} "
+                 "controller actuation(s)]"
+                 if ctl != "off" else ""))
         if dps < floor:
             status = 1
         # per-shard dec/s (mesh rows) as its own warn-only series:
@@ -374,7 +430,7 @@ def main() -> int:
         if psm is not None:
             p_hist = series(wl, "dps_per_shard_mean", impl, cal,
                             loop, scen, pop, provon, shards, sync,
-                            wk)
+                            wk, ctl)
             if len(p_hist) < args.min_records:
                 print(f"bench_guard: {tag}: per-shard "
                       f"{psm/1e6:.2f}M ({len(p_hist)} prior "
@@ -403,7 +459,7 @@ def main() -> int:
         p99 = row.get("tardiness_p99_ns")
         if p99 is not None:
             t_hist = series(wl, "tardiness_p99_ns", impl, cal, loop,
-                            scen, pop, provon, shards, sync, wk)
+                            scen, pop, provon, shards, sync, wk, ctl)
             if len(t_hist) < args.min_records:
                 print(f"bench_guard: {tag}: p99 tardiness "
                       f"{p99/1e6:.2f}ms ({len(t_hist)} prior "
@@ -435,7 +491,7 @@ def main() -> int:
         disp = row.get("dispatch_ms_per_launch")
         if disp is not None:
             d_hist = series(wl, "dispatch_ms_per_launch", impl, cal,
-                            loop, scen, pop, provon, shards, sync, wk)
+                            loop, scen, pop, provon, shards, sync, wk, ctl)
             if len(d_hist) < args.min_records:
                 print(f"bench_guard: {tag}: dispatch "
                       f"{disp:.2f}ms/launch ({len(d_hist)} prior "
@@ -468,7 +524,7 @@ def main() -> int:
         viol = row.get("slo_violations_total")
         if viol is not None:
             v_hist = series(wl, "slo_violations_total", impl, cal,
-                            loop, scen, pop, provon, shards, sync, wk)
+                            loop, scen, pop, provon, shards, sync, wk, ctl)
             if len(v_hist) < args.min_records:
                 print(f"bench_guard: {tag}: slo violations {viol} "
                       f"({len(v_hist)} prior record(s) -- not "
@@ -492,7 +548,7 @@ def main() -> int:
         serr = row.get("slo_worst_share_err")
         if serr is not None:
             s_hist = series(wl, "slo_worst_share_err", impl, cal,
-                            loop, scen, pop, provon, shards, sync, wk)
+                            loop, scen, pop, provon, shards, sync, wk, ctl)
             if len(s_hist) < args.min_records:
                 print(f"bench_guard: {tag}: worst-window share err "
                       f"{serr:.3f} ({len(s_hist)} prior record(s) "
@@ -524,7 +580,7 @@ def main() -> int:
         cms = row.get("compile_ms_total")
         if cms is not None:
             c_hist = series(wl, "compile_ms_total", impl, cal, loop,
-                            scen, pop, provon, shards, sync, wk)
+                            scen, pop, provon, shards, sync, wk, ctl)
             if len(c_hist) < args.min_records:
                 print(f"bench_guard: {tag}: compile {cms:.0f}ms "
                       f"({len(c_hist)} prior record(s) -- not "
@@ -554,7 +610,7 @@ def main() -> int:
         rt = row.get("retraces")
         if rt is not None:
             r_hist = series(wl, "retraces", impl, cal, loop, scen,
-                            pop, provon, shards, sync, wk)
+                            pop, provon, shards, sync, wk, ctl)
             if len(r_hist) < args.min_records:
                 print(f"bench_guard: {tag}: retraces {rt} "
                       f"({len(r_hist)} prior record(s) -- not "
@@ -583,7 +639,7 @@ def main() -> int:
         mp99 = row.get("margin_p99_ns")
         if mp99 is not None:
             m_hist = series(wl, "margin_p99_ns", impl, cal, loop,
-                            scen, pop, provon, shards, sync, wk)
+                            scen, pop, provon, shards, sync, wk, ctl)
             if len(m_hist) < args.min_records:
                 print(f"bench_guard: {tag}: margin p99 "
                       f"{mp99/1e6:.2f}ms ({len(m_hist)} prior "
@@ -610,7 +666,7 @@ def main() -> int:
         sv = row.get("starvation_max_ns")
         if sv is not None:
             s_hist2 = series(wl, "starvation_max_ns", impl, cal,
-                             loop, scen, pop, provon, shards, sync, wk)
+                             loop, scen, pop, provon, shards, sync, wk, ctl)
             if len(s_hist2) < args.min_records:
                 print(f"bench_guard: {tag}: starvation max "
                       f"{sv/1e6:.0f}ms ({len(s_hist2)} prior "

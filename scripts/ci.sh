@@ -1254,6 +1254,73 @@ print(f"mesh chaos smoke: S=4 churn dynamic == static canonical "
       f"{dyn.lifecycle['grows']} grows)")
 EOF
 
+echo "== controller smoke (off==bare gate + forced-burn actuation + WAL replay) =="
+# the closed-loop controller (docs/CONTROLLER.md): (1) the off gate --
+# EpochJob(controller=False) is bit-identical to the bare runner
+# (digest, final state, metric vector); (2) seeded forced-burn
+# limit_thrash: backlog pressure fires the expected protective rule
+# (clamp_down, at the FIRST checkpoint boundary) and the journal
+# trajectory is run-to-run deterministic; (3) a run SIGKILLed
+# mid-actuation (after the journal write, before the apply) resumes
+# by REPLAYING the WAL instead of re-deciding -- same digest, same
+# knob trajectory, replays >= 1.
+timeout -k 30 900 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import dataclasses, os, tempfile
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+from dmclock_tpu.lifecycle import make_spec
+from dmclock_tpu.robust import host_faults as HF, supervisor as SV
+
+spec = make_spec("limit_thrash", total_ids=12, base_lam=1.5,
+                 capacity0=12)
+job = SV.EpochJob(engine="prefix", churn=spec, epochs=12, m=2, k=8,
+                  ring=16, waves=4, ckpt_every=2, seed=13,
+                  with_slo=True)
+
+bare = SV.run_job(job)
+off = SV.run_job(dataclasses.replace(job, controller=False))
+assert off.digest == bare.digest, "controller=off diverged from bare"
+assert off.state_digest == bare.state_digest
+assert np.array_equal(np.asarray(off.metrics), np.asarray(bare.metrics))
+assert off.controller_decisions == 0 and off.controller_knobs is None
+print(f"controller-off gate ok (== bare runner, digest "
+      f"{bare.digest[:16]})")
+
+# forced burn: backlog_hi=1 pressures every boundary
+forced = dataclasses.replace(job, controller={"backlog_hi": 1})
+on = SV.run_job(forced)
+assert on.controller_decisions > 0, "forced burn fired no rules"
+rules = [row[2] for row in on.controller_trajectory]
+assert rules[0] == "clamp_down", rules
+assert on.controller_trajectory[0][1] == job.ckpt_every, \
+    "first decision must land on the first boundary"
+assert on.controller_knobs[2] < 100, "clamp knob never actuated"
+on2 = SV.run_job(forced)
+assert on2.controller_trajectory == on.controller_trajectory, \
+    "controller trajectory is not run-to-run deterministic"
+print(f"forced-burn actuation ok ({on.controller_decisions} "
+      f"decision(s), rule sequence {rules}, clamp "
+      f"{on.controller_knobs[2]}%)")
+
+# kill mid-actuation around the LAST journaled decision: the entry is
+# durable before the kill, so the resumed run must REPLAY it
+kill_epoch = on.controller_trajectory[-1][1]
+plan = HF.HostFaultPlan(
+    kill_at_controller=((kill_epoch, "after_journal"),))
+with tempfile.TemporaryDirectory() as wd:
+    res = SV.run_supervised(forced, wd, plan)
+SV.assert_crash_equivalent(res, on)
+assert res.restarts == 1
+assert res.controller_replays >= 1, \
+    "post-write kill must replay the journal, not re-decide"
+print(f"controller replay smoke ok (killed at epoch {kill_epoch} "
+      f"after_journal; {res.controller_replays} replay(s), "
+      f"trajectory bit-identical)")
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

@@ -802,3 +802,91 @@ def test_chaos_row_prints_dropout_accounting(monkeypatch, capsys,
     assert rc == 0
     assert "fault_plan 'T32xS8:drop12+resync11+inject138'" in out
     assert "dropouts [2, 2, 2, 2, 2, 2, 2, 2]" in out
+
+
+# -- controller A/B sessions (bench.py --mode controller) -------------
+
+def _ctl_row(dps, *, decisions=2, sides="both"):
+    return {"workload": "controller", "dps": dps,
+            "scenario": "shard_skew", "total_ids": 192,
+            "engine_loop": "stream", "controller": sides,
+            "controller_decisions": decisions,
+            "recovered_dps": 1e4, "burn_epochs_on": 8,
+            "burn_epochs_off": 20}
+
+
+def _ctl_rec(row, **extra):
+    return {"platform": "tpu", "device": "tpu0",
+            "controller": row.get("controller", "both"),
+            "workloads": {"controller_shard_skew": row}, **extra}
+
+
+def test_controller_actuated_newest_not_judged(monkeypatch, capsys,
+                                               tmp_path):
+    # the newest session's controller actually actuated: its on-twin
+    # wall time includes knob transitions + recompiles -- announced,
+    # never judged, rc 0 even though the rate cratered
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 45e6)])
+    (hist / "bench_2000.json").write_text(json.dumps(
+        _ctl_rec(_ctl_row(2e6, decisions=3))))
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "controller-actuated session" in out
+    assert "3 journaled decision(s)" in out
+    assert "REGRESSION" not in out
+
+
+def test_controller_actuated_priors_excluded_from_medians(
+        monkeypatch, capsys, tmp_path):
+    # actuated records in the prior set must not drag the clean
+    # median down and mask a real regression on a bare session
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 44e6)])
+    (hist / "bench_1500.json").write_text(json.dumps(
+        _ctl_rec(_ctl_row(0.2e6))))
+    (hist / "bench_2000.json").write_text(json.dumps(
+        {"platform": "tpu", "device": "tpu0",
+         "workloads": {"serve": {"dps": 10e6}}}))
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 1 and "REGRESSION" in out
+    assert "controller-actuated record(s)" in out
+
+
+def test_controller_zero_decisions_is_clean_and_tagged(monkeypatch,
+                                                       capsys,
+                                                       tmp_path):
+    # a controller session that never actuated IS a clean run (the
+    # digest gate pins it bit-identical to the bare runner): judged
+    # against its own ctl-tagged series, actuation count printed
+    h = tmp_path / "history"
+    h.mkdir()
+    for i, dps in enumerate((30e6, 34e6, 31e6)):
+        (h / f"bench_{1000 + i}.json").write_text(json.dumps(
+            _ctl_rec(_ctl_row(dps, decisions=0))))
+    rc, out = run_guard(monkeypatch, capsys, h)
+    assert rc == 0
+    assert "controller_shard_skew[stream][N=192][ctl=both]" in out
+    assert "0 controller actuation(s)" in out
+    assert "OK" in out
+
+
+def test_controller_tag_splits_the_series(monkeypatch, capsys,
+                                          tmp_path):
+    # zero-actuation controller rows at 10x the bare rate must not
+    # RAISE the bare serve median and fail an honest clean session
+    # (record-level exclusion does not bite at zero decisions, so
+    # the row-level series identity is what protects the medians)
+    hist = write_history(tmp_path, [("tpu0", 40e6), ("tpu0", 44e6)])
+    for ts, dps in ((1500, 400e6), (1501, 420e6)):
+        (hist / f"bench_{ts}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": "tpu0",
+             "controller": "both",
+             "workloads": {"serve": {
+                 "dps": dps, "controller": "both",
+                 "controller_decisions": 0}}}))
+    (hist / "bench_2000.json").write_text(json.dumps(
+        {"platform": "tpu", "device": "tpu0",
+         "workloads": {"serve": {"dps": 35e6}}}))
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "REGRESSION" not in out
+    assert "vs median 42.0M over 2 sessions" in out

@@ -464,6 +464,7 @@ class TestDocDrift:
     def _registered_names(self):
         """Exercise every cheaply-runnable publisher into one registry
         and return the family names it holds."""
+        from dmclock_tpu.control import Controller, as_spec
         from dmclock_tpu.lifecycle import make_spec
         from dmclock_tpu.lifecycle.plane import LifecyclePlane
         from dmclock_tpu.obs import device as obsdev
@@ -506,6 +507,7 @@ class TestDocDrift:
                                         workload="t")
         LifecyclePlane(make_spec("flash_crowd", total_ids=8)) \
             .publish(reg)
+        Controller(as_spec(True), n=4, ring=4, registry=reg)
         return sorted({m.name for m in reg.metrics()})
 
     @staticmethod

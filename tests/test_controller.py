@@ -1,0 +1,396 @@
+"""Closed-loop serving controller (control/; docs/CONTROLLER.md).
+
+The headline gates:
+
+- **controller=off == bare**: ``EpochJob(controller=None)`` (and
+  ``False``) is bit-identical to the bare runner -- zero plumbing
+  cost, so every actuation stays digest-explainable against the off
+  twin;
+- **cross-loop identity**: the same controller-on job produces the
+  same decision digest AND the same journal trajectory on the round,
+  stream, and S=1 mesh loops (the actuation grid is the shared
+  checkpoint-boundary grid);
+- **SIGKILL matrix**: a kill at ``before_journal`` /
+  ``after_journal`` / ``after_apply`` around any decision resumes to
+  the exact knob trajectory of the uninterrupted twin
+  (fsync-before-apply + replay-not-re-decide), with
+  ``before_journal`` replaying zero journal entries and the
+  post-write stages replaying at least one;
+- plus the pure-policy unit gates (hysteresis, cooldown, fixed-order
+  chaining), the WAL journal's torn-tail truncation, and the
+  satellite-1 churn+provenance composition the boundary ``extras``
+  rider unlocked.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from dmclock_tpu.control import (Controller, ControllerConfig,
+                                 as_spec)
+from dmclock_tpu.control import journal as journal_mod
+from dmclock_tpu.control import policy as pol
+from dmclock_tpu.control import signals as sigs
+from dmclock_tpu.lifecycle import make_spec
+from dmclock_tpu.robust import host_faults as HF
+from dmclock_tpu.robust import supervisor as SV
+
+
+def mk_sig(epoch=2, **kw):
+    """A synthetic all-quiet boundary snapshot; override per test."""
+    base = dict(epoch=epoch, backlog=0, live=0, capacity=0,
+                resv_miss_d=0, limit_break_d=0, share_skew_d=0,
+                violations_d=0, guard_trips_d=0, ingest_drops_d=0,
+                ladder_steps_d=0, starvation_ns=0)
+    base.update(kw)
+    base.setdefault("press_backlog", base["backlog"])
+    return sigs.ControlSignals(**base)
+
+
+# a fully-resolved spec for the pure-policy units (no auto fields)
+SPEC = dict(pol.DEFAULT_SPEC, backlog_hi=100, occ_floor=4,
+            ladder_max=3)
+
+# the supervised-run spec that FORCES actuation: backlog_hi=1 makes
+# every boundary pressured, so clamp_down fires at the very first one
+FORCED = {"backlog_hi": 1}
+
+JOB = SV.EpochJob(engine="prefix", n=96, depth=6, ring=10, epochs=8,
+                  m=2, k=16, seed=5, arrival_lam=1.0, waves=2,
+                  ckpt_every=2)
+
+_REFS: dict = {}
+
+
+def ref_of(loop: str, controller=True) -> SV.SupervisedResult:
+    key = (loop, repr(controller))
+    if key not in _REFS:
+        _REFS[key] = SV.run_job(dataclasses.replace(
+            JOB, engine_loop=loop, controller=controller))
+    return _REFS[key]
+
+
+class TestSignals:
+    def test_digest_reads_deterministic_tier_only(self):
+        a = mk_sig(backlog=7, resv_miss_d=1)
+        b = a._replace(retraces=9, compile_ms=3.5, bound_class="hbm",
+                       dispatch_share=0.4, fallbacks=2)
+        assert sigs.digest(a) == sigs.digest(b)
+
+    def test_digest_changes_on_deterministic_field(self):
+        a = mk_sig(backlog=7)
+        assert sigs.digest(a) != sigs.digest(a._replace(backlog=8))
+        assert sigs.digest(a) != sigs.digest(a._replace(epoch=3))
+
+
+class TestPolicy:
+    def test_down_rule_fires_first_triggering_boundary(self):
+        """Protective moves have hysteresis 1: one resv-miss episode
+        snaps the sync grid to sync_min immediately."""
+        ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
+        ps, dec = pol.step(ps, [4, 0, 100, 0],
+                           mk_sig(resv_miss_d=1), SPEC)
+        assert dec == [("staleness_down", [1, 0, 100, 0])]
+
+    def test_up_rule_needs_clean_streak(self):
+        """Relaxing moves need ``hysteresis`` consecutive clean
+        boundaries -- the anti-flap half of the table."""
+        ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
+        ps, dec = pol.step(ps, [1, 0, 100, 0], mk_sig(), SPEC)
+        assert dec == []            # streak 1 of 2: no decision yet
+        ps, dec = pol.step(ps, [1, 0, 100, 0], mk_sig(epoch=4), SPEC)
+        assert dec == [("staleness_up", [2, 0, 100, 0])]
+
+    def test_dirty_boundary_resets_the_streak(self):
+        ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
+        ps, _ = pol.step(ps, [1, 0, 100, 0], mk_sig(), SPEC)
+        # a guard trip breaks the clean streak (and fires ladder_down)
+        ps, dec = pol.step(ps, [1, 0, 100, 0],
+                           mk_sig(epoch=4, guard_trips_d=1), SPEC)
+        assert ("staleness_up", [2, 0, 100, 0]) not in dec
+        ps, dec = pol.step(ps, [1, 0, 100, 0], mk_sig(epoch=6), SPEC)
+        assert dec == []            # streak restarted at 1
+
+    def test_cooldown_inert_then_refires(self):
+        """An applied decision cools its rule for ``cooldown``
+        boundaries; the trigger persisting past the cooldown fires
+        again."""
+        ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
+        knobs = [1, 0, 100, 0]
+        fired = []
+        for e in (2, 4, 6, 8):
+            ps, dec = pol.step(ps, knobs,
+                               mk_sig(epoch=e, guard_trips_d=1), SPEC)
+            for rule, new in dec:
+                knobs = new
+            fired.append([r for r, _ in dec])
+        assert fired == [["ladder_down"], [], [], ["ladder_down"]]
+        assert knobs[pol.KNOB_LADDER] == 2
+
+    def test_fixed_order_knob_chaining(self):
+        """Later rules see earlier rules' knob updates within one
+        boundary -- the fixed RULES order keeps a multi-rule boundary
+        deterministic."""
+        ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
+        sig = mk_sig(resv_miss_d=1, guard_trips_d=1, limit_break_d=1)
+        ps, dec = pol.step(ps, [4, 0, 100, 0], sig, SPEC)
+        assert [r for r, _ in dec] == \
+            ["staleness_down", "ladder_down", "clamp_down"]
+        assert [new for _, new in dec] == \
+            [[1, 0, 100, 0], [1, 1, 100, 0], [1, 1, 75, 0]]
+
+    def test_clamp_floor_and_ladder_ceiling(self):
+        ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
+        _, dec = pol.step(ps, [1, 3, 25, 0],
+                          mk_sig(limit_break_d=1, guard_trips_d=1),
+                          SPEC)
+        assert dec == []        # clamp at clamp_min, ladder at max
+
+    def test_compact_on_sparse_occupancy(self):
+        # sync pinned at sync_max so the clean boundary exercises the
+        # compact rule alone
+        ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
+        sig = mk_sig(live=3, capacity=16)
+        ps, dec = pol.step(ps, [8, 0, 100, 0], sig, SPEC)
+        assert dec == []            # hysteresis 2
+        _, dec = pol.step(ps, [8, 0, 100, 0],
+                          sig._replace(epoch=4), SPEC)
+        assert dec == [("compact", [8, 0, 100, 1])]
+
+    def test_overlay_chains_ladder_rungs(self):
+        from dmclock_tpu.robust.guarded import LADDER_RUNGS
+        knob, fast, safe = LADDER_RUNGS[0]
+        assert pol.overlay({knob: fast}, 0) == {knob: fast}
+        assert pol.overlay({knob: fast}, 1)[knob] == safe
+        # the shared-knob calendar rungs chain: two conceded levels
+        # walk wheel -> bucketed -> minstop
+        assert pol.overlay({knob: fast}, 2)[knob] == "minstop"
+        # a config not on any rung's fast side passes through
+        assert pol.overlay({"select_impl": "sort"}, 4) \
+            == {"select_impl": "sort"}
+
+
+class TestJournal:
+    def test_append_asserts_sequential_seq(self, tmp_path):
+        j = journal_mod.DecisionJournal(tmp_path)
+        j.append({"seq": 0, "epoch": 2, "rule": "clamp_down",
+                  "digest": "x", "old": [1, 0, 100, 0],
+                  "new": [1, 0, 75, 0]})
+        with pytest.raises(AssertionError):
+            j.append({"seq": 2, "epoch": 4, "rule": "clamp_down",
+                      "digest": "x", "old": [], "new": []})
+
+    def test_reload_and_entry_at(self, tmp_path):
+        j = journal_mod.DecisionJournal(tmp_path)
+        for s in range(3):
+            j.append({"seq": s, "epoch": 2 * (s + 1),
+                      "rule": "clamp_down", "digest": "x",
+                      "old": [1, 0, 100, 0], "new": [1, 0, 75, 0]})
+        k = journal_mod.DecisionJournal(tmp_path)
+        assert len(k) == 3
+        assert k.entry_at(1)["epoch"] == 4
+        assert k.entry_at(3) is None
+
+    def test_torn_tail_truncated_on_open(self, tmp_path):
+        j = journal_mod.DecisionJournal(tmp_path)
+        j.append({"seq": 0, "epoch": 2, "rule": "clamp_down",
+                  "digest": "x", "old": [1, 0, 100, 0],
+                  "new": [1, 0, 75, 0]})
+        with open(j.path, "a") as fh:    # kill landed mid-write
+            fh.write('{"seq": 1, "epo')
+        k = journal_mod.DecisionJournal(tmp_path)
+        assert len(k) == 1
+        # the tear is gone durably: a third open sees a clean file
+        with open(k.path) as fh:
+            lines = fh.read().splitlines()
+        assert len(lines) == 1 and json.loads(lines[0])["seq"] == 0
+
+
+class TestSpec:
+    def test_as_spec_normalization(self):
+        assert as_spec(None) is None
+        assert as_spec(False) is None
+        assert as_spec({"enabled": False}) is None
+        full = as_spec(True)
+        assert full["hysteresis"] == 2 and full["ladder_max"] > 0
+        assert as_spec(ControllerConfig(clamp_min=10))["clamp_min"] \
+            == 10
+        with pytest.raises(AssertionError, match="unknown"):
+            as_spec({"no_such_knob": 1})
+
+    def test_clamp_counts_rng_neutral_cap(self):
+        ctl = Controller(as_spec(True), n=4, ring=4)
+        counts = np.array([5, 0, 9, 1], dtype=np.int64)
+        assert ctl.clamp_counts(counts, 4) is counts  # 100% == off
+        ctl.knobs[pol.KNOB_CLAMP] = 50
+        assert ctl.clamp_counts(counts, 4).tolist() == [2, 0, 2, 1]
+        ctl.knobs[pol.KNOB_CLAMP] = 25
+        # the cap never reaches zero: admission is clamped, not shut
+        assert ctl.clamp_counts(counts, 4).tolist() == [1, 0, 1, 1]
+
+
+class TestOffGate:
+    @pytest.mark.parametrize("loop", ["round", "stream"])
+    def test_off_equals_bare(self, loop):
+        """controller=False is bit-identical to the bare runner --
+        the zero-plumbing gate that keeps every actuation
+        explainable against the off twin."""
+        bare = ref_of(loop, controller=None)
+        off = ref_of(loop, controller=False)
+        assert off.digest == bare.digest
+        assert off.state_digest == bare.state_digest
+        assert np.array_equal(np.asarray(off.metrics),
+                              np.asarray(bare.metrics))
+        assert off.controller_decisions == 0
+        assert off.controller_knobs is None
+        assert off.controller_trajectory is None
+
+
+class TestForcedActuation:
+    def test_clamp_down_fires_and_shapes_the_run(self):
+        """backlog_hi=1 pressures every boundary: clamp_down fires at
+        the first one, the knob drops below 100, and the clamped
+        arrival stream leaves a different final state than the off
+        twin (the actuation is real, not just journaled -- at this
+        small shape the thinner backlog does not reorder the served
+        decisions, so the divergence shows up in the state digest)."""
+        on = SV.run_job(dataclasses.replace(JOB, controller=FORCED))
+        off = ref_of("round", controller=None)
+        assert on.controller_decisions > 0
+        rules = [row[2] for row in on.controller_trajectory]
+        assert "clamp_down" in rules
+        assert on.controller_knobs[pol.KNOB_CLAMP] < 100
+        assert on.state_digest != off.state_digest
+        # first decision fires at the FIRST boundary of the grid
+        assert on.controller_trajectory[0][1] == JOB.ckpt_every
+
+    def test_quiet_controller_decides_but_never_clamps_rng(self):
+        """With the default spec the quiet job only relaxes
+        (staleness_up is a round-loop no-op knob), so the decision
+        digest matches the off twin exactly -- actuation is
+        digest-explainable."""
+        on = ref_of("round", controller=True)
+        off = ref_of("round", controller=None)
+        assert on.digest == off.digest
+        assert on.controller_knobs is not None
+
+
+class TestCrossLoopIdentity:
+    @pytest.mark.parametrize("loop", [
+        "stream", pytest.param("mesh", marks=pytest.mark.slow)])
+    def test_trajectory_identical_across_loops(self, loop):
+        """The same forced-actuation job journals the same decisions
+        (seq, epoch, rule, knobs) and lands the same digest on every
+        loop -- the actuation grid IS the shared boundary grid."""
+        r = _REFS.setdefault(("round", "forced"), SV.run_job(
+            dataclasses.replace(JOB, controller=FORCED)))
+        o = SV.run_job(dataclasses.replace(
+            JOB, engine_loop=loop, controller=FORCED))
+        assert o.digest == r.digest
+        assert o.state_digest == r.state_digest
+        assert o.controller_trajectory == r.controller_trajectory
+        assert o.controller_knobs == r.controller_knobs
+
+
+class TestSigkillMatrix:
+    """Satellite 4: the kill lands at each stage of the
+    fsync-before-apply window around a real decision; the resumed run
+    must be crash-equivalent to the uninterrupted controller-on twin
+    with the exactly-once replay accounting."""
+
+    @pytest.mark.parametrize("loop", [
+        "round", "stream", pytest.param("mesh",
+                                        marks=pytest.mark.slow)])
+    @pytest.mark.parametrize("stage", HF.CONTROLLER_STAGES)
+    def test_kill_at_stage_resumes_exact(self, tmp_path, loop, stage):
+        job = dataclasses.replace(JOB, engine_loop=loop,
+                                  controller=FORCED)
+        ref = _REFS.setdefault((loop, "forced"), SV.run_job(job))
+        assert ref.controller_decisions > 0
+        # kill around the decision at the SECOND boundary, so the
+        # resume restores the first boundary's checkpoint and walks
+        # back through a journaled decision
+        epoch = 2 * JOB.ckpt_every
+        assert any(row[1] == epoch for row in ref.controller_trajectory)
+        plan = HF.HostFaultPlan(kill_at_controller=((epoch, stage),))
+        res = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 1
+        if stage == "before_journal":
+            # nothing durable yet: the resumed run RE-DECIDES (the
+            # policy is pure) -- zero replays, identical trajectory
+            assert res.controller_replays == 0
+        else:
+            # the entry was durable before the kill: the resumed run
+            # REPLAYS it instead of re-deciding
+            assert res.controller_replays >= 1
+
+    def test_exactly_once_with_two_kills(self, tmp_path):
+        """Two kills in one run (one per boundary window): every
+        journaled seq is still applied exactly once."""
+        job = dataclasses.replace(JOB, controller=FORCED)
+        ref = _REFS.setdefault(("round", "forced"), SV.run_job(job))
+        plan = HF.HostFaultPlan(kill_at_controller=(
+            (JOB.ckpt_every, "after_journal"),
+            (2 * JOB.ckpt_every, "after_apply")))
+        res = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 2
+        seqs = [row[0] for row in res.controller_trajectory]
+        assert seqs == sorted(set(seqs))
+
+
+@pytest.mark.slow
+class TestSpawnSigkill:
+    """REAL SIGKILL: the supervised child is a separate interpreter
+    and the injector delivers an actual signal 9 mid-actuation."""
+
+    @pytest.mark.parametrize("stage", HF.CONTROLLER_STAGES)
+    def test_spawned_kill_mid_actuation(self, tmp_path, monkeypatch,
+                                        stage):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        job = dataclasses.replace(JOB, controller=FORCED)
+        ref = _REFS.setdefault(("round", "forced"), SV.run_job(job))
+        plan = HF.HostFaultPlan(
+            kill_at_controller=((2 * JOB.ckpt_every, stage),))
+        res = SV.run_supervised(job, tmp_path, plan, mode="spawn")
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 1
+        if stage == "before_journal":
+            assert res.controller_replays == 0
+        else:
+            assert res.controller_replays >= 1
+
+
+class TestChurnProvComposition:
+    """Satellite 1: the lifecycle boundary now carries the provenance
+    watermark through grow/compact/evict via the ``extras`` rider --
+    the PR-12 with_prov+churn rejection is lifted."""
+
+    def _job(self, loop="round"):
+        spec = make_spec("churn_storm", total_ids=16, base_lam=1.5,
+                         compact_every=1, gens=4, stride=4, life=2,
+                         capacity0=4)
+        return SV.EpochJob(engine="prefix", churn=spec, epochs=12,
+                           m=2, k=8, ring=16, waves=4, ckpt_every=2,
+                           seed=11, engine_loop=loop, with_prov=True)
+
+    def test_round_equals_stream_with_prov_arrays(self):
+        r = SV.run_job(self._job("round"))
+        s = SV.run_job(self._job("stream"))
+        assert r.digest == s.digest
+        assert r.prov_scal is not None
+        for f in ("prov_margin_hist", "prov_scal",
+                  "prov_last_served"):
+            assert np.array_equal(getattr(r, f), getattr(s, f)), f
+
+    def test_crash_equivalent_through_compaction(self, tmp_path):
+        job = self._job()
+        ref = SV.run_job(job)
+        plan = HF.HostFaultPlan(
+            kill_at_decisions=(max(ref.decisions // 2, 1),))
+        res = SV.run_supervised(job, tmp_path, plan)
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 1

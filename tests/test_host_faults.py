@@ -46,9 +46,20 @@ class TestPlans:
         ev = HF.host_plan_events(plan)
         assert ev == {"kills": 2, "save_kills": 1,
                       "corrupt_saves": 1, "scrape_drops": 2,
-                      "restarts": 3}
+                      "ctl_kills": 0, "restarts": 3}
         assert HF.describe_host(plan) == \
             "host:kill2+savekill1+corrupt1+scrape2"
+
+    def test_controller_kills_count_as_restarts(self):
+        plan = HF.HostFaultPlan(
+            kill_at_controller=((2, "after_journal"),
+                                (4, "after_apply")))
+        ev = HF.host_plan_events(plan)
+        assert ev["ctl_kills"] == 2 and ev["restarts"] == 2
+        assert HF.describe_host(plan) == \
+            "host:kill0+savekill0+corrupt0+scrape0+ctlkill2"
+        for _e, stage in plan.kill_at_controller:
+            assert stage in HF.CONTROLLER_STAGES
 
     def test_json_round_trip(self):
         plan = HF.sample_host_plan(5, epochs=6, est_decisions=300,

@@ -536,11 +536,15 @@ class TestSupervisorProv:
         s = SV.run_job(dataclasses.replace(job, engine_loop="stream"))
         assert np.array_equal(r.prov_scal, s.prov_scal)
 
-    def test_churn_plus_prov_rejected(self):
-        """The lifecycle boundary does not carry the provenance
-        watermark through grow/compact/evict yet: the combination
-        must fail loudly, not mis-attribute a recycled slot's serve
-        history (or crash at the first capacity growth)."""
+    def test_churn_plus_prov_composes(self):
+        """The lifecycle boundary carries the provenance watermark
+        through grow/compact/evict as a boundary ``extras`` rider
+        (the lifted PR-12 rejection): the combination runs, reports
+        the prov arrays, and stays loop-identical.  The deeper
+        churn-storm + crash-equivalence gates live in
+        tests/test_controller.py::TestChurnProvComposition."""
+        import dataclasses
+
         from dmclock_tpu.lifecycle import make_spec
         from dmclock_tpu.robust import supervisor as SV
 
@@ -548,8 +552,11 @@ class TestSupervisorProv:
         job = SV.EpochJob(engine="prefix", k=8, churn=spec,
                           epochs=4, m=2, ckpt_every=2,
                           with_prov=True)
-        with pytest.raises(ValueError, match="churn"):
-            SV.run_job(job)
+        r = SV.run_job(job)
+        assert r.prov_scal is not None
+        s = SV.run_job(dataclasses.replace(job, engine_loop="stream"))
+        assert r.digest == s.digest
+        assert np.array_equal(r.prov_scal, s.prov_scal)
 
 
 class TestShardPressure:

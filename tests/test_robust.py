@@ -371,6 +371,78 @@ class TestRetryBackoff:
             retry_with_backoff(bug, sleep=lambda s: None)
         assert len(calls) == 1
 
+    @staticmethod
+    def _always_flaky(fails):
+        state = {"n": 0}
+
+        def fn():
+            state["n"] += 1
+            if state["n"] <= fails:
+                raise OSError("transient")
+            return "ok"
+
+        return fn
+
+    def test_seeded_jitter_is_deterministic(self):
+        """Same seed -> same sleep schedule (replayable under the
+        supervisor's determinism discipline); a different seed moves
+        it; every jittered delay stays in [0.5, 1.5) x the unjittered
+        rung."""
+        a, b, c = [], [], []
+        retry_with_backoff(self._always_flaky(3), retries=3,
+                           sleep=a.append, jitter_seed=7)
+        retry_with_backoff(self._always_flaky(3), retries=3,
+                           sleep=b.append, jitter_seed=7)
+        retry_with_backoff(self._always_flaky(3), retries=3,
+                           sleep=c.append, jitter_seed=8)
+        assert a == b and len(a) == 3
+        assert a != c
+        for slept, rung in zip(a, [0.05, 0.1, 0.2]):
+            assert 0.5 * rung <= slept < 1.5 * rung
+
+    def test_unseeded_schedule_is_the_exact_ladder(self):
+        # regression: callers without a seed keep the historical
+        # deterministic rungs bit-for-bit
+        sleeps = []
+        retry_with_backoff(self._always_flaky(3), retries=3,
+                           sleep=sleeps.append)
+        assert sleeps == [0.05, 0.1, 0.2]
+
+    def test_deadline_reraises_with_retries_left(self):
+        """Wall-clock budget exhausted -> the transient surfaces even
+        though the retry count would allow another attempt."""
+        now = {"t": 0.0}
+
+        def fn():
+            now["t"] += 0.9         # each attempt burns 0.9s
+            raise OSError("transient")
+
+        sleeps = []
+        with pytest.raises(OSError, match="transient"):
+            retry_with_backoff(fn, retries=10, base_s=0.5,
+                               deadline_s=2.0, sleep=sleeps.append,
+                               clock=lambda: now["t"])
+        # attempts at t=0.9, 1.8; the third would start past the
+        # 2.0s deadline, so only two sleeps ever happened
+        assert len(sleeps) == 2
+
+    def test_deadline_truncates_final_sleep(self):
+        now = {"t": 0.0}
+
+        def fn():
+            now["t"] += 0.9
+            raise OSError("transient")
+
+        sleeps = []
+        with pytest.raises(OSError):
+            retry_with_backoff(fn, retries=10, base_s=0.5,
+                               deadline_s=1.0, sleep=sleeps.append,
+                               clock=lambda: now["t"])
+        # 0.9s of the 1.0s budget is gone at the first retry: the
+        # 0.5s rung is truncated to the 0.1s remaining
+        assert len(sleeps) == 1
+        assert sleeps[0] == pytest.approx(0.1)
+
 
 # ----------------------------------------------------------------------
 # queue-level guarded commit
