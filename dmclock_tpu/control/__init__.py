@@ -24,7 +24,8 @@ from . import journal as journal_mod
 from . import policy as policy_mod
 from . import signals as signals_mod
 from .policy import (KNOB_CLAMP, KNOB_COMPACT, KNOB_LADDER,  # noqa: F401
-                     KNOB_NAMES, KNOB_SYNC, NUM_KNOBS, NUM_RULES, RULES)
+                     KNOB_MIGRATE, KNOB_NAMES, KNOB_SYNC, NUM_KNOBS,
+                     NUM_RULES, RULES)
 from .signals import ControlSignals  # noqa: F401
 
 __all__ = ["Controller", "ControllerConfig", "ControlSignals",
@@ -48,6 +49,9 @@ class ControllerConfig:
     occ_lo: float = 0.5
     occ_floor: int = 0
     ladder_max: int = 0
+    migrate_skew_hi: float = 0.0
+    migrate_max: int = 4
+    migrate_pick: str = "hot"
 
 
 def as_spec(obj) -> Optional[dict]:
@@ -86,13 +90,17 @@ class Controller:
 
     def __init__(self, spec: dict, *, n: int, ring: int,
                  counter_sync_every: int = 1, capacity0: int = 0,
+                 n_shards: int = 1,
                  workdir: Optional[str] = None, registry=None):
         self.spec = dict(spec)
         if int(self.spec.get("backlog_hi", 0)) <= 0:
             self.spec["backlog_hi"] = max(int(n) * int(ring) * 3 // 4, 1)
         if int(self.spec.get("occ_floor", 0)) <= 0:
             self.spec["occ_floor"] = max(int(capacity0), 0)
-        self.knobs = [max(int(counter_sync_every), 1), 0, 100, 0]
+        # the migrate rule needs the shard count for its skew ratio
+        # (pure policy sees only the spec, so the ctor pins it there)
+        self.spec["migrate_shards"] = max(int(n_shards), 1)
+        self.knobs = [max(int(counter_sync_every), 1), 0, 100, 0, 0]
         self.pstate = np.zeros(2 * NUM_RULES, dtype=np.int64)
         self.applied = 0            # the ctl_cursor leaf
         self.replays = 0            # journaled decisions replayed
@@ -253,6 +261,17 @@ class Controller:
     def clamp_pct(self) -> int:
         return int(self.knobs[KNOB_CLAMP])
 
+    def migrate_batch(self) -> int:
+        """Max clients the ``migrate`` actuation moves per firing."""
+        return max(int(self.spec.get("migrate_max", 4)), 0)
+
+    def migrate_pick(self) -> str:
+        """Candidate pick policy for the migrate actuation: ``"hot"``
+        (largest served-demand first) or ``"cold"`` (never-served
+        first -- the digest-gate mode: quiet movers are exactly the
+        clients whose move is provably placement-equivalent)."""
+        return str(self.spec.get("migrate_pick", "hot"))
+
     def overlay(self, cfg: dict) -> dict:
         """Engine config through the controller's conceded ladder
         rungs (exact twins only)."""
@@ -305,7 +324,8 @@ def publish_controller(registry, ctl: Controller) -> None:
         registry.gauge(
             "dmclock_controller_knob",
             "current actuated knob vector (counter_sync_every / "
-            "ladder_level / clamp_pct / compact_trigger)",
+            "ladder_level / clamp_pct / compact_trigger / "
+            "migrate_trigger)",
             labels={"knob": name}) \
             .set_function(lambda i=i: float(ctl.knobs[i]))
     registry.gauge(

@@ -21,6 +21,11 @@ the ``ctl_knobs`` leaf):
   eligible triggers fired (the actuation itself is the digest-neutral
   ``LifecyclePlane.force_compact``; on the mesh it marks
   migration-eligible without moving state).
+- ``migrate_trigger`` -- monotone count of AUTHORIZED migration slots
+  (bumped by ``migrate_max`` each time the ``migrate`` rule fires on
+  per-shard pressure skew; the actuation is the supervisor's
+  ``_mesh_migrate`` executing digest-neutral EVICT/REGISTER handoffs
+  through :mod:`~dmclock_tpu.lifecycle.placement`).
 
 Per-rule hysteresis and cooldown: protective moves (``*_down``) fire
 on the FIRST triggering boundary; relaxing moves (``*_up``) and
@@ -38,24 +43,30 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 RULES = ("staleness_down", "staleness_up", "ladder_down", "ladder_up",
-         "clamp_down", "clamp_up", "compact")
+         "clamp_down", "clamp_up", "compact", "migrate")
 NUM_RULES = len(RULES)
 
 # fast-first rules: one triggering boundary is enough
 _IMMEDIATE = frozenset(("staleness_down", "ladder_down", "clamp_down"))
 
-KNOB_SYNC, KNOB_LADDER, KNOB_CLAMP, KNOB_COMPACT = 0, 1, 2, 3
+KNOB_SYNC, KNOB_LADDER, KNOB_CLAMP, KNOB_COMPACT, KNOB_MIGRATE = \
+    0, 1, 2, 3, 4
 KNOB_NAMES = ("counter_sync_every", "ladder_level", "clamp_pct",
-              "compact_trigger")
-NUM_KNOBS = 4
+              "compact_trigger", "migrate_trigger")
+NUM_KNOBS = 5
 
 # ``0`` means auto: backlog_hi <- n * ring * 3 // 4, occ_floor <- the
-# job's initial slot capacity, ladder_max <- len(LADDER_RUNGS).
+# job's initial slot capacity, ladder_max <- len(LADDER_RUNGS);
+# migrate_skew_hi == 0 keeps the migrate rule OFF (its trigger is a
+# per-shard skew ratio, meaningless off the mesh -- migrate_shards is
+# filled in by the Controller ctor from the job's n_shards).
 DEFAULT_SPEC = dict(enabled=True, hysteresis=2, cooldown=2,
                     sync_min=1, sync_max=8,
                     clamp_min=25, clamp_step=25,
                     backlog_hi=0, occ_lo=0.5, occ_floor=0,
-                    ladder_max=0)
+                    ladder_max=0,
+                    migrate_skew_hi=0.0, migrate_max=4,
+                    migrate_pick="hot", migrate_shards=1)
 
 
 def ladder_max_default() -> int:
@@ -72,7 +83,7 @@ def _propose(rule: str, knobs: List[int], sig,
     """Proposed knob vector when ``rule`` triggers on ``sig``, else
     None.  Evaluated against the CURRENT (possibly just-updated this
     boundary) knobs, in fixed RULES order."""
-    sync, level, clamp, compact = knobs
+    sync, level, clamp, compact, migr = knobs
     burn = sig.resv_miss_d + sig.limit_break_d + sig.share_skew_d
     trips = sig.guard_trips_d
     clean = burn == 0 and trips == 0
@@ -80,36 +91,50 @@ def _propose(rule: str, knobs: List[int], sig,
     if rule == "staleness_down":
         # resv-miss burn: counters are too stale to honor reservations
         if sig.resv_miss_d > 0 and sync > spec["sync_min"]:
-            return [int(spec["sync_min"]), level, clamp, compact]
+            return [int(spec["sync_min"]), level, clamp, compact, migr]
     elif rule == "staleness_up":
         # clean streak: widen the sync grid, buy back collective share
         if clean and sync < spec["sync_max"]:
             return [min(sync * 2, int(spec["sync_max"])), level, clamp,
-                    compact]
+                    compact, migr]
     elif rule == "ladder_down":
         if trips > 0 and level < int(spec["ladder_max"]):
-            return [sync, level + 1, clamp, compact]
+            return [sync, level + 1, clamp, compact, migr]
     elif rule == "ladder_up":
         if clean and level > 0:
-            return [sync, level - 1, clamp, compact]
+            return [sync, level - 1, clamp, compact, migr]
     elif rule == "clamp_down":
         pressured = sig.limit_break_d > 0 or \
             (backlog_hi > 0 and sig.backlog > backlog_hi)
         if pressured and clamp > spec["clamp_min"]:
             return [sync, level,
                     max(clamp - int(spec["clamp_step"]),
-                        int(spec["clamp_min"])), compact]
+                        int(spec["clamp_min"])), compact, migr]
     elif rule == "clamp_up":
         drained = backlog_hi <= 0 or sig.backlog <= backlog_hi // 2
         if clean and drained and clamp < 100:
             return [sync, level,
-                    min(clamp + int(spec["clamp_step"]), 100), compact]
+                    min(clamp + int(spec["clamp_step"]), 100), compact,
+                    migr]
     elif rule == "compact":
         # low occupancy after growth: slots fragmented / shard shrunk
         sparse = sig.capacity > int(spec["occ_floor"]) and \
             sig.live > 0 and sig.live < spec["occ_lo"] * sig.capacity
         if sparse:
-            return [sync, level, clamp, compact + 1]
+            return [sync, level, clamp, compact + 1, migr]
+    elif rule == "migrate":
+        # per-shard pressure skew: the hottest shard's backlog exceeds
+        # migrate_skew_hi times the all-shard mean (press_backlog * S
+        # > hi * backlog avoids the division).  Hysteresis applies
+        # (migrate is NOT in _IMMEDIATE): moving clients is never an
+        # emergency action, and cooldown spaces the handoffs out so a
+        # move's effect lands before the next decision.
+        hi = float(spec.get("migrate_skew_hi", 0.0))
+        shards = int(spec.get("migrate_shards", 1))
+        if hi > 0 and shards > 1 and sig.backlog > 0 and \
+                sig.press_backlog * shards > hi * sig.backlog:
+            return [sync, level, clamp, compact,
+                    migr + int(spec.get("migrate_max", 4))]
     else:
         raise ValueError(f"unknown controller rule {rule!r}")
     return None

@@ -100,8 +100,16 @@ def make_spec(scenario: str, *, total_ids: int, seed: int = 0,
         # shard's ids trickle at idle_x.  Invisible at one shard;
         # at S=4 it is the one-shard-melts-while-others-idle shape
         # inter-shard placement/migration will have to fix.
+        # cold_frac / cold_until carve a QUIET TAIL out of the hot
+        # shard's partition: the lowest-rate ``cold_frac`` of its
+        # Zipf ranks stay at lam = 0 until epoch ``cold_until``
+        # (0 = knob off, bit-identical to before).  Those ids are
+        # registered-but-drained with zero completions -- exactly the
+        # movers the migration twin gate can prove placement-
+        # equivalent (docs/LIFECYCLE.md "quiet-since-start").
         "shard_skew": {"n_shards": 4, "hot_shard": 0,
-                       "zipf_a": 1.2, "hot_x": 8.0, "idle_x": 0.1},
+                       "zipf_a": 1.2, "hot_x": 8.0, "idle_x": 0.1,
+                       "cold_frac": 0.0, "cold_until": 0},
     }
     d = dict(defaults[scenario])
     unknown = set(params) - set(d)
@@ -210,6 +218,16 @@ def lam_vector(spec: dict, epoch: int) -> np.ndarray:
             hot,
             lam * float(spec["hot_x"]) * zipf / max(zipf_mean, 1e-12),
             lam * float(spec["idle_x"]))
+        cf = float(spec.get("cold_frac", 0.0))
+        until = int(spec.get("cold_until", 0))
+        if cf > 0 and epoch < until:
+            # quiet tail: the coldest cold_frac of the hot shard's
+            # ranks arrive NOTHING until cold_until -- drained,
+            # zero-completion residents the migrate rule can move
+            # with a provably placement-equivalent digest
+            n_cold = int(round(cf * n_hot))
+            quiet = hot & (rank >= n_hot - n_cold)
+            lam = np.where(quiet, 0.0, lam)
     return lam
 
 

@@ -1,0 +1,336 @@
+"""Shard placement + live client migration: the inter-server routing
+layer over the per-shard lifecycle planes (docs/LIFECYCLE.md
+"Placement and migration").
+
+The paper's inter-server coordination is exactly a per-client
+(delta, rho) counter handoff, which means a client can MOVE between
+servers with nothing but the piggyback contract -- yet the mesh pinned
+every client to ``cid % n_shards`` forever, so the ``shard_skew``
+scenario melts one shard while its siblings idle.  This module is the
+RackSched-shaped two-level fix: an inter-server placement policy
+routing over otherwise-unmodified per-server engines.
+
+- **Placement** (:meth:`PlacementMap.place_batch`): new registrations
+  sample TWO candidate shards from the checkpointed placement RNG and
+  pick the lower ``dmclock_shard_pressure_*`` backlog
+  (power-of-two-choices).  ``mode="static"`` keeps the historical
+  ``cid % n_shards`` ownership bit-identically (the map is not even
+  attached then); scenario **pins** (``placement_pins``) keep
+  workloads whose shape IS the ownership function -- ``shard_skew``'s
+  hot mask is ``cid % n_shards == hot_shard`` -- on their scripted
+  shards without consuming RNG.  Under a fault plan, a registration
+  whose sampled choices are DOWN re-routes to the live one, or defers
+  one boundary when both are down (the supervisor's old up-front
+  ValueError became this defined behavior).
+- **Migration** (:meth:`PlacementMap.plan_moves` + the supervisor's
+  ``_mesh_migrate``): at a controller-fired boundary, drained clients
+  leave the hottest shard as the EXISTING digest-neutral ops -- EVICT
+  on the source (final ledger row folded into the departed report),
+  REGISTER on the destination with the carried (delta, rho) counter
+  views and provenance watermark riding as boundary extras.  The
+  canonical client-id-space digest gate: a run that migrates a
+  quiet-since-start client at boundary B is bit-identical to a run
+  that placed it on the destination from the start (tests/
+  test_placement.py; the ci.sh migration smoke).
+- **Determinism**: the RNG is a checkpointed PCG64 (``pm_*`` rotation
+  leaves), pinned ids never consume draws, unpinned registrations
+  always consume exactly two -- so a resumed incarnation, and a twin
+  run given ``overrides`` (run B of the digest gate), replay the
+  identical placement stream.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# test seam: called between the stages of a live migration
+# (``evicted`` -> ``handoff`` -> ``registered``) -- the "SIGKILL
+# mid-migration" injection points of the crash-equivalence matrix
+# (tests/test_placement.py).  Signature: hook(stage: str).
+_migrate_hook = None
+
+PM_COUNTER_KEYS = ("placements", "p2c_draws", "migrations",
+                   "reroutes", "defers", "overrides")
+
+
+def parse_placement(obj) -> Tuple[str, Dict[int, int]]:
+    """Normalize ``EpochJob.placement`` (None / ``"static"`` /
+    ``"p2c"`` / ``{"mode": .., "overrides": {cid: shard}}``) to
+    ``(mode, overrides)``.  The dict form is what the digest gate's
+    run-B twin uses: moved clients placed on their run-A destinations
+    from the start (JSON keys arrive as strings)."""
+    if obj is None or obj == "static":
+        return "static", {}
+    if obj == "p2c":
+        return "p2c", {}
+    if isinstance(obj, dict):
+        mode = str(obj.get("mode", "p2c"))
+        if mode not in ("static", "p2c"):
+            raise ValueError(f"unknown placement mode {mode!r} "
+                             "(one of 'static', 'p2c')")
+        ov = {int(k): int(v)
+              for k, v in (obj.get("overrides") or {}).items()}
+        return mode, ov
+    raise ValueError(f"unknown placement spec {obj!r} (expected "
+                     "'static', 'p2c', or a {'mode', 'overrides'} "
+                     "dict)")
+
+
+def placement_pins(spec: Optional[dict], n_shards: int) -> np.ndarray:
+    """Scenario pins: ``bool[total_ids]``, True where the churn
+    scenario's SHAPE is the ownership function and p2c must not
+    re-route it.  ``shard_skew`` pins every id -- its hot mask is
+    ``cid % n_shards == hot_shard`` (lifecycle.churn), so spreading
+    the boundary-0 registrations would dissolve the melt the scenario
+    exists to produce (migration, not placement, is what fixes it).
+    Every other scenario is placement-free (no pins)."""
+    if spec is None:
+        return np.zeros(0, dtype=bool)
+    total = int(spec["total_ids"])
+    if spec.get("scenario") == "shard_skew":
+        return np.ones(total, dtype=bool)
+    return np.zeros(total, dtype=bool)
+
+
+class PlacementMap:
+    """The cluster-wide client->shard assignment (one instance shared
+    by every per-shard :class:`~.plane.LifecyclePlane`; their
+    ``_owns`` consults it instead of ``slots.owner_shard``).
+
+    Checkpoint state (rides the rotation payload as ``pm_*`` leaves):
+    the assignment array, the placement RNG (PCG64 as uint64[6]),
+    the counters, the move log, and the deferred-registration list.
+    Everything else (pins, overrides, mode) re-derives from the job
+    config."""
+
+    def __init__(self, n_shards: int, total_ids: int, *,
+                 mode: str = "p2c", seed: int = 0,
+                 pins: Optional[np.ndarray] = None,
+                 overrides: Optional[Dict[int, int]] = None):
+        self.mode = str(mode)
+        self.n_shards = int(n_shards)
+        self.total = int(total_ids)
+        self.assign = np.full(self.total, -1, dtype=np.int64)
+        if self.mode == "static":
+            self.assign = np.arange(self.total,
+                                    dtype=np.int64) % self.n_shards
+        self.pins = np.zeros(self.total, dtype=bool) \
+            if pins is None else np.asarray(pins, dtype=bool).copy()
+        self.override = np.full(self.total, -1, dtype=np.int64)
+        for cid, s in (overrides or {}).items():
+            if not 0 <= int(s) < self.n_shards:
+                raise ValueError(f"placement override for client "
+                                 f"{cid} targets shard {s} outside "
+                                 f"[0, {self.n_shards})")
+            self.override[int(cid)] = int(s)
+        # a DISTINCT stream from the arrival RNG (same job seed, own
+        # spawn key), so placement draws never perturb arrival draws
+        self.rng = np.random.Generator(np.random.PCG64(
+            np.random.SeedSequence([int(seed), 0x706C6163])))
+        self.counters = {k: 0 for k in PM_COUNTER_KEYS}
+        self.moves: List[Tuple[int, int, int, int]] = []
+        self.deferred: List[int] = []
+
+    # -- lookups -------------------------------------------------------
+    def shard_of(self, cid: int) -> int:
+        """Current owner shard of ``cid`` (-1 = not placed yet --
+        either never registered or deferred while its p2c choices
+        were both down)."""
+        return int(self.assign[int(cid)])
+
+    def shard_counts(self) -> np.ndarray:
+        """Placed clients per shard (``int64[S]``)."""
+        out = np.zeros(self.n_shards, dtype=np.int64)
+        placed = self.assign[self.assign >= 0]
+        np.add.at(out, placed, 1)
+        return out
+
+    # -- power-of-two-choices placement --------------------------------
+    def _draw2(self) -> Tuple[int, int]:
+        a = int(self.rng.integers(self.n_shards))
+        b = int(self.rng.integers(self.n_shards))
+        self.counters["p2c_draws"] += 2
+        return a, b
+
+    def place_batch(self, cids: Sequence[int], *, backlog,
+                    up: Optional[np.ndarray] = None) -> List[int]:
+        """Assign shards to the registrations due at one boundary
+        (deferred-first, then ascending-cid -- the caller's order).
+        ``backlog`` is the per-shard pressure vector the choice
+        minimizes (``dmclock_shard_pressure_backlog``: per-shard
+        queued totals); ``up`` the boundary's liveness row (None =
+        every shard live).  A pinned id takes ``cid % n_shards`` with
+        NO draw; an unpinned id always consumes exactly two draws
+        (override ids too -- RNG parity is what keeps a twin run's
+        stream aligned), picks the lower-backlog live choice, and
+        DEFERS to the next boundary when both choices are down.
+        Returns the cids actually placed."""
+        backlog = np.asarray(backlog, dtype=np.int64)
+        placed: List[int] = []
+        deferred: List[int] = []
+        for cid in cids:
+            cid = int(cid)
+            if self.assign[cid] >= 0:
+                continue                      # replayed boundary
+            if self.pins[cid] and self.override[cid] < 0:
+                self.assign[cid] = cid % self.n_shards
+                self.counters["placements"] += 1
+                placed.append(cid)
+                continue
+            a, b = (None, None)
+            if not self.pins[cid]:
+                a, b = self._draw2()
+            if self.override[cid] >= 0:
+                self.assign[cid] = int(self.override[cid])
+                self.counters["placements"] += 1
+                self.counters["overrides"] += 1
+                placed.append(cid)
+                continue
+            live = [s for s in (a, b)
+                    if up is None or bool(up[s])]
+            if not live:
+                # both sampled shards down: defer one boundary (the
+                # registration stays pending; re-offered next time)
+                deferred.append(cid)
+                self.counters["defers"] += 1
+                continue
+            if len(live) < 2:
+                # one choice was down: deterministic re-route to the
+                # healthier (here: only) live sample
+                self.counters["reroutes"] += 1
+            dst = min(live, key=lambda s: (int(backlog[s]), s))
+            self.assign[cid] = dst
+            self.counters["placements"] += 1
+            placed.append(cid)
+        self.deferred = deferred
+        return placed
+
+    def take_deferred(self) -> List[int]:
+        """Registrations deferred at the previous boundary (both p2c
+        choices down), in original order; cleared on read -- the
+        caller re-offers them through :meth:`place_batch`."""
+        out = list(self.deferred)
+        self.deferred = []
+        return out
+
+    # -- migration planning --------------------------------------------
+    def plan_moves(self, b: int, *, src: int,
+                   candidates: Sequence[int], backlog,
+                   up: Optional[np.ndarray] = None,
+                   max_moves: int = 4) -> List[Tuple[int, int]]:
+        """Plan up to ``max_moves`` migrations off shard ``src`` at
+        boundary ``b``: each candidate (the caller orders them by its
+        pick policy) samples two destination shards from the
+        placement RNG and takes the lower-backlog LIVE one; samples
+        that land back on the source (or on a down shard) drop out,
+        and a candidate with no usable choice is skipped -- no move,
+        deterministic either way.  Records the move log and updates
+        the assignment; returns ``[(cid, dst)]`` in plan order."""
+        backlog = np.asarray(backlog, dtype=np.int64)
+        out: List[Tuple[int, int]] = []
+        for cid in candidates:
+            if len(out) >= int(max_moves):
+                break
+            cid = int(cid)
+            a, c = self._draw2()
+            live = [s for s in (a, c)
+                    if s != int(src) and (up is None or bool(up[s]))]
+            if not live:
+                continue
+            dst = min(live, key=lambda s: (int(backlog[s]), s))
+            self.assign[cid] = dst
+            self.moves.append((int(b), cid, int(src), dst))
+            self.counters["migrations"] += 1
+            out.append((cid, dst))
+        return out
+
+    def move_log(self) -> List[List[int]]:
+        """JSON-able ``[[boundary, cid, src, dst]]`` in move order --
+        the run-B twin's ``overrides`` source and the bench record's
+        rebalance block."""
+        return [[int(x) for x in row] for row in self.moves]
+
+    def snapshot(self) -> dict:
+        return {"mode": self.mode, "n_shards": self.n_shards,
+                "deferred": len(self.deferred),
+                **{k: int(v) for k, v in self.counters.items()}}
+
+    # -- observability -------------------------------------------------
+    def publish(self, registry, labels=None) -> None:
+        """Mount the ``dmclock_placement_*`` / ``dmclock_migration_*``
+        families (docs/OBSERVABILITY.md metric-family index)."""
+        rows = (
+            ("dmclock_placement_total", "placements",
+             "registrations routed by the placement map (pins + "
+             "power-of-two-choices)"),
+            ("dmclock_placement_draws_total", "p2c_draws",
+             "placement RNG samples consumed (2 per unpinned "
+             "registration, 2 per migration candidate)"),
+            ("dmclock_placement_reroutes_total", "reroutes",
+             "registrations re-routed off a DOWN sampled shard to "
+             "the live choice"),
+            ("dmclock_placement_defers_total", "defers",
+             "registrations deferred one boundary because both "
+             "sampled shards were down"),
+            ("dmclock_placement_overrides_total", "overrides",
+             "registrations placed by an explicit override (the "
+             "digest gate's placed-from-start twin)"),
+            ("dmclock_migration_total", "migrations",
+             "live clients moved between shards (EVICT on source + "
+             "REGISTER on destination with carried counter views)"),
+        )
+        for name, key, help_text in rows:
+            registry.gauge(name, help_text, labels=labels) \
+                .set_function(lambda k=key: float(self.counters[k]))
+        registry.gauge(
+            "dmclock_migration_last_boundary",
+            "epoch boundary of the most recent migration (-1 = "
+            "never)", labels=labels) \
+            .set_function(lambda: float(self.moves[-1][0]
+                                        if self.moves else -1))
+
+    # -- checkpoint round-trip -----------------------------------------
+    def encode(self) -> dict:
+        from ..robust.supervisor import _rng_state_array
+
+        return {"pm_assign": self.assign.copy(),
+                "pm_rng": _rng_state_array(self.rng),
+                "pm_counters": np.asarray(
+                    [self.counters[k] for k in PM_COUNTER_KEYS],
+                    dtype=np.int64),
+                "pm_moves": np.asarray(
+                    self.moves, dtype=np.int64).reshape(
+                        len(self.moves), 4),
+                "pm_deferred": np.asarray(self.deferred,
+                                          dtype=np.int64)}
+
+    def load(self, payload: dict) -> None:
+        from ..robust.supervisor import _rng_from_array
+
+        assign = np.asarray(payload["pm_assign"], dtype=np.int64)
+        if assign.shape[0] == 0:
+            return                       # pre-placement payload
+        self.assign = assign.copy()
+        self.rng = _rng_from_array(payload["pm_rng"])
+        ctr = np.asarray(payload["pm_counters"], dtype=np.int64)
+        self.counters = {k: int(v)
+                         for k, v in zip(PM_COUNTER_KEYS, ctr)}
+        self.moves = [tuple(int(x) for x in row)
+                      for row in np.asarray(payload["pm_moves"],
+                                            dtype=np.int64)]
+        self.deferred = [int(x)
+                         for x in np.asarray(payload["pm_deferred"],
+                                             dtype=np.int64)]
+
+    @staticmethod
+    def empty_leaves() -> dict:
+        """Zero-size ``pm_*`` leaves for jobs without a placement map
+        (the always-present payload-structure convention)."""
+        return {"pm_assign": np.zeros(0, dtype=np.int64),
+                "pm_rng": np.zeros(6, dtype=np.uint64),
+                "pm_counters": np.zeros(len(PM_COUNTER_KEYS),
+                                        dtype=np.int64),
+                "pm_moves": np.zeros((0, 4), dtype=np.int64),
+                "pm_deferred": np.zeros(0, dtype=np.int64)}

@@ -169,7 +169,8 @@ def apply_op_vector(state: EngineState, kind, slot, resv_inv,
 # ----------------------------------------------------------------------
 
 COUNTER_KEYS = ("registrations", "evictions", "compactions",
-                "qos_updates", "slot_recycles", "grows", "idle_marks")
+                "qos_updates", "slot_recycles", "grows", "idle_marks",
+                "migrations_in", "migrations_out")
 
 
 class LifecyclePlane:
@@ -220,6 +221,17 @@ class LifecyclePlane:
         # closed conformance windows attribute to exactly one
         # (client, contract_version) pair (docs/OBSERVABILITY.md)
         self._slo = None
+        # optional lifecycle.placement.PlacementMap, shared by every
+        # shard of a mesh job: when attached, IT is the routing
+        # contract (``_owns`` consults it instead of the static
+        # ``slots.owner_shard``) and registration ``order`` becomes
+        # the client id -- placement-path-independent, which is what
+        # makes a migrated client's REGISTER on the destination
+        # byte-identical to a placed-there-from-start one
+        self.placement = None
+
+    def attach_placement(self, pm) -> None:
+        self.placement = pm
 
     def attach_slo(self, slo) -> None:
         self._slo = slo
@@ -256,12 +268,11 @@ class LifecyclePlane:
                 f"client id {cid} outside the churn spec's id space "
                 f"[0, {self.total})")
         if not self._owns(cid):
-            from .slots import owner_shard
             raise ValueError(
                 f"client id {cid} is owned by shard "
-                f"{int(owner_shard(cid, self.shard[1]))}, not this "
-                f"plane's shard {self.shard[0]} (route by "
-                f"slots.owner_shard)")
+                f"{self._owner_of(cid)}, not this plane's shard "
+                f"{self.shard[0]} (route by the placement map when "
+                f"attached, else slots.owner_shard)")
         if kind in ("register", "update"):
             validate_client_info(
                 (op["r"], op["w"], op["l"]), name=cid)
@@ -348,13 +359,19 @@ class LifecyclePlane:
             return out
 
     # -- scripted + pending op resolution ------------------------------
-    def _owns(self, cid: int) -> bool:
-        # slots.owner_shard IS the routing contract (one place; the
-        # rack-scheduling migration item will change it there)
+    def _owner_of(self, cid: int) -> int:
+        # the routing contract, in one place: the shared PlacementMap
+        # when one is attached (p2c placement / live migration), else
+        # the historical static ``slots.owner_shard``
+        if self.placement is not None:
+            return int(self.placement.shard_of(cid))
         from .slots import owner_shard
 
+        return int(owner_shard(cid, self.shard[1]))
+
+    def _owns(self, cid: int) -> bool:
         return self.shard is None or \
-            int(owner_shard(cid, self.shard[1])) == self.shard[0]
+            self._owner_of(cid) == self.shard[0]
 
     def _due_scripted(self, b: int, every: int) -> List[dict]:
         if self.static:
@@ -536,7 +553,16 @@ class LifecyclePlane:
             slot = self.slots.allocate(cid)
         if self.slots.was_used(slot):
             self.counters["slot_recycles"] += 1
-        order = self.slots.take_order()
+        if self.placement is not None:
+            # placement-path-independent tie-break rank: a client
+            # must carry the SAME order whether it registered here
+            # at its cohort boundary or arrived by migration -- the
+            # client id is the one rank every path agrees on (the
+            # churn generators register cohorts in ascending-id =
+            # start order, so at S=1 this matches take_order exactly)
+            order = cid
+        else:
+            order = self.slots.take_order()
         self.qos[cid] = (op["r"], op["w"], op["l"])
         if cid < self.total:
             self.streak[cid] = 0
@@ -644,6 +670,58 @@ class LifecyclePlane:
         self.counters["evictions"] += 1
         if self._slo is not None:
             self._slo.evict(cid)
+
+    # -- live migration halves (docs/LIFECYCLE.md "Placement and
+    # migration"): the supervisor's ``_mesh_migrate`` drives these as
+    # one two-sided move -- EVICT on the source plane, REGISTER on the
+    # destination -- both expressed as the EXISTING digest-neutral op
+    # vector, with the carried per-slot riders (counter views,
+    # provenance watermark) installed by the caller.
+    def migrate_out(self, cid: int, ledger):
+        """Source half of a live move: fold the departing client's
+        final ledger row into the departed report (same contract as
+        idle eviction -- QoS history never silently zeroes), release
+        its slot, and hand back ``(slot, qos_triple)`` for the
+        destination's REGISTER.  Returns None when the client is not
+        (or no longer -- a replayed boundary) resident here; counted
+        as ``migrations_out``, NOT an eviction."""
+        import jax
+
+        with self.lock:
+            slot = self.slots.slot_of.get(cid)
+            if slot is None:
+                return None
+            qos = self.qos.get(cid, (0.0, 1.0, 0.0))
+            if ledger is not None:
+                row = np.asarray(jax.device_get(ledger[slot]),
+                                 dtype=np.int64).copy()
+            else:
+                row = np.zeros(5, dtype=np.int64)
+            self.departed.append((cid, row))
+            self.slots.release(cid)
+            self.qos.pop(cid, None)
+            if cid < self.total:
+                self.streak[cid] = 0
+            self.counters["migrations_out"] += 1
+            if self._slo is not None:
+                self._slo.evict(cid)
+            return slot, qos
+
+    def migrate_in(self, cid: int, qos) -> list:
+        """Destination half: a plain registration (``_register_row``
+        semantics -- growth staged on demand, order = client id under
+        an attached placement map, SLO contract epoch bumped) carrying
+        the source's QoS triple.  Returns the LC_REGISTER op rows for
+        the destination's batched ``apply_op_vector`` launch; also
+        counted as ``migrations_in`` (``registrations`` counts every
+        REGISTER, migrations included)."""
+        with self.lock:
+            r, w, l = (float(qos[0]), float(qos[1]), float(qos[2]))
+            rows = self._register_row({"op": "register", "cid": cid,
+                                       "r": r, "w": w, "l": l})
+            if rows:
+                self.counters["migrations_in"] += 1
+            return rows
 
     def _maybe_compact(self, state, ledger, slo_block, extras,
                        b: int, every: int, _spans):

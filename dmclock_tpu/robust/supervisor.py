@@ -196,14 +196,18 @@ class EpochJob:
     # the counter plane + per-shard telemetry ride the rotation
     # checkpoints, so crash equivalence extends to the mesh loop
     # unchanged.  ``churn`` composes via PER-SHARD lifecycle planes
-    # (client ids routed by ``cid % n_shards``; docs/LIFECYCLE.md
+    # (client ids routed by the placement map -- ``placement`` below;
+    # the default static map IS ``cid % n_shards``; docs/LIFECYCLE.md
     # "Per-shard routing") and ``flight_records`` via per-shard HBM
     # rings merged in shard order at drain; mesh churn does not yet
     # compose with ``with_slo`` (the merged window table would need
-    # an id-space merge across per-shard slot layouts) or with
-    # ``fault_plan`` (a down shard's boundary semantics are the
-    # rack-scheduling item's migration question) -- both rejected up
-    # front.
+    # an id-space merge across per-shard slot layouts -- rejected up
+    # front) and composes with ``fault_plan`` only under
+    # ``placement="p2c"``, where a registration routed to a DOWN
+    # shard deterministically re-routes to its live sampled choice
+    # (or defers one boundary when both are down); static placement
+    # has no re-route path, so churn + fault_plan + static stays a
+    # loud up-front ValueError.
     engine_loop: str = "round"
     # mesh serving plane knobs (engine_loop="mesh" only): shard count
     # (devices used; obs.capacity.plan_capacity sizes it from the
@@ -216,6 +220,20 @@ class EpochJob:
     # delay_counters fault)
     n_shards: int = 1
     counter_sync_every: int = 1
+    # shard placement plane (lifecycle/placement.py; docs/LIFECYCLE.md
+    # "Placement and migration"; engine_loop="mesh" + churn only):
+    # "static" keeps the historical ``cid % n_shards`` ownership
+    # BIT-IDENTICALLY (no PlacementMap is even built); "p2c" routes
+    # new registrations by power-of-two-choices over the per-shard
+    # pressure backlog from a checkpointed placement RNG (scenario
+    # pins keep shard_skew's scripted ownership), enables the
+    # controller's ``migrate`` actuation (live digest-neutral
+    # EVICT/REGISTER handoffs between shards), and lifts the
+    # churn-with-fault_plan rejection (DOWN-shard registrations
+    # re-route/defer deterministically).  A ``{"mode": "p2c",
+    # "overrides": {cid: shard}}`` dict pins specific clients to
+    # specific shards -- the digest gate's placed-from-start twin.
+    placement: object = "static"
     # degraded-mode mesh serving (docs/ROBUSTNESS.md "Degraded-mode
     # mesh"; engine_loop="mesh" only): a JSON-able fault-plan SPEC
     # (dict, or the bench's "seed=..,p_dropout=.." string form) --
@@ -331,6 +349,17 @@ class SupervisedResult(NamedTuple):
     controller_replays: int = 0
     controller_knobs: Optional[list] = None
     controller_trajectory: Optional[list] = None
+    # shard placement / migration plane outputs (mesh churn with
+    # placement != "static"; None/0 otherwise): the placement mode,
+    # the migration count, the move log [[boundary, cid, src, dst]]
+    # in move order (the digest gate's overrides source), and the
+    # PlacementMap counter snapshot -- all deterministic (the
+    # placement RNG rides the rotation checkpoints), all compared by
+    # the crash-equivalence gate
+    placement: Optional[str] = None
+    migrations: int = 0
+    migration_log: Optional[list] = None
+    placement_counters: Optional[dict] = None
 
 
 def assert_crash_equivalent(interrupted: SupervisedResult,
@@ -432,6 +461,23 @@ def assert_crash_equivalent(interrupted: SupervisedResult,
         (f"controller decision trajectory diverged: "
          f"{interrupted.controller_trajectory} vs "
          f"{reference.controller_trajectory}")
+    # the placement map's RNG/assignment/move-log ride the rotation
+    # checkpoints and migrations replay deterministically from the
+    # journaled trigger + checkpointed RNG, so the whole plane -- the
+    # move log included, in order -- must be bit-identical
+    assert interrupted.placement == reference.placement, \
+        "placement mode diverged across the crash"
+    assert interrupted.migrations == reference.migrations, \
+        (f"migration count diverged: {interrupted.migrations} vs "
+         f"{reference.migrations}")
+    assert interrupted.migration_log == reference.migration_log, \
+        (f"migration log diverged: {interrupted.migration_log} vs "
+         f"{reference.migration_log}")
+    assert interrupted.placement_counters == \
+        reference.placement_counters, \
+        (f"placement counters diverged: "
+         f"{interrupted.placement_counters} vs "
+         f"{reference.placement_counters}")
 
 
 
@@ -549,10 +595,11 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
              epoch: int, decisions: int, ladder_vec,
              hists=None, ledger=None, flight=None,
              plane=None, slo=None, prov=None, mesh=None,
-             ctl=None) -> dict:
+             ctl=None, pm=None) -> dict:
     import jax
 
     from ..control import Controller
+    from ..lifecycle.placement import PlacementMap
     from ..lifecycle.plane import LifecyclePlane
     from ..obs import flight as obsflight
     from ..obs import slo as obsslo
@@ -615,7 +662,13 @@ def _payload(job: EpochJob, state, rng, met, digest: bytes,
     # hysteresis/cooldown state (fixed shapes from the rule table, so
     # even the zero template matches exactly)
     ct = ctl.encode() if ctl is not None else Controller.empty_leaves()
-    return {**lc, **sl, **mz, **ct,
+    # placement-map leaves (mesh churn with placement != "static"):
+    # assignment, placement RNG, counters, move log, deferred list --
+    # always present (zero-size otherwise), the structure-from-config
+    # convention; move-log/deferred axis 0 is runtime state, so such
+    # jobs already restore with strict_shapes=False (churn)
+    pmz = pm.encode() if pm is not None else PlacementMap.empty_leaves()
+    return {**lc, **sl, **mz, **ct, **pmz,
             "digest": np.frombuffer(digest, dtype=np.uint8).copy(),
             "decisions": np.int64(decisions),
             "engine": state,
@@ -681,13 +734,39 @@ def _tele_init(job: EpochJob):
     return hists, ledger, flight, prov
 
 
-def _mesh_planes(job: EpochJob, *, tracer=None, payload=None):
+def _placement_map(job: EpochJob, *, payload=None):
+    """The shared :class:`~dmclock_tpu.lifecycle.placement
+    .PlacementMap` of a mesh churn job with ``placement != "static"``
+    -- None otherwise (the static path must stay byte-identical to
+    the pre-placement mesh, so no map is even built).  Pins and
+    overrides re-derive from the job config; the assignment array,
+    placement RNG, counters, move log, and deferred list restore from
+    the ``pm_*`` checkpoint leaves when a payload is given."""
+    from ..lifecycle import placement as placement_mod
+
+    mode, overrides = placement_mod.parse_placement(job.placement)
+    if mode == "static" or job.churn is None \
+            or job.engine_loop != "mesh":
+        return None
+    pm = placement_mod.PlacementMap(
+        job.n_shards, int(job.churn["total_ids"]), mode=mode,
+        seed=job.seed,
+        pins=placement_mod.placement_pins(job.churn, job.n_shards),
+        overrides=overrides)
+    if payload is not None:
+        pm.load(payload)
+    return pm
+
+
+def _mesh_planes(job: EpochJob, *, tracer=None, payload=None,
+                 pm=None):
     """The per-shard lifecycle planes of a mesh churn job (client ids
-    routed by ``cid % n_shards`` -- ``lifecycle.slots.owner_shard``),
-    fresh or restored from the namespaced ``lc_s{s}_*`` checkpoint
-    leaves.  Planes run WITHOUT a workdir: the admin WAL/API surface
-    is single-shard, mesh churn is scripted-events-only (routing live
-    control ops per shard is the ROADMAP rack-scheduling item)."""
+    routed by the shared placement map ``pm`` when one exists, else
+    by ``cid % n_shards`` -- ``lifecycle.slots.owner_shard``), fresh
+    or restored from the namespaced ``lc_s{s}_*`` checkpoint leaves.
+    Planes run WITHOUT a workdir: the admin WAL/API surface is
+    single-shard, mesh churn is scripted-events-only (routing live
+    control ops per shard is the remaining rack-scheduling item)."""
     from ..lifecycle.plane import LifecyclePlane
 
     planes = []
@@ -702,6 +781,8 @@ def _mesh_planes(job: EpochJob, *, tracer=None, payload=None):
         else:
             planes.append(LifecyclePlane(
                 job.churn, tracer=tracer, shard=(s, job.n_shards)))
+        if pm is not None:
+            planes[-1].attach_placement(pm)
     return planes
 
 
@@ -722,8 +803,10 @@ def _payload_like(job: EpochJob) -> dict:
     # contract count), so such jobs restore with the axis-0-only
     # relaxation (trailing dims still gate) -- see _job_loop
     plane = None
+    pm = _placement_map(job)
     if job.churn is not None:
-        plane = _mesh_planes(job) if job.engine_loop == "mesh" \
+        plane = _mesh_planes(job, pm=pm) \
+            if job.engine_loop == "mesh" \
             else LifecyclePlane(job.churn)
     tmpl = _payload(job, _job_state(job),
                     np.random.Generator(np.random.PCG64(job.seed)),
@@ -731,7 +814,7 @@ def _payload_like(job: EpochJob) -> dict:
                     b"\x00" * 32, 0, 0,
                     DegradationLadder().encode(),
                     hists=hists, ledger=ledger, flight=flight,
-                    prov=prov, mesh=mesh, plane=plane)
+                    prov=prov, mesh=mesh, plane=plane, pm=pm)
     if job.engine_loop == "mesh" and job.with_slo:
         # a mesh job's saved window block is the STACKED per-shard
         # [S, N, W_FIELDS] layout -- the template must carry the rank
@@ -917,6 +1000,15 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
 
     from ..obs import flight as obsflight
 
+    from ..lifecycle.placement import parse_placement
+    _pl_mode, _ = parse_placement(job.placement)   # validates the spec
+    if _pl_mode != "static" and (job.engine_loop != "mesh"
+                                 or job.churn is None):
+        raise ValueError(
+            "EpochJob(placement='p2c') is the mesh churn placement "
+            "plane (engine_loop='mesh' + churn=...): power-of-two-"
+            "choices needs per-shard pressure to choose between and "
+            "an open population to place")
     if job.engine_loop == "mesh":
         if job.churn is not None and job.with_slo:
             raise ValueError(
@@ -925,12 +1017,15 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                 "window_mesh_reduce table is slot-indexed, and "
                 "per-shard slot layouts diverge under churn -- the "
                 "merge needs an id-space scatter first")
-        if job.churn is not None and job.fault_plan is not None:
+        if job.churn is not None and job.fault_plan is not None \
+                and _pl_mode == "static":
             raise ValueError(
-                "EpochJob(engine_loop='mesh') does not compose "
-                "churn with fault_plan yet: a down shard's lifecycle "
-                "boundary (register into a dead server? migrate?) is "
-                "the ROADMAP rack-scheduling placement question")
+                "EpochJob(engine_loop='mesh') does not compose churn "
+                "with fault_plan under placement='static': a static "
+                "map has no answer for a registration routed to a "
+                "DOWN shard.  placement='p2c' does (re-route to the "
+                "live sampled choice, defer one boundary when both "
+                "are down) -- pass placement='p2c'")
         if job.n_shards < 1:
             raise ValueError(f"n_shards must be >= 1, "
                              f"got {job.n_shards}")
@@ -996,6 +1091,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
             counter_sync_every=job.counter_sync_every,
             capacity0=int(job.churn["capacity0"])
             if job.churn is not None else 0,
+            n_shards=job.n_shards,
             workdir=workdir)
 
     payload = None
@@ -1082,9 +1178,11 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
 
     plane = None
     mesh_planes = None
+    pm = None
     if job.churn is not None and job.engine_loop == "mesh":
+        pm = _placement_map(job, payload=payload)
         mesh_planes = _mesh_planes(job, tracer=tracer,
-                                   payload=payload)
+                                   payload=payload, pm=pm)
     elif job.churn is not None:
         from ..lifecycle.plane import LifecyclePlane
         if payload is not None:
@@ -1206,7 +1304,7 @@ def _job_loop(job: EpochJob, workdir: Optional[str],
                             decisions, ladder, tracer, hists, ledger,
                             flight, prov, resumed_from, slo_block,
                             slo_plane, slo_eval, mesh_ctrs,
-                            mesh_planes, ctl)
+                            mesh_planes, ctl, pm)
     assert job.engine_loop == "round", job.engine_loop
     ingest = _jit_ingest(job) \
         if job.arrival_lam > 0 and plane is None else None
@@ -1440,7 +1538,7 @@ def _build_result(job, state, digest, decisions, met, ladder,
                   slo_eval=None, prov=None, mesh=None,
                   mesh_fallbacks: int = 0,
                   mesh_chaos_fallbacks: int = 0,
-                  ctl=None) -> SupervisedResult:
+                  ctl=None, pm=None) -> SupervisedResult:
     import jax
 
     slo_kw = {}
@@ -1450,6 +1548,13 @@ def _build_result(job, state, digest, decisions, met, ladder,
             controller_replays=int(ctl.replays),
             controller_knobs=[int(k) for k in ctl.knobs],
             controller_trajectory=ctl.trajectory())
+    if pm is not None:
+        slo_kw.update(
+            placement=pm.mode,
+            migrations=int(pm.counters["migrations"]),
+            migration_log=pm.move_log(),
+            placement_counters={k: int(v)
+                                for k, v in pm.counters.items()})
     if mesh is not None and job.n_shards == 1:
         # S=1 canonicalization: a 1-shard mesh IS a single engine, so
         # the result (state digest, telemetry blocks, window block,
@@ -1821,22 +1926,57 @@ def _draw_counts_mesh(rng: np.random.Generator, job: EpochJob,
 
 
 def _mesh_boundary(job: EpochJob, planes, state, ledger,
-                   cd, cr, vd, vr, b: int, prov=None):
+                   cd, cr, vd, vr, b: int, prov=None, pm=None,
+                   up=None):
     """One mesh churn job's lifecycle boundary: every shard's plane
     applies its own due ops to its own slice (registrations routed by
-    ``cid % n_shards``, per-shard SlotMaps), the counter plane's
-    cd/cr (fill 0), held views (fill 1), and the provenance
-    last_served watermark (fill 0 = never served) ride each shard's
-    grow/evict/compact transforms as boundary extras, and the stacked
-    layout is forced back RECTANGULAR: one shard's grow-on-demand
-    doubling grows every sibling to the max capacity before the
-    restack."""
+    the placement map when one exists, else ``cid % n_shards``;
+    per-shard SlotMaps), the counter plane's cd/cr (fill 0), held
+    views (fill 1), and the provenance last_served watermark (fill 0
+    = never served) ride each shard's grow/evict/compact transforms
+    as boundary extras, and the stacked layout is forced back
+    RECTANGULAR: one shard's grow-on-demand doubling grows every
+    sibling to the max capacity before the restack.
+
+    ``pm`` (placement != "static") runs the p2c ROUTING PASS first:
+    every registration due at this boundary -- last boundary's
+    deferrals first, then this cohort in ascending-cid order -- gets
+    its shard assigned against the current per-shard backlog and the
+    boundary's liveness row ``up`` BEFORE any plane filters its due
+    ops.  A deferral finally placed re-enters as a pending op on its
+    destination plane (its scripted event already fired), so nothing
+    is lost across a both-choices-down boundary."""
     import jax
     import jax.numpy as jnp
 
     from ..parallel import mesh as mesh_mod
 
     S = job.n_shards
+    if pm is not None:
+        from ..lifecycle import churn as churn_mod
+
+        deferred = pm.take_deferred()
+        if job.churn.get("static") and b == 0:
+            due = list(range(int(job.churn["total_ids"])))
+        else:
+            due = [int(e["cid"])
+                   for e in churn_mod.events(job.churn, b,
+                                             job.ckpt_every)
+                   if e["op"] == "register"]
+        cohort = [cid for cid in due if pm.shard_of(cid) < 0]
+        if deferred or cohort:
+            backlog = np.asarray(jax.device_get(state.depth),
+                                 dtype=np.int64).sum(axis=-1)
+            placed = pm.place_batch(deferred + cohort,
+                                    backlog=backlog, up=up)
+            for cid in placed:
+                if cid in deferred:
+                    # its scripted event fired at the earlier
+                    # boundary; re-enter through the pending journal
+                    r, w, l = churn_mod.init_qos(job.churn, cid)
+                    planes[pm.shard_of(cid)].pending.append(
+                        {"op": "register", "cid": cid, "r": r,
+                         "w": w, "l": l, "apply_at": b})
     sts, leds, ctrs = [], [], []
     for s in range(S):
         st_s = mesh_mod.unstack_shard(state, s)
@@ -1873,13 +2013,163 @@ def _mesh_boundary(job: EpochJob, planes, state, ledger,
     return state, ledger, cd, cr, vd, vr, prov
 
 
+def _mesh_migrate(job: EpochJob, pm, ctl, planes, state, ledger,
+                  cd, cr, vd, vr, b: int, prov=None, up=None):
+    """The controller's ``migrate`` actuation (docs/LIFECYCLE.md
+    "Placement and migration"): move up to ``migrate_max`` drained
+    clients off the hottest live shard as the EXISTING digest-neutral
+    lifecycle ops -- EVICT on the source (final ledger row folded
+    into the departed report first), REGISTER on the destination with
+    the carried counter views (cd/cr completions, vd/vr held views --
+    the paper's delta/rho piggyback as handoff) and the provenance
+    last_served watermark installed at the destination slot.
+
+    Determinism/crash story: the trigger is journaled (a resumed run
+    REPLAYS it), the destination draws come from the checkpointed
+    placement RNG, and the candidate order is a pure function of the
+    replayed boundary state -- so a SIGKILL at ANY stage of
+    evict -> handoff -> register (the ``placement._migrate_hook``
+    seam) replays the identical move list from the previous
+    checkpoint.  Runs AFTER the controller boundary and BEFORE the
+    boundary's checkpoint save, like every other actuation."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..lifecycle import placement as placement_mod
+    from ..lifecycle.plane import (LC_EVICT, LC_NOP, _pad_len,
+                                   apply_op_vector)
+    from ..parallel import mesh as mesh_mod
+
+    S = job.n_shards
+    depth = np.asarray(jax.device_get(state.depth), dtype=np.int64)
+    backlog = depth.sum(axis=-1)
+    # source = hottest LIVE shard (a down shard has no pressure to
+    # shed -- its in-chunk commits are masked -- and its host-side
+    # rows stay put until it returns)
+    eligible = np.asarray(
+        [int(backlog[s]) if (up is None or bool(up[s])) else -1
+         for s in range(S)], dtype=np.int64)
+    src = int(np.argmax(eligible))
+    if eligible[src] <= 0:
+        return state, ledger, cd, cr, vd, vr, prov
+    plane_src = planes[src]
+    cd_src = np.asarray(jax.device_get(cd[src]), dtype=np.int64)
+    pick = ctl.migrate_pick()
+    keyed = []
+    for cid in sorted(plane_src.slots.slot_of):
+        slot = plane_src.slots.slot_of[cid]
+        # only DRAINED clients move: there are no queued ops to
+        # teleport, so the whole handoff is counter state + contract
+        if depth[src, slot] != 0:
+            continue
+        served = int(cd_src[slot])
+        if pick == "cold" and served == 0:
+            # quiet-since-start movers -- the digest gate's provably
+            # placement-equivalent class (ascending cid)
+            keyed.append((0, cid))
+        elif pick != "cold" and served > 0:
+            # largest served demand first (cid breaks ties): the
+            # clients whose future arrivals the move actually sheds
+            keyed.append((-served, cid))
+    moves = pm.plan_moves(b, src=src,
+                          candidates=[cid for _k, cid in sorted(keyed)],
+                          backlog=backlog, up=up,
+                          max_moves=ctl.migrate_batch())
+    if not moves:
+        return state, ledger, cd, cr, vd, vr, prov
+
+    sts = [mesh_mod.unstack_shard(state, s) for s in range(S)]
+    leds = [None if ledger is None else ledger[s] for s in range(S)]
+    ctrs = [[(jnp.asarray(cd[s]), 0), (jnp.asarray(cr[s]), 0),
+             (jnp.asarray(vd[s]), 1), (jnp.asarray(vr[s]), 1)]
+            + ([(prov.last_served[s], 0)] if prov is not None else [])
+            for s in range(S)]
+
+    # source half: read the carried riders BEFORE the rows reset,
+    # fold the final ledger rows, release the slots, EVICT on device
+    carried = {}
+    evict_rows = []
+    handoff = []
+    for cid, dst in moves:
+        out = plane_src.migrate_out(cid, leds[src])
+        if out is None:
+            continue
+        slot, qos = out
+        carried[cid] = [arr[slot] for arr, _fill in ctrs[src]]
+        evict_rows.append((LC_EVICT, slot, 0, 0, 0, 0))
+        handoff.append((cid, dst, qos))
+    if evict_rows:
+        pad = _pad_len(len(evict_rows))
+        rows = evict_rows + [(LC_NOP, 0, 0, 0, 0, 0)] \
+            * (pad - len(evict_rows))
+        arr = np.asarray(rows, dtype=np.int64)
+        sts[src] = apply_op_vector(sts[src], arr[:, 0], arr[:, 1],
+                                   arr[:, 2], arr[:, 3], arr[:, 4],
+                                   arr[:, 5])
+        idx = jnp.asarray([r[1] for r in evict_rows])
+        if leds[src] is not None:
+            leds[src] = leds[src].at[idx].set(0)
+        ctrs[src] = [(a.at[idx].set(f), f) for a, f in ctrs[src]]
+    if placement_mod._migrate_hook is not None:
+        placement_mod._migrate_hook("evicted")
+
+    # destination half: REGISTER with the carried QoS contract
+    reg_rows: dict = {s: [] for s in range(S)}
+    for cid, dst, qos in handoff:
+        reg_rows[dst] += planes[dst].migrate_in(cid, qos)
+    if placement_mod._migrate_hook is not None:
+        placement_mod._migrate_hook("handoff")
+
+    # one rectangle: a destination's grow-on-demand forces every
+    # sibling to the same capacity before the restack
+    cap = max(max(int(p.slots.capacity) for p in planes),
+              max(int(st.capacity) for st in sts))
+    for s in range(S):
+        out = planes[s].ensure_capacity(cap, sts[s], ledger=leds[s],
+                                        extras=ctrs[s])
+        sts[s], leds[s] = out[0], out[1]
+        ctrs[s] = out[-1]
+    for s in range(S):
+        if not reg_rows[s]:
+            continue
+        rows = list(reg_rows[s])
+        pad = _pad_len(len(rows))
+        rows += [(LC_NOP, 0, 0, 0, 0, 0)] * (pad - len(rows))
+        arr = np.asarray(rows, dtype=np.int64)
+        sts[s] = apply_op_vector(sts[s], arr[:, 0], arr[:, 1],
+                                 arr[:, 2], arr[:, 3], arr[:, 4],
+                                 arr[:, 5])
+    # install the carried riders at the destination slots: the
+    # delta/rho completions and held views arrive WITH the client
+    # (the piggyback-as-handoff), the last_served watermark keeps its
+    # starvation clock honest across the move
+    for cid, dst, _qos in handoff:
+        slot_d = planes[dst].slots.slot_of[cid]
+        ctrs[dst] = [(a.at[slot_d].set(v), f)
+                     for (a, f), v in zip(ctrs[dst], carried[cid])]
+    if placement_mod._migrate_hook is not None:
+        placement_mod._migrate_hook("registered")
+
+    state = jax.tree.map(lambda *xs: jnp.stack(xs), *sts)
+    ledger = None if ledger is None else jnp.stack(leds)
+    cd, cr, vd, vr = (jnp.stack([ctrs[s][j][0] for s in range(S)])
+                      for j in range(4))
+    if prov is not None:
+        from ..obs import provenance as obsprov
+
+        prov = obsprov.prov_from_arrays(
+            prov.margin_hist, prov.scal,
+            jnp.stack([ctrs[s][4][0] for s in range(S)]))
+    return state, ledger, cd, cr, vd, vr, prov
+
+
 def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                  scr: _ScrapeCtl, base_cfg: dict, state, rng, met,
                  digest: bytes, start_epoch: int, decisions: int,
                  ladder, tracer, hists, ledger, flight, prov,
                  resumed_from, slo_block=None, slo_plane=None,
                  slo_eval=None, mesh_ctrs=None,
-                 planes=None, ctl=None) -> SupervisedResult:
+                 planes=None, ctl=None, pm=None) -> SupervisedResult:
     """The mesh serving loop (docs/ENGINE.md "Mesh serving"):
     ``n_shards`` full per-device engines advance a whole
     checkpoint-boundary chunk of epochs inside ONE ``shard_map``
@@ -1957,12 +2247,15 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
             # BEFORE the chunk, on the chunk grid (the stream loop's
             # discipline); the counter plane follows each shard's
             # slot transforms as boundary extras
+            up_row = None if plan is None \
+                else plan.up[min(e0, plan.up.shape[0] - 1)]
             if planes is not None:
                 with _spans.span(tracer, "lifecycle.boundary",
                                  "host_prep", epoch=e0):
                     state, ledger, cd, cr, vd, vr, prov = \
                         _mesh_boundary(job, planes, state, ledger,
-                                       cd, cr, vd, vr, e0, prov)
+                                       cd, cr, vd, vr, e0, prov,
+                                       pm=pm, up=up_row)
             counts = None
             if do_ingest:
                 with _spans.span(tracer, "mesh.pregen", "host_prep"):
@@ -2084,16 +2377,30 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                 # cluster-level controller boundary: signals aggregate
                 # over every shard (backlog = cluster depth total,
                 # press_backlog = hottest shard's total).  A fired
-                # ``compact`` journals + counts as MIGRATION-ELIGIBLE
-                # only -- actually moving a partition off a pressured
-                # shard is the ROADMAP rack-scheduling item, so mesh
-                # actuation stops at the marker (staleness / ladder /
-                # clamp knobs actuate exactly as on the other loops).
+                # ``compact`` journals + counts as migration-eligible
+                # only; a fired ``migrate`` (placement != "static")
+                # ACTUATES -- _mesh_migrate moves drained clients off
+                # the hottest shard as digest-neutral EVICT/REGISTER
+                # handoffs, BEFORE this boundary's checkpoint save so
+                # a replayed trigger re-moves the replayed state
+                # deterministically (staleness / ladder / clamp knobs
+                # actuate exactly as on the other loops).
                 sig = ctl.collect(b, state=state, met=met,
                                   slo_eval=slo_eval, prov=prov,
                                   planes=planes)
-                ctl.step(b, sig, fault=None if injector is None
-                         else injector.controller_point)
+                fired = ctl.step(b, sig,
+                                 fault=None if injector is None
+                                 else injector.controller_point)
+                if "migrate" in fired and pm is not None:
+                    with _spans.span(tracer, "lifecycle.migrate",
+                                     "host_prep", epoch=b):
+                        up_b = None if plan is None \
+                            else plan.up[min(b, plan.up.shape[0] - 1)]
+                        state, ledger, cd, cr, vd, vr, prov = \
+                            _mesh_migrate(job, pm, ctl, planes,
+                                          state, ledger, cd, cr,
+                                          vd, vr, b, prov=prov,
+                                          up=up_b)
             if ckpt_dir is not None:
                 with _spans.span(tracer, "supervisor.checkpoint_save",
                                  "checkpoint", epoch=b):
@@ -2105,7 +2412,8 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                                        mesh=(cd, cr, vd, vr),
                                        slo=None if slo_plane is None
                                        else (slo_block, slo_plane,
-                                             slo_eval), ctl=ctl)
+                                             slo_eval), ctl=ctl,
+                                       pm=pm)
 
                     def save(payload=payload):
                         return ckpt_mod.save_pytree_rotating(
@@ -2146,7 +2454,7 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                          mesh=(cd, cr, vd, vr),
                          mesh_fallbacks=mesh_fallbacks,
                          mesh_chaos_fallbacks=mesh_chaos_fallbacks,
-                         ctl=ctl)
+                         ctl=ctl, pm=pm)
 
 
 def _healthz_ok(scrape, timeout_s: float = 2.0) -> bool:

@@ -251,7 +251,7 @@ def main() -> int:
              and not is_degraded(r)]
     def series(wl, key, impl, cal, loop, scen=None, pop=None,
                provon=True, shards=None, sync=None, wk="xla",
-               ctl="off"):
+               ctl="off", rebal="off", placement="static"):
         """Prior values of one per-workload scalar column, filtered to
         the same fast-path identity (select_impl + calendar_impl +
         engine_loop + provenance_on) the throughput series uses.
@@ -290,6 +290,15 @@ def main() -> int:
                                            "xla") == wk
                 and r["workloads"][wl].get("controller",
                                            "off") == ctl
+                # the rebalance plane splits mesh series exactly like
+                # the controller tag: a migrating session's rates
+                # include the host-side handoffs, and a p2c-placed
+                # population is a different machine than cid % S --
+                # rows predating the knob == off/static
+                and r["workloads"][wl].get("rebalance",
+                                           "off") == rebal
+                and r["workloads"][wl].get("placement",
+                                           "static") == placement
                 and bool(r["workloads"][wl].get("provenance_on",
                                                 True)) == provon]
 
@@ -355,6 +364,12 @@ def main() -> int:
         # which twin(s) ran; the tag joins the series identity so an
         # A/B session never median-compares against a bare one
         ctl = row.get("controller", "off")
+        # rebalance rows (bench.py --mode mesh --rebalance on) carry
+        # the placement mode; both join the series identity and the
+        # mesh tag (P=) -- a migrating A/B row never median-compares
+        # against a static mesh session
+        rebal = row.get("rebalance", "off")
+        placement = row.get("placement", "static")
         tag = f"{wl}[{impl}]" if impl != "sort" else wl
         if cal != "minstop":
             tag += f"[{cal}]"
@@ -365,7 +380,9 @@ def main() -> int:
         if scen is not None:
             tag += f"[N={pop}]"
         if shards is not None:
-            tag += f"[S={shards},K={sync},N={pop}]"
+            tag += f"[S={shards},K={sync},N={pop},P={placement}]"
+        if rebal != "off":
+            tag += f"[rebal={rebal}]"
         if ctl != "off":
             tag += f"[ctl={ctl}]"
         if not provon:
@@ -384,7 +401,7 @@ def main() -> int:
                   "against clean-run medians")
             continue
         hist = series(wl, "dps", impl, cal, loop, scen, pop, provon,
-                      shards, sync, wk, ctl)
+                      shards, sync, wk, ctl, rebal, placement)
         if len(hist) < args.min_records:
             print(f"bench_guard: {tag}: {dps/1e6:.1f}M "
                   f"({len(hist)} prior record(s) -- not judged)")
@@ -430,7 +447,7 @@ def main() -> int:
         if psm is not None:
             p_hist = series(wl, "dps_per_shard_mean", impl, cal,
                             loop, scen, pop, provon, shards, sync,
-                            wk, ctl)
+                            wk, ctl, rebal, placement)
             if len(p_hist) < args.min_records:
                 print(f"bench_guard: {tag}: per-shard "
                       f"{psm/1e6:.2f}M ({len(p_hist)} prior "
@@ -449,6 +466,37 @@ def main() -> int:
                     print(f"bench_guard: {tag}: per-shard "
                           f"{psm/1e6:.2f}M vs median "
                           f"{p_med/1e6:.2f}M -- OK")
+        # final shard skew (rebalance rows: max/mean of the per-shard
+        # completion totals; 1.0 = level) as its own warn-only series:
+        # the migration plane's whole claim is that skew comes DOWN,
+        # so a session ending more skewed than tolerance x the median
+        # is worth a warning even when the aggregate rate held.
+        # Warn-only: skew depends on how many migrations the
+        # controller authorized before the run ended, and a hard gate
+        # on a ratio of counters would flap.  Median floored at 1.0
+        # (perfectly level) so a history of near-level finals never
+        # warns on noise.
+        sk = row.get("shard_skew_final")
+        if sk is not None:
+            k_hist = series(wl, "shard_skew_final", impl, cal, loop,
+                            scen, pop, provon, shards, sync, wk,
+                            ctl, rebal, placement)
+            if len(k_hist) < args.min_records:
+                print(f"bench_guard: {tag}: final shard skew "
+                      f"{sk:.2f} ({len(k_hist)} prior record(s) -- "
+                      "not judged)")
+            else:
+                k_med = max(median(k_hist), 1.0)
+                if sk > k_med * args.tolerance:
+                    print(f"bench_guard: {tag}: WARNING final shard "
+                          f"skew {sk:.2f} vs median {k_med:.2f} over "
+                          f"{len(k_hist)} sessions "
+                          f"(> {args.tolerance:g}x) -- the rebalance "
+                          "plane left the mesh more skewed than its "
+                          "history; investigate", file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: final shard skew "
+                          f"{sk:.2f} vs median {k_med:.2f} -- OK")
         # p99 reservation tardiness rides the same per-workload
         # history as its own series: a QoS regression (tail tardiness
         # UP past tolerance x the median) is worth a warning even

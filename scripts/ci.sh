@@ -1321,6 +1321,105 @@ print(f"controller replay smoke ok (killed at epoch {kill_epoch} "
       f"trajectory bit-identical)")
 EOF
 
+echo "== migration smoke (p2c neutrality + twin digest gate) =="
+# the shard rebalancing plane (lifecycle/placement.py;
+# docs/LIFECYCLE.md "Placement and migration"), on the 8-device
+# forced host mesh: (1) S=1 p2c loop neutrality PER ENGINE
+# (prefix/chain/calendar-wheel) -- placement="p2c" over one shard is
+# bit-identical to the static path (digest + state digest + metrics);
+# combined with the earlier mesh (S=1 == stream) and streaming
+# (stream == round) gates this carries the placed-there digest across
+# round/stream/mesh; (2) the S=4 TWIN GATE on prefix and chain: after
+# the controller's migrate rule moves quiet-since-start clients off
+# the hot shard, the canonical digest equals the run that had them
+# placed on the destination from epoch 0 (overrides from run A's
+# migration log, migrate rule disarmed) -- migration is
+# placement-equivalent, not just plausible; (3) calendar engines
+# drain state.depth at every deadline commit, so the backlog-
+# triggered migrate rule is structurally inert there -- gate that the
+# inert rule is a bit-exact no-op.
+timeout -k 30 1200 python - <<'EOF'
+import jax, os
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+        " --xla_force_host_platform_device_count=8")
+jax.config.update("jax_enable_x64", True)
+import dataclasses
+import numpy as np
+from dmclock_tpu.lifecycle import make_spec
+from dmclock_tpu.robust import supervisor as SV
+
+GATE_CTL = dict(sync_max=1, backlog_hi=10**9, occ_lo=0.0,
+                hysteresis=1, cooldown=8,
+                migrate_skew_hi=1.5, migrate_pick="cold",
+                migrate_max=4)
+
+def base_job(**over):
+    kw = dict(engine="prefix", k=16, select_impl="sort",
+              n=96, depth=6, ring=10, epochs=8, m=2, seed=5,
+              arrival_lam=1.0, waves=2, ckpt_every=2,
+              engine_loop="mesh", n_shards=1)
+    kw.update(over)
+    return SV.EpochJob(**kw)
+
+def skew_job(**over):
+    spec = make_spec("shard_skew", total_ids=64, seed=3,
+                     cold_frac=0.5, cold_until=10**9)
+    return base_job(n_shards=4, churn=spec, placement="p2c",
+                    controller=GATE_CTL, **over)
+
+ENGINES = (dict(engine="prefix"),
+           dict(engine="chain"),
+           dict(engine="calendar", k=4, calendar_impl="wheel",
+                ladder_levels=2))
+
+# (1) S=1 p2c loop neutrality per engine
+flash = make_spec("flash_crowd", total_ids=32)
+for kw in ENGINES:
+    a = SV.run_job(base_job(churn=flash, **kw))
+    b = SV.run_job(base_job(churn=flash, placement="p2c", **kw))
+    name = kw.get("calendar_impl", kw["engine"])
+    assert a.digest == b.digest, f"{name}: S=1 p2c digest diverged"
+    assert a.state_digest == b.state_digest, f"{name}: state digest"
+    assert np.array_equal(np.asarray(a.metrics),
+                          np.asarray(b.metrics)), f"{name}: metrics"
+    print(f"migration smoke: S=1 p2c == static on {name} "
+          f"(digest {a.digest[:16]})")
+
+# (2) the S=4 twin gate where the backlog trigger fires
+for kw in (dict(engine="prefix"), dict(engine="chain")):
+    a = SV.run_job(skew_job(**kw))
+    assert a.migrations > 0, f"{kw['engine']}: migrate never fired"
+    assert all(src == 0 for _b, _c, src, _d in a.migration_log), \
+        f"{kw['engine']}: a move left a non-hot shard"
+    ov = {str(cid): dst for _b, cid, _s, dst in a.migration_log}
+    off = dict(GATE_CTL, migrate_skew_hi=0.0)
+    b = SV.run_job(dataclasses.replace(
+        skew_job(**kw), placement={"mode": "p2c", "overrides": ov},
+        controller=off))
+    assert b.migrations == 0
+    assert a.digest == b.digest, \
+        f"{kw['engine']}: post-migration digest != placed-there-" \
+        f"from-start"
+    print(f"migration smoke: S=4 twin gate on {kw['engine']} "
+          f"({a.migrations} move(s), digest {a.digest[:16]})")
+
+# (3) calendar: the inert migrate rule is a bit-exact no-op
+cal = dict(engine="calendar", k=4, calendar_impl="wheel",
+           ladder_levels=2)
+a = SV.run_job(skew_job(**cal))
+assert a.migrations == 0, \
+    "calendar reported backlog -- inert-rule premise broke"
+b = SV.run_job(dataclasses.replace(
+    skew_job(**cal), controller=dict(GATE_CTL, migrate_skew_hi=0.0)))
+assert a.digest == b.digest, "calendar: armed rule perturbed digest"
+print("migration smoke ok (inert calendar rule is a no-op; twin "
+      "gates green on prefix+chain)")
+EOF
+
 echo "== bench smoke (one small epoch) =="
 timeout -k 30 900 python - <<'EOF'
 import functools, jax, jax.numpy as jnp

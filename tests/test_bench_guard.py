@@ -669,7 +669,7 @@ def test_mesh_series_judged_with_shard_tag(monkeypatch, capsys,
         _mesh_row(80e6), _mesh_row(90e6), _mesh_row(85e6)])
     rc, out = run_guard(monkeypatch, capsys, hist)
     assert rc == 0
-    assert "mesh[S=8,K=1,N=100000]" in out
+    assert "mesh[S=8,K=1,N=100000,P=static]" in out
     assert "/shard aggregate-of-8" in out
     assert "OK" in out
 
@@ -690,7 +690,7 @@ def test_mesh_shard_count_splits_the_series(monkeypatch, capsys,
         _mesh_row(11e6, shards=1)])
     rc, out = run_guard(monkeypatch, capsys, hist)
     assert rc == 0
-    assert "mesh[S=1,K=1,N=100000]" in out
+    assert "mesh[S=1,K=1,N=100000,P=static]" in out
     assert "not judged" in out
 
 
@@ -703,7 +703,7 @@ def test_mesh_sync_cadence_splits_the_series(monkeypatch, capsys,
         _mesh_row(20e6, sync=4)])
     rc, out = run_guard(monkeypatch, capsys, hist)
     assert rc == 0
-    assert "mesh[S=8,K=4,N=100000]" in out
+    assert "mesh[S=8,K=4,N=100000,P=static]" in out
     assert "not judged" in out
 
 
@@ -743,7 +743,7 @@ def test_mesh_client_population_splits_the_series(monkeypatch,
              clients_per_shard=125_000)])
     rc, out = run_guard(monkeypatch, capsys, hist)
     assert rc == 0
-    assert "mesh[S=8,K=1,N=1000000]" in out
+    assert "mesh[S=8,K=1,N=1000000,P=static]" in out
     assert "not judged" in out
 
 

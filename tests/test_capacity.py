@@ -466,6 +466,7 @@ class TestDocDrift:
         and return the family names it holds."""
         from dmclock_tpu.control import Controller, as_spec
         from dmclock_tpu.lifecycle import make_spec
+        from dmclock_tpu.lifecycle.placement import PlacementMap
         from dmclock_tpu.lifecycle.plane import LifecyclePlane
         from dmclock_tpu.obs import device as obsdev
         from dmclock_tpu.obs import histograms as obshist
@@ -507,6 +508,7 @@ class TestDocDrift:
                                         workload="t")
         LifecyclePlane(make_spec("flash_crowd", total_ids=8)) \
             .publish(reg)
+        PlacementMap(2, 8).publish(reg)
         Controller(as_spec(True), n=4, ring=4, registry=reg)
         return sorted({m.name for m in reg.metrics()})
 

@@ -90,27 +90,27 @@ class TestPolicy:
         """Protective moves have hysteresis 1: one resv-miss episode
         snaps the sync grid to sync_min immediately."""
         ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
-        ps, dec = pol.step(ps, [4, 0, 100, 0],
+        ps, dec = pol.step(ps, [4, 0, 100, 0, 0],
                            mk_sig(resv_miss_d=1), SPEC)
-        assert dec == [("staleness_down", [1, 0, 100, 0])]
+        assert dec == [("staleness_down", [1, 0, 100, 0, 0])]
 
     def test_up_rule_needs_clean_streak(self):
         """Relaxing moves need ``hysteresis`` consecutive clean
         boundaries -- the anti-flap half of the table."""
         ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
-        ps, dec = pol.step(ps, [1, 0, 100, 0], mk_sig(), SPEC)
+        ps, dec = pol.step(ps, [1, 0, 100, 0, 0], mk_sig(), SPEC)
         assert dec == []            # streak 1 of 2: no decision yet
-        ps, dec = pol.step(ps, [1, 0, 100, 0], mk_sig(epoch=4), SPEC)
-        assert dec == [("staleness_up", [2, 0, 100, 0])]
+        ps, dec = pol.step(ps, [1, 0, 100, 0, 0], mk_sig(epoch=4), SPEC)
+        assert dec == [("staleness_up", [2, 0, 100, 0, 0])]
 
     def test_dirty_boundary_resets_the_streak(self):
         ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
-        ps, _ = pol.step(ps, [1, 0, 100, 0], mk_sig(), SPEC)
+        ps, _ = pol.step(ps, [1, 0, 100, 0, 0], mk_sig(), SPEC)
         # a guard trip breaks the clean streak (and fires ladder_down)
-        ps, dec = pol.step(ps, [1, 0, 100, 0],
+        ps, dec = pol.step(ps, [1, 0, 100, 0, 0],
                            mk_sig(epoch=4, guard_trips_d=1), SPEC)
-        assert ("staleness_up", [2, 0, 100, 0]) not in dec
-        ps, dec = pol.step(ps, [1, 0, 100, 0], mk_sig(epoch=6), SPEC)
+        assert ("staleness_up", [2, 0, 100, 0, 0]) not in dec
+        ps, dec = pol.step(ps, [1, 0, 100, 0, 0], mk_sig(epoch=6), SPEC)
         assert dec == []            # streak restarted at 1
 
     def test_cooldown_inert_then_refires(self):
@@ -118,7 +118,7 @@ class TestPolicy:
         boundaries; the trigger persisting past the cooldown fires
         again."""
         ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
-        knobs = [1, 0, 100, 0]
+        knobs = [1, 0, 100, 0, 0]
         fired = []
         for e in (2, 4, 6, 8):
             ps, dec = pol.step(ps, knobs,
@@ -135,15 +135,15 @@ class TestPolicy:
         deterministic."""
         ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
         sig = mk_sig(resv_miss_d=1, guard_trips_d=1, limit_break_d=1)
-        ps, dec = pol.step(ps, [4, 0, 100, 0], sig, SPEC)
+        ps, dec = pol.step(ps, [4, 0, 100, 0, 0], sig, SPEC)
         assert [r for r, _ in dec] == \
             ["staleness_down", "ladder_down", "clamp_down"]
         assert [new for _, new in dec] == \
-            [[1, 0, 100, 0], [1, 1, 100, 0], [1, 1, 75, 0]]
+            [[1, 0, 100, 0, 0], [1, 1, 100, 0, 0], [1, 1, 75, 0, 0]]
 
     def test_clamp_floor_and_ladder_ceiling(self):
         ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
-        _, dec = pol.step(ps, [1, 3, 25, 0],
+        _, dec = pol.step(ps, [1, 3, 25, 0, 0],
                           mk_sig(limit_break_d=1, guard_trips_d=1),
                           SPEC)
         assert dec == []        # clamp at clamp_min, ladder at max
@@ -153,11 +153,11 @@ class TestPolicy:
         # compact rule alone
         ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
         sig = mk_sig(live=3, capacity=16)
-        ps, dec = pol.step(ps, [8, 0, 100, 0], sig, SPEC)
+        ps, dec = pol.step(ps, [8, 0, 100, 0, 0], sig, SPEC)
         assert dec == []            # hysteresis 2
-        _, dec = pol.step(ps, [8, 0, 100, 0],
+        _, dec = pol.step(ps, [8, 0, 100, 0, 0],
                           sig._replace(epoch=4), SPEC)
-        assert dec == [("compact", [8, 0, 100, 1])]
+        assert dec == [("compact", [8, 0, 100, 1, 0])]
 
     def test_overlay_chains_ladder_rungs(self):
         from dmclock_tpu.robust.guarded import LADDER_RUNGS
@@ -176,8 +176,8 @@ class TestJournal:
     def test_append_asserts_sequential_seq(self, tmp_path):
         j = journal_mod.DecisionJournal(tmp_path)
         j.append({"seq": 0, "epoch": 2, "rule": "clamp_down",
-                  "digest": "x", "old": [1, 0, 100, 0],
-                  "new": [1, 0, 75, 0]})
+                  "digest": "x", "old": [1, 0, 100, 0, 0],
+                  "new": [1, 0, 75, 0, 0]})
         with pytest.raises(AssertionError):
             j.append({"seq": 2, "epoch": 4, "rule": "clamp_down",
                       "digest": "x", "old": [], "new": []})
@@ -187,7 +187,7 @@ class TestJournal:
         for s in range(3):
             j.append({"seq": s, "epoch": 2 * (s + 1),
                       "rule": "clamp_down", "digest": "x",
-                      "old": [1, 0, 100, 0], "new": [1, 0, 75, 0]})
+                      "old": [1, 0, 100, 0, 0], "new": [1, 0, 75, 0, 0]})
         k = journal_mod.DecisionJournal(tmp_path)
         assert len(k) == 3
         assert k.entry_at(1)["epoch"] == 4
@@ -196,8 +196,8 @@ class TestJournal:
     def test_torn_tail_truncated_on_open(self, tmp_path):
         j = journal_mod.DecisionJournal(tmp_path)
         j.append({"seq": 0, "epoch": 2, "rule": "clamp_down",
-                  "digest": "x", "old": [1, 0, 100, 0],
-                  "new": [1, 0, 75, 0]})
+                  "digest": "x", "old": [1, 0, 100, 0, 0],
+                  "new": [1, 0, 75, 0, 0]})
         with open(j.path, "a") as fh:    # kill landed mid-write
             fh.write('{"seq": 1, "epo')
         k = journal_mod.DecisionJournal(tmp_path)

@@ -657,6 +657,64 @@ class TestMultichipRecordV2:
         assert sum(rec["mesh"]["fault_dropouts_per_shard"]) == 16
         assert rec["mesh"]["faults_injected_total"] == 138
 
+    def test_v1_v2_normalize_rebalance_none(self, tmp_path):
+        """Pre-v3 records read back with rebalance=None (never a
+        KeyError in history tooling)."""
+        import json as _json
+
+        mod = self._load_reader()
+        p = tmp_path / "r.json"
+        p.write_text('{"n_devices": 8, "rc": 0, "ok": true, '
+                     '"tail": ""}')
+        assert mod.load_multichip(str(p))["rebalance"] is None
+        p.write_text(_json.dumps({
+            "schema": 2, "n_devices": 8, "rc": 0, "ok": True,
+            "tail": "", "mesh": {"dps": 1e6}}))
+        assert mod.load_multichip(str(p))["rebalance"] is None
+
+    def test_reader_accepts_v3(self, tmp_path):
+        """v3 carries the rebalance block (bench_mesh_rebalance row):
+        placement mode, migrations + per-move log, skew before/after,
+        the recovery currencies."""
+        import json as _json
+
+        mod = self._load_reader()
+        p = tmp_path / "r.json"
+        p.write_text(_json.dumps({
+            "schema": 3, "n_devices": 4, "rc": 0, "ok": True,
+            "tail": "", "mesh": {"dps": 1e6, "n_shards": 4},
+            "rebalance": {
+                "placement": "p2c", "migrations": 4,
+                "migration_log": [[4, 48, 0, 2], [4, 56, 0, 3]],
+                "shard_skew_before": 3.26, "shard_skew_after": 2.83,
+                "recovered_dps": -700.0,
+                "recovered_decisions": 136}}))
+        rec = mod.load_multichip(str(p))
+        assert rec["schema"] == 3
+        assert rec["rebalance"]["placement"] == "p2c"
+        assert rec["rebalance"]["migrations"] == 4
+        assert rec["rebalance"]["migration_log"][0] == [4, 48, 0, 2]
+        assert rec["rebalance"]["shard_skew_before"] > \
+            rec["rebalance"]["shard_skew_after"]
+        # v2 mesh normalization still applies underneath
+        assert rec["mesh"]["counter_sync_every"] == 1
+
+    def test_v3_rebalance_defaults_normalized(self, tmp_path):
+        import json as _json
+
+        mod = self._load_reader()
+        p = tmp_path / "r.json"
+        p.write_text(_json.dumps({
+            "schema": 3, "n_devices": 4, "rc": 0, "ok": True,
+            "tail": "", "mesh": {"dps": 1e6},
+            "rebalance": {}}))
+        rec = mod.load_multichip(str(p))
+        r = rec["rebalance"]
+        assert r["placement"] == "p2c" and r["migrations"] == 0
+        assert r["migration_log"] == []
+        assert r["shard_skew_before"] == 0.0
+        assert r["recovered_decisions"] == 0
+
 
 # ----------------------------------------------------------------------
 # degraded-mode mesh serving (ISSUE-15; docs/ROBUSTNESS.md

@@ -1,0 +1,488 @@
+"""Shard rebalancing plane (lifecycle/placement.py; docs/LIFECYCLE.md
+"Placement and migration").
+
+The headline gates:
+
+- **power-of-two-choices placement**: new registrations sample two
+  shards from the checkpointed placement RNG and join the lower-
+  backlog one; pinned scenarios (shard_skew) keep ``cid % S`` with
+  ZERO draws, overrides win over pins, a DOWN sampled shard re-routes
+  to the live choice, both-down defers one boundary -- all
+  deterministic, all replayed bit-identically from ``encode/load``;
+- **S=1 loop neutrality**: a 1-shard mesh churn job under
+  ``placement="p2c"`` is BIT-IDENTICAL to the static path (p2c over
+  one shard can only pick shard 0, and order = cid equals the
+  take_order sequence at S=1);
+- **the migration twin gate**: after the controller's ``migrate``
+  rule moves quiet-since-start clients off the hot shard, the chain
+  digest equals the run that had them placed on the destination from
+  epoch 0 (same arrival RNG, overrides pinning the moved cids) --
+  the canonical-digest proof that migration is placement-equivalent,
+  not just plausible.  ``state_digest`` is deliberately NOT compared:
+  slot layouts legitimately differ between the twins;
+- **crash equivalence**: SIGKILL at ANY stage of the two-sided move
+  (evicted -> handoff -> registered; the ``placement._migrate_hook``
+  seam) replays the identical run from the previous checkpoint;
+- **chaos composition**: churn + fault_plan is accepted under
+  placement="p2c" (the DOWN-shard re-route path) and stays a loud
+  ValueError under static routing -- the PR-15 rejection, now scoped.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dmclock_tpu.lifecycle import churn as churn_mod
+from dmclock_tpu.lifecycle import placement as placement_mod
+from dmclock_tpu.lifecycle.placement import (PlacementMap,
+                                             parse_placement,
+                                             placement_pins)
+from dmclock_tpu.robust import host_faults as HF
+from dmclock_tpu.robust import supervisor as SV
+
+# controller spec whose ONLY live rule is migrate: sync pinned at 1
+# (staleness_up can't fire), backlog_hi parked sky-high (clamp_down
+# can't), occ_lo 0 (compact can't); cooldown 8 spaces fires out
+GATE_CTL = dict(sync_max=1, backlog_hi=10**9, occ_lo=0.0,
+                hysteresis=1, cooldown=8,
+                migrate_skew_hi=1.5, migrate_pick="cold",
+                migrate_max=4)
+
+
+def base_job(**over):
+    kw = dict(engine="prefix", k=16, select_impl="sort",
+              n=96, depth=6, ring=10, epochs=8, m=2, seed=5,
+              arrival_lam=1.0, waves=2, ckpt_every=2,
+              engine_loop="mesh", n_shards=1)
+    kw.update(over)
+    return SV.EpochJob(**kw)
+
+
+def skew_job(**over):
+    """The S=4 migration shape: shard_skew with a quiet tail (half
+    the hot shard's ranks drained at zero completions -- the twin
+    gate's provably placement-equivalent mover class)."""
+    spec = churn_mod.make_spec("shard_skew", total_ids=64, seed=3,
+                               cold_frac=0.5, cold_until=10**9)
+    return base_job(n_shards=4, churn=spec, placement="p2c",
+                    controller=GATE_CTL, **over)
+
+
+_REFS: dict = {}
+
+
+def migration_ref():
+    """One cached S=4 run with real migrations (run A of the twin)."""
+    if "A" not in _REFS:
+        res = SV.run_job(skew_job())
+        assert res.migrations > 0, \
+            "migrate rule never fired -- the gate would be vacuous"
+        _REFS["A"] = res
+    return _REFS["A"]
+
+
+# ----------------------------------------------------------------------
+# PlacementMap unit behavior (no devices)
+# ----------------------------------------------------------------------
+
+
+class TestPlacementMapUnit:
+
+    def test_parse_placement(self):
+        assert parse_placement(None) == ("static", {})
+        assert parse_placement("static") == ("static", {})
+        assert parse_placement("p2c") == ("p2c", {})
+        mode, ov = parse_placement(
+            {"mode": "p2c", "overrides": {"37": 2}})
+        assert mode == "p2c" and ov == {37: 2}
+        with pytest.raises(ValueError):
+            parse_placement("zipf")
+
+    def test_p2c_deterministic_and_seeded(self):
+        a = PlacementMap(4, 32, mode="p2c", seed=7)
+        b = PlacementMap(4, 32, mode="p2c", seed=7)
+        backlog = np.zeros(4, dtype=np.int64)
+        a.place_batch(list(range(32)), backlog=backlog)
+        b.place_batch(list(range(32)), backlog=backlog)
+        assert np.array_equal(a.assign, b.assign)
+        c = PlacementMap(4, 32, mode="p2c", seed=8)
+        c.place_batch(list(range(32)), backlog=backlog)
+        assert not np.array_equal(a.assign, c.assign)
+
+    def test_p2c_prefers_lower_backlog(self):
+        pm = PlacementMap(2, 64, mode="p2c", seed=1)
+        backlog = np.asarray([10**6, 0], dtype=np.int64)
+        pm.place_batch(list(range(64)), backlog=backlog)
+        # both samples equal -> that shard regardless; otherwise the
+        # empty shard wins every time
+        assert (pm.assign == 1).sum() > (pm.assign == 0).sum()
+
+    def test_pins_keep_static_routing_with_zero_draws(self):
+        spec = churn_mod.make_spec("shard_skew", total_ids=32)
+        pins = placement_pins(spec, 4)
+        assert pins.all()
+        pm = PlacementMap(4, 32, mode="p2c", seed=7, pins=pins)
+        pm.place_batch(list(range(32)),
+                       backlog=np.zeros(4, dtype=np.int64))
+        assert np.array_equal(pm.assign, np.arange(32) % 4)
+        assert pm.counters["p2c_draws"] == 0
+
+    def test_no_pins_for_unpinned_scenarios(self):
+        spec = churn_mod.make_spec("flash_crowd", total_ids=32)
+        assert not placement_pins(spec, 4).any()
+
+    def test_overrides_win_over_pins(self):
+        spec = churn_mod.make_spec("shard_skew", total_ids=32)
+        pm = PlacementMap(4, 32, mode="p2c", seed=7,
+                          pins=placement_pins(spec, 4),
+                          overrides={8: 3, 9: 2})
+        pm.place_batch(list(range(32)),
+                       backlog=np.zeros(4, dtype=np.int64))
+        assert pm.shard_of(8) == 3 and pm.shard_of(9) == 2
+        assert pm.shard_of(12) == 0          # still pinned
+        assert pm.counters["overrides"] == 2
+
+    def test_down_shard_reroutes_to_live_choice(self):
+        pm = PlacementMap(2, 128, mode="p2c", seed=3)
+        up = np.asarray([True, False])
+        placed = pm.place_batch(list(range(128)),
+                                backlog=np.zeros(2, dtype=np.int64),
+                                up=up)
+        # a (live, down) pair re-routes to the live sample; a
+        # (down, down) pair -- possible at S=2 -- defers instead
+        assert placed, "every pair deferred?"
+        assert all(pm.shard_of(c) == 0 for c in placed)
+        assert pm.counters["reroutes"] > 0
+        assert pm.counters["defers"] == 128 - len(placed)
+        assert len(pm.take_deferred()) == 128 - len(placed)
+
+    def test_both_down_defers_one_boundary(self):
+        pm = PlacementMap(2, 8, mode="p2c", seed=3)
+        up = np.asarray([False, False])
+        placed = pm.place_batch(list(range(8)),
+                                backlog=np.zeros(2, dtype=np.int64),
+                                up=up)
+        assert placed == []
+        assert pm.counters["defers"] == 8
+        deferred = pm.take_deferred()
+        assert deferred == list(range(8))
+        assert pm.take_deferred() == []       # cleared on read
+        # next boundary, shards back: the deferrals place normally
+        placed = pm.place_batch(deferred,
+                                backlog=np.zeros(2, dtype=np.int64))
+        assert placed == deferred
+        assert all(pm.shard_of(c) >= 0 for c in deferred)
+
+    def test_rng_parity_reroute_vs_clean(self):
+        """A DOWN shard changes the DESTINATION, never the draw
+        count: the RNG stream stays aligned with the clean run."""
+        a = PlacementMap(2, 64, mode="p2c", seed=9)
+        b = PlacementMap(2, 64, mode="p2c", seed=9)
+        a.place_batch(list(range(32)),
+                      backlog=np.zeros(2, dtype=np.int64))
+        b.place_batch(list(range(32)),
+                      backlog=np.zeros(2, dtype=np.int64),
+                      up=np.asarray([True, False]))
+        assert a.counters["p2c_draws"] == b.counters["p2c_draws"]
+        # post-divergence draws identical again
+        a2 = a.place_batch([40], backlog=np.zeros(2, dtype=np.int64))
+        b2 = b.place_batch([40], backlog=np.zeros(2, dtype=np.int64))
+        assert a.assign[40] == b.assign[40]
+
+    def test_plan_moves_excludes_src_and_down(self):
+        pm = PlacementMap(4, 32, mode="p2c", seed=7)
+        pm.place_batch(list(range(32)),
+                       backlog=np.zeros(4, dtype=np.int64))
+        backlog = np.asarray([100, 0, 0, 0], dtype=np.int64)
+        up = np.asarray([True, True, False, False])
+        cands = [c for c in range(32) if pm.shard_of(c) == 0]
+        moves = pm.plan_moves(1, src=0, candidates=cands,
+                              backlog=backlog, up=up, max_moves=2)
+        assert len(moves) <= 2
+        for cid, dst in moves:
+            assert dst == 1                  # only live non-src shard
+            assert pm.shard_of(cid) == 1     # assign updated
+        assert pm.counters["migrations"] == len(moves)
+        for row in pm.move_log():
+            assert row[0] == 1 and row[2] == 0
+
+    def test_encode_load_round_trip(self):
+        pm = PlacementMap(4, 32, mode="p2c", seed=7)
+        pm.place_batch(list(range(16)),
+                       backlog=np.zeros(4, dtype=np.int64))
+        cands = [c for c in range(16) if pm.shard_of(c) == 0]
+        pm.plan_moves(3, src=0, candidates=cands,
+                      backlog=np.asarray([9, 0, 0, 0]), max_moves=2)
+        enc = pm.encode()
+        pm2 = PlacementMap(4, 32, mode="p2c", seed=0)   # seed differs
+        pm2.load(enc)
+        assert np.array_equal(pm2.assign, pm.assign)
+        assert pm2.counters == pm.counters
+        assert pm2.move_log() == pm.move_log()
+        # the RESTORED rng continues the original stream
+        a = pm.place_batch([20], backlog=np.zeros(4, dtype=np.int64))
+        b = pm2.place_batch([20], backlog=np.zeros(4, dtype=np.int64))
+        assert pm.shard_of(20) == pm2.shard_of(20)
+
+
+# ----------------------------------------------------------------------
+# supervisor integration: validation, S=1 neutrality, the twin gate
+# ----------------------------------------------------------------------
+
+
+class TestPlacementSupervisor:
+
+    def test_p2c_requires_mesh_churn(self):
+        with pytest.raises(ValueError, match="placement"):
+            SV.run_job(base_job(engine_loop="stream",
+                                placement="p2c"))
+        with pytest.raises(ValueError, match="placement"):
+            SV.run_job(base_job(placement="p2c"))   # mesh, no churn
+
+    def test_static_chaos_rejection_still_loud(self):
+        """The PR-15 rejection pin, now scoped to static routing:
+        churn + fault_plan without a placement map stays a loud
+        ValueError (a registration routed to a DOWN shard would have
+        no re-route path)."""
+        spec = churn_mod.make_spec("flash_crowd", total_ids=32)
+        with pytest.raises(ValueError, match="p2c"):
+            SV.run_job(base_job(
+                n_shards=4, churn=spec,
+                fault_plan={"seed": 11, "p_dropout": 0.3}))
+
+    def test_s1_p2c_is_loop_neutral(self):
+        """p2c over ONE shard can only ever pick shard 0, and
+        order = cid equals the take_order sequence at S=1 -- so the
+        digest, metrics, and lifecycle snapshot are bit-identical to
+        the static path."""
+        spec = churn_mod.make_spec("flash_crowd", total_ids=32)
+        a = SV.run_job(base_job(churn=spec))
+        b = SV.run_job(base_job(churn=spec, placement="p2c"))
+        assert a.digest == b.digest
+        assert a.state_digest == b.state_digest
+        assert np.array_equal(a.metrics, b.metrics)
+        assert a.lifecycle == b.lifecycle
+        assert b.placement == "p2c" and a.placement is None
+
+    def test_migration_fires_and_logs(self):
+        res = migration_ref()
+        assert res.placement == "p2c"
+        assert res.migrations == len(res.migration_log)
+        assert res.placement_counters["migrations"] == res.migrations
+        for bnd, cid, src, dst in res.migration_log:
+            assert src == 0                    # off the hot shard
+            assert dst in (1, 2, 3)
+            assert cid % 4 == 0                # a hot-shard-owned id
+
+    def test_migration_twin_gate(self):
+        """THE tentpole gate: the post-migration run's chain digest
+        equals the run that had the moved clients placed on their
+        destinations from epoch 0 (placement overrides from run A's
+        migration log; migrate rule disabled).  state_digest is NOT
+        compared -- the twins' slot layouts legitimately differ."""
+        a = migration_ref()
+        ov = {str(cid): dst for _b, cid, _s, dst in a.migration_log}
+        off = dict(GATE_CTL)
+        off["migrate_skew_hi"] = 0.0
+        b = SV.run_job(dataclasses.replace(
+            skew_job(), placement={"mode": "p2c", "overrides": ov},
+            controller=off))
+        assert b.migrations == 0
+        assert a.digest == b.digest
+        assert b.placement_counters["overrides"] == len(ov)
+
+    @pytest.mark.parametrize("stage",
+                             ["evicted", "handoff", "registered"])
+    def test_migration_crash_equivalence(self, stage, tmp_path):
+        """SIGKILL at any stage of the two-sided move replays the
+        identical run -- the journaled trigger + checkpointed
+        placement RNG recompute the same move list from the previous
+        checkpoint."""
+        ref = migration_ref()
+        fired = []
+
+        def hook(s):
+            if s == stage and not fired:
+                fired.append(1)
+                raise HF.HostKill(f"mid-migration:{stage}")
+
+        old = placement_mod._migrate_hook
+        placement_mod._migrate_hook = hook
+        try:
+            res = SV.run_supervised(skew_job(), tmp_path,
+                                    HF.zero_host_plan())
+        finally:
+            placement_mod._migrate_hook = old
+        assert fired, f"migrate hook never reached at {stage}"
+        SV.assert_crash_equivalent(res, ref)
+        assert res.restarts == 1
+        assert res.migration_log == ref.migration_log
+
+    def test_p2c_chaos_composes_and_source_down_is_masked(self):
+        """Migration mid-chaos: a fault plan whose hot shard is DOWN
+        at the first migrate-eligible boundary.  The composition must
+        (a) be accepted at all (the scoped rejection), (b) never pick
+        a down shard as migration source or destination, and (c) be
+        deterministic -- two clean runs bit-equal."""
+        from dmclock_tpu.robust import faults as F
+
+        job0 = skew_job(epochs=8)
+        # deterministic seed search: a plan with the hot shard down
+        # at boundary 4 (the first migrate fire of the clean run)
+        fault = None
+        for seed in range(64):
+            spec = {"seed": seed, "p_dropout": 0.5,
+                    "mean_outage_steps": 2.0}
+            plan = F.plan_from_spec(F.parse_fault_spec(spec),
+                                    job0.epochs, job0.n_shards)
+            if not plan.up[4, 0]:
+                fault = spec
+                break
+        assert fault is not None
+        job = dataclasses.replace(job0, fault_plan=fault)
+        a = SV.run_job(job)
+        b = SV.run_job(job)
+        assert a.digest == b.digest
+        assert a.migration_log == b.migration_log
+        plan = F.plan_from_spec(F.parse_fault_spec(fault),
+                                job.epochs, job.n_shards)
+        for bnd, cid, src, dst in a.migration_log:
+            row = plan.up[min(bnd, plan.up.shape[0] - 1)]
+            assert row[src] and row[dst], \
+                "moved through a DOWN shard"
+
+
+# ----------------------------------------------------------------------
+# migrated client x the other planes
+# ----------------------------------------------------------------------
+
+
+class TestMigratedClientWheel:
+
+    def test_migration_reslot_adjust_equals_rebuild(self):
+        """The two wheel halves of a migration at a fixed now: the
+        source wheel adjusted over the departing slot equals the
+        rebuild of the evicted state, and the destination wheel
+        adjusted over the recycled slot -- now carrying the mover's
+        QoS -- equals the rebuild of the registered state."""
+        import jax.numpy as jnp
+
+        from dmclock_tpu.core.timebase import NS_PER_SEC
+        from dmclock_tpu.engine import fastpath as FP
+
+        from test_calendar_bucketed import zipf64_state
+        from test_calendar_wheel import _assert_wheel_equal
+
+        state = zipf64_state(n=10, depth=32)
+        now = jnp.int64(500 * NS_PER_SEC)
+        c = 4
+        onehot = jnp.arange(state.capacity) == c
+        # source half: EVICT drains + deactivates the slot
+        evicted = state._replace(
+            active=state.active.at[c].set(False),
+            depth=state.depth.at[c].set(0))
+        w_src = FP.wheel_build(state, now, False)
+        adj_out = FP.wheel_adjust(w_src, evicted, now, False, onehot)
+        _assert_wheel_equal(adj_out,
+                            FP.wheel_build(evicted, now, False))
+        assert int(adj_out.slot[c]) == 3 * FP._WHEEL_BUCKETS
+        # destination half: the recycled slot re-registers with the
+        # mover's carried weight (a DIFFERENT contract than the slot
+        # held before -- the contract-epoch bump)
+        registered = evicted._replace(
+            active=evicted.active.at[c].set(True),
+            weight_inv=evicted.weight_inv.at[c].set(
+                evicted.weight_inv[c] * 2))
+        adj_in = FP.wheel_adjust(adj_out, registered, now, False,
+                                 onehot)
+        _assert_wheel_equal(adj_in,
+                            FP.wheel_build(registered, now, False))
+
+    def test_calendar_inert_migrate_rule_is_digest_noop(self):
+        """Calendar engines drain ``state.depth`` at every deadline
+        commit, so the backlog-triggered migrate rule is structurally
+        inert there: the same skew job that migrates clients under
+        chain/prefix reports zero controller backlog on the wheel
+        calendar and never fires.  The gate that matters is that an
+        inert rule is a bit-exact no-op -- attaching the migrate
+        controller to a calendar mesh must not perturb the digest
+        relative to the rule disarmed."""
+        job = skew_job(engine="calendar", k=4,
+                       calendar_impl="wheel", ladder_levels=2)
+        a = SV.run_job(job)
+        assert a.migrations == 0
+        assert a.migration_log == []
+        off = dict(GATE_CTL)
+        off["migrate_skew_hi"] = 0.0
+        b = SV.run_job(dataclasses.replace(job, controller=off))
+        assert a.digest == b.digest
+
+
+class TestMigratedClientExplain:
+
+    def _rows(self):
+        """A migrated client's window log: two contract epochs (the
+        destination REGISTER bumps it), limit-capped in both."""
+        rows = []
+        for seq, cep in ((0, 1), (1, 1), (2, 2), (3, 2)):
+            rows.append({"client": 7, "seq": seq,
+                         "contract_epoch": cep, "ops": 40,
+                         "rate": 40.0, "limit": 40.0,
+                         "reservation": 5.0, "share": 0.5,
+                         "entitled_share": 0.5, "share_err": 0.0,
+                         "backlog": 12, "resv_ops": 4,
+                         "tardy_ops": 0, "resv_deficit": 0.0,
+                         "resv_miss": False})
+        rows.append({"client": 9, "seq": 0, "contract_epoch": 1,
+                     "ops": 0, "rate": 0.0, "backlog": 0})
+        return rows
+
+    def test_attribution_survives_contract_epoch_bump(self):
+        import importlib.util
+        from pathlib import Path
+
+        repo = Path(__file__).resolve().parent.parent
+        spec = importlib.util.spec_from_file_location(
+            "explain", repo / "scripts" / "explain.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        wins = mod.client_windows(self._rows(), 7)
+        # BOTH contract epochs' windows attribute as one client: the
+        # migration handoff carries identity, not a fresh client
+        assert len(wins) == 4
+        assert {w["contract_epoch"] for w in wins} == {1, 2}
+        att = mod.attribute(wins)
+        assert att["cause"] == "limit_capped"
+        assert att["scores"]["no_demand"] == 0.0
+        # pre- and post-migration epochs attribute identically when
+        # the windows are identical (epoch is identity metadata, not
+        # an attribution input)
+        pre = mod.attribute([w for w in wins
+                             if w["contract_epoch"] == 1])
+        post = mod.attribute([w for w in wins
+                              if w["contract_epoch"] == 2])
+        assert pre["scores"] == post["scores"]
+
+    def test_exit_2_when_client_absent(self, tmp_path):
+        import json
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        repo = Path(__file__).resolve().parent.parent
+        log = tmp_path / "slo.jsonl"
+        log.write_text("\n".join(json.dumps(r)
+                                 for r in self._rows()) + "\n")
+        proc = subprocess.run(
+            [sys.executable, str(repo / "scripts" / "explain.py"),
+             "--slo", str(log), "--client", "12345"],
+            capture_output=True, text=True)
+        assert proc.returncode == 2
+        proc = subprocess.run(
+            [sys.executable, str(repo / "scripts" / "explain.py"),
+             "--slo", str(log), "--client", "7"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0
+        assert "limit_capped" in proc.stdout
