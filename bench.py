@@ -1942,6 +1942,96 @@ def bench_controller(scenarios=("shard_skew", "limit_thrash",
     return out
 
 
+def bench_rpc(*, workers: int = 4, requests: int = 64, n: int = 32,
+              epochs: int = 16, ckpt_every: int = 2, m: int = 2,
+              k: int = 32, ring: int = 16, waves: int = 6,
+              seed: int = 17, engine: str = "prefix",
+              fault_spec=None, tracer=None) -> dict:
+    """The RPC ingest front-end leg (docs/RPC.md): a real loopback
+    :class:`net.server.IngestServer`, ``workers`` concurrent
+    loadgen clients driving seeded deterministic schedules over real
+    sockets, the serving loop admitting the coalesced superwaves
+    through the existing device clamp -- then the acceptance gate
+    in-process: a self-generated replay fed the journaled
+    admitted-counts trace must land on the IDENTICAL chain digest
+    (``digest_match``).  ``fault_spec`` runs the leg as seeded
+    network chaos with exact drop/dup/reorder accounting against
+    the host oracle (``chaos_exact``).  This is a serving-plane
+    demo row, not a throughput record: wall time includes socket
+    round-trips and the journal's fsyncs (that cost is the point)."""
+    import dataclasses
+    import tempfile
+    import threading
+
+    from dmclock_tpu.net import faults as net_faults
+    from dmclock_tpu.net.journal import ArrivalJournal
+    from dmclock_tpu.net.serve import (RpcServeConfig, make_server,
+                                       run_serve, trace_sha)
+    from scripts.loadgen import full_schedule, run_worker
+
+    scheds = full_schedule(seed, workers=workers, requests=requests,
+                           n_clients=n, max_nops=3)
+    spec = net_faults.parse_net_fault_spec(fault_spec)
+    oracle = net_faults.plan_schedule_events(
+        spec, [[(c, s) for c, s, _ in sc] for sc in scheds])
+    with tempfile.TemporaryDirectory() as d:
+        cfg = RpcServeConfig(
+            engine=engine, n=n, epochs=epochs, ckpt_every=ckpt_every,
+            m=m, k=k, ring=ring, waves=waves, seed=seed, workdir=d,
+            fault_spec=fault_spec, high_watermark=10 ** 6,
+            wait_ops=1, wait_timeout_s=60)
+        server = make_server(cfg).start()
+        threads = [threading.Thread(
+            target=run_worker,
+            args=("127.0.0.1", server.port, scheds[w]),
+            kwargs=dict(timeout_s=0.5, max_attempts=10))
+            for w in range(workers)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        out = run_serve(cfg, server=server)
+        wall = time.perf_counter() - t0
+        server.stop()
+        trace = ArrivalJournal(d).counts_trace()
+        replay = run_serve(dataclasses.replace(cfg, workdir=None,
+                                               wait_ops=0),
+                           trace=trace)
+    ev = out["events"]
+    chaos_exact = (ev.get("drops_injected", 0) == oracle["drops"]
+                   and ev.get("dup_frames", 0) == oracle["dups"]
+                   and ev.get("reordered", 0) == oracle["reorders"])
+    return {"rpc": {
+        "workload": "rpc",
+        "scenario": net_faults.describe(spec),
+        "workers": int(workers),
+        "requests_per_worker": int(requests),
+        "engine": engine, "epochs": epochs,
+        "dps": out["decisions"] / max(wall, 1e-9),
+        "decisions": out["decisions"],
+        "wall_s": wall,
+        "admitted_ops": out["admitted_ops_traced"],
+        "carry_ops": out["carry_ops"],
+        "ingest_drops": out["ingest_drops"],
+        "digest": out["digest"],
+        "digest_match": bool(replay["digest"] == out["digest"]
+                             and replay["trace_sha"]
+                             == out["trace_sha"]),
+        "chaos_exact": bool(chaos_exact),
+        "oracle_drops": oracle["drops"],
+        "oracle_dups": oracle["dups"],
+        "oracle_reorders": oracle["reorders"],
+        "chaos_drops": int(ev.get("drops_injected", 0)),
+        "chaos_dups": int(ev.get("dup_frames", 0)),
+        "chaos_reorders": int(ev.get("reordered", 0)),
+        "busy": int(ev.get("busy", 0)),
+        "deduped": int(ev.get("deduped", 0)),
+        "lat_p50_ms": out["latency"]["p50_ms"],
+        "lat_p99_ms": out["latency"]["p99_ms"],
+    }}
+
+
 def bench_mesh_rebalance(*, n_shards: int = 4, total_ids: int = 64,
                          epochs: int = 24, ckpt_every: int = 4,
                          engine: str = "prefix", m: int = 2,
@@ -2166,7 +2256,7 @@ def main() -> None:
     ap.add_argument("--mode",
                     choices=["all", "serve", "cfg3", "cfg4",
                              "frontier", "churn", "mesh",
-                             "controller"],
+                             "controller", "rpc"],
                     default="all")
     ap.add_argument("--clients", type=int, default=100_000,
                     metavar="N",
@@ -2362,6 +2452,19 @@ def main() -> None:
                     "history record tags controller-actuated "
                     "sessions so bench_guard keeps them out of the "
                     "clean-run medians")
+    ap.add_argument("--rpc-workers", type=int, default=4,
+                    metavar="W",
+                    help="--mode rpc: concurrent loadgen workers "
+                    "driving the loopback ingest server (each owns a "
+                    "disjoint client-id partition with a seeded, "
+                    "byte-identical request schedule)")
+    ap.add_argument("--rpc-fault-spec", default=None, metavar="SPEC",
+                    help="--mode rpc: seeded network chaos spec "
+                    "(net.faults grammar, e.g. 'seed=7,p_drop=0.1,"
+                    "p_dup=0.05,p_reorder=0.05'); the row then gates "
+                    "exact drop/dup/reorder accounting against the "
+                    "host oracle (chaos_exact) and the session is "
+                    "kept out of bench_guard's clean medians")
     ap.add_argument("--supervised", action="store_true",
                     default=os.environ.get("DMCLOCK_SUPERVISED")
                     == "1",
@@ -2639,6 +2742,25 @@ def main() -> None:
                 else dict(total_ids=192, epochs=48)
             results.update(bench_controller(
                 sides=args.controller, tracer=tracer, **ctl_shape))
+        if args.mode == "rpc":
+            # the RPC ingest front-end leg (docs/RPC.md): real
+            # loopback sockets + N concurrent loadgen workers, then
+            # the digest gate vs a self-generated replay of the
+            # journaled admitted-counts trace.  cpu boxes run a
+            # scaled shape (the controller-mode convention): the
+            # serving plane's correctness story needs no accelerator
+            rpc_shape = dict(n=16, epochs=8, requests=32) \
+                if backend == "cpu" \
+                else dict(n=32, epochs=16, requests=64)
+            results.update(bench_rpc(
+                workers=args.rpc_workers,
+                fault_spec=args.rpc_fault_spec, tracer=tracer,
+                **rpc_shape))
+            if args.rpc_fault_spec:
+                # chaos sessions self-identify in the history record
+                # (bench_guard keeps them out of clean medians)
+                args.fault_plan = "rpc:" \
+                    + results["rpc"]["scenario"]
         if args.mode in ("all", "cfg4") and backend != "cpu":
             # 100k clients, Zipfian weights, reservation-constrained
             # (constraint share auto-calibrated to 0.50 -- a faster
@@ -2839,6 +2961,17 @@ def main() -> None:
                 f"{r.get('burn_epochs_' + side, 0)} epochs"
                 + (f"; {r.get('controller_decisions', 0)} "
                    f"actuations)" if side == "on" else ")"))
+    if "rpc" in results:
+        r = results["rpc"]
+        parts.append(
+            f"rpc[{r['scenario']}] {r['workers']} workers over real "
+            f"loopback sockets ({r['admitted_ops']} ops admitted, "
+            f"digest {'MATCH' if r['digest_match'] else 'MISMATCH'} "
+            f"vs journaled-trace replay"
+            + (", chaos accounting "
+               + ("EXACT" if r["chaos_exact"] else "INEXACT")
+               if r["scenario"] != "none" else "")
+            + f"; admit->commit p99 {r['lat_p99_ms']:.0f}ms)")
 
     # device histogram blocks feed the live scrape registry per
     # workload (proper Prometheus _bucket/_sum/_count families), then

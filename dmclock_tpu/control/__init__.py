@@ -171,22 +171,34 @@ class Controller:
 
     def collect(self, epoch: int, *, state=None, met=None,
                 slo_eval=None, prov=None, planes=None,
-                advisory=None) -> ControlSignals:
+                press=None, advisory=None) -> ControlSignals:
         """Assemble one boundary's snapshot and advance the delta
         baseline.  ``planes`` is a list of LifecyclePlane (or None
-        entries); ``advisory`` a dict of best-effort extras."""
+        entries); ``press`` the chunk's per-shard mid-epoch pressure
+        peaks (``int64[S, PRESS_FIELDS]``, ``MeshGuarded.press``) --
+        replay-deterministic, so the peak fields stay in the
+        deterministic tier; ``advisory`` a dict of best-effort
+        extras."""
         import jax
         cur = self._snap(met=met, slo_eval=slo_eval)
         dmet = cur["met"] - self._prev["met"]
         dslo = cur["slo"] - self._prev["slo"]
         self._prev = cur
-        backlog = press = 0
+        backlog = press_bk = 0
         if state is not None:
             depth = np.asarray(jax.device_get(state.depth),
                                dtype=np.int64)
             backlog = int(depth.sum())
-            press = int(depth.sum(axis=-1).max()) if depth.ndim > 1 \
-                else backlog
+            press_bk = int(depth.sum(axis=-1).max()) \
+                if depth.ndim > 1 else backlog
+        press_peak = backlog_peak = 0
+        if press is not None:
+            from ..obs import provenance as obs_prov
+            peaks = np.asarray(press, dtype=np.int64) \
+                .reshape(-1, obs_prov.PRESS_FIELDS)[
+                    :, obs_prov.PRESS_BACKLOG]
+            press_peak = int(peaks.max())
+            backlog_peak = int(peaks.sum())
         live = cap = 0
         for p in (planes or []):
             if p is not None:
@@ -205,7 +217,8 @@ class Controller:
             share_skew_d=int(dslo[3]), violations_d=int(dslo[0]),
             guard_trips_d=int(dmet[0]), ingest_drops_d=int(dmet[1]),
             ladder_steps_d=int(dmet[2]), starvation_ns=starve,
-            press_backlog=press,
+            press_backlog=press_bk,
+            press_peak=press_peak, backlog_peak=backlog_peak,
             retraces=int(adv.get("retraces", 0)),
             compile_ms=float(adv.get("compile_ms", 0.0)),
             projected_hbm=int(adv.get("projected_hbm", 0)),

@@ -125,16 +125,26 @@ def _propose(rule: str, knobs: List[int], sig,
     elif rule == "migrate":
         # per-shard pressure skew: the hottest shard's backlog exceeds
         # migrate_skew_hi times the all-shard mean (press_backlog * S
-        # > hi * backlog avoids the division).  Hysteresis applies
-        # (migrate is NOT in _IMMEDIATE): moving clients is never an
-        # emergency action, and cooldown spaces the handoffs out so a
-        # move's effect lands before the next decision.
+        # > hi * backlog avoids the division).  Two interchangeable
+        # reads of the same ratio: the boundary-time depth read
+        # (press_backlog / backlog) and the mid-epoch pressure-peak
+        # read (press_peak / backlog_peak) -- the peaks are what arms
+        # the rule on calendar engines, whose deadline commits drain
+        # state.depth within the epoch so the boundary read is
+        # structurally zero there.  Hysteresis applies (migrate is NOT
+        # in _IMMEDIATE): moving clients is never an emergency action,
+        # and cooldown spaces the handoffs out so a move's effect
+        # lands before the next decision.
         hi = float(spec.get("migrate_skew_hi", 0.0))
         shards = int(spec.get("migrate_shards", 1))
-        if hi > 0 and shards > 1 and sig.backlog > 0 and \
-                sig.press_backlog * shards > hi * sig.backlog:
-            return [sync, level, clamp, compact,
-                    migr + int(spec.get("migrate_max", 4))]
+        if hi > 0 and shards > 1:
+            depth_skew = sig.backlog > 0 and \
+                sig.press_backlog * shards > hi * sig.backlog
+            peak_skew = sig.backlog_peak > 0 and \
+                sig.press_peak * shards > hi * sig.backlog_peak
+            if depth_skew or peak_skew:
+                return [sync, level, clamp, compact,
+                        migr + int(spec.get("migrate_max", 4))]
     else:
         raise ValueError(f"unknown controller rule {rule!r}")
     return None

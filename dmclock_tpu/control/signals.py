@@ -50,6 +50,17 @@ class ControlSignals(NamedTuple):
     ladder_steps_d: int
     starvation_ns: int        # provenance PS_STARVE_MAX watermark
     press_backlog: int        # hottest shard's backlog (== backlog, S=1)
+    # mid-epoch pressure PEAKS (deterministic: the chunk's per-shard
+    # post-ingest pre-serve probe maxima, replay-exact from the
+    # checkpointed RNG + state -- obs.provenance.pressure_vec through
+    # engine.stream.make_epoch_step).  The boundary-time depth reads
+    # above are structurally zero on calendar engines (deadline
+    # commits drain depth within the epoch); these peaks are the
+    # migrate rule's calendar-capable twin.  Default 0 = no probe
+    # (round/stream loops, controller off), which keeps the peak
+    # branch of the migrate rule inert there.
+    press_peak: int = 0       # hottest shard's mid-epoch backlog peak
+    backlog_peak: int = 0     # sum of per-shard mid-epoch peaks
     # -- advisory tier (observability only; NOT rules, NOT digest) -----
     retraces: int = 0         # capacity plane, this process only
     compile_ms: float = 0.0
@@ -63,7 +74,7 @@ DETERMINISTIC_FIELDS = (
     "epoch", "backlog", "live", "capacity",
     "resv_miss_d", "limit_break_d", "share_skew_d", "violations_d",
     "guard_trips_d", "ingest_drops_d", "ladder_steps_d",
-    "starvation_ns", "press_backlog",
+    "starvation_ns", "press_backlog", "press_peak", "backlog_peak",
 )
 
 

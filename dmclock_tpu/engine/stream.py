@@ -111,7 +111,8 @@ def clamped_ingest(state: EngineState, counts, t_base, *, waves: int,
 
 
 def make_epoch_step(*, engine: str, m: int, kw: dict, dt_epoch_ns: int,
-                    waves: int, ingest: bool):
+                    waves: int, ingest: bool,
+                    with_pressure: bool = False):
     """The ONE fused per-epoch step shared by the stream chunk body
     and the mesh serving plane's per-shard chunk
     (``parallel.mesh.build_mesh_chunk``): clamped superwave ingest at
@@ -121,6 +122,17 @@ def make_epoch_step(*, engine: str, m: int, kw: dict, dt_epoch_ns: int,
     construction, not a test-only coincidence -- the two loops cannot
     drift because they trace the same step.
 
+    ``with_pressure`` adds a MID-EPOCH pressure probe
+    (``obs.provenance.pressure_vec`` on the post-ingest pre-serve
+    state, at the epoch's serve time): the one instant where arrivals
+    are queued but not yet drained, which is what makes the probe a
+    real backlog signal on the calendar engines too -- their deadline
+    commits drain ``state.depth`` within the epoch, so any
+    boundary-time depth read is structurally zero there.  The probe is
+    a pure integer read (no state change, no collective); it rides
+    ``outs["pressure"]`` (``int64[PRESS_FIELDS]``) and is ignored by
+    the digest's epoch views.
+
     Returns ``step(state, t_base, counts_e, hists, ledger, flight,
     slo, prov) -> ((state', hists', ledger', flight', slo', prov'),
     outs)`` with ``outs`` the engine's :data:`STREAM_OUT_FIELDS` plus
@@ -129,15 +141,21 @@ def make_epoch_step(*, engine: str, m: int, kw: dict, dt_epoch_ns: int,
     fields = STREAM_OUT_FIELDS[engine]
     dt = int(dt_epoch_ns)
     dt_wave = dt // int(waves)
+    if with_pressure:
+        from ..obs import provenance as _prov
 
     def step(st, t_base, counts_e, h, l, f, s, p):
         if ingest:
             st = clamped_ingest(st, counts_e, t_base,
                                 waves=waves, dt_wave=dt_wave)
+        if with_pressure:
+            press = _prov.pressure_vec(st, t_base + dt)
         ep = fn(st, t_base + dt, m=m, **kw,
                 hists=h, ledger=l, flight=f, slo=s, prov=p)
         outs = {name: getattr(ep, name) for name in fields}
         outs["metrics"] = ep.metrics
+        if with_pressure:
+            outs["pressure"] = press
         return (ep.state, ep.hists, ep.ledger, ep.flight,
                 ep.slo, ep.prov), outs
 

@@ -1,0 +1,31 @@
+"""The RPC ingest front-end (docs/RPC.md).
+
+A fault-tolerant network serving plane in front of the PR-8 fused
+stream chunks: many concurrent client processes push tagged requests
+over real sockets, the host coalesces them into the superwave count
+matrix, and the existing device-side admission clamp prices them --
+the network plane adds EXACTLY ZERO new device math, which is what
+keeps ``--mode rpc`` digest-comparable to a self-generated run fed
+the same admitted-counts trace.
+
+Layering (each module stands alone and is unit-tested alone):
+
+- :mod:`.framing`  -- wire format: length-prefixed stream frames and
+  single-datagram frames sharing one payload encoding.
+- :mod:`.faults`   -- the deterministic network fault plane (seeded
+  drops / duplicates / reorders), PR-3 spec-grammar style, with an
+  exact host oracle for the chaos gates.
+- :mod:`.journal`  -- the fsync'd arrival journal on the checkpoint-
+  boundary grid; the crash-equivalence contract's durable half.
+- :mod:`.server`   -- the selectors event loop: backpressure,
+  dedup watermarks, per-shard routing accounting, completion
+  notifications, counters.
+- :mod:`.client`   -- the blocking client with bounded exponential
+  backoff (what scripts/loadgen.py workers drive).
+- :mod:`.serve`    -- the serving loop: journal -> fused chunk ->
+  checkpoint, double-buffered, SIGKILL-resumable, replayable.
+"""
+
+from . import framing, faults, journal  # noqa: F401
+
+__all__ = ["framing", "faults", "journal"]

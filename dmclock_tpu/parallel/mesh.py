@@ -162,7 +162,8 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
                      collective_skipping: Optional[bool] = None,
                      ingest: bool = True,
                      with_faults: bool = False,
-                     with_flight: bool = False):
+                     with_flight: bool = False,
+                     with_pressure: bool = False):
     """Build the pure mesh chunk program ``(state, cd, cr, view_d,
     view_r, epoch0, counts, hists, ledger, slo, prov, flight, faults)
     -> MeshChunk`` for one static configuration.
@@ -221,7 +222,14 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
     ``None`` auto-enables for fault-free chunks with ``epochs``
     divisible by K > 1; faulty chunks always run flat -- a mid-group
     restart must re-sync from a FRESH psum, which is exactly the
-    collective the skipping removes."""
+    collective the skipping removes.
+
+    ``with_pressure`` threads the mid-epoch pressure probe
+    (``engine.stream.make_epoch_step``) through the chunk:
+    ``outs["pressure"]`` stacks to ``int64[S, E, PRESS_FIELDS]``, a
+    down epoch's row masks to zeros (a nonneg no-op under the peak
+    max), and the probe is shard-local -- no collective, so the
+    collective-skipping cost gates are unaffected."""
     from ..obs import device as obsdev
 
     assert engine in fastpath.EPOCH_ENGINES, engine
@@ -249,7 +257,7 @@ def build_mesh_chunk(mesh: Mesh, *, engine: str, epochs: int, m: int,
             f"by counter_sync_every ({every})"
     epoch_step = stream_mod.make_epoch_step(
         engine=engine, m=m, kw=kw, dt_epoch_ns=dt, waves=waves,
-        ingest=ingest)
+        ingest=ingest, with_pressure=with_pressure)
 
     def per_server(st, cd, cr, vd, vr, epoch0, counts_s, h, l, s, p,
                    f, flt):

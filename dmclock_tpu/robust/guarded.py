@@ -573,12 +573,34 @@ class MeshGuarded(NamedTuple):
     prov: object = None
     slo_merged: object = None  # int64[N, W_FIELDS] cluster-wide block
     flight: object = None    # stacked per-shard flight rings
+    press: object = None     # int64[S, PRESS_FIELDS] per-shard
+    #                          mid-epoch pressure PEAKS over the chunk
+    #                          (with_pressure chunks; max over epochs
+    #                          of the post-ingest pre-serve probe --
+    #                          the controller's migrate signal, exact
+    #                          across both legs because down epochs
+    #                          contribute zeros in each)
 
 
 # eval_shape'd neutral epoch results for the host chaos replay's DOWN
 # epochs, keyed by the static epoch configuration + state shape (the
 # module-jit-cache convention; eval_shape traces, so it is not free)
 _NEUTRAL_EPOCH_CACHE: dict = {}
+
+# one jitted mid-epoch pressure probe for the host replay leg --
+# integer-only reads, so the standalone launch is bit-identical to the
+# fused chunk's in-scan probe
+_PRESSURE_PROBE_JIT: list = []
+
+
+def _pressure_probe():
+    if not _PRESSURE_PROBE_JIT:
+        import jax
+
+        from ..obs import provenance as obsprov
+
+        _PRESSURE_PROBE_JIT.append(jax.jit(obsprov.pressure_vec))
+    return _PRESSURE_PROBE_JIT[0]
 
 
 def neutral_epoch_view(engine: str, state_slice, m: int, kw: dict,
@@ -658,6 +680,7 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
                            wheel_kernel: str = "xla",
                            counter_sync_every: int = 1,
                            collective_skipping: Optional[bool] = None,
+                           with_pressure: bool = False,
                            hists=None, ledger=None, slo=None,
                            prov=None, flight=None, faults=None,
                            retries: int = 3, base_s: float = 0.05,
@@ -753,7 +776,8 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
         counter_sync_every=counter_sync_every,
         collective_skipping=collective_skipping, ingest=do_ingest,
         with_faults=faults is not None,
-        with_flight=flight is not None)
+        with_flight=flight is not None,
+        with_pressure=with_pressure)
     retry_count = [0]
 
     def count_retry(attempt, exc):
@@ -785,6 +809,13 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
     guards = np.asarray(jax.device_get(out.outs[guard_field]))
     if bool(guards.all()):
         fetched = jax.device_get(out.outs)
+        press = None
+        if with_pressure:
+            # per-shard chunk PEAKS: max over the epoch axis of the
+            # mid-epoch probe rows (down epochs read zeros -- a no-op
+            # under max on the nonneg fields)
+            press = np.asarray(fetched["pressure"],
+                               dtype=np.int64).max(axis=1)
         return MeshGuarded(
             state=out.state, cd=out.cd, cr=out.cr,
             view_d=out.view_d, view_r=out.view_r,
@@ -797,7 +828,8 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
             guard_trips=(0,) * epochs, mesh_fallback=0,
             retries=retry_count[0], hists=out.hists,
             ledger=out.ledger, slo=out.slo, prov=out.prov,
-            slo_merged=out.slo_merged, flight=out.flight)
+            slo_merged=out.slo_merged, flight=out.flight,
+            press=press)
 
     # a guard tripped somewhere in the mesh chunk: discard it (the
     # entry state/counters are never donated) and replay epoch-major
@@ -819,6 +851,7 @@ def run_mesh_chunk_guarded(state, cd, cr, view_d, view_r,
         calendar_impl=calendar_impl, ladder_levels=ladder_levels,
         wheel_kernel=wheel_kernel,
         counter_sync_every=counter_sync_every,
+        with_pressure=with_pressure,
         hists=hists, ledger=ledger, slo=slo, prov=prov,
         flight=flight, faults=faults, retries=retries,
         base_s=base_s, sleep=sleep, on_retry=on_retry,
@@ -840,6 +873,7 @@ def mesh_chunk_host_replay(state, cd, cr, view_d, view_r,
                            ladder_levels: int = 8,
                            wheel_kernel: str = "xla",
                            counter_sync_every: int = 1,
+                           with_pressure: bool = False,
                            hists=None, ledger=None, slo=None,
                            prov=None, flight=None, faults=None,
                            retries: int = 3, base_s: float = 0.05,
@@ -923,6 +957,11 @@ def mesh_chunk_host_replay(state, cd, cr, view_d, view_r,
         anticipation_ns=anticipation_ns,
         allow_limit_break=allow_limit_break,
         with_metrics=with_metrics)
+    press_np = None
+    if with_pressure:
+        from ..obs import provenance as obsprov
+        press_np = np.zeros((n_shards, obsprov.PRESS_FIELDS),
+                            dtype=np.int64)
     ep_rows, count_rows, trip_rows = [], [], []
     for i in range(epochs):
         t_base = (int(epoch0) + i) * int(dt_epoch_ns)
@@ -974,6 +1013,14 @@ def mesh_chunk_host_replay(state, cd, cr, view_d, view_r,
                     sts[s],
                     jax.device_put(counts[s, i], dev0),
                     jnp.int64(t_base + skew))
+            if press_np is not None:
+                # the fused chunk's mid-epoch probe: post-ingest,
+                # pre-serve, at the shard's (skew-lensed) serve time
+                press_np[s] = np.maximum(press_np[s], np.asarray(
+                    jax.device_get(_pressure_probe()(
+                        sts[s],
+                        jnp.int64(t_base + skew + int(dt_epoch_ns)))),
+                    dtype=np.int64))
             w_prev = np.asarray(jax.device_get(cur["slo"][s]),
                                 dtype=np.int64)
             ep = run_epoch_guarded(
@@ -1039,7 +1086,8 @@ def mesh_chunk_host_replay(state, cd, cr, view_d, view_r,
         flight=restack(cur["flight"]),
         slo_merged=jnp.asarray(obsslo.window_combine_np(
             np.zeros_like(np.asarray(slo_stacked[0])),
-            *np.asarray(jax.device_get(slo_stacked)))))
+            *np.asarray(jax.device_get(slo_stacked)))),
+        press=press_np)
 
 
 # ----------------------------------------------------------------------

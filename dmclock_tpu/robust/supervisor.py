@@ -2014,7 +2014,8 @@ def _mesh_boundary(job: EpochJob, planes, state, ledger,
 
 
 def _mesh_migrate(job: EpochJob, pm, ctl, planes, state, ledger,
-                  cd, cr, vd, vr, b: int, prov=None, up=None):
+                  cd, cr, vd, vr, b: int, prov=None, up=None,
+                  press=None):
     """The controller's ``migrate`` actuation (docs/LIFECYCLE.md
     "Placement and migration"): move up to ``migrate_max`` drained
     clients off the hottest live shard as the EXISTING digest-neutral
@@ -2051,7 +2052,21 @@ def _mesh_migrate(job: EpochJob, pm, ctl, planes, state, ledger,
          for s in range(S)], dtype=np.int64)
     src = int(np.argmax(eligible))
     if eligible[src] <= 0:
-        return state, ledger, cd, cr, vd, vr, prov
+        # boundary-time depth is structurally zero on calendar
+        # engines (deadline commits drain within the epoch): fall
+        # back to the chunk's mid-epoch pressure peaks -- the same
+        # replay-deterministic signal that armed the rule
+        if press is None:
+            return state, ledger, cd, cr, vd, vr, prov
+        from ..obs import provenance as obsprov
+        peaks = np.asarray(press, dtype=np.int64)[
+            :, obsprov.PRESS_BACKLOG]
+        eligible = np.asarray(
+            [int(peaks[s]) if (up is None or bool(up[s])) else -1
+             for s in range(S)], dtype=np.int64)
+        src = int(np.argmax(eligible))
+        if eligible[src] <= 0:
+            return state, ledger, cd, cr, vd, vr, prov
     plane_src = planes[src]
     cd_src = np.asarray(jax.device_get(cd[src]), dtype=np.int64)
     pick = ctl.migrate_pick()
@@ -2298,6 +2313,7 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                         counter_sync_every=ctl.knob_sync()
                         if ctl is not None
                         else job.counter_sync_every,
+                        with_pressure=ctl is not None,
                         hists=hists, ledger=ledger, slo=wblock,
                         prov=prov, flight=flight, faults=faults,
                         tracer=tracer)
@@ -2385,9 +2401,19 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                 # a replayed trigger re-moves the replayed state
                 # deterministically (staleness / ladder / clamp knobs
                 # actuate exactly as on the other loops).
+                if g.press is not None and scr.scrape is not None:
+                    # live placement signal: the chunk's per-shard
+                    # mid-epoch peaks on the dmclock_shard_pressure_*
+                    # gauges (best-effort host telemetry)
+                    try:
+                        from ..obs import provenance as obsprov
+                        obsprov.publish_shard_pressure(
+                            scr.scrape.registry, g.press)
+                    except Exception:
+                        pass
                 sig = ctl.collect(b, state=state, met=met,
                                   slo_eval=slo_eval, prov=prov,
-                                  planes=planes)
+                                  planes=planes, press=g.press)
                 fired = ctl.step(b, sig,
                                  fault=None if injector is None
                                  else injector.controller_point)
@@ -2400,7 +2426,7 @@ def _mesh_epochs(job: EpochJob, injector, ckpt_dir,
                             _mesh_migrate(job, pm, ctl, planes,
                                           state, ledger, cd, cr,
                                           vd, vr, b, prov=prov,
-                                          up=up_b)
+                                          up=up_b, press=g.press)
             if ckpt_dir is not None:
                 with _spans.span(tracer, "supervisor.checkpoint_save",
                                  "checkpoint", epoch=b):

@@ -251,7 +251,8 @@ def main() -> int:
              and not is_degraded(r)]
     def series(wl, key, impl, cal, loop, scen=None, pop=None,
                provon=True, shards=None, sync=None, wk="xla",
-               ctl="off", rebal="off", placement="static"):
+               ctl="off", rebal="off", placement="static",
+               rpcw=None):
         """Prior values of one per-workload scalar column, filtered to
         the same fast-path identity (select_impl + calendar_impl +
         engine_loop + provenance_on) the throughput series uses.
@@ -265,7 +266,11 @@ def main() -> int:
         may enter the other's medians in either direction.
         Controller rows (bench.py --mode controller) add the
         ``controller`` tag the same way: a closed-loop A/B row never
-        median-compares against a bare row.  Rows
+        median-compares against a bare row.  RPC rows (bench.py
+        --mode rpc) add scenario + worker count: a 2-worker loopback
+        session and an 8-worker one drive different arrival
+        concurrency, and a chaos scenario's rates reflect injected
+        faults -- neither may enter the other's medians.  Rows
         predating the provenance knob count as provenance-on (the
         default)."""
         return [r["workloads"][wl][key] for _, r in prior
@@ -299,6 +304,9 @@ def main() -> int:
                                            "off") == rebal
                 and r["workloads"][wl].get("placement",
                                            "static") == placement
+                # rpc rows carry their loadgen worker count; only
+                # they have the key, so non-rpc rows pass with None
+                and r["workloads"][wl].get("workers") == rpcw
                 and bool(r["workloads"][wl].get("provenance_on",
                                                 True)) == provon]
 
@@ -370,6 +378,11 @@ def main() -> int:
         # against a static mesh session
         rebal = row.get("rebalance", "off")
         placement = row.get("placement", "static")
+        # rpc rows (bench.py --mode rpc) carry the loadgen worker
+        # count and a chaos-scenario tag; both join the series
+        # identity -- only rpc rows have the key, so everything else
+        # filters on None
+        rpcw = row.get("workers")
         tag = f"{wl}[{impl}]" if impl != "sort" else wl
         if cal != "minstop":
             tag += f"[{cal}]"
@@ -377,8 +390,10 @@ def main() -> int:
             tag += f"[{wk}]"
         if loop != "round" and loop not in wl:
             tag += f"[{loop}]"
-        if scen is not None:
+        if scen is not None and rpcw is None:
             tag += f"[N={pop}]"
+        if rpcw is not None:
+            tag += f"[{scen},W={rpcw}]"
         if shards is not None:
             tag += f"[S={shards},K={sync},N={pop},P={placement}]"
         if rebal != "off":
@@ -401,7 +416,7 @@ def main() -> int:
                   "against clean-run medians")
             continue
         hist = series(wl, "dps", impl, cal, loop, scen, pop, provon,
-                      shards, sync, wk, ctl, rebal, placement)
+                      shards, sync, wk, ctl, rebal, placement, rpcw)
         if len(hist) < args.min_records:
             print(f"bench_guard: {tag}: {dps/1e6:.1f}M "
                   f"({len(hist)} prior record(s) -- not judged)")
@@ -735,6 +750,79 @@ def main() -> int:
                     print(f"bench_guard: {tag}: starvation max "
                           f"{sv/1e6:.0f}ms vs median "
                           f"{s_med/1e6:.0f}ms -- OK")
+        # rpc rows (bench.py --mode rpc; docs/RPC.md): the digest
+        # gate already ran inside the bench (live vs journaled-trace
+        # replay) -- surface a MISMATCH loudly even though the rate
+        # held, since a serving plane that admits differently than
+        # its journal replays is broken regardless of throughput
+        if row.get("digest_match") is False:
+            print(f"bench_guard: {tag}: WARNING rpc digest MISMATCH "
+                  "-- the live serve and its journaled-trace replay "
+                  "disagreed; the admission plane is not "
+                  "crash-equivalent; investigate before trusting "
+                  "this session", file=sys.stderr)
+        # ingest drops (device-side clamp discards) as a warn-only
+        # series in the GROWTH direction, median floored at 1: a
+        # clean history must not flap on one stray clamp, but drops
+        # past tolerance x the median mean the coalesce window is
+        # overrunning wave capacity -- admitted work silently
+        # discarded on device.  Warn-only: drops depend on arrival
+        # timing over real sockets, which drifts with box load.
+        idrops = row.get("ingest_drops")
+        if idrops is not None and rpcw is not None:
+            i_hist = series(wl, "ingest_drops", impl, cal, loop,
+                            scen, pop, provon, shards, sync, wk,
+                            ctl, rebal, placement, rpcw)
+            if len(i_hist) < args.min_records:
+                print(f"bench_guard: {tag}: ingest drops {idrops} "
+                      f"({len(i_hist)} prior record(s) -- not "
+                      "judged)")
+            else:
+                i_med = median(i_hist)
+                ceil = max(i_med, 1.0) * args.tolerance
+                if idrops > ceil:
+                    print(f"bench_guard: {tag}: WARNING ingest "
+                          f"drops {idrops} vs median {i_med:g} over "
+                          f"{len(i_hist)} sessions "
+                          f"(> {args.tolerance:g}x) -- the device "
+                          "admission clamp is discarding more "
+                          "coalesced ops; the ingest window is "
+                          "overrunning wave capacity; investigate",
+                          file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: ingest drops "
+                          f"{idrops} vs median {i_med:g} -- OK")
+        # p99 admission-to-commit latency as a warn-only series in
+        # the GROWTH direction, median floored at 50ms: the serving
+        # plane's end-to-end tail (socket arrival -> device commit)
+        # can regress while dec/s holds (e.g. a longer coalesce
+        # stall or a slower journal fsync path sits outside the
+        # timed chunk).  Warn-only: wall-clock tails on a shared box
+        # drift with load, and a hard gate would flap.
+        lat99 = row.get("lat_p99_ms")
+        if lat99 is not None and rpcw is not None:
+            l_hist = series(wl, "lat_p99_ms", impl, cal, loop, scen,
+                            pop, provon, shards, sync, wk, ctl,
+                            rebal, placement, rpcw)
+            if len(l_hist) < args.min_records:
+                print(f"bench_guard: {tag}: admit->commit p99 "
+                      f"{lat99:.0f}ms ({len(l_hist)} prior "
+                      "record(s) -- not judged)")
+            else:
+                l_med = median(l_hist)
+                ceil = max(l_med, 50.0) * args.tolerance
+                if lat99 > ceil:
+                    print(f"bench_guard: {tag}: WARNING "
+                          f"admit->commit p99 {lat99:.0f}ms vs "
+                          f"median {l_med:.0f}ms over {len(l_hist)} "
+                          f"sessions (> {args.tolerance:g}x) -- the "
+                          "serving plane's end-to-end tail "
+                          "regressed even though throughput held; "
+                          "investigate", file=sys.stderr)
+                else:
+                    print(f"bench_guard: {tag}: admit->commit p99 "
+                          f"{lat99:.0f}ms vs median {l_med:.0f}ms "
+                          "-- OK")
     if status:
         print(f"bench_guard: FAILED on {newest_name} -- a >"
               f"{args.tolerance:g}x drop survived the drift margin; "

@@ -1329,15 +1329,16 @@ echo "== migration smoke (p2c neutrality + twin digest gate) =="
 # bit-identical to the static path (digest + state digest + metrics);
 # combined with the earlier mesh (S=1 == stream) and streaming
 # (stream == round) gates this carries the placed-there digest across
-# round/stream/mesh; (2) the S=4 TWIN GATE on prefix and chain: after
-# the controller's migrate rule moves quiet-since-start clients off
-# the hot shard, the canonical digest equals the run that had them
-# placed on the destination from epoch 0 (overrides from run A's
-# migration log, migrate rule disarmed) -- migration is
-# placement-equivalent, not just plausible; (3) calendar engines
-# drain state.depth at every deadline commit, so the backlog-
-# triggered migrate rule is structurally inert there -- gate that the
-# inert rule is a bit-exact no-op.
+# round/stream/mesh; (2) the S=4 TWIN GATE on prefix, chain AND the
+# wheel calendar: after the controller's migrate rule moves
+# quiet-since-start clients off the hot shard, the canonical digest
+# equals the run that had them placed on the destination from epoch 0
+# (overrides from run A's migration log, migrate rule disarmed) --
+# migration is placement-equivalent, not just plausible.  Calendar
+# engines drain state.depth at every deadline commit, so the
+# boundary-time depth read is structurally zero there; the mid-epoch
+# pressure peaks (MeshGuarded.press -> ControlSignals.press_peak) are
+# what arm the rule on calendar meshes.
 timeout -k 30 1200 python - <<'EOF'
 import jax, os
 jax.config.update("jax_platforms", "cpu")
@@ -1389,12 +1390,15 @@ for kw in ENGINES:
     print(f"migration smoke: S=1 p2c == static on {name} "
           f"(digest {a.digest[:16]})")
 
-# (2) the S=4 twin gate where the backlog trigger fires
-for kw in (dict(engine="prefix"), dict(engine="chain")):
+# (2) the S=4 twin gate: the depth trigger fires on prefix/chain,
+# the mid-epoch pressure-peak trigger fires on the wheel calendar
+# (boundary-time depth is structurally zero there)
+for kw in ENGINES:
     a = SV.run_job(skew_job(**kw))
-    assert a.migrations > 0, f"{kw['engine']}: migrate never fired"
+    name = kw.get("calendar_impl", kw["engine"])
+    assert a.migrations > 0, f"{name}: migrate never fired"
     assert all(src == 0 for _b, _c, src, _d in a.migration_log), \
-        f"{kw['engine']}: a move left a non-hot shard"
+        f"{name}: a move left a non-hot shard"
     ov = {str(cid): dst for _b, cid, _s, dst in a.migration_log}
     off = dict(GATE_CTL, migrate_skew_hi=0.0)
     b = SV.run_job(dataclasses.replace(
@@ -1402,22 +1406,137 @@ for kw in (dict(engine="prefix"), dict(engine="chain")):
         controller=off))
     assert b.migrations == 0
     assert a.digest == b.digest, \
-        f"{kw['engine']}: post-migration digest != placed-there-" \
-        f"from-start"
-    print(f"migration smoke: S=4 twin gate on {kw['engine']} "
+        f"{name}: post-migration digest != placed-there-from-start"
+    print(f"migration smoke: S=4 twin gate on {name} "
           f"({a.migrations} move(s), digest {a.digest[:16]})")
+print("migration smoke ok (twin gates green on prefix+chain+wheel; "
+      "calendar armed by mid-epoch pressure peaks)")
+EOF
 
-# (3) calendar: the inert migrate rule is a bit-exact no-op
-cal = dict(engine="calendar", k=4, calendar_impl="wheel",
-           ladder_levels=2)
-a = SV.run_job(skew_job(**cal))
-assert a.migrations == 0, \
-    "calendar reported backlog -- inert-rule premise broke"
-b = SV.run_job(dataclasses.replace(
-    skew_job(**cal), controller=dict(GATE_CTL, migrate_skew_hi=0.0)))
-assert a.digest == b.digest, "calendar: armed rule perturbed digest"
-print("migration smoke ok (inert calendar rule is a no-op; twin "
-      "gates green on prefix+chain)")
+echo "== rpc smoke (loopback serve + loadgen processes; digest + chaos gates) =="
+# the serving-plane spine (docs/RPC.md): (1) a REAL loopback serve --
+# `python -m dmclock_tpu.net.serve` as a subprocess, driven by 4
+# loadgen worker PROCESSES racing over real sockets -- journals its
+# admitted-counts trace, and a socketless replay of that trace
+# through the same loop must land on the IDENTICAL chain digest;
+# (2) the seeded chaos leg (drops + dups) must report fault counters
+# EXACTLY equal to the host oracle's plan over the loadgen schedules
+# -- equality, not "roughly behaved".
+timeout -k 30 900 python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import dataclasses, importlib.util, json, os, pathlib, subprocess
+import sys, tempfile, time
+os.environ["JAX_PLATFORMS"] = "cpu"   # inherited by the subprocesses
+from dmclock_tpu.net import faults
+from dmclock_tpu.net.journal import ArrivalJournal
+from dmclock_tpu.net.serve import RpcServeConfig, run_serve
+
+spec_l = importlib.util.spec_from_file_location(
+    "loadgen", pathlib.Path("scripts/loadgen.py").resolve())
+loadgen = importlib.util.module_from_spec(spec_l)
+spec_l.loader.exec_module(loadgen)
+
+WORKERS, REQUESTS, NCLIENTS, SEED, ATTEMPTS = 4, 16, 16, 7, 8
+scheds = loadgen.full_schedule(SEED, workers=WORKERS,
+                               requests=REQUESTS,
+                               n_clients=NCLIENTS, max_nops=3)
+
+def admitted_ops(fault_spec):
+    """Ops the server will admit under this spec -- what wait_ops
+    must hold the first boundary take for (the oracle walks fates
+    per request; ops weight each admitted request by its nops)."""
+    spec = faults.parse_net_fault_spec(fault_spec)
+    tot = 0
+    for sched in scheds:
+        for cid, seq, nops in sched:
+            for a in range(ATTEMPTS):
+                drop, _, _ = faults.decide(spec, cid, seq, a)
+                if not drop:
+                    tot += nops
+                    break
+    return tot
+
+def serve_leg(wd, fault_spec, timeout_s):
+    cfg = RpcServeConfig(
+        engine="prefix", n=NCLIENTS, depth=2, ring=8, epochs=4,
+        m=2, k=8, chain_depth=2, waves=2, ckpt_every=2, seed=11,
+        wait_ops=admitted_ops(fault_spec), wait_timeout_s=240.0,
+        high_watermark=4096, fault_spec=fault_spec, workdir=wd)
+    cfgp, outp, portp = (os.path.join(wd, f)
+                         for f in ("cfg.json", "out.json", "port"))
+    with open(cfgp, "w") as f:
+        json.dump(dataclasses.asdict(cfg), f)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dmclock_tpu.net.serve",
+         "--config", cfgp, "--out", outp, "--port-file", portp],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(portp):
+            assert proc.poll() is None, "serve subprocess died early"
+            assert time.monotonic() < deadline, "port file never came"
+            time.sleep(0.05)
+        port = int(open(portp).read())
+        lg = subprocess.run(
+            [sys.executable, "scripts/loadgen.py", "--port",
+             str(port), "--workers", str(WORKERS), "--requests",
+             str(REQUESTS), "--n-clients", str(NCLIENTS), "--seed",
+             str(SEED), "--timeout-s", str(timeout_s),
+             "--max-attempts", str(ATTEMPTS)],
+            capture_output=True, text=True, timeout=600)
+        assert lg.returncode == 0, f"loadgen failed: {lg.stderr}"
+        merged = json.loads(lg.stdout)
+        assert proc.wait(timeout=600) == 0, "serve subprocess rc != 0"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    with open(outp) as f:
+        return cfg, merged, json.load(f)
+
+# (1) clean leg + the digest gate vs the journaled-trace replay
+with tempfile.TemporaryDirectory() as wd:
+    cfg, merged, out = serve_leg(wd, None, 0.5)
+    total = sum(n for s in scheds for _, _, n in s)
+    assert out["admitted_ops_traced"] + out["carry_ops"] == total, \
+        (out, total)
+    trace = ArrivalJournal(wd).counts_trace()
+replay = run_serve(dataclasses.replace(cfg, workdir=None,
+                                       wait_ops=0), trace=trace)
+assert out["digest"] == replay["digest"], \
+    f"rpc digest gate: live {out['digest'][:16]} != " \
+    f"replay {replay['digest'][:16]}"
+assert out["trace_sha"] == replay["trace_sha"]
+print(f"rpc digest gate ok ({WORKERS} worker processes, "
+      f"{merged['workers'] * merged['requests_per_worker']} requests,"
+      f" {total} ops; live == journaled-trace replay, "
+      f"digest {out['digest'][:16]})")
+
+# (2) seeded chaos leg: drops + dups, EXACT oracle accounting
+CHAOS = "seed=5,p_drop=0.25,p_dup=0.2"
+oracle = faults.plan_schedule_events(
+    faults.parse_net_fault_spec(CHAOS),
+    [[(c, s) for c, s, _ in sc] for sc in scheds],
+    max_attempts=ATTEMPTS)
+assert oracle["lost"] == 0, \
+    "chaos leg wants a seed where every request eventually admits"
+with tempfile.TemporaryDirectory() as wd:
+    _, merged, out = serve_leg(wd, CHAOS, 0.25)
+ev = out["events"]
+for srv_key, orc_key in (("drops_injected", "drops"),
+                         ("dup_frames", "dups"),
+                         ("reordered", "reorders"),
+                         ("admitted_reqs", "admitted")):
+    assert ev[srv_key] == oracle[orc_key], \
+        f"chaos {srv_key}: server {ev[srv_key]} != " \
+        f"oracle {oracle[orc_key]}"
+assert out["admitted_ops_traced"] + out["carry_ops"] \
+    == admitted_ops(CHAOS)
+print(f"rpc chaos gate ok ({CHAOS}: {ev['drops_injected']} drops, "
+      f"{ev['dup_frames']} dups injected across {WORKERS} racing "
+      "processes; server counters == host oracle exactly)")
+print("rpc smoke ok (loopback digest gate + exact chaos accounting)")
 EOF
 
 echo "== bench smoke (one small epoch) =="

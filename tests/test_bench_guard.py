@@ -890,3 +890,131 @@ def test_controller_tag_splits_the_series(monkeypatch, capsys,
     assert rc == 0
     assert "REGRESSION" not in out
     assert "vs median 42.0M over 2 sessions" in out
+
+
+# -- rpc ingest plane sessions (bench.py --mode rpc; docs/RPC.md) -----
+
+def _rpc_row(dps, *, workers=4, scenario="none", drops=0,
+             lat99=20.0, digest_match=True):
+    return {"workload": "rpc", "dps": dps, "scenario": scenario,
+            "workers": workers, "requests_per_worker": 64,
+            "ingest_drops": drops, "lat_p99_ms": lat99,
+            "lat_p50_ms": lat99 / 4, "digest_match": digest_match,
+            "chaos_exact": True}
+
+
+def write_history_rpc(tmp_path, rows):
+    h = tmp_path / "history"
+    h.mkdir()
+    for i, row in enumerate(rows):
+        (h / f"bench_{1000 + i}.json").write_text(json.dumps(
+            {"platform": "tpu", "device": "tpu0",
+             "workloads": {"rpc": row}}))
+    return h
+
+
+def test_rpc_series_judged_with_scenario_worker_tag(monkeypatch,
+                                                    capsys, tmp_path):
+    hist = write_history_rpc(tmp_path, [
+        _rpc_row(4e6), _rpc_row(5e6), _rpc_row(4.5e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "rpc[none,W=4]" in out
+    assert "OK" in out
+
+
+def test_rpc_regression_fails(monkeypatch, capsys, tmp_path):
+    hist = write_history_rpc(tmp_path, [
+        _rpc_row(4e6), _rpc_row(5e6), _rpc_row(1e6)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 1 and "REGRESSION" in out
+
+
+def test_rpc_worker_count_splits_the_series(monkeypatch, capsys,
+                                            tmp_path):
+    # an 8-worker session drives different arrival concurrency than a
+    # 4-worker one -- never median-compared even under the same key
+    hist = write_history_rpc(tmp_path, [
+        _rpc_row(40e6), _rpc_row(45e6), _rpc_row(4e6, workers=8)])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "rpc[none,W=8]" in out and "not judged" in out
+
+
+def test_rpc_rows_never_pollute_non_rpc_medians(monkeypatch, capsys,
+                                                tmp_path):
+    # the workers key joins the series identity from BOTH sides: two
+    # rpc-shaped rows under a colliding workload key must not drag a
+    # bare workload's median
+    hist = write_history_rows(tmp_path, [
+        {"serve": {"dps": 40e6}},
+        {"serve": {"dps": 44e6}},
+        {"serve": _rpc_row(1e6)},
+        {"serve": _rpc_row(1.2e6)},
+        {"serve": {"dps": 38e6}},
+    ])
+    rc, out = run_guard(monkeypatch, capsys, hist)
+    assert rc == 0
+    assert "REGRESSION" not in out
+    assert "vs median 42.0M over 2 sessions" in out
+
+
+def test_rpc_ingest_drop_growth_warns_but_passes(monkeypatch, capsys,
+                                                 tmp_path):
+    # device clamp discards 5x past the floored median while dec/s
+    # held: warn-only -- drop counts ride arrival timing over real
+    # sockets, a hard gate would flap
+    monkeypatch.setattr(bg, "HISTORY", write_history_rpc(
+        tmp_path, [_rpc_row(4e6, drops=0), _rpc_row(4.2e6, drops=0),
+                   _rpc_row(4.1e6, drops=5)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING ingest drops" in cap.err
+    assert "overrunning wave capacity" in cap.err
+
+
+def test_rpc_lat_p99_growth_warns_but_passes(monkeypatch, capsys,
+                                             tmp_path):
+    monkeypatch.setattr(bg, "HISTORY", write_history_rpc(
+        tmp_path, [_rpc_row(4e6, lat99=60.0),
+                   _rpc_row(4.2e6, lat99=70.0),
+                   _rpc_row(4.1e6, lat99=400.0)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING admit->commit p99" in cap.err
+    assert "end-to-end tail regressed" in cap.err
+
+
+def test_rpc_clean_history_floors_never_flap(monkeypatch, capsys,
+                                             tmp_path):
+    # a clean-drop history (median 0, floored at 1) must not warn on
+    # one stray clamp, and sub-50ms p99 medians must not warn on
+    # wall-clock jitter under the 50ms floor
+    monkeypatch.setattr(bg, "HISTORY", write_history_rpc(
+        tmp_path, [_rpc_row(4e6, drops=0, lat99=10.0),
+                   _rpc_row(4.2e6, drops=0, lat99=15.0),
+                   _rpc_row(4.1e6, drops=1, lat99=90.0)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING ingest drops" not in cap.err
+    assert "WARNING admit->commit" not in cap.err
+
+
+def test_rpc_digest_mismatch_warns(monkeypatch, capsys, tmp_path):
+    # the bench's own digest gate (live vs journaled-trace replay)
+    # failed: surfaced loudly on stderr even though throughput held
+    monkeypatch.setattr(bg, "HISTORY", write_history_rpc(
+        tmp_path, [_rpc_row(4e6), _rpc_row(4.2e6),
+                   _rpc_row(4.1e6, digest_match=False)]))
+    monkeypatch.setattr(sys, "argv", ["bench_guard.py"])
+    rc = bg.main()
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "WARNING rpc digest MISMATCH" in cap.err
+    assert "not crash-equivalent" in cap.err

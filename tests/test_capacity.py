@@ -510,6 +510,13 @@ class TestDocDrift:
             .publish(reg)
         PlacementMap(2, 8).publish(reg)
         Controller(as_spec(True), n=4, ring=4, registry=reg)
+        from dmclock_tpu.obs import rpc as obsrpc
+        obsrpc.publish_rpc(reg, {"queue_depth": 0, "connections": 0,
+                                 "device_pressure": False,
+                                 "shard_rx": {"0": 0},
+                                 "counters": {}})
+        obsrpc.publish_rpc_latency(reg,
+                                   obsrpc.latency_summary([10 ** 6]))
         return sorted({m.name for m in reg.metrics()})
 
     @staticmethod

@@ -85,6 +85,58 @@ class TestSignals:
         assert sigs.digest(a) != sigs.digest(a._replace(epoch=3))
 
 
+class TestMigratePeakBranch:
+    """The satellite pressure feed: the migrate rule's two
+    interchangeable skew reads (boundary depth vs mid-epoch peaks)."""
+
+    MSPEC = dict(pol.DEFAULT_SPEC, hysteresis=1,
+                 migrate_skew_hi=1.5, migrate_shards=4)
+
+    def _fire(self, sig):
+        ps = np.zeros(2 * pol.NUM_RULES, dtype=np.int64)
+        _, dec = pol.step(ps, [1, 0, 100, 0, 0], sig, self.MSPEC)
+        return [r for r, _k in dec]
+
+    def test_peaks_arm_with_zero_boundary_depth(self):
+        """The calendar shape: depth fully drained at the boundary
+        (backlog == 0) but the mid-epoch peaks show the skew."""
+        sig = mk_sig(backlog=0, press_peak=12, backlog_peak=12)
+        assert "migrate" in self._fire(sig)
+
+    def test_depth_read_still_arms_without_peaks(self):
+        sig = mk_sig(backlog=8, press_backlog=8)
+        assert "migrate" in self._fire(sig)
+
+    def test_balanced_peaks_stay_quiet(self):
+        # 4 shards x peak 3 each: hottest * S == 12 == backlog_peak,
+        # not > 1.5x -- no skew, no fire
+        sig = mk_sig(backlog=0, press_peak=3, backlog_peak=12)
+        assert "migrate" not in self._fire(sig)
+
+    def test_defaults_keep_peak_branch_inert(self):
+        """Round/stream loops never feed peaks: the defaulted fields
+        leave the rule exactly as before."""
+        sig = mk_sig(backlog=0)
+        assert sig.press_peak == 0 and sig.backlog_peak == 0
+        assert "migrate" not in self._fire(sig)
+
+    def test_peaks_ride_the_deterministic_digest(self):
+        a = mk_sig(press_peak=5, backlog_peak=9)
+        assert sigs.digest(a) != sigs.digest(a._replace(press_peak=6))
+        assert "press_peak" in sigs.DETERMINISTIC_FIELDS
+        assert "backlog_peak" in sigs.DETERMINISTIC_FIELDS
+
+    def test_collect_reduces_per_shard_peaks(self):
+        from dmclock_tpu.obs import provenance as obsprov
+
+        ctl = Controller(dict(self.MSPEC), n=8, ring=4, n_shards=4)
+        press = np.zeros((4, obsprov.PRESS_FIELDS), dtype=np.int64)
+        press[:, obsprov.PRESS_BACKLOG] = (9, 1, 2, 0)
+        sig = ctl.collect(2, press=press)
+        assert sig.press_peak == 9
+        assert sig.backlog_peak == 12
+
+
 class TestPolicy:
     def test_down_rule_fires_first_triggering_boundary(self):
         """Protective moves have hysteresis 1: one resv-miss episode

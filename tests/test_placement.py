@@ -399,23 +399,34 @@ class TestMigratedClientWheel:
         _assert_wheel_equal(adj_in,
                             FP.wheel_build(registered, now, False))
 
-    def test_calendar_inert_migrate_rule_is_digest_noop(self):
+    def test_calendar_pressure_peaks_arm_migrate_rule(self):
         """Calendar engines drain ``state.depth`` at every deadline
-        commit, so the backlog-triggered migrate rule is structurally
-        inert there: the same skew job that migrates clients under
-        chain/prefix reports zero controller backlog on the wheel
-        calendar and never fires.  The gate that matters is that an
-        inert rule is a bit-exact no-op -- attaching the migrate
-        controller to a calendar mesh must not perturb the digest
-        relative to the rule disarmed."""
+        commit, so the BOUNDARY-TIME depth read that arms the migrate
+        rule on prefix/chain is structurally zero there -- the rule
+        used to be inert on calendar meshes.  The mid-epoch pressure
+        peaks (``MeshGuarded.press`` -> ``ControlSignals.press_peak``/
+        ``backlog_peak``) read the one instant where arrivals are
+        queued but not yet drained, so the same skew job now fires on
+        the wheel calendar too: migrations happen, every move leaves
+        the hot shard, and the twin gate holds (cold movers placed on
+        their destinations from epoch 0, rule disarmed, equal
+        digest)."""
         job = skew_job(engine="calendar", k=4,
                        calendar_impl="wheel", ladder_levels=2)
         a = SV.run_job(job)
-        assert a.migrations == 0
-        assert a.migration_log == []
+        assert a.migrations > 0, \
+            "pressure peaks failed to arm the calendar migrate rule"
+        assert a.migrations == len(a.migration_log)
+        for _bnd, _cid, src, dst in a.migration_log:
+            assert src == 0                # off the hot shard
+            assert dst in (1, 2, 3)
+        ov = {str(cid): dst for _b, cid, _s, dst in a.migration_log}
         off = dict(GATE_CTL)
         off["migrate_skew_hi"] = 0.0
-        b = SV.run_job(dataclasses.replace(job, controller=off))
+        b = SV.run_job(dataclasses.replace(
+            job, placement={"mode": "p2c", "overrides": ov},
+            controller=off))
+        assert b.migrations == 0
         assert a.digest == b.digest
 
 
